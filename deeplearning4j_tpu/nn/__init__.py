@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.nn import activations, initializers, losses, updaters  # noqa: F401

@@ -1,0 +1,171 @@
+"""Weight initializer catalog.
+
+Reference analog: ``WeightInit`` enum + ``WeightInitUtil``
+(/root/reference/deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/
+weights/WeightInit.java, WeightInitUtil.java). Each initializer is a function
+``(key, shape, fan_in, fan_out, dtype) -> array``; the reference computes
+fan_in/fan_out per layer family, and so do the layer configs here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.utils.serde import register_config
+
+
+def zero(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+def normal(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    # ND4J NORMAL: N(0, 1/sqrt(fan_in))
+    return jax.random.normal(key, shape, dtype) / jnp.sqrt(jnp.asarray(fan_in, dtype))
+
+
+def uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = (3.0 / fan_in) ** 0.5
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+def xavier(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    std = (2.0 / (fan_in + fan_out)) ** 0.5
+    return std * jax.random.normal(key, shape, dtype)
+
+
+def xavier_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = (6.0 / (fan_in + fan_out)) ** 0.5
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+def xavier_fan_in(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype) / jnp.sqrt(jnp.asarray(fan_in, dtype))
+
+
+def relu_init(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    # He normal: N(0, 2/fan_in)
+    return (2.0 / fan_in) ** 0.5 * jax.random.normal(key, shape, dtype)
+
+
+def relu_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = (6.0 / fan_in) ** 0.5
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+def lecun_normal(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return (1.0 / fan_in) ** 0.5 * jax.random.normal(key, shape, dtype)
+
+
+def lecun_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = (3.0 / fan_in) ** 0.5
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+def sigmoid_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = 4.0 * (6.0 / (fan_in + fan_out)) ** 0.5
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+def identity_init(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    if len(shape) == 2 and shape[0] == shape[1]:
+        return jnp.eye(shape[0], dtype=dtype)
+    raise ValueError(f"IDENTITY init requires a square 2-D shape, got {shape}")
+
+
+def var_scaling_normal_fan_in(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return (1.0 / fan_in) ** 0.5 * jax.random.normal(key, shape, dtype)
+
+
+def var_scaling_normal_fan_out(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return (1.0 / fan_out) ** 0.5 * jax.random.normal(key, shape, dtype)
+
+
+def var_scaling_normal_fan_avg(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return (2.0 / (fan_in + fan_out)) ** 0.5 * jax.random.normal(key, shape, dtype)
+
+
+def var_scaling_uniform_fan_in(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = (3.0 / fan_in) ** 0.5
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+def var_scaling_uniform_fan_out(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = (3.0 / fan_out) ** 0.5
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+def var_scaling_uniform_fan_avg(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    a = (6.0 / (fan_in + fan_out)) ** 0.5
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+_CATALOG = {
+    "zero": zero,
+    "ones": ones,
+    "normal": normal,
+    "uniform": uniform,
+    "xavier": xavier,
+    "xavier_uniform": xavier_uniform,
+    "xavier_fan_in": xavier_fan_in,
+    "relu": relu_init,
+    "relu_uniform": relu_uniform,
+    "lecun_normal": lecun_normal,
+    "lecun_uniform": lecun_uniform,
+    "sigmoid_uniform": sigmoid_uniform,
+    "identity": identity_init,
+    "var_scaling_normal_fan_in": var_scaling_normal_fan_in,
+    "var_scaling_normal_fan_out": var_scaling_normal_fan_out,
+    "var_scaling_normal_fan_avg": var_scaling_normal_fan_avg,
+    "var_scaling_uniform_fan_in": var_scaling_uniform_fan_in,
+    "var_scaling_uniform_fan_out": var_scaling_uniform_fan_out,
+    "var_scaling_uniform_fan_avg": var_scaling_uniform_fan_avg,
+}
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Distribution:
+    """Explicit-distribution init (reference: WeightInit.DISTRIBUTION + dl4j
+    nn/conf/distribution/)."""
+
+    kind: str = "normal"  # normal | uniform | constant | truncated_normal | orthogonal
+    mean: float = 0.0
+    std: float = 1.0
+    lower: float = -1.0
+    upper: float = 1.0
+    value: float = 0.0
+    gain: float = 1.0
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        if self.kind == "normal":
+            return self.mean + self.std * jax.random.normal(key, shape, dtype)
+        if self.kind == "uniform":
+            return jax.random.uniform(key, shape, dtype, self.lower, self.upper)
+        if self.kind == "constant":
+            return jnp.full(shape, self.value, dtype)
+        if self.kind == "truncated_normal":
+            return self.mean + self.std * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+        if self.kind == "orthogonal":
+            return self.gain * jax.nn.initializers.orthogonal()(key, shape, dtype)
+        raise ValueError(f"Unknown distribution kind {self.kind!r}")
+
+
+def init_weight(name_or_dist, key, shape, fan_in, fan_out, dtype=jnp.float32):
+    """Initialize a weight tensor by catalog name or explicit Distribution."""
+    if isinstance(name_or_dist, Distribution):
+        return name_or_dist.sample(key, shape, dtype)
+    fn = _CATALOG.get(str(name_or_dist).lower())
+    if fn is None:
+        raise KeyError(f"Unknown weight init {name_or_dist!r}. Known: {sorted(_CATALOG)}")
+    return fn(key, shape, fan_in, fan_out, dtype)
+
+
+def names():
+    return sorted(_CATALOG)

@@ -1,0 +1,187 @@
+"""Loss-function catalog with per-example masking and label weights.
+
+Reference analog: ND4J ``LossFunctions.LossFunction`` enum + ILossFunction
+implementations consumed by dl4j output layers (/root/reference/
+deeplearning4j-nn/.../nn/conf/layers/OutputLayer.java lossFn field; score
+computed at MultiLayerNetwork.java:2307). All losses here take
+``(predictions, labels, mask)`` where predictions are post-activation network
+outputs, and return the scalar mean-over-examples score the reference reports,
+plus elementwise variants for evaluation plumbing.
+
+Masking follows the reference's time-series convention: mask has shape
+[batch] or [batch, time] and zeroes out padded steps from both score and
+gradient (MaskedReductionUtil in the reference).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-8
+
+
+def _flatten_tail(x):
+    """[B, ..., F] -> [B*, F] collapsing any time dims into batch."""
+    return x.reshape((-1, x.shape[-1]))
+
+
+def _apply_mask_and_mean(per_example, mask):
+    """per_example: [N] loss per (example, step); mask broadcastable to it."""
+    if mask is None:
+        return jnp.mean(per_example)
+    mask = mask.reshape(-1).astype(per_example.dtype)
+    total = jnp.sum(per_example * mask)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / denom
+
+
+def mse(pred, labels, mask=None, weights=None):
+    d = (pred - labels) ** 2
+    if weights is not None:
+        d = d * weights
+    per = jnp.mean(_flatten_tail(d), axis=-1)
+    return _apply_mask_and_mean(per, mask)
+
+
+def mae(pred, labels, mask=None, weights=None):
+    d = jnp.abs(pred - labels)
+    if weights is not None:
+        d = d * weights
+    per = jnp.mean(_flatten_tail(d), axis=-1)
+    return _apply_mask_and_mean(per, mask)
+
+
+l1 = mae
+l2 = mse
+
+
+def xent(pred, labels, mask=None, weights=None):
+    """Binary cross-entropy on sigmoid outputs (reference: LossBinaryXENT)."""
+    p = jnp.clip(pred, _EPS, 1.0 - _EPS)
+    ce = -(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p))
+    if weights is not None:
+        ce = ce * weights
+    per = jnp.sum(_flatten_tail(ce), axis=-1)
+    return _apply_mask_and_mean(per, mask)
+
+
+def mcxent(pred, labels, mask=None, weights=None):
+    """Multi-class cross-entropy on softmax outputs (reference: LossMCXENT).
+
+    ``pred`` is a probability distribution (post-softmax), matching the
+    reference convention where the output layer applies its activation before
+    the loss. Internally uses logs with clipping for stability.
+    """
+    logp = jnp.log(jnp.clip(pred, _EPS, 1.0))
+    ce = -labels * logp
+    if weights is not None:
+        ce = ce * weights
+    per = jnp.sum(_flatten_tail(ce), axis=-1)
+    return _apply_mask_and_mean(per, mask)
+
+
+negativeloglikelihood = mcxent
+
+
+def sparse_mcxent(pred, labels, mask=None, weights=None):
+    """mcxent with integer class labels (TPU-friendly: no one-hot transfer)."""
+    logp = jnp.log(jnp.clip(pred, _EPS, 1.0))
+    flat = _flatten_tail(logp)
+    idx = labels.reshape(-1).astype(jnp.int32)
+    per = -jnp.take_along_axis(flat, idx[:, None], axis=-1)[:, 0]
+    if weights is not None:
+        per = per * weights.reshape(-1)
+    return _apply_mask_and_mean(per, mask)
+
+
+def hinge(pred, labels, mask=None, weights=None):
+    """labels in {-1, +1} (reference: LossHinge)."""
+    h = jnp.maximum(0.0, 1.0 - labels * pred)
+    if weights is not None:
+        h = h * weights
+    per = jnp.sum(_flatten_tail(h), axis=-1)
+    return _apply_mask_and_mean(per, mask)
+
+
+def squared_hinge(pred, labels, mask=None, weights=None):
+    h = jnp.maximum(0.0, 1.0 - labels * pred) ** 2
+    if weights is not None:
+        h = h * weights
+    per = jnp.sum(_flatten_tail(h), axis=-1)
+    return _apply_mask_and_mean(per, mask)
+
+
+def kl_divergence(pred, labels, mask=None, weights=None):
+    p = jnp.clip(pred, _EPS, 1.0)
+    q = jnp.clip(labels, _EPS, 1.0)
+    kl = labels * (jnp.log(q) - jnp.log(p))
+    if weights is not None:
+        kl = kl * weights
+    per = jnp.sum(_flatten_tail(kl), axis=-1)
+    return _apply_mask_and_mean(per, mask)
+
+
+def cosine_proximity(pred, labels, mask=None, weights=None):
+    pf, lf = _flatten_tail(pred), _flatten_tail(labels)
+    pn = pf / (jnp.linalg.norm(pf, axis=-1, keepdims=True) + _EPS)
+    ln = lf / (jnp.linalg.norm(lf, axis=-1, keepdims=True) + _EPS)
+    per = -jnp.sum(pn * ln, axis=-1)
+    return _apply_mask_and_mean(per, mask)
+
+
+def poisson(pred, labels, mask=None, weights=None):
+    p = jnp.clip(pred, _EPS, None)
+    loss = p - labels * jnp.log(p)
+    if weights is not None:
+        loss = loss * weights
+    per = jnp.sum(_flatten_tail(loss), axis=-1)
+    return _apply_mask_and_mean(per, mask)
+
+
+def mean_squared_log_error(pred, labels, mask=None, weights=None):
+    d = (jnp.log1p(jnp.clip(pred, 0, None)) - jnp.log1p(jnp.clip(labels, 0, None))) ** 2
+    if weights is not None:
+        d = d * weights
+    per = jnp.mean(_flatten_tail(d), axis=-1)
+    return _apply_mask_and_mean(per, mask)
+
+
+def mean_absolute_percentage_error(pred, labels, mask=None, weights=None):
+    d = 100.0 * jnp.abs((labels - pred) / jnp.clip(jnp.abs(labels), _EPS, None))
+    if weights is not None:
+        d = d * weights
+    per = jnp.mean(_flatten_tail(d), axis=-1)
+    return _apply_mask_and_mean(per, mask)
+
+
+_CATALOG = {
+    "mse": mse,
+    "mae": mae,
+    "l1": l1,
+    "l2": l2,
+    "xent": xent,
+    "mcxent": mcxent,
+    "sparse_mcxent": sparse_mcxent,
+    "negativeloglikelihood": negativeloglikelihood,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "kl_divergence": kl_divergence,
+    "cosine_proximity": cosine_proximity,
+    "poisson": poisson,
+    "mean_squared_log_error": mean_squared_log_error,
+    "mean_absolute_percentage_error": mean_absolute_percentage_error,
+}
+
+
+def get(name):
+    if callable(name):
+        return name
+    try:
+        return _CATALOG[name.lower()]
+    except KeyError:
+        raise KeyError(f"Unknown loss {name!r}. Known: {sorted(_CATALOG)}") from None
+
+
+def names():
+    return sorted(_CATALOG)

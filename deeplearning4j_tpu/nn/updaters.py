@@ -1,0 +1,334 @@
+"""Updaters (optimizers) + learning-rate schedules.
+
+Reference analog: the ND4J ``GradientUpdater`` implementations dispatched via
+dl4j's Updater enum (/root/reference/deeplearning4j-nn/.../nn/conf/
+Updater.java:12 — SGD, ADAM, ADAMAX, ADADELTA, NESTEROVS, NADAM, ADAGRAD,
+RMSPROP, NONE) and the view-based state management in
+nn/updater/BaseMultiLayerUpdater.java. TPU-native design: optimizer state is a
+pytree mirroring the params pytree; the update is a pure function folded into
+the jitted train step so XLA fuses the elementwise math into one pass over
+HBM. State averaging across replicas (ParallelWrapper.java:338-370) collapses
+to replicated state under per-step psum data-parallelism.
+
+Each updater config is a frozen dataclass with:
+  init(params)  -> opt_state pytree
+  update(grads, opt_state, params, step) -> (updates, new_opt_state)
+where ``updates`` are deltas to ADD to params (sign convention: update already
+includes the negative learning rate, like optax).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.utils.serde import register_config
+
+# --------------------------------------------------------------------------
+# Learning-rate schedules (reference: org.nd4j.linalg.schedule ISchedule —
+# Exponential, Inverse, Poly, Sigmoid, Step, Map; dl4j LearningRatePolicy)
+# --------------------------------------------------------------------------
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class FixedSchedule:
+    value: float = 0.1
+
+    def __call__(self, step):
+        return jnp.asarray(self.value)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class ExponentialSchedule:
+    initial: float = 0.1
+    gamma: float = 0.99
+
+    def __call__(self, step):
+        return self.initial * self.gamma ** jnp.asarray(step, jnp.float32)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class InverseSchedule:
+    initial: float = 0.1
+    gamma: float = 0.99
+    power: float = 1.0
+
+    def __call__(self, step):
+        return self.initial / (1.0 + self.gamma * jnp.asarray(step, jnp.float32)) ** self.power
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class PolySchedule:
+    initial: float = 0.1
+    power: float = 1.0
+    max_iter: int = 10000
+
+    def __call__(self, step):
+        frac = jnp.clip(jnp.asarray(step, jnp.float32) / self.max_iter, 0.0, 1.0)
+        return self.initial * (1.0 - frac) ** self.power
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class SigmoidSchedule:
+    initial: float = 0.1
+    gamma: float = 0.99
+    step_size: int = 100
+
+    def __call__(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        return self.initial / (1.0 + jnp.exp(-self.gamma * (s - self.step_size)))
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class StepSchedule:
+    initial: float = 0.1
+    decay_rate: float = 0.5
+    step_size: int = 1000
+
+    def __call__(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        return self.initial * self.decay_rate ** jnp.floor(s / self.step_size)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class WarmupCosineSchedule:
+    """TPU-era addition: linear warmup + cosine decay (not in reference)."""
+
+    peak: float = 1e-3
+    warmup_steps: int = 100
+    total_steps: int = 10000
+    floor: float = 0.0
+
+    def __call__(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        warm = self.peak * s / jnp.maximum(self.warmup_steps, 1)
+        frac = jnp.clip((s - self.warmup_steps) / jnp.maximum(self.total_steps - self.warmup_steps, 1), 0.0, 1.0)
+        cos = self.floor + 0.5 * (self.peak - self.floor) * (1.0 + jnp.cos(jnp.pi * frac))
+        return jnp.where(s < self.warmup_steps, warm, cos)
+
+
+def resolve_lr(lr, step):
+    if callable(lr):
+        return lr(step)
+    return jnp.asarray(lr)
+
+
+# --------------------------------------------------------------------------
+# Updaters
+# --------------------------------------------------------------------------
+
+Schedule = typing.Union[float, FixedSchedule, ExponentialSchedule, InverseSchedule,
+                        PolySchedule, SigmoidSchedule, StepSchedule, WarmupCosineSchedule]
+
+
+def _zeros_like_tree(params):
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Sgd:
+    learning_rate: Schedule = 0.1
+
+    def init(self, params):
+        return ()
+
+    def update(self, grads, state, params, step):
+        lr = resolve_lr(self.learning_rate, step)
+        return jax.tree_util.tree_map(lambda g: -lr * g, grads), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Nesterovs:
+    learning_rate: Schedule = 0.1
+    momentum: float = 0.9
+
+    def init(self, params):
+        return _zeros_like_tree(params)
+
+    def update(self, grads, state, params, step):
+        lr = resolve_lr(self.learning_rate, step)
+        mu = self.momentum
+        new_v = jax.tree_util.tree_map(lambda v, g: mu * v - lr * g, state, grads)
+        # Nesterov look-ahead: update = mu*v_new - lr*g (ND4J NesterovsUpdater semantics)
+        updates = jax.tree_util.tree_map(lambda v, g: mu * v - lr * g, new_v, grads)
+        return updates, new_v
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Adam:
+    learning_rate: Schedule = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"m": _zeros_like_tree(params), "v": _zeros_like_tree(params)}
+
+    def update(self, grads, state, params, step):
+        lr = resolve_lr(self.learning_rate, step)
+        t = jnp.asarray(step, jnp.float32) + 1.0
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], grads)
+        bc = jnp.sqrt(1 - b2**t) / (1 - b1**t)
+        updates = jax.tree_util.tree_map(lambda m, v: -lr * bc * m / (jnp.sqrt(v) + self.epsilon), m, v)
+        return updates, {"m": m, "v": v}
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class AdaMax:
+    learning_rate: Schedule = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"m": _zeros_like_tree(params), "u": _zeros_like_tree(params)}
+
+    def update(self, grads, state, params, step):
+        lr = resolve_lr(self.learning_rate, step)
+        t = jnp.asarray(step, jnp.float32) + 1.0
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        u = jax.tree_util.tree_map(lambda u, g: jnp.maximum(b2 * u, jnp.abs(g)), state["u"], grads)
+        scale = lr / (1 - b1**t)
+        updates = jax.tree_util.tree_map(lambda m, u: -scale * m / (u + self.epsilon), m, u)
+        return updates, {"m": m, "u": u}
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Nadam:
+    learning_rate: Schedule = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"m": _zeros_like_tree(params), "v": _zeros_like_tree(params)}
+
+    def update(self, grads, state, params, step):
+        lr = resolve_lr(self.learning_rate, step)
+        t = jnp.asarray(step, jnp.float32) + 1.0
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], grads)
+        mhat = jax.tree_util.tree_map(
+            lambda m, g: b1 * m / (1 - b1 ** (t + 1)) + (1 - b1) * g / (1 - b1**t), m, grads)
+        vhat = jax.tree_util.tree_map(lambda v: v / (1 - b2**t), v)
+        updates = jax.tree_util.tree_map(lambda mh, vh: -lr * mh / (jnp.sqrt(vh) + self.epsilon), mhat, vhat)
+        return updates, {"m": m, "v": v}
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class AdaGrad:
+    learning_rate: Schedule = 0.1
+    epsilon: float = 1e-6
+
+    def init(self, params):
+        return _zeros_like_tree(params)
+
+    def update(self, grads, state, params, step):
+        lr = resolve_lr(self.learning_rate, step)
+        h = jax.tree_util.tree_map(lambda h, g: h + g * g, state, grads)
+        updates = jax.tree_util.tree_map(lambda h, g: -lr * g / (jnp.sqrt(h) + self.epsilon), h, grads)
+        return updates, h
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class AdaDelta:
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init(self, params):
+        return {"g2": _zeros_like_tree(params), "dx2": _zeros_like_tree(params)}
+
+    def update(self, grads, state, params, step):
+        rho, eps = self.rho, self.epsilon
+        g2 = jax.tree_util.tree_map(lambda a, g: rho * a + (1 - rho) * g * g, state["g2"], grads)
+        updates = jax.tree_util.tree_map(
+            lambda g, a, d: -g * jnp.sqrt(d + eps) / jnp.sqrt(a + eps), grads, g2, state["dx2"])
+        dx2 = jax.tree_util.tree_map(lambda d, u: rho * d + (1 - rho) * u * u, state["dx2"], updates)
+        return updates, {"g2": g2, "dx2": dx2}
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class RmsProp:
+    learning_rate: Schedule = 1e-3
+    decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return _zeros_like_tree(params)
+
+    def update(self, grads, state, params, step):
+        lr = resolve_lr(self.learning_rate, step)
+        d = self.decay
+        avg = jax.tree_util.tree_map(lambda a, g: d * a + (1 - d) * g * g, state, grads)
+        updates = jax.tree_util.tree_map(lambda a, g: -lr * g / (jnp.sqrt(a) + self.epsilon), avg, grads)
+        return updates, avg
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class AmsGrad:
+    learning_rate: Schedule = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        z = _zeros_like_tree(params)
+        return {"m": z, "v": _zeros_like_tree(params), "vhat": _zeros_like_tree(params)}
+
+    def update(self, grads, state, params, step):
+        lr = resolve_lr(self.learning_rate, step)
+        b1, b2 = self.beta1, self.beta2
+        m = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state["m"], grads)
+        v = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * g * g, state["v"], grads)
+        vhat = jax.tree_util.tree_map(jnp.maximum, state["vhat"], v)
+        updates = jax.tree_util.tree_map(lambda m, vh: -lr * m / (jnp.sqrt(vh) + self.epsilon), m, vhat)
+        return updates, {"m": m, "v": v, "vhat": vhat}
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class NoOp:
+    def init(self, params):
+        return ()
+
+    def update(self, grads, state, params, step):
+        return jax.tree_util.tree_map(jnp.zeros_like, grads), state
+
+
+UPDATERS = {
+    "sgd": Sgd, "adam": Adam, "adamax": AdaMax, "adadelta": AdaDelta,
+    "nesterovs": Nesterovs, "nadam": Nadam, "adagrad": AdaGrad,
+    "rmsprop": RmsProp, "amsgrad": AmsGrad, "none": NoOp,
+}
+
+
+def get(name, **kwargs):
+    if not isinstance(name, str):
+        return name
+    cls = UPDATERS.get(name.lower())
+    if cls is None:
+        raise KeyError(f"Unknown updater {name!r}. Known: {sorted(UPDATERS)}")
+    return cls(**kwargs)

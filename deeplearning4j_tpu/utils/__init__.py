@@ -1,0 +1,2 @@
+from deeplearning4j_tpu.utils import dtypes  # noqa: F401
+from deeplearning4j_tpu.utils.serde import register_config, config_to_dict, config_from_dict  # noqa: F401

@@ -1,0 +1,49 @@
+"""Dtype policy for the framework.
+
+TPU-first: parameters and optimizer state live in float32; matmul/conv inputs
+are computed in bfloat16 on TPU by default (MXU-native), with float32
+accumulation via ``preferred_element_type``. Tests (CPU) run everything in
+float32/float64 for gradient checking.
+
+Reference analog: nd4j's global dtype (Nd4j.setDataType) — but here the policy
+is a pair (param_dtype, compute_dtype) as is idiomatic for mixed-precision jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class DtypePolicy:
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    accum_dtype: jnp.dtype = jnp.float32
+
+
+_POLICY = DtypePolicy()
+
+
+def get_policy() -> DtypePolicy:
+    return _POLICY
+
+
+def set_policy(param_dtype=None, compute_dtype=None, accum_dtype=None) -> DtypePolicy:
+    global _POLICY
+    _POLICY = DtypePolicy(
+        param_dtype=jnp.dtype(param_dtype) if param_dtype is not None else _POLICY.param_dtype,
+        compute_dtype=jnp.dtype(compute_dtype) if compute_dtype is not None else _POLICY.compute_dtype,
+        accum_dtype=jnp.dtype(accum_dtype) if accum_dtype is not None else _POLICY.accum_dtype,
+    )
+    return _POLICY
+
+
+def bf16_policy() -> DtypePolicy:
+    """The TPU training policy: f32 params, bf16 compute, f32 accumulation."""
+    return set_policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16, accum_dtype=jnp.float32)
+
+
+def f32_policy() -> DtypePolicy:
+    return set_policy(param_dtype=jnp.float32, compute_dtype=jnp.float32, accum_dtype=jnp.float32)

@@ -1,0 +1,69 @@
+"""Finite-difference gradient checker.
+
+Reference analog: ``GradientCheckUtil``
+(/root/reference/deeplearning4j-nn/src/main/java/org/deeplearning4j/
+gradientcheck/GradientCheckUtil.java:109) — the correctness backbone of the
+reference's entire test suite (14 gradcheck test files, SURVEY.md §4.2).
+
+Central differences per parameter, double precision, relative error
+  relError = |analytic - numeric| / max(|analytic|, |numeric|)
+with an absolute-error floor below which parameters pass regardless (same
+semantics as the reference's minAbsoluteError).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_gradients(loss_fn, params, *, epsilon=1e-6, max_rel_error=1e-5,
+                    min_abs_error=1e-8, max_params_per_leaf=None, verbose=False):
+    """Compare analytic grads of ``loss_fn(params) -> scalar`` to central differences.
+
+    Returns (ok, failures) where failures is a list of dicts. Runs in float64;
+    callers must pass float64 params (tests enable jax_enable_x64).
+    """
+    params = jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float64), params)
+    analytic = jax.grad(loss_fn)(params)
+    loss_jit = jax.jit(loss_fn)
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    a_leaves = jax.tree_util.tree_flatten(analytic)[0]
+    paths = [jax.tree_util.keystr(p) for p, _ in jax.tree_util.tree_flatten_with_path(params)[0]]
+
+    failures = []
+    total_checked = 0
+    for li, (leaf, a_leaf, path) in enumerate(zip(leaves, a_leaves, paths)):
+        flat = np.asarray(leaf, np.float64).ravel()
+        a_flat = np.asarray(a_leaf, np.float64).ravel()
+        n = flat.size
+        idxs = range(n)
+        if max_params_per_leaf is not None and n > max_params_per_leaf:
+            rng = np.random.RandomState(12345 + li)
+            idxs = rng.choice(n, size=max_params_per_leaf, replace=False)
+        for i in idxs:
+            orig = flat[i]
+            flat[i] = orig + epsilon
+            leaves_p = list(leaves)
+            leaves_p[li] = jnp.asarray(flat.reshape(leaf.shape))
+            score_plus = float(loss_jit(jax.tree_util.tree_unflatten(treedef, leaves_p)))
+            flat[i] = orig - epsilon
+            leaves_p[li] = jnp.asarray(flat.reshape(leaf.shape))
+            score_minus = float(loss_jit(jax.tree_util.tree_unflatten(treedef, leaves_p)))
+            flat[i] = orig
+            numeric = (score_plus - score_minus) / (2.0 * epsilon)
+            analytic_i = a_flat[i]
+            abs_err = abs(analytic_i - numeric)
+            denom = max(abs(analytic_i), abs(numeric))
+            rel_err = abs_err / denom if denom > 0 else 0.0
+            total_checked += 1
+            if rel_err > max_rel_error and abs_err > min_abs_error:
+                failures.append({"param": path, "index": int(i), "analytic": float(analytic_i),
+                                 "numeric": float(numeric), "rel_error": float(rel_err)})
+                if verbose:
+                    print(f"FAIL {path}[{i}]: analytic={analytic_i:.3e} numeric={numeric:.3e} rel={rel_err:.3e}")
+    if verbose:
+        print(f"gradcheck: {total_checked} params checked, {len(failures)} failures")
+    return len(failures) == 0, failures

@@ -1,0 +1,89 @@
+"""Polymorphic JSON serde for config dataclasses.
+
+Reference analog: Jackson-based serde of the config DSL
+(nn/conf/serde/, MultiLayerConfiguration.toJson:120 / fromJson:138 in
+/root/reference/deeplearning4j-nn). Every config dataclass registers itself
+under its class name; dicts carry a ``"@type"`` discriminator so arbitrary
+config trees (layers, updaters, schedules, distributions, graph vertices)
+round-trip through JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import typing
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register_config(cls):
+    """Class decorator: make a dataclass JSON round-trippable by name."""
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def lookup(name: str) -> type:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"Unknown config type {name!r}. Registered: {sorted(_REGISTRY)}") from None
+
+
+def config_to_dict(obj):
+    """Recursively convert a registered dataclass tree to plain JSON types."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, enum.Enum):
+        return {"@enum": type(obj).__name__, "value": obj.name}
+    if isinstance(obj, (list, tuple)):
+        return [config_to_dict(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: config_to_dict(v) for k, v in obj.items()}
+    if dataclasses.is_dataclass(obj):
+        d = {"@type": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            d[f.name] = config_to_dict(getattr(obj, f.name))
+        return d
+    # numpy / jax scalars
+    if hasattr(obj, "item") and getattr(obj, "ndim", None) == 0:
+        return obj.item()
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    raise TypeError(f"Cannot serialize {type(obj)}: {obj!r}")
+
+
+def config_from_dict(d):
+    if isinstance(d, list):
+        return [config_from_dict(v) for v in d]
+    if isinstance(d, dict):
+        if "@enum" in d:
+            return lookup(d["@enum"])[d["value"]]
+        if "@type" in d:
+            cls = lookup(d["@type"])
+            fields = {f.name for f in dataclasses.fields(cls)}
+            kwargs = {k: config_from_dict(v) for k, v in d.items() if k in fields}
+            # tuple-typed fields arrive as lists from JSON
+            hints = typing.get_type_hints(cls)
+            for f in dataclasses.fields(cls):
+                origin = typing.get_origin(hints.get(f.name))
+                if origin is tuple and isinstance(kwargs.get(f.name), list):
+                    kwargs[f.name] = tuple(kwargs[f.name])
+            return cls(**kwargs)
+        return {k: config_from_dict(v) for k, v in d.items()}
+    return d
+
+
+def register_enum(cls):
+    """Enum decorator: register for serde."""
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def to_json(obj, **kwargs) -> str:
+    return json.dumps(config_to_dict(obj), **kwargs)
+
+
+def from_json(s: str):
+    return config_from_dict(json.loads(s))

@@ -1,0 +1,38 @@
+"""Test fixtures: CPU-only jax with a virtual 8-device mesh + float64 enabled.
+
+Mirrors the reference's backend-parametrized test strategy (SURVEY.md §4.1 /
+§4.5): tests are device-agnostic and run on CPU with
+xla_force_host_platform_device_count=8 so every parallelism test exercises a
+real (virtual) mesh, the same suite running unchanged on real TPU.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(12345)
+
+
+@pytest.fixture
+def np_rng():
+    return np.random.RandomState(12345)
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs[:8]

@@ -1,0 +1,159 @@
+"""Tests for activations, initializers, losses, updaters, serde."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import activations, initializers, losses, updaters
+from deeplearning4j_tpu.utils import serde
+
+
+class TestActivations:
+    @pytest.mark.parametrize("name", activations.names())
+    def test_finite_and_shape(self, name, rng):
+        x = jax.random.normal(rng, (4, 7))
+        y = activations.get(name)(x)
+        assert y.shape == x.shape
+        assert bool(jnp.all(jnp.isfinite(y)))
+
+    def test_softmax_normalizes(self, rng):
+        x = jax.random.normal(rng, (3, 10))
+        s = activations.get("softmax")(x)
+        np.testing.assert_allclose(np.sum(np.asarray(s), axis=-1), 1.0, rtol=1e-6)
+
+    def test_relu(self):
+        x = jnp.array([-1.0, 0.0, 2.0])
+        np.testing.assert_array_equal(np.asarray(activations.relu(x)), [0.0, 0.0, 2.0])
+
+
+class TestInitializers:
+    @pytest.mark.parametrize("name", initializers.names())
+    def test_shapes(self, name, rng):
+        shape = (64, 64) if name == "identity" else (64, 32)
+        w = initializers.init_weight(name, rng, shape, fan_in=64, fan_out=32)
+        assert w.shape == shape
+        assert bool(jnp.all(jnp.isfinite(w)))
+
+    def test_xavier_variance(self, rng):
+        fan_in, fan_out = 400, 300
+        w = initializers.init_weight("xavier", rng, (fan_in, fan_out), fan_in, fan_out)
+        expect = 2.0 / (fan_in + fan_out)
+        assert abs(float(jnp.var(w)) - expect) < 0.2 * expect
+
+    def test_distribution_serde(self, rng):
+        d = initializers.Distribution(kind="uniform", lower=-0.5, upper=0.5)
+        d2 = serde.from_json(serde.to_json(d))
+        assert d == d2
+        w = d2.sample(rng, (100,))
+        assert float(jnp.min(w)) >= -0.5 and float(jnp.max(w)) <= 0.5
+
+
+class TestLosses:
+    @pytest.mark.parametrize("name", losses.names())
+    def test_scalar_and_nonnegative_at_match(self, name, rng):
+        k1, k2 = jax.random.split(rng)
+        if name in ("hinge", "squared_hinge"):
+            labels = jnp.sign(jax.random.normal(k1, (4, 5)))
+            pred = jax.random.normal(k2, (4, 5))
+        elif name == "sparse_mcxent":
+            labels = jax.random.randint(k1, (4,), 0, 5)
+            pred = jax.nn.softmax(jax.random.normal(k2, (4, 5)))
+        elif name in ("mcxent", "negativeloglikelihood", "kl_divergence"):
+            labels = jax.nn.softmax(jax.random.normal(k1, (4, 5)))
+            pred = jax.nn.softmax(jax.random.normal(k2, (4, 5)))
+        elif name == "xent":
+            labels = (jax.random.uniform(k1, (4, 5)) > 0.5).astype(jnp.float32)
+            pred = jax.nn.sigmoid(jax.random.normal(k2, (4, 5)))
+        elif name == "poisson":
+            labels = jax.random.uniform(k1, (4, 5), minval=0, maxval=3)
+            pred = jax.random.uniform(k2, (4, 5), minval=0.1, maxval=3)
+        else:
+            labels = jax.random.normal(k1, (4, 5))
+            pred = jax.random.normal(k2, (4, 5))
+        val = losses.get(name)(pred, labels)
+        assert val.shape == ()
+        assert bool(jnp.isfinite(val))
+
+    def test_mse_known_value(self):
+        pred = jnp.array([[1.0, 2.0]])
+        lab = jnp.array([[0.0, 0.0]])
+        assert float(losses.mse(pred, lab)) == pytest.approx(2.5)
+
+    def test_mask_zeroes_out_examples(self):
+        pred = jnp.array([[1.0], [100.0]])
+        lab = jnp.zeros((2, 1))
+        mask = jnp.array([1.0, 0.0])
+        assert float(losses.mse(pred, lab, mask)) == pytest.approx(1.0)
+
+    def test_mcxent_matches_nll(self, rng):
+        k1, k2 = jax.random.split(rng)
+        pred = jax.nn.softmax(jax.random.normal(k1, (6, 4)))
+        idx = jax.random.randint(k2, (6,), 0, 4)
+        onehot = jax.nn.one_hot(idx, 4)
+        assert float(losses.mcxent(pred, onehot)) == pytest.approx(
+            float(losses.sparse_mcxent(pred, idx)), rel=1e-6)
+
+
+class TestUpdaters:
+    @pytest.mark.parametrize("name", sorted(updaters.UPDATERS))
+    def test_descends_quadratic(self, name):
+        """Every updater must reduce f(x) = ||x||^2 over 50 steps."""
+        upd = updaters.get(name)
+        params = {"w": jnp.array([3.0, -2.0]), "b": jnp.array([1.5])}
+        state = upd.init(params)
+
+        def loss(p):
+            return jnp.sum(p["w"] ** 2) + jnp.sum(p["b"] ** 2)
+
+        l0 = float(loss(params))
+        for step in range(50):
+            grads = jax.grad(loss)(params)
+            upds, state = upd.update(grads, state, params, step)
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params, upds)
+        l1 = float(loss(params))
+        if name == "none":
+            assert l1 == pytest.approx(l0)
+        elif name == "adadelta":  # self-scaling: slow from cold start, by design
+            assert l1 < l0 * 0.9, f"{name}: {l0} -> {l1}"
+        else:
+            assert l1 < l0 * 0.5, f"{name}: {l0} -> {l1}"
+
+    def test_sgd_exact(self):
+        upd = updaters.Sgd(learning_rate=0.5)
+        params = {"w": jnp.array([2.0])}
+        upds, _ = upd.update({"w": jnp.array([1.0])}, upd.init(params), params, 0)
+        assert float(upds["w"][0]) == pytest.approx(-0.5)
+
+    def test_schedule_serde_roundtrip(self):
+        for sched in [updaters.ExponentialSchedule(0.1, 0.9),
+                      updaters.StepSchedule(0.1, 0.5, 100),
+                      updaters.WarmupCosineSchedule(1e-3, 10, 100)]:
+            s2 = serde.from_json(serde.to_json(sched))
+            assert s2 == sched
+            assert float(s2(7)) == pytest.approx(float(sched(7)))
+
+    def test_updater_serde_roundtrip(self):
+        upd = updaters.Adam(learning_rate=updaters.StepSchedule(0.01, 0.1, 10), beta1=0.8)
+        u2 = serde.from_json(serde.to_json(upd))
+        assert u2 == upd
+
+
+class TestSerde:
+    def test_nested_roundtrip(self):
+        @serde.register_config
+        @dataclasses.dataclass(frozen=True)
+        class Inner:
+            x: int = 1
+
+        @serde.register_config
+        @dataclasses.dataclass(frozen=True)
+        class Outer:
+            items: tuple = ()
+            inner: object = None
+
+        o = Outer(items=(1, 2, 3), inner=Inner(x=7))
+        o2 = serde.from_json(serde.to_json(o))
+        assert o2.inner.x == 7 and o2.items == (1, 2, 3)
