@@ -101,7 +101,8 @@ class TestUpdaters:
     @pytest.mark.parametrize("name", sorted(updaters.UPDATERS))
     def test_descends_quadratic(self, name):
         """Every updater must reduce f(x) = ||x||^2 over 50 steps."""
-        upd = updaters.get(name)
+        kwargs = {} if name in ("none", "adadelta") else {"learning_rate": 0.1}
+        upd = updaters.get(name, **kwargs)
         params = {"w": jnp.array([3.0, -2.0]), "b": jnp.array([1.5])}
         state = upd.init(params)
 
