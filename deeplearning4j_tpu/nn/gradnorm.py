@@ -1,0 +1,51 @@
+"""Gradient normalization / clipping.
+
+Reference analog: ``GradientNormalization`` enum applied in
+BaseUpdater.updateGradientAccordingToParams (/root/reference/deeplearning4j-nn/
+.../nn/updater/BaseMultiLayerUpdater.java; modes defined in
+nn/conf/GradientNormalization.java): RenormalizeL2PerLayer,
+RenormalizeL2PerParamType, ClipElementWiseAbsoluteValue, ClipL2PerLayer,
+ClipL2PerParamType. "Layer" here = one layer's params dict; "ParamType" = one
+named param array.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _tree_l2(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(l * l) for l in leaves) + 1e-32)
+
+
+def normalize_layer_grads(mode, layer_grads, threshold=1.0):
+    """Apply normalization to one layer's gradient dict."""
+    if mode in (None, "none"):
+        return layer_grads
+    if mode == "renormalize_l2_per_layer":
+        norm = _tree_l2(layer_grads)
+        return jax.tree_util.tree_map(lambda g: g / norm, layer_grads)
+    if mode == "renormalize_l2_per_param_type":
+        return {k: v / jnp.sqrt(jnp.sum(v * v) + 1e-32) for k, v in layer_grads.items()}
+    if mode == "clip_elementwise_absolute_value":
+        return jax.tree_util.tree_map(lambda g: jnp.clip(g, -threshold, threshold), layer_grads)
+    if mode == "clip_l2_per_layer":
+        norm = _tree_l2(layer_grads)
+        scale = jnp.minimum(1.0, threshold / norm)
+        return jax.tree_util.tree_map(lambda g: g * scale, layer_grads)
+    if mode == "clip_l2_per_param_type":
+        out = {}
+        for k, v in layer_grads.items():
+            norm = jnp.sqrt(jnp.sum(v * v) + 1e-32)
+            out[k] = v * jnp.minimum(1.0, threshold / norm)
+        return out
+    raise ValueError(f"Unknown gradient normalization mode {mode!r}")
+
+
+def normalize_grads(mode, grads, threshold=1.0):
+    """Apply per-layer normalization across a list-of-dicts gradient pytree."""
+    if mode in (None, "none"):
+        return grads
+    return [normalize_layer_grads(mode, g, threshold) if g else g for g in grads]

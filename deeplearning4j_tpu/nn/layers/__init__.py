@@ -1,0 +1,16 @@
+from deeplearning4j_tpu.nn.layers.base import Layer, ParamLayer  # noqa: F401
+from deeplearning4j_tpu.nn.layers.core import (  # noqa: F401
+    DenseLayer, OutputLayer, LossLayer, ActivationLayer, DropoutLayer,
+    EmbeddingLayer, AutoEncoder,
+)
+from deeplearning4j_tpu.nn.layers.conv import (  # noqa: F401
+    ConvolutionLayer, Convolution1DLayer, Deconvolution2DLayer,
+    SeparableConvolution2DLayer, SubsamplingLayer, Subsampling1DLayer,
+    Upsampling1DLayer, Upsampling2DLayer, ZeroPaddingLayer, ZeroPadding1DLayer,
+    BatchNormalization, LocalResponseNormalization, GlobalPoolingLayer,
+    SpaceToDepthLayer, SpaceToBatchLayer,
+)
+from deeplearning4j_tpu.nn.layers.rnn import (  # noqa: F401
+    LSTM, GravesLSTM, GravesBidirectionalLSTM, SimpleRnn, RnnOutputLayer,
+    RnnLossLayer, LastTimeStep, Bidirectional,
+)

@@ -1,0 +1,569 @@
+"""Convolutional family: conv, pooling, upsampling, padding, BN, LRN, global pooling.
+
+Reference analogs in /root/reference/deeplearning4j-nn/src/main/java/org/
+deeplearning4j/nn/: conf/layers/ConvolutionLayer.java + layers/convolution/
+ConvolutionLayer.java (im2col path + cuDNN helper dispatch at :74-84),
+SubsamplingLayer, Upsampling1D/2D, ZeroPadding1D/2D,
+conf/layers/BatchNormalization.java + layers/normalization/
+BatchNormalization.java (462 LoC), LocalResponseNormalization,
+GlobalPoolingLayer, SpaceToDepth/SpaceToBatch.
+
+TPU-first design: NHWC layout (XLA:TPU native), lax.conv_general_dilated with
+bf16 inputs + f32 accumulation lands directly on the MXU — this *is* the
+cuDNN-helper replacement (SURVEY.md §2.2: "XLA's native conv/BN lowering plays
+this role"). Pooling = lax.reduce_window. No im2col materialization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn import initializers as _init
+from deeplearning4j_tpu.nn.conf import inputs as _inputs
+from deeplearning4j_tpu.nn.layers.base import ParamLayer, Layer
+from deeplearning4j_tpu.utils import dtypes as _dtypes
+from deeplearning4j_tpu.utils.serde import register_config
+
+DIMNUMS_2D = ("NHWC", "HWIO", "NHWC")
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _conv_out_size(size, kernel, stride, pad_mode, pad):
+    if pad_mode == "same":
+        return -(-size // stride)
+    if pad_mode == "valid":
+        return (size - kernel) // stride + 1
+    return (size + 2 * pad - kernel) // stride + 1
+
+
+def _explicit_padding(pad_mode, pad_hw):
+    if pad_mode in ("same", "valid"):
+        return pad_mode.upper()
+    ph, pw = pad_hw
+    return [(ph, ph), (pw, pw)]
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class ConvolutionLayer(ParamLayer):
+    """2-D convolution. Kernel layout HWIO; params: W [kh,kw,cin,cout], b [cout]."""
+
+    n_out: int = 0  # number of filters
+    kernel: tuple = (3, 3)
+    stride: tuple = (1, 1)
+    padding: str = "valid"  # "same" | "valid" | "explicit"
+    pad: tuple = (0, 0)
+    dilation: tuple = (1, 1)
+    has_bias: bool = True
+    weight_init: object = dataclasses.field(default="relu", kw_only=True)
+
+    input_family = _inputs.ConvolutionalType
+
+    def output_type(self, input_type):
+        assert isinstance(input_type, _inputs.ConvolutionalType), \
+            f"{type(self).__name__} needs CNN input, got {input_type}"
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.pad)
+        h = _conv_out_size(input_type.height, kh + (kh - 1) * (self.dilation[0] - 1), sh, self.padding, ph)
+        w = _conv_out_size(input_type.width, kw + (kw - 1) * (self.dilation[1] - 1), sw, self.padding, pw)
+        return _inputs.ConvolutionalType(h, w, self.n_out)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel)
+        cin = input_type.channels
+        fan_in = cin * kh * kw
+        fan_out = self.n_out * kh * kw
+        p = {"W": _init.init_weight(self.weight_init, key, (kh, kw, cin, self.n_out),
+                                    fan_in, fan_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        cd, ad = _dtypes.compute_dtypes_for(x.dtype)
+        z = lax.conv_general_dilated(
+            x.astype(cd), params["W"].astype(cd),
+            window_strides=_pair(self.stride),
+            padding=_explicit_padding(self.padding, _pair(self.pad)),
+            rhs_dilation=_pair(self.dilation),
+            dimension_numbers=DIMNUMS_2D,
+            preferred_element_type=ad,
+        )
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation_fn()(z), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Convolution1DLayer(ParamLayer):
+    """1-D conv over time (reference: conf/layers/Convolution1DLayer.java).
+    Input [B, T, F]; implemented as conv_general_dilated over a width-1 axis."""
+
+    n_out: int = 0
+    kernel: int = 3
+    stride: int = 1
+    padding: str = "valid"
+    pad: int = 0
+    dilation: int = 1
+    has_bias: bool = True
+    weight_init: object = dataclasses.field(default="relu", kw_only=True)
+
+    input_family = _inputs.RecurrentType
+
+    def output_type(self, input_type):
+        assert isinstance(input_type, _inputs.RecurrentType)
+        t = input_type.timesteps
+        if t is not None:
+            k_eff = self.kernel + (self.kernel - 1) * (self.dilation - 1)
+            t = _conv_out_size(t, k_eff, self.stride, self.padding, self.pad)
+        return _inputs.RecurrentType(self.n_out, t)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        cin = input_type.size
+        fan_in = cin * self.kernel
+        fan_out = self.n_out * self.kernel
+        p = {"W": _init.init_weight(self.weight_init, key, (self.kernel, cin, self.n_out),
+                                    fan_in, fan_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        cd, ad = _dtypes.compute_dtypes_for(x.dtype)
+        pad = self.padding.upper() if self.padding in ("same", "valid") else [(self.pad, self.pad)]
+        z = lax.conv_general_dilated(
+            x.astype(cd), params["W"].astype(cd),
+            window_strides=(self.stride,), padding=pad,
+            rhs_dilation=(self.dilation,),
+            dimension_numbers=("NWC", "WIO", "NWC"),
+            preferred_element_type=ad,
+        )
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation_fn()(z), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Deconvolution2DLayer(ConvolutionLayer):
+    """Transposed conv (reference: conf/layers/Deconvolution2D.java)."""
+
+    def output_type(self, input_type):
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.pad)
+        if self.padding == "same":
+            h, w = input_type.height * sh, input_type.width * sw
+        else:
+            pads = (0, 0) if self.padding == "valid" else (ph, pw)
+            h = sh * (input_type.height - 1) + kh - 2 * pads[0]
+            w = sw * (input_type.width - 1) + kw - 2 * pads[1]
+        return _inputs.ConvolutionalType(h, w, self.n_out)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        cd, ad = _dtypes.compute_dtypes_for(x.dtype)
+        pad = self.padding.upper() if self.padding in ("same", "valid") else \
+            [(p, p) for p in _pair(self.pad)]
+        z = lax.conv_transpose(
+            x.astype(cd), params["W"].astype(cd),
+            strides=_pair(self.stride), padding=pad,
+            dimension_numbers=DIMNUMS_2D,
+            preferred_element_type=ad,
+        )
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation_fn()(z), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class SeparableConvolution2DLayer(ParamLayer):
+    """Depthwise-separable conv (reference: conf/layers/SeparableConvolution2D.java).
+    params: D [kh,kw,cin,mult] depthwise, P [1,1,cin*mult,cout] pointwise."""
+
+    n_out: int = 0
+    kernel: tuple = (3, 3)
+    stride: tuple = (1, 1)
+    padding: str = "valid"
+    pad: tuple = (0, 0)
+    depth_multiplier: int = 1
+    has_bias: bool = True
+    weight_init: object = dataclasses.field(default="relu", kw_only=True)
+
+    input_family = _inputs.ConvolutionalType
+
+    def output_type(self, input_type):
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.pad)
+        h = _conv_out_size(input_type.height, kh, sh, self.padding, ph)
+        w = _conv_out_size(input_type.width, kw, sw, self.padding, pw)
+        return _inputs.ConvolutionalType(h, w, self.n_out)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel)
+        cin = input_type.channels
+        k1, k2 = jax.random.split(key)
+        p = {
+            "D": _init.init_weight(self.weight_init, k1,
+                                   (kh, kw, 1, cin * self.depth_multiplier),
+                                   cin * kh * kw, cin * self.depth_multiplier, dtype),
+            "P": _init.init_weight(self.weight_init, k2,
+                                   (1, 1, cin * self.depth_multiplier, self.n_out),
+                                   cin * self.depth_multiplier, self.n_out, dtype),
+        }
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        cd, ad = _dtypes.compute_dtypes_for(x.dtype)
+        cin = x.shape[-1]
+        z = lax.conv_general_dilated(
+            x.astype(cd), params["D"].astype(cd),
+            window_strides=_pair(self.stride),
+            padding=_explicit_padding(self.padding, _pair(self.pad)),
+            dimension_numbers=DIMNUMS_2D, feature_group_count=cin,
+            preferred_element_type=ad,
+        )
+        z = lax.conv_general_dilated(
+            z.astype(cd), params["P"].astype(cd),
+            window_strides=(1, 1), padding="VALID",
+            dimension_numbers=DIMNUMS_2D,
+            preferred_element_type=ad,
+        )
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation_fn()(z), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class SubsamplingLayer(Layer):
+    """Pooling (reference: conf/layers/SubsamplingLayer.java — MAX/AVG/PNORM).
+    lax.reduce_window; for PNORM, (sum |x|^p)^(1/p)."""
+
+    kernel: tuple = (2, 2)
+    stride: tuple = (2, 2)
+    padding: str = "valid"
+    pad: tuple = (0, 0)
+    mode: str = "max"  # max | avg | sum | pnorm
+    pnorm: int = 2
+
+    input_family = _inputs.ConvolutionalType
+
+    def output_type(self, input_type):
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.pad)
+        h = _conv_out_size(input_type.height, kh, sh, self.padding, ph)
+        w = _conv_out_size(input_type.width, kw, sw, self.padding, pw)
+        return _inputs.ConvolutionalType(h, w, input_type.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        if self.padding in ("same", "valid"):
+            pads = self.padding.upper()
+        else:
+            ph, pw = _pair(self.pad)
+            pads = [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+        window = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        if self.mode == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
+        elif self.mode in ("avg", "sum"):
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+            if self.mode == "avg":
+                y = y / (kh * kw)
+        elif self.mode == "pnorm":
+            p = float(self.pnorm)
+            y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, pads) ** (1.0 / p)
+        else:
+            raise ValueError(f"Unknown pooling mode {self.mode!r}")
+        return y, state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Subsampling1DLayer(Layer):
+    """1-D pooling over time (reference: conf/layers/Subsampling1DLayer.java)."""
+
+    kernel: int = 2
+    stride: int = 2
+    padding: str = "valid"
+    mode: str = "max"
+
+    input_family = _inputs.RecurrentType
+
+    def output_type(self, input_type):
+        t = input_type.timesteps
+        if t is not None:
+            t = _conv_out_size(t, self.kernel, self.stride, self.padding, 0)
+        return _inputs.RecurrentType(input_type.size, t)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        window, strides = (1, self.kernel, 1), (1, self.stride, 1)
+        pads = self.padding.upper()
+        if self.mode == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
+        else:
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+            if self.mode == "avg":
+                y = y / self.kernel
+        return y, state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Upsampling2DLayer(Layer):
+    """(reference: conf/layers/Upsampling2D.java) — nearest-neighbor repeat."""
+
+    size: tuple = (2, 2)
+
+    input_family = _inputs.ConvolutionalType
+
+    def output_type(self, input_type):
+        sh, sw = _pair(self.size)
+        return _inputs.ConvolutionalType(input_type.height * sh, input_type.width * sw,
+                                         input_type.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        sh, sw = _pair(self.size)
+        return jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Upsampling1DLayer(Layer):
+    size: int = 2
+
+    input_family = _inputs.RecurrentType
+
+    def output_type(self, input_type):
+        t = None if input_type.timesteps is None else input_type.timesteps * self.size
+        return _inputs.RecurrentType(input_type.size, t)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return jnp.repeat(x, self.size, axis=1), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class ZeroPaddingLayer(Layer):
+    """(reference: conf/layers/ZeroPaddingLayer.java) pad = (top, bottom, left, right)."""
+
+    pad: tuple = (1, 1, 1, 1)
+
+    input_family = _inputs.ConvolutionalType
+
+    def output_type(self, input_type):
+        t, b, l, r = self.pad
+        return _inputs.ConvolutionalType(input_type.height + t + b,
+                                         input_type.width + l + r, input_type.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        t, b, l, r = self.pad
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class ZeroPadding1DLayer(Layer):
+    pad: tuple = (1, 1)
+
+    input_family = _inputs.RecurrentType
+
+    def output_type(self, input_type):
+        l, r = self.pad
+        t = None if input_type.timesteps is None else input_type.timesteps + l + r
+        return _inputs.RecurrentType(input_type.size, t)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        l, r = self.pad
+        return jnp.pad(x, ((0, 0), (l, r), (0, 0))), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class BatchNormalization(ParamLayer):
+    """Batch normalization over the channel/feature axis.
+
+    Reference: conf/layers/BatchNormalization.java + layers/normalization/
+    BatchNormalization.java (+ CudnnBatchNormalizationHelper — XLA's fused BN
+    lowering is the TPU replacement). ``decay`` matches the reference's
+    running-average momentum (default 0.9); state holds running mean/var used
+    at inference.
+    """
+
+    decay: float = 0.9
+    eps: float = 1e-5
+    use_gamma_beta: bool = True  # reference: lockGammaBeta inverts this
+    activation: object = dataclasses.field(default="identity", kw_only=True)
+
+    input_family = None  # works on FF [B,F], RNN [B,T,F] and CNN [B,H,W,C]
+
+    def _nfeat(self, input_type):
+        if isinstance(input_type, _inputs.ConvolutionalType):
+            return input_type.channels
+        return input_type.size
+
+    def output_type(self, input_type):
+        return input_type
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n = self._nfeat(input_type)
+        if not self.use_gamma_beta:
+            return {}
+        return {"gamma": jnp.ones((n,), dtype), "beta": jnp.zeros((n,), dtype)}
+
+    def init_state(self, input_type, dtype=jnp.float32):
+        n = self._nfeat(input_type)
+        return {"mean": jnp.zeros((n,), dtype), "var": jnp.ones((n,), dtype)}
+
+    WEIGHT_KEYS = ("gamma",)
+    BIAS_KEYS = ("beta",)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        y = (x - mean) * inv
+        if self.use_gamma_beta:
+            y = y * params["gamma"] + params["beta"]
+        return self.activation_fn()(y), new_state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class LocalResponseNormalization(Layer):
+    """Cross-channel LRN (reference: conf/layers/LocalResponseNormalization.java;
+    defaults k=2, n=5, alpha=1e-4, beta=0.75 per the AlexNet formulation)."""
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    input_family = _inputs.ConvolutionalType
+
+    def output_type(self, input_type):
+        return input_type
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        half = self.n // 2
+        sq = x * x
+        # sliding window over the channel axis via reduce_window
+        ssum = lax.reduce_window(sq, 0.0, lax.add, (1, 1, 1, self.n), (1, 1, 1, 1),
+                                 [(0, 0), (0, 0), (0, 0), (half, self.n - 1 - half)])
+        denom = (self.k + self.alpha * ssum) ** self.beta
+        return x / denom, state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class GlobalPoolingLayer(Layer):
+    """Pool over time (RNN) or space (CNN) (reference: conf/layers/
+    GlobalPoolingLayer.java — MAX/AVG/SUM/PNORM with mask support)."""
+
+    mode: str = "max"
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    input_family = None
+
+    def output_type(self, input_type):
+        if isinstance(input_type, _inputs.RecurrentType):
+            return _inputs.FeedForwardType(input_type.size)
+        if isinstance(input_type, _inputs.ConvolutionalType):
+            return _inputs.FeedForwardType(input_type.channels)
+        return input_type
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        axes = (1,) if x.ndim == 3 else (1, 2) if x.ndim == 4 else None
+        if axes is None:
+            return x, state
+        if mask is not None and x.ndim == 3:
+            m = mask[..., None].astype(x.dtype)
+            if self.mode == "max":
+                y = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
+            elif self.mode == "sum":
+                y = jnp.sum(x * m, axis=1)
+            elif self.mode == "avg":
+                y = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+            else:
+                p = float(self.pnorm)
+                y = jnp.sum(jnp.abs(x * m) ** p, axis=1) ** (1.0 / p)
+            return y, state
+        if self.mode == "max":
+            y = jnp.max(x, axis=axes)
+        elif self.mode == "avg":
+            y = jnp.mean(x, axis=axes)
+        elif self.mode == "sum":
+            y = jnp.sum(x, axis=axes)
+        elif self.mode == "pnorm":
+            p = float(self.pnorm)
+            y = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+        else:
+            raise ValueError(f"Unknown pooling mode {self.mode!r}")
+        return y, state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class SpaceToDepthLayer(Layer):
+    """(reference: conf/layers/SpaceToDepthLayer.java; used by YOLO passthrough)"""
+
+    blocks: int = 2
+
+    input_family = _inputs.ConvolutionalType
+
+    def output_type(self, input_type):
+        b = self.blocks
+        return _inputs.ConvolutionalType(input_type.height // b, input_type.width // b,
+                                         input_type.channels * b * b)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        b = self.blocks
+        n, h, w, c = x.shape
+        y = x.reshape(n, h // b, b, w // b, b, c).transpose(0, 1, 3, 2, 4, 5)
+        return y.reshape(n, h // b, w // b, b * b * c), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class SpaceToBatchLayer(Layer):
+    """(reference: conf/layers/SpaceToBatchLayer.java)"""
+
+    blocks: tuple = (2, 2)
+
+    input_family = _inputs.ConvolutionalType
+
+    def output_type(self, input_type):
+        bh, bw = _pair(self.blocks)
+        return _inputs.ConvolutionalType(input_type.height // bh, input_type.width // bw,
+                                         input_type.channels)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        bh, bw = _pair(self.blocks)
+        n, h, w, c = x.shape
+        y = x.reshape(n, h // bh, bh, w // bw, bw, c).transpose(2, 4, 0, 1, 3, 5)
+        return y.reshape(n * bh * bw, h // bh, w // bw, c), state

@@ -1,0 +1,113 @@
+"""Training listeners.
+
+Reference analog: optimize/api/TrainingListener.java + optimize/listeners/
+(ScoreIterationListener, PerformanceListener.java:109 samples/sec,
+CollectScoresIterationListener, TimeIterationListener, EvaluativeListener) in
+/root/reference/deeplearning4j-nn. The ETL-time split mirrors the reference's
+lastEtlTime measurement inside the fit loop (MultiLayerNetwork.java:1239-1242).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        pass
+
+    def iteration_done(self, model, iteration, score, etl_time=0.0):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    def __init__(self, frequency=10, print_fn=None):
+        self.frequency = frequency
+        self.print_fn = print_fn or (lambda s: logger.info(s))
+        self.scores = []
+
+    def iteration_done(self, model, iteration, score, etl_time=0.0):
+        if iteration % self.frequency == 0:
+            self.print_fn(f"Score at iteration {iteration} is {score}")
+        self.scores.append((iteration, score))
+
+
+class PerformanceListener(TrainingListener):
+    """Samples/sec + batches/sec + ETL time per iteration (reference:
+    PerformanceListener.java:109)."""
+
+    def __init__(self, frequency=10, report_batch_size=None, print_fn=None):
+        self.frequency = frequency
+        self.batch_size = report_batch_size
+        self.print_fn = print_fn or (lambda s: logger.info(s))
+        self._last = None
+        self.records = []
+
+    def iteration_done(self, model, iteration, score, etl_time=0.0):
+        now = time.perf_counter()
+        if self._last is not None:
+            dt = now - self._last
+            rec = {"iteration": iteration, "iter_time_s": dt, "etl_time_s": etl_time,
+                   "batches_per_sec": 1.0 / dt if dt > 0 else 0.0}
+            if self.batch_size:
+                rec["samples_per_sec"] = self.batch_size / dt if dt > 0 else 0.0
+            self.records.append(rec)
+            if iteration % self.frequency == 0:
+                self.print_fn(
+                    f"iteration {iteration}: {dt * 1e3:.2f} ms/iter"
+                    + (f", {rec.get('samples_per_sec', 0):.1f} samples/sec" if self.batch_size else "")
+                    + f", etl {etl_time * 1e3:.2f} ms")
+        self._last = now
+
+
+class CollectScoresListener(TrainingListener):
+    def __init__(self):
+        self.iterations = []
+        self.scores = []
+
+    def iteration_done(self, model, iteration, score, etl_time=0.0):
+        self.iterations.append(iteration)
+        self.scores.append(score)
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA logger (reference: TimeIterationListener)."""
+
+    def __init__(self, total_iterations, frequency=50, print_fn=None):
+        self.total = total_iterations
+        self.frequency = frequency
+        self.print_fn = print_fn or (lambda s: logger.info(s))
+        self.start = time.perf_counter()
+
+    def iteration_done(self, model, iteration, score, etl_time=0.0):
+        if iteration and iteration % self.frequency == 0:
+            elapsed = time.perf_counter() - self.start
+            per_iter = elapsed / iteration
+            remaining = max(self.total - iteration, 0) * per_iter
+            self.print_fn(f"iteration {iteration}/{self.total}, ETA {remaining:.1f}s")
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation during training (reference: EvaluativeListener)."""
+
+    def __init__(self, data, labels, frequency=100, evaluator=None):
+        self.data = data
+        self.labels = labels
+        self.frequency = frequency
+        self.evaluator = evaluator
+        self.results = []
+
+    def iteration_done(self, model, iteration, score, etl_time=0.0):
+        if iteration % self.frequency != 0:
+            return
+        preds = model.output(self.data)
+        if self.evaluator is not None:
+            self.results.append((iteration, self.evaluator(preds, self.labels)))
+        else:
+            self.results.append((iteration, preds))

@@ -1,0 +1,245 @@
+"""MultiLayerNetwork: the sequential-stack trainer.
+
+Reference analog: nn/multilayer/MultiLayerNetwork.java (3225 LoC) —
+fit(DataSetIterator):1205, calcBackpropGradients:1315, output:1993,
+computeGradientAndScore:2255 — plus the Solver/StochasticGradientDescent/
+BaseOptimizer stack (optimize/solvers/*, gradientAndScore at
+BaseOptimizer.java:171, updater application at :187).
+
+TPU-native design: instead of a mutable flat param buffer with per-layer views
+mutated in place through a JNI boundary per op, the entire
+forward+backward+update is ONE jitted XLA computation over a params pytree
+(list of per-layer dicts). Donated buffers give the same zero-copy param update
+the reference gets from views. The reference's workspace machinery
+(MultiLayerNetwork.java:1221-1229) is subsumed by XLA's static buffer
+allocation; its AsyncDataSetIterator prefetch is datasets/iterator.py.
+
+The stateful-object API (fit/output/score) wraps the functional core
+(init_fn/apply_fn/loss_fn/train_step) — use the functional core directly for
+custom training loops or pjit sharding.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import gradnorm as _gradnorm
+from deeplearning4j_tpu.nn.conf import inputs as _inputs
+from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.utils import dtypes as _dtypes
+
+
+def _accepts_mask(layer):
+    try:
+        return "mask" in inspect.signature(type(layer).apply).parameters
+    except (ValueError, TypeError):
+        return False
+
+
+class MultiLayerNetwork:
+    """Sequential network: config in, functional core + convenience API out."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layer_inputs, self.output_type = conf.layer_input_types()
+        self._mask_aware = [_accepts_mask(l) for l in conf.layers]
+        self.params = None
+        self.state = None
+        self.opt_state = None
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners = []
+        self._train_step = None
+        self._rng = jax.random.PRNGKey(conf.seed)
+
+    # ------------------------------------------------------------------
+    # functional core
+    # ------------------------------------------------------------------
+
+    def init(self, rng=None, dtype=None):
+        """Initialize params/state/opt_state. Returns (params, state)."""
+        rng = self._rng if rng is None else rng
+        dtype = dtype or _dtypes.get_policy().param_dtype
+        params, state = [], []
+        for layer, in_type in zip(self.conf.layers, self.layer_inputs):
+            rng, sub = jax.random.split(rng)
+            params.append(layer.init(sub, in_type, dtype))
+            state.append(layer.init_state(in_type, dtype))
+        self.params, self.state = params, state
+        self.opt_state = self.conf.updater.init(params)
+        return params, state
+
+    def apply_fn(self, params, state, x, *, train=False, rng=None, mask=None,
+                 layer_limit=None):
+        """Forward pass. Returns (output, new_state)."""
+        new_state = list(state)
+        cur_type = self.conf.input_type
+        n = len(self.conf.layers) if layer_limit is None else layer_limit
+        for i in range(n):
+            layer = self.conf.layers[i]
+            fam = layer.input_family
+            if fam is not None and not isinstance(cur_type, fam):
+                x = _inputs.adapt(x, cur_type, fam)
+                cur_type = _inputs.adapted_type(cur_type, fam)
+            if train and layer.dropout > 0.0 and rng is not None:
+                rng, sub = jax.random.split(rng)
+                from deeplearning4j_tpu.nn.layers.base import dropout_mask
+                x = dropout_mask(sub, x, layer.dropout)
+            kwargs = {}
+            if self._mask_aware[i] and mask is not None:
+                kwargs["mask"] = mask
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            x, new_state[i] = layer.apply(params[i], state[i], x, train=train,
+                                          rng=sub, **kwargs)
+            cur_type = layer.output_type(cur_type)
+        return x, new_state
+
+    def loss_fn(self, params, state, x, y, *, train=True, rng=None, mask=None,
+                label_mask=None):
+        """Score = output-layer loss + L1/L2 penalties (reference:
+        computeGradientAndScore at MultiLayerNetwork.java:2255 + calcL1/calcL2).
+        Returns (loss, (new_state, predictions))."""
+        preds, new_state = self.apply_fn(params, state, x, train=train, rng=rng,
+                                         mask=mask)
+        out_layer = self.conf.layers[-1]
+        if not hasattr(out_layer, "compute_loss"):
+            raise ValueError("Last layer must be an output/loss layer, got "
+                             f"{type(out_layer).__name__}")
+        lm = label_mask if label_mask is not None else mask
+        loss = out_layer.compute_loss(preds, y, lm)
+        for layer, p in zip(self.conf.layers, params):
+            if p:
+                loss = loss + layer.regularization_penalty(p)
+        return loss, (new_state, preds)
+
+    def make_train_step(self, donate=True):
+        """Build the jitted train step:
+        (params, state, opt_state, x, y, step, rng, mask) ->
+        (params, state, opt_state, loss).
+
+        Mirrors BaseOptimizer.gradientAndScore:171 -> updater :187 ->
+        StochasticGradientDescent step :78, fused into one XLA computation.
+        """
+        conf = self.conf
+
+        def train_step(params, state, opt_state, x, y, step, rng, mask=None):
+            (loss, (new_state, _)), grads = jax.value_and_grad(
+                self.loss_fn, has_aux=True)(params, state, x, y, train=True,
+                                            rng=rng, mask=mask)
+            grads = _gradnorm.normalize_grads(conf.gradient_normalization, grads,
+                                              conf.gradient_normalization_threshold)
+            updates, new_opt = conf.updater.update(grads, opt_state, params, step)
+            new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            # constraints are projections applied after the update (reference:
+            # applyConstraints at StochasticGradientDescent.java:97)
+            new_params = [l.apply_constraints(p, step, 0) if p else p
+                          for l, p in zip(conf.layers, new_params)]
+            return new_params, new_state, new_opt, loss
+
+        donate_argnums = (0, 1, 2) if donate else ()
+        return jax.jit(train_step, donate_argnums=donate_argnums)
+
+    # ------------------------------------------------------------------
+    # convenience (stateful) API
+    # ------------------------------------------------------------------
+
+    def fit(self, data, labels=None, *, epochs=1, batch_size=None, mask=None):
+        """Train. ``data`` is either (features, labels) arrays or an iterator
+        yielding dicts/tuples per minibatch (reference: fit(DataSetIterator)
+        at MultiLayerNetwork.java:1205)."""
+        if self.params is None:
+            self.init()
+        if self._train_step is None:
+            self._train_step = self.make_train_step()
+        for _ in range(epochs):
+            for l in self.listeners:
+                l.on_epoch_start(self)
+            batches = self._batches(data, labels, batch_size, mask)
+            for batch in batches:
+                x, y, m = batch
+                etl_start = time.perf_counter()
+                x, y = jnp.asarray(x), jnp.asarray(y)
+                m = jnp.asarray(m) if m is not None else None
+                etl_time = time.perf_counter() - etl_start
+                self._rng, step_rng = jax.random.split(self._rng)
+                self.params, self.state, self.opt_state, loss = self._train_step(
+                    self.params, self.state, self.opt_state, x, y,
+                    self.iteration, step_rng, m)
+                self.score_value = loss
+                self.iteration += 1
+                for l in self.listeners:
+                    l.iteration_done(self, self.iteration, float(loss), etl_time)
+            for l in self.listeners:
+                l.on_epoch_end(self)
+            self.epoch += 1
+        return self
+
+    def _batches(self, data, labels, batch_size, mask):
+        if labels is None and hasattr(data, "__iter__") and not isinstance(data, (tuple, list, np.ndarray, jnp.ndarray)):
+            for item in data:
+                if isinstance(item, dict):
+                    yield item["features"], item["labels"], item.get("mask")
+                elif len(item) == 3:
+                    yield item
+                else:
+                    yield item[0], item[1], None
+            return
+        x, y = (data, labels) if labels is not None else data
+        n = x.shape[0]
+        bs = batch_size or n
+        for i in range(0, n, bs):
+            m = mask[i:i + bs] if mask is not None else None
+            yield x[i:i + bs], y[i:i + bs], m
+
+    def output(self, x, train=False, mask=None):
+        """Inference forward pass (reference: MultiLayerNetwork.output:1993)."""
+        if self.params is None:
+            self.init()
+        out, _ = self._jitted_apply()(self.params, self.state, jnp.asarray(x),
+                                      mask if mask is None else jnp.asarray(mask))
+        return out
+
+    @functools.lru_cache(maxsize=1)
+    def _jitted_apply(self):
+        def fwd(params, state, x, mask):
+            return self.apply_fn(params, state, x, train=False, mask=mask)
+        return jax.jit(fwd)
+
+    def feed_forward(self, x, train=False):
+        """All intermediate activations (reference: feedForwardToLayer:2286)."""
+        acts = []
+        x = jnp.asarray(x)
+        cur_type = self.conf.input_type
+        state = list(self.state)
+        for i, layer in enumerate(self.conf.layers):
+            fam = layer.input_family
+            if fam is not None and not isinstance(cur_type, fam):
+                x = _inputs.adapt(x, cur_type, fam)
+                cur_type = _inputs.adapted_type(cur_type, fam)
+            x, state[i] = layer.apply(self.params[i], state[i], x, train=train)
+            cur_type = layer.output_type(cur_type)
+            acts.append(x)
+        return acts
+
+    def score(self, x, y, mask=None):
+        if self.params is None:
+            self.init()
+        loss, _ = self.loss_fn(self.params, self.state, jnp.asarray(x),
+                               jnp.asarray(y), train=False, mask=mask)
+        return float(loss)
+
+    def num_params(self):
+        return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
+
+    def add_listener(self, *ls):
+        self.listeners.extend(ls)
+        return self

@@ -40,6 +40,15 @@ def set_policy(param_dtype=None, compute_dtype=None, accum_dtype=None) -> DtypeP
     return _POLICY
 
 
+def compute_dtypes_for(x_dtype):
+    """(compute, accum) dtypes for an input dtype. float64 inputs (gradient
+    checking) stay in float64; everything else follows the global policy."""
+    if jnp.dtype(x_dtype) == jnp.float64:
+        return jnp.float64, jnp.float64
+    pol = get_policy()
+    return pol.compute_dtype, pol.accum_dtype
+
+
 def bf16_policy() -> DtypePolicy:
     """The TPU training policy: f32 params, bf16 compute, f32 accumulation."""
     return set_policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16, accum_dtype=jnp.float32)

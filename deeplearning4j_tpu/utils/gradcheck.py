@@ -36,7 +36,7 @@ def check_gradients(loss_fn, params, *, epsilon=1e-6, max_rel_error=1e-5,
     failures = []
     total_checked = 0
     for li, (leaf, a_leaf, path) in enumerate(zip(leaves, a_leaves, paths)):
-        flat = np.asarray(leaf, np.float64).ravel()
+        flat = np.array(leaf, np.float64).ravel().copy()
         a_flat = np.asarray(a_leaf, np.float64).ravel()
         n = flat.size
         idxs = range(n)

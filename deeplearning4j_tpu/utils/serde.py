@@ -67,8 +67,9 @@ def config_from_dict(d):
             # tuple-typed fields arrive as lists from JSON
             hints = typing.get_type_hints(cls)
             for f in dataclasses.fields(cls):
-                origin = typing.get_origin(hints.get(f.name))
-                if origin is tuple and isinstance(kwargs.get(f.name), list):
+                hint = hints.get(f.name)
+                if (hint is tuple or typing.get_origin(hint) is tuple) and \
+                        isinstance(kwargs.get(f.name), list):
                     kwargs[f.name] = tuple(kwargs[f.name])
             return cls(**kwargs)
         return {k: config_from_dict(v) for k, v in d.items()}

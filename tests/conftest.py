@@ -8,13 +8,16 @@ real (virtual) mesh, the same suite running unchanged on real TPU.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU unconditionally: the sandbox's axon sitecustomize presets
+# JAX_PLATFORMS=axon (real TPU over a tunnel); tests must never dial it.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")  # wins over sitecustomize's axon hook
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
