@@ -1,0 +1,69 @@
+"""Benchmark: LeNet-MNIST training throughput on one TPU chip.
+
+BASELINE.md config #1 (LeNet MNIST MultiLayerNetwork). The reference publishes
+no in-repo numbers (BASELINE.json published:{}); ``vs_baseline`` is therefore
+measured against REFERENCE_CPU_SAMPLES_PER_SEC, a recorded order-of-magnitude
+estimate for DL4J 0.9 LeNet minibatch training on nd4j-native CPU — to be
+replaced by a real measured reference number when one exists.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_CPU_SAMPLES_PER_SEC = 500.0  # documented estimate, see module docstring
+
+BATCH = 256
+WARMUP = 3
+ITERS = 20
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import lenet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.utils import dtypes
+
+    dtypes.bf16_policy()  # bf16 compute on the MXU, f32 params/accum
+
+    net = MultiLayerNetwork(lenet())
+    net.init()
+    step = net.make_train_step(donate=False)
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(BATCH, 28, 28, 1).astype(np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rs.randint(0, 10, BATCH)])
+    rng = jax.random.PRNGKey(0)
+
+    params, state, opt = net.params, net.state, net.opt_state
+    for i in range(WARMUP):
+        params, state, opt, loss = step(params, state, opt, x, y, i, rng, None)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        params, state, opt, loss = step(params, state, opt, x, y, i, rng, None)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = BATCH * ITERS / dt
+    out = {
+        "metric": "lenet_mnist_train_samples_per_sec",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(samples_per_sec / REFERENCE_CPU_SAMPLES_PER_SEC, 2),
+        "step_time_ms": round(1e3 * dt / ITERS, 2),
+        "batch": BATCH,
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

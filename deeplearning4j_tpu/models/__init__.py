@@ -1,0 +1,3 @@
+"""Model zoo (reference: deeplearning4j-zoo, SURVEY.md §2.6)."""
+
+from deeplearning4j_tpu.models.lenet import lenet  # noqa: F401

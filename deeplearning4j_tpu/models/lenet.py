@@ -1,0 +1,26 @@
+"""LeNet (reference: /root/reference/deeplearning4j-zoo/src/main/java/org/
+deeplearning4j/zoo/model/LeNet.java — conv5x5x20 -> pool -> conv5x5x50 ->
+pool -> dense500 -> softmax10, the classic MNIST config and BASELINE.md
+config #1)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+
+
+def lenet(height=28, width=28, channels=1, n_classes=10, updater=None, seed=12345):
+    updater = updater or U.Adam(learning_rate=1e-3)
+    return NeuralNetConfig(seed=seed, updater=updater).list(
+        L.ConvolutionLayer(n_out=20, kernel=(5, 5), stride=(1, 1), padding="same",
+                           activation="relu", weight_init="xavier"),
+        L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2), mode="max"),
+        L.ConvolutionLayer(n_out=50, kernel=(5, 5), stride=(1, 1), padding="same",
+                           activation="relu", weight_init="xavier"),
+        L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2), mode="max"),
+        L.DenseLayer(n_out=500, activation="relu", weight_init="xavier"),
+        L.OutputLayer(n_out=n_classes, loss="mcxent", weight_init="xavier"),
+        input_type=I.ConvolutionalType(height, width, channels),
+    )

@@ -121,7 +121,7 @@ class MultiLayerNetwork:
                 loss = loss + layer.regularization_penalty(p)
         return loss, (new_state, preds)
 
-    def make_train_step(self, donate=True):
+    def make_train_step(self, donate=True, jit=True):
         """Build the jitted train step:
         (params, state, opt_state, x, y, step, rng, mask) ->
         (params, state, opt_state, loss).
@@ -145,6 +145,8 @@ class MultiLayerNetwork:
                           for l, p in zip(conf.layers, new_params)]
             return new_params, new_state, new_opt, loss
 
+        if not jit:
+            return train_step
         donate_argnums = (0, 1, 2) if donate else ()
         return jax.jit(train_step, donate_argnums=donate_argnums)
 

@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.parallel.mesh import make_mesh, MeshSpec  # noqa: F401
+from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer  # noqa: F401
+from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401

@@ -1,0 +1,102 @@
+"""Parallel / batched inference.
+
+Reference analog: ParallelInference (/root/reference/deeplearning4j-scaleout/
+deeplearning4j-scaleout-parallelwrapper/.../ParallelInference.java:32 —
+InferenceMode.BATCHED request batching across threads with observable
+completion, SURVEY.md §2.5 row 3).
+
+TPU-native: one jitted forward compiled at a fixed max batch size; incoming
+requests are queued, padded into the static batch shape (XLA needs static
+shapes), executed, and results sliced back out. Multi-device serving = shard
+the padded batch over the mesh data axis.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.parallel import mesh as _mesh
+
+
+class ParallelInference:
+    def __init__(self, net, *, max_batch_size=32, mesh=None, timeout_s=0.005):
+        self.net = net
+        self.max_batch = max_batch_size
+        self.mesh = mesh
+        self.timeout_s = timeout_s
+        self._queue: queue.Queue = queue.Queue()
+        self._fwd = jax.jit(lambda p, s, x: net.apply_fn(p, s, x, train=False)[0])
+        self._thread = None
+        self._stop = threading.Event()
+
+    # ---- synchronous API ----
+
+    def output(self, x):
+        """Direct batched inference (pads to max_batch internally)."""
+        x = np.asarray(x)
+        n = x.shape[0]
+        outs = []
+        for i in range(0, n, self.max_batch):
+            chunk = x[i:i + self.max_batch]
+            pad = self.max_batch - chunk.shape[0]
+            if pad:
+                chunk = np.concatenate([chunk, np.zeros((pad,) + chunk.shape[1:], chunk.dtype)])
+            y = self._fwd(self.net.params, self.net.state, jnp.asarray(chunk))
+            outs.append(np.asarray(y)[:self.max_batch - pad])
+        return np.concatenate(outs)
+
+    # ---- async request-batching API (BATCHED InferenceMode) ----
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def submit(self, x):
+        """Submit one example; returns a Future-like holder."""
+        holder = _Result()
+        self._queue.put((np.asarray(x), holder))
+        return holder
+
+    def _worker(self):
+        while not self._stop.is_set():
+            batch = []
+            try:
+                batch.append(self._queue.get(timeout=0.1))
+            except queue.Empty:
+                continue
+            # opportunistically drain up to max_batch requests
+            while len(batch) < self.max_batch:
+                try:
+                    batch.append(self._queue.get(timeout=self.timeout_s))
+                except queue.Empty:
+                    break
+            xs = np.stack([b[0] for b in batch])
+            ys = self.output(xs)
+            for (_, holder), y in zip(batch, ys):
+                holder._set(y)
+
+
+class _Result:
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+
+    def _set(self, v):
+        self._value = v
+        self._event.set()
+
+    def get(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("inference result not ready")
+        return self._value

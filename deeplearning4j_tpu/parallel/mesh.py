@@ -1,0 +1,64 @@
+"""Device-mesh helpers.
+
+Reference analog: the device-topology assumptions inside ParallelWrapper
+(/root/reference/deeplearning4j-scaleout/deeplearning4j-scaleout-
+parallelwrapper/.../ParallelWrapper.java — one replica per CUDA device) and
+the Spark cluster layout of the TrainingMasters. TPU-native replacement: a
+``jax.sharding.Mesh`` with named axes
+
+    data  — data parallelism (replica axis; per-step psum of grads rides ICI)
+    model — tensor parallelism (weight shards; collectives inserted by XLA)
+    seq   — sequence/context parallelism for long sequences
+
+Multi-host: pass all ``jax.devices()`` from a jax.distributed-initialized
+process set; the same named-axis code then spans hosts with DCN-aware
+collective lowering — the reference's Aeron/Spark tier collapses into this.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Named mesh shape; -1 on the data axis = use all remaining devices."""
+
+    data: int = -1
+    model: int = 1
+    seq: int = 1
+
+    def resolve(self, n_devices):
+        d = self.data
+        if d == -1:
+            d = n_devices // (self.model * self.seq)
+        assert d * self.model * self.seq == n_devices, \
+            f"mesh {d}x{self.model}x{self.seq} != {n_devices} devices"
+        return d, self.model, self.seq
+
+
+def make_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    spec = spec or MeshSpec()
+    d, m, s = spec.resolve(len(devices))
+    arr = np.asarray(devices).reshape(d, m, s)
+    return Mesh(arr, axis_names=("data", "model", "seq"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharded(mesh: Mesh) -> NamedSharding:
+    """Batch-dim sharding over the data axis."""
+    return NamedSharding(mesh, P("data"))
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host batch sharded over the data axis."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, data_sharded(mesh)), batch)

@@ -1,0 +1,119 @@
+"""Parallelism tests on the virtual 8-device CPU mesh (SURVEY.md §4.5:
+reference tests distributed behavior via local-mode Spark; our fixture is
+xla_force_host_platform_device_count=8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import MeshSpec, ParallelInference, ParallelTrainer, make_mesh
+
+
+def _net(seed=7, n_in=4, n_out=2, hidden=16):
+    conf = NeuralNetConfig(seed=seed, updater=U.Adam(learning_rate=0.01)).list(
+        L.DenseLayer(n_out=hidden, activation="tanh"),
+        L.OutputLayer(n_out=n_out, loss="mcxent"),
+        input_type=I.FeedForwardType(n_in),
+    )
+    return MultiLayerNetwork(conf)
+
+
+def _data(n=64, n_in=4, n_out=2, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, n_in)
+    y = np.eye(n_out)[rs.randint(0, n_out, n)]
+    return x, y
+
+
+class TestDataParallel:
+    def test_dp_trains_and_matches_single_device_semantics(self, eight_devices):
+        """DP-8 training must produce the same loss trajectory as single-device
+        training on the same global batch (per-step all-reduce is exact)."""
+        x, y = _data(64)
+
+        # single-device baseline
+        net1 = _net()
+        net1.init()
+        step1 = net1.make_train_step(donate=False)
+        p, s, o = net1.params, net1.state, net1.opt_state
+        losses1 = []
+        rngs = [jax.random.PRNGKey(i) for i in range(5)]
+        for i in range(5):
+            p, s, o, loss = step1(p, s, o, jnp.asarray(x), jnp.asarray(y), i, rngs[i], None)
+            losses1.append(float(loss))
+
+        # 8-way data parallel
+        mesh = make_mesh(MeshSpec(data=8), devices=eight_devices)
+        net2 = _net()
+        trainer = ParallelTrainer(net2, mesh).init()
+        losses2 = []
+        for i in range(5):
+            trainer._rng = jax.random.PRNGKey(0)  # keep per-step rng comparable
+            loss = trainer.step(x, y)
+            losses2.append(float(loss))
+
+        # same starting loss (identical seed/init), similar descent
+        assert losses1[0] == pytest.approx(losses2[0], rel=1e-5)
+        assert losses2[-1] < losses2[0]
+
+    def test_dp_params_replicated(self, eight_devices):
+        mesh = make_mesh(MeshSpec(data=8), devices=eight_devices)
+        net = _net()
+        trainer = ParallelTrainer(net, mesh).init()
+        x, y = _data(32)
+        trainer.step(x, y)
+        w = trainer.params[0]["W"]
+        assert w.sharding.is_fully_replicated
+
+    def test_tensor_parallel_shards_weights(self, eight_devices):
+        mesh = make_mesh(MeshSpec(data=4, model=2), devices=eight_devices)
+        net = _net(hidden=16)  # 16 divisible by tp=2
+        trainer = ParallelTrainer(net, mesh, tensor_parallel=True).init()
+        x, y = _data(32)
+        loss0 = float(trainer.step(x, y))
+        loss1 = float(trainer.step(x, y))
+        assert np.isfinite(loss0) and loss1 < loss0 * 1.5
+        w = trainer.params[0]["W"]
+        # W [4,16] sharded over model axis on dim 1
+        spec = w.sharding.spec
+        assert spec[-1] == "model", spec
+
+    def test_sync_to_net_roundtrip(self, eight_devices):
+        mesh = make_mesh(MeshSpec(data=8), devices=eight_devices)
+        net = _net()
+        trainer = ParallelTrainer(net, mesh).init()
+        x, y = _data(32)
+        trainer.step(x, y)
+        trainer.sync_to_net()
+        out = net.output(x)
+        assert out.shape == (32, 2)
+
+
+class TestParallelInference:
+    def test_output_matches_direct(self):
+        net = _net()
+        net.init()
+        x, _ = _data(20)
+        pi = ParallelInference(net, max_batch_size=8)
+        direct = np.asarray(net.output(x))
+        batched = pi.output(x)
+        np.testing.assert_allclose(batched, direct, rtol=1e-5)
+
+    def test_async_batching(self):
+        net = _net()
+        net.init()
+        x, _ = _data(10)
+        pi = ParallelInference(net, max_batch_size=4).start()
+        try:
+            holders = [pi.submit(x[i]) for i in range(10)]
+            results = [h.get(timeout=30) for h in holders]
+        finally:
+            pi.stop()
+        direct = np.asarray(net.output(x))
+        np.testing.assert_allclose(np.stack(results), direct, rtol=1e-5)
