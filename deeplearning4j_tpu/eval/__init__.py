@@ -1,0 +1,4 @@
+from deeplearning4j_tpu.eval.classification import Evaluation, ConfusionMatrix, EvaluationBinary  # noqa: F401
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation  # noqa: F401
+from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass  # noqa: F401
+from deeplearning4j_tpu.eval.calibration import EvaluationCalibration  # noqa: F401

@@ -1,0 +1,61 @@
+"""Calibration evaluation.
+
+Reference analog: org.deeplearning4j.eval.EvaluationCalibration
+(/root/reference/deeplearning4j-nn/.../eval/EvaluationCalibration.java) —
+reliability diagram bins, residual-probability histogram, probability
+histograms per class, expected calibration error.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.eval.classification import _flatten_masked
+
+
+class EvaluationCalibration:
+    def __init__(self, reliability_bins=10, histogram_bins=50):
+        self.rel_bins = reliability_bins
+        self.hist_bins = histogram_bins
+        self._init_done = False
+
+    def _ensure(self, c):
+        if not self._init_done:
+            self.n_classes = c
+            self.bin_count = np.zeros((c, self.rel_bins), np.int64)
+            self.bin_pos = np.zeros((c, self.rel_bins), np.int64)
+            self.bin_prob_sum = np.zeros((c, self.rel_bins), np.float64)
+            self.residual_hist = np.zeros(self.hist_bins, np.int64)
+            self.prob_hist = np.zeros((c, self.hist_bins), np.int64)
+            self._init_done = True
+
+    def eval(self, labels, predictions, mask=None):
+        preds, labels = _flatten_masked(predictions, labels, mask)
+        self._ensure(preds.shape[-1])
+        for c in range(self.n_classes):
+            p = preds[:, c]
+            l = labels[:, c] >= 0.5
+            bins = np.clip((p * self.rel_bins).astype(np.int64), 0, self.rel_bins - 1)
+            np.add.at(self.bin_count[c], bins, 1)
+            np.add.at(self.bin_pos[c], bins[l], 1)
+            np.add.at(self.bin_prob_sum[c], bins, p)
+            hb = np.clip((p * self.hist_bins).astype(np.int64), 0, self.hist_bins - 1)
+            np.add.at(self.prob_hist[c], hb, 1)
+        resid = np.abs(labels - preds).reshape(-1)
+        rb = np.clip((resid * self.hist_bins).astype(np.int64), 0, self.hist_bins - 1)
+        np.add.at(self.residual_hist, rb, 1)
+
+    def reliability_diagram(self, cls):
+        """(mean predicted prob, observed frequency) per bin."""
+        count = np.maximum(self.bin_count[cls], 1)
+        mean_pred = self.bin_prob_sum[cls] / count
+        frac_pos = self.bin_pos[cls] / count
+        return mean_pred, frac_pos
+
+    def expected_calibration_error(self, cls=None):
+        if cls is None:
+            return float(np.mean([self.expected_calibration_error(c)
+                                  for c in range(self.n_classes)]))
+        mean_pred, frac_pos = self.reliability_diagram(cls)
+        weights = self.bin_count[cls] / max(self.bin_count[cls].sum(), 1)
+        return float(np.sum(weights * np.abs(mean_pred - frac_pos)))

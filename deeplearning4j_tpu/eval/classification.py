@@ -1,0 +1,224 @@
+"""Classification evaluation.
+
+Reference analog: org.deeplearning4j.eval.Evaluation (/root/reference/
+deeplearning4j-nn/src/main/java/org/deeplearning4j/eval/Evaluation.java,
+1627 LoC), ConfusionMatrix.java, EvaluationBinary.java. Behavior parity:
+accuracy/precision/recall/F1 with micro & macro averaging, per-class stats,
+top-N accuracy, confusion matrix, time-series masking (flatten [B,T,C] with
+[B,T] mask), stats() pretty-printer.
+
+Device note: metrics accumulate on host in numpy — evaluation is a streaming
+reduction over minibatches, not a jit-hot path; predictions arrive as device
+arrays and are pulled once per batch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _flatten_masked(preds, labels, mask):
+    """[B,C] or [B,T,C] (+[B,T] mask) -> 2-D arrays of kept rows."""
+    preds = np.asarray(preds)
+    labels = np.asarray(labels)
+    if preds.ndim == 3:
+        c = preds.shape[-1]
+        preds = preds.reshape(-1, c)
+        labels = labels.reshape(-1, c)
+        if mask is not None:
+            keep = np.asarray(mask).reshape(-1) > 0
+            preds, labels = preds[keep], labels[keep]
+    elif mask is not None:
+        keep = np.asarray(mask).reshape(-1) > 0
+        preds, labels = preds[keep], labels[keep]
+    return preds, labels
+
+
+class ConfusionMatrix:
+    """Dense integer confusion matrix (reference: eval/ConfusionMatrix.java)."""
+
+    def __init__(self, n_classes):
+        self.n_classes = n_classes
+        self.matrix = np.zeros((n_classes, n_classes), np.int64)
+
+    def add(self, actual, predicted, count=1):
+        self.matrix[actual, predicted] += count
+
+    def add_batch(self, actual, predicted):
+        np.add.at(self.matrix, (actual, predicted), 1)
+
+    def get_count(self, actual, predicted):
+        return int(self.matrix[actual, predicted])
+
+    def total(self):
+        return int(self.matrix.sum())
+
+    def __str__(self):
+        return str(self.matrix)
+
+
+class Evaluation:
+    """Multi-class classification metrics, streaming over minibatches."""
+
+    def __init__(self, n_classes=None, labels=None, top_n=1):
+        self.class_names = list(labels) if labels else None
+        self.n_classes = n_classes or (len(labels) if labels else None)
+        self.top_n = top_n
+        self.confusion = None
+        self.top_n_correct = 0
+        self.total_examples = 0
+
+    def _ensure(self, c):
+        if self.confusion is None:
+            self.n_classes = self.n_classes or c
+            self.confusion = ConfusionMatrix(self.n_classes)
+
+    def eval(self, labels, predictions, mask=None):
+        """labels: one-hot [B,C] (or [B,T,C]); predictions: probabilities."""
+        preds, labels = _flatten_masked(predictions, labels, mask)
+        self._ensure(preds.shape[-1])
+        actual = np.argmax(labels, -1)
+        predicted = np.argmax(preds, -1)
+        self.confusion.add_batch(actual, predicted)
+        self.total_examples += len(actual)
+        if self.top_n > 1:
+            topn = np.argsort(-preds, axis=-1)[:, :self.top_n]
+            self.top_n_correct += int(np.sum(topn == actual[:, None]))
+        else:
+            self.top_n_correct += int(np.sum(actual == predicted))
+
+    # ---- aggregate metrics ----
+
+    def _tp(self, i):
+        return int(self.confusion.matrix[i, i])
+
+    def _fp(self, i):
+        return int(self.confusion.matrix[:, i].sum() - self.confusion.matrix[i, i])
+
+    def _fn(self, i):
+        return int(self.confusion.matrix[i, :].sum() - self.confusion.matrix[i, i])
+
+    def accuracy(self):
+        if self.total_examples == 0:
+            return 0.0
+        return float(np.trace(self.confusion.matrix)) / self.total_examples
+
+    def top_n_accuracy(self):
+        return self.top_n_correct / self.total_examples if self.total_examples else 0.0
+
+    def precision(self, cls=None):
+        if cls is not None:
+            tp, fp = self._tp(cls), self._fp(cls)
+            return tp / (tp + fp) if tp + fp else 0.0
+        return self._macro_avg(self.precision)
+
+    def recall(self, cls=None):
+        if cls is not None:
+            tp, fn = self._tp(cls), self._fn(cls)
+            return tp / (tp + fn) if tp + fn else 0.0
+        return self._macro_avg(self.recall)
+
+    def f1(self, cls=None):
+        if cls is not None:
+            p, r = self.precision(cls), self.recall(cls)
+            return 2 * p * r / (p + r) if p + r else 0.0
+        return self._macro_avg(self.f1)
+
+    def _macro_avg(self, fn):
+        """Macro average over classes that appear (reference: Evaluation
+        averages over classes with at least one true/predicted instance)."""
+        vals = []
+        for i in range(self.n_classes):
+            seen = self.confusion.matrix[i, :].sum() + self.confusion.matrix[:, i].sum()
+            if seen > 0:
+                vals.append(fn(i))
+        return float(np.mean(vals)) if vals else 0.0
+
+    def micro_precision(self):
+        tp = sum(self._tp(i) for i in range(self.n_classes))
+        fp = sum(self._fp(i) for i in range(self.n_classes))
+        return tp / (tp + fp) if tp + fp else 0.0
+
+    def micro_recall(self):
+        tp = sum(self._tp(i) for i in range(self.n_classes))
+        fn = sum(self._fn(i) for i in range(self.n_classes))
+        return tp / (tp + fn) if tp + fn else 0.0
+
+    def matthews_correlation(self, cls):
+        tp, fp, fn = self._tp(cls), self._fp(cls), self._fn(cls)
+        tn = self.total_examples - tp - fp - fn
+        denom = np.sqrt(float((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)))
+        return (tp * tn - fp * fn) / denom if denom else 0.0
+
+    def stats(self):
+        name = lambda i: (self.class_names[i] if self.class_names else str(i))
+        lines = ["========================Evaluation Metrics========================",
+                 f" # of classes: {self.n_classes}",
+                 f" Accuracy: {self.accuracy():.4f}",
+                 f" Precision: {self.precision():.4f}",
+                 f" Recall: {self.recall():.4f}",
+                 f" F1 Score: {self.f1():.4f}"]
+        if self.top_n > 1:
+            lines.append(f" Top-{self.top_n} Accuracy: {self.top_n_accuracy():.4f}")
+        lines.append("\n=========================Confusion Matrix=========================")
+        lines.append(str(self.confusion))
+        lines.append("Per-class: " + ", ".join(
+            f"{name(i)}: P={self.precision(i):.3f} R={self.recall(i):.3f} F1={self.f1(i):.3f}"
+            for i in range(self.n_classes)))
+        return "\n".join(lines)
+
+
+class EvaluationBinary:
+    """Per-output independent binary evaluation for multi-label sigmoid
+    outputs (reference: eval/EvaluationBinary.java), with optional decision
+    threshold per output."""
+
+    def __init__(self, n_outputs=None, thresholds=None):
+        self.n_outputs = n_outputs
+        self.thresholds = thresholds
+        self.tp = None
+        self.fp = None
+        self.tn = None
+        self.fn = None
+
+    def _ensure(self, c):
+        if self.tp is None:
+            self.n_outputs = self.n_outputs or c
+            z = lambda: np.zeros(self.n_outputs, np.int64)
+            self.tp, self.fp, self.tn, self.fn = z(), z(), z(), z()
+
+    def eval(self, labels, predictions, mask=None):
+        preds, labels = _flatten_masked(predictions, labels, mask)
+        self._ensure(preds.shape[-1])
+        thr = self.thresholds if self.thresholds is not None else 0.5
+        p = (preds >= thr).astype(np.int64)
+        l = (labels >= 0.5).astype(np.int64)
+        self.tp += ((p == 1) & (l == 1)).sum(0)
+        self.fp += ((p == 1) & (l == 0)).sum(0)
+        self.tn += ((p == 0) & (l == 0)).sum(0)
+        self.fn += ((p == 0) & (l == 1)).sum(0)
+
+    def accuracy(self, i):
+        tot = self.tp[i] + self.fp[i] + self.tn[i] + self.fn[i]
+        return float(self.tp[i] + self.tn[i]) / tot if tot else 0.0
+
+    def precision(self, i):
+        d = self.tp[i] + self.fp[i]
+        return float(self.tp[i]) / d if d else 0.0
+
+    def recall(self, i):
+        d = self.tp[i] + self.fn[i]
+        return float(self.tp[i]) / d if d else 0.0
+
+    def f1(self, i):
+        p, r = self.precision(i), self.recall(i)
+        return 2 * p * r / (p + r) if p + r else 0.0
+
+    def average_accuracy(self):
+        return float(np.mean([self.accuracy(i) for i in range(self.n_outputs)]))
+
+    def stats(self):
+        return "\n".join(
+            f"out {i}: acc={self.accuracy(i):.3f} P={self.precision(i):.3f} "
+            f"R={self.recall(i):.3f} F1={self.f1(i):.3f}"
+            for i in range(self.n_outputs))

@@ -1,0 +1,204 @@
+"""Evaluation suite tests (reference: deeplearning4j-core eval tests —
+EvaluationTest, ROCTest, RegressionEvalTest, EvaluationCalibrationTest)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval import (Evaluation, EvaluationBinary, EvaluationCalibration,
+                                     ROC, ROCBinary, ROCMultiClass, RegressionEvaluation)
+
+
+def _onehot(idx, c):
+    return np.eye(c)[idx]
+
+
+class TestEvaluation:
+    def test_perfect_predictions(self):
+        e = Evaluation()
+        y = _onehot([0, 1, 2, 1, 0], 3)
+        e.eval(y, y * 0.9 + 0.05)
+        assert e.accuracy() == 1.0
+        assert e.precision() == 1.0
+        assert e.recall() == 1.0
+        assert e.f1() == 1.0
+
+    def test_known_confusion(self):
+        e = Evaluation(n_classes=2)
+        labels = _onehot([0, 0, 0, 0, 1, 1], 2)
+        preds = _onehot([0, 0, 1, 1, 1, 0], 2).astype(float)
+        e.eval(labels, preds)
+        # class0: tp=2 fn=2 fp=1; class1: tp=1 fn=1 fp=2
+        assert e.accuracy() == pytest.approx(3 / 6)
+        assert e.precision(0) == pytest.approx(2 / 3)
+        assert e.recall(0) == pytest.approx(2 / 4)
+        assert e.confusion.get_count(0, 1) == 2
+        assert "Accuracy" in e.stats()
+
+    def test_streaming_equals_single_batch(self):
+        rs = np.random.RandomState(0)
+        labels = _onehot(rs.randint(0, 4, 100), 4)
+        preds = rs.dirichlet(np.ones(4), 100)
+        e1 = Evaluation()
+        e1.eval(labels, preds)
+        e2 = Evaluation()
+        for i in range(0, 100, 17):
+            e2.eval(labels[i:i + 17], preds[i:i + 17])
+        assert e1.accuracy() == e2.accuracy()
+        assert e1.f1() == pytest.approx(e2.f1())
+
+    def test_top_n(self):
+        e = Evaluation(top_n=2)
+        labels = _onehot([0, 1, 2], 3)
+        preds = np.array([[0.5, 0.4, 0.1],   # top1 correct
+                          [0.45, 0.35, 0.2],  # top2 correct
+                          [0.5, 0.3, 0.2]])   # wrong even top2
+        e.eval(labels, preds)
+        assert e.accuracy() == pytest.approx(1 / 3)
+        assert e.top_n_accuracy() == pytest.approx(2 / 3)
+
+    def test_time_series_masking(self):
+        labels = np.zeros((2, 3, 2))
+        preds = np.zeros((2, 3, 2))
+        labels[:, :, 0] = 1
+        preds[:, :, 0] = 0.9
+        preds[:, :, 1] = 0.1
+        # second example: wrong at masked step 2 -> must not count
+        preds[1, 2] = [0.1, 0.9]
+        mask = np.array([[1, 1, 1], [1, 1, 0]])
+        e = Evaluation()
+        e.eval(labels, preds, mask)
+        assert e.total_examples == 5
+        assert e.accuracy() == 1.0
+
+
+class TestEvaluationBinary:
+    def test_multilabel(self):
+        e = EvaluationBinary()
+        labels = np.array([[1, 0], [1, 1], [0, 0], [0, 1]])
+        preds = np.array([[0.9, 0.2], [0.8, 0.4], [0.3, 0.1], [0.2, 0.7]])
+        e.eval(labels, preds)
+        assert e.accuracy(0) == 1.0
+        assert e.recall(1) == pytest.approx(0.5)  # one of two positives found
+
+
+class TestROC:
+    def test_perfect_separation(self):
+        roc = ROC()
+        labels = np.array([0, 0, 1, 1])
+        preds = np.array([0.1, 0.2, 0.8, 0.9])
+        roc.eval(labels, preds)
+        assert roc.auc() == pytest.approx(1.0)
+
+    def test_random_is_half(self):
+        rs = np.random.RandomState(0)
+        labels = rs.randint(0, 2, 5000)
+        preds = rs.rand(5000)
+        roc = ROC()
+        roc.eval(labels, preds)
+        assert roc.auc() == pytest.approx(0.5, abs=0.05)
+
+    def test_exact_matches_sklearn_formula(self):
+        """AUC == P(score_pos > score_neg) + 0.5 P(tie) (Mann-Whitney)."""
+        rs = np.random.RandomState(3)
+        labels = rs.randint(0, 2, 300)
+        preds = np.round(rs.rand(300), 2)  # force ties
+        roc = ROC()
+        roc.eval(labels, preds)
+        pos = preds[labels == 1]
+        neg = preds[labels == 0]
+        gt = (pos[:, None] > neg[None, :]).mean()
+        tie = (pos[:, None] == neg[None, :]).mean()
+        assert roc.auc() == pytest.approx(gt + 0.5 * tie, abs=1e-9)
+
+    def test_thresholded_close_to_exact(self):
+        rs = np.random.RandomState(1)
+        labels = rs.randint(0, 2, 2000)
+        preds = np.clip(labels * 0.3 + rs.rand(2000) * 0.7, 0, 1)
+        exact = ROC()
+        exact.eval(labels, preds)
+        binned = ROC(threshold_steps=200)
+        binned.eval(labels, preds)
+        assert binned.auc() == pytest.approx(exact.auc(), abs=0.02)
+
+    def test_onehot_input(self):
+        roc = ROC()
+        labels = _onehot([0, 0, 1, 1], 2)
+        preds = np.array([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.1, 0.9]])
+        roc.eval(labels, preds)
+        assert roc.auc() == pytest.approx(1.0)
+
+    def test_auprc(self):
+        roc = ROC()
+        labels = np.array([0, 0, 1, 1])
+        preds = np.array([0.1, 0.2, 0.8, 0.9])
+        roc.eval(labels, preds)
+        assert roc.auprc() == pytest.approx(1.0, abs=1e-6)
+
+    def test_multiclass(self):
+        rs = np.random.RandomState(2)
+        labels = _onehot(rs.randint(0, 3, 200), 3)
+        preds = np.abs(labels * 0.7 + rs.dirichlet(np.ones(3), 200) * 0.3)
+        rm = ROCMultiClass()
+        rm.eval(labels, preds)
+        assert rm.average_auc() > 0.9
+
+    def test_roc_binary(self):
+        labels = np.array([[1, 0], [0, 1], [1, 1], [0, 0]])
+        preds = np.array([[0.9, 0.1], [0.1, 0.9], [0.8, 0.8], [0.2, 0.2]])
+        rb = ROCBinary()
+        rb.eval(labels, preds)
+        assert rb.auc(0) == pytest.approx(1.0)
+        assert rb.average_auc() == pytest.approx(1.0)
+
+
+class TestRegression:
+    def test_known_values(self):
+        r = RegressionEvaluation()
+        labels = np.array([[1.0], [2.0], [3.0]])
+        preds = np.array([[1.5], [2.0], [2.5]])
+        r.eval(labels, preds)
+        assert r.mean_squared_error(0) == pytest.approx((0.25 + 0 + 0.25) / 3)
+        assert r.mean_absolute_error(0) == pytest.approx(1.0 / 3)
+
+    def test_perfect_correlation(self):
+        rs = np.random.RandomState(0)
+        labels = rs.randn(100, 2)
+        r = RegressionEvaluation()
+        r.eval(labels, labels)
+        assert r.pearson_correlation(0) == pytest.approx(1.0)
+        assert r.r_squared(1) == pytest.approx(1.0)
+        assert r.average_r_squared() == pytest.approx(1.0)
+
+    def test_streaming(self):
+        rs = np.random.RandomState(1)
+        labels = rs.randn(90, 1)
+        preds = labels + 0.1 * rs.randn(90, 1)
+        r1 = RegressionEvaluation()
+        r1.eval(labels, preds)
+        r2 = RegressionEvaluation()
+        for i in range(0, 90, 30):
+            r2.eval(labels[i:i + 30], preds[i:i + 30])
+        assert r1.mean_squared_error(0) == pytest.approx(r2.mean_squared_error(0))
+        assert r1.pearson_correlation(0) == pytest.approx(r2.pearson_correlation(0))
+
+
+class TestCalibration:
+    def test_well_calibrated(self):
+        rs = np.random.RandomState(0)
+        p = rs.rand(20000)
+        labels_bin = (rs.rand(20000) < p).astype(float)
+        labels = np.stack([1 - labels_bin, labels_bin], 1)
+        preds = np.stack([1 - p, p], 1)
+        c = EvaluationCalibration()
+        c.eval(labels, preds)
+        assert c.expected_calibration_error(1) < 0.02
+
+    def test_miscalibrated(self):
+        n = 5000
+        preds = np.full((n, 2), [0.1, 0.9])
+        labels = np.zeros((n, 2))
+        labels[: n // 2, 1] = 1  # true frequency 0.5, predicted 0.9
+        labels[n // 2:, 0] = 1
+        c = EvaluationCalibration()
+        c.eval(labels, preds)
+        assert c.expected_calibration_error(1) > 0.3
