@@ -98,6 +98,10 @@ class ROC:
 
     def auprc(self):
         precision, recall = self.precision_recall_curve()
+        if len(recall) == 0:
+            return 0.0
+        precision = np.r_[precision[0], precision]  # extend flat to recall=0
+        recall = np.r_[0.0, recall]
         return float(np.trapezoid(precision, recall))
 
 
