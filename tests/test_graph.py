@@ -1,0 +1,227 @@
+"""ComputationGraph tests (reference: deeplearning4j-core graph tests —
+TestComputationGraphNetwork, TestGraphNodes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.graph import (ComputationGraph, DuplicateToTimeSeriesVertex,
+                                         ElementWiseVertex, GraphBuilder,
+                                         GraphConfiguration, L2NormalizeVertex, L2Vertex,
+                                         LastTimeStepVertex, MergeVertex, ScaleVertex,
+                                         ShiftVertex, StackVertex, SubsetVertex,
+                                         UnstackVertex)
+from deeplearning4j_tpu.utils.gradcheck import check_gradients
+
+
+def _simple_graph():
+    return (GraphBuilder(updater=U.Adam(learning_rate=0.01), seed=3)
+            .add_inputs("in")
+            .set_input_types(I.FeedForwardType(4))
+            .add_layer("d1", L.DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out", L.OutputLayer(n_out=2, loss="mcxent"), "d1")
+            .set_outputs("out")
+            .build())
+
+
+class TestTopology:
+    def test_topo_order_respects_deps(self):
+        conf = (GraphBuilder()
+                .add_inputs("in")
+                .set_input_types(I.FeedForwardType(4))
+                .add_layer("b", L.DenseLayer(n_out=4), "a")
+                .add_layer("a", L.DenseLayer(n_out=4), "in")
+                .add_layer("out", L.OutputLayer(n_out=2), "b")
+                .set_outputs("out")
+                .build())
+        order = conf.topological_order()
+        assert order.index("a") < order.index("b") < order.index("out")
+
+    def test_cycle_detection(self):
+        with pytest.raises(ValueError, match="cycle"):
+            (GraphBuilder()
+             .add_inputs("in")
+             .set_input_types(I.FeedForwardType(4))
+             .add_layer("a", L.DenseLayer(n_out=4), "b")
+             .add_layer("b", L.DenseLayer(n_out=4), "a")
+             .set_outputs("b")
+             .build())
+
+    def test_undefined_input(self):
+        with pytest.raises(ValueError, match="undefined"):
+            (GraphBuilder()
+             .add_inputs("in")
+             .set_input_types(I.FeedForwardType(4))
+             .add_layer("a", L.DenseLayer(n_out=4), "nope")
+             .set_outputs("a")
+             .build())
+
+    def test_shape_inference_merge(self):
+        conf = (GraphBuilder()
+                .add_inputs("in")
+                .set_input_types(I.FeedForwardType(4))
+                .add_layer("a", L.DenseLayer(n_out=3), "in")
+                .add_layer("b", L.DenseLayer(n_out=5), "in")
+                .add_vertex("m", MergeVertex(), "a", "b")
+                .add_layer("out", L.OutputLayer(n_out=2), "m")
+                .set_outputs("out")
+                .build())
+        assert conf.vertex_types()["m"] == I.FeedForwardType(8)
+
+
+class TestTraining:
+    def test_simple_graph_learns(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 4)
+        w = rs.randn(4)
+        y_cls = (x @ w > 0).astype(int)
+        y = np.eye(2)[y_cls]
+        g = ComputationGraph(_simple_graph())
+        g.init()
+        s0 = g.score(x, y)
+        g.fit(x, y, epochs=30)
+        assert g.score(x, y) < s0 * 0.7
+        preds = np.asarray(g.output(x))
+        assert float(np.mean(np.argmax(preds, 1) == y_cls)) > 0.85
+
+    def test_residual_block(self):
+        """ElementWise add skip-connection (the ResNet pattern)."""
+        conf = (GraphBuilder(updater=U.Adam(learning_rate=0.01))
+                .add_inputs("in")
+                .set_input_types(I.FeedForwardType(8))
+                .add_layer("d1", L.DenseLayer(n_out=8, activation="relu"), "in")
+                .add_vertex("res", ElementWiseVertex(op="add"), "d1", "in")
+                .add_layer("out", L.OutputLayer(n_out=2, loss="mcxent"), "res")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf)
+        rs = np.random.RandomState(1)
+        x = rs.randn(32, 8)
+        y = np.eye(2)[rs.randint(0, 2, 32)]
+        g.fit(x, y, epochs=5)
+        assert np.isfinite(g.score(x, y))
+
+    def test_multi_input_multi_output(self):
+        conf = (GraphBuilder(updater=U.Adam(learning_rate=0.01))
+                .add_inputs("a", "b")
+                .set_input_types(I.FeedForwardType(3), I.FeedForwardType(3))
+                .add_vertex("m", MergeVertex(), "a", "b")
+                .add_layer("h", L.DenseLayer(n_out=8, activation="tanh"), "m")
+                .add_layer("out1", L.OutputLayer(n_out=2, loss="mcxent"), "h")
+                .add_layer("out2", L.OutputLayer(n_out=1, loss="mse", activation="identity"), "h")
+                .set_outputs("out1", "out2")
+                .build())
+        g = ComputationGraph(conf)
+        rs = np.random.RandomState(2)
+        xa, xb = rs.randn(16, 3), rs.randn(16, 3)
+        y1 = np.eye(2)[rs.randint(0, 2, 16)]
+        y2 = rs.randn(16, 1)
+        g.fit({"a": xa, "b": xb}, {"out1": y1, "out2": y2}, epochs=3)
+        outs = g.output({"a": xa, "b": xb})
+        assert outs["out1"].shape == (16, 2)
+        assert outs["out2"].shape == (16, 1)
+
+    def test_rnn_vertices(self):
+        """LastTimeStep + DuplicateToTimeSeries round trip."""
+        conf = (GraphBuilder(updater=U.Adam(learning_rate=0.01))
+                .add_inputs("seq")
+                .set_input_types(I.RecurrentType(3, 5))
+                .add_layer("lstm", L.LSTM(n_out=6), "seq")
+                .add_vertex("last", LastTimeStepVertex(), "lstm")
+                .add_layer("out", L.OutputLayer(n_out=2, loss="mcxent"), "last")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf)
+        rs = np.random.RandomState(3)
+        x = rs.randn(8, 5, 3)
+        y = np.eye(2)[rs.randint(0, 2, 8)]
+        g.fit(x, y, epochs=3)
+        assert g.output(x).shape == (8, 2)
+
+
+class TestVertices:
+    def test_elementwise_ops(self):
+        a = jnp.array([[1.0, 2.0]])
+        b = jnp.array([[3.0, 4.0]])
+        assert np.allclose(ElementWiseVertex(op="add").apply({}, {}, [a, b])[0], [[4, 6]])
+        assert np.allclose(ElementWiseVertex(op="subtract").apply({}, {}, [a, b])[0], [[-2, -2]])
+        assert np.allclose(ElementWiseVertex(op="product").apply({}, {}, [a, b])[0], [[3, 8]])
+        assert np.allclose(ElementWiseVertex(op="average").apply({}, {}, [a, b])[0], [[2, 3]])
+        assert np.allclose(ElementWiseVertex(op="max").apply({}, {}, [a, b])[0], [[3, 4]])
+
+    def test_subset(self):
+        x = jnp.arange(12.0).reshape(2, 6)
+        y, _ = SubsetVertex(from_idx=1, to_idx=3).apply({}, {}, [x])
+        assert y.shape == (2, 3)
+        np.testing.assert_array_equal(np.asarray(y[0]), [1, 2, 3])
+
+    def test_stack_unstack(self):
+        a, b = jnp.ones((2, 3)), 2 * jnp.ones((2, 3))
+        s, _ = StackVertex().apply({}, {}, [a, b])
+        assert s.shape == (4, 3)
+        u, _ = UnstackVertex(index=1, stack_size=2).apply({}, {}, [s])
+        np.testing.assert_array_equal(np.asarray(u), np.asarray(b))
+
+    def test_scale_shift(self):
+        x = jnp.ones((1, 2))
+        assert float(ScaleVertex(factor=3.0).apply({}, {}, [x])[0][0, 0]) == 3.0
+        assert float(ShiftVertex(amount=2.0).apply({}, {}, [x])[0][0, 0]) == 3.0
+
+    def test_l2_normalize(self):
+        x = jnp.array([[3.0, 4.0]])
+        y, _ = L2NormalizeVertex().apply({}, {}, [x])
+        np.testing.assert_allclose(np.asarray(y), [[0.6, 0.8]], rtol=1e-6)
+
+    def test_l2_distance(self):
+        a = jnp.array([[0.0, 0.0]])
+        b = jnp.array([[3.0, 4.0]])
+        y, _ = L2Vertex().apply({}, {}, [a, b])
+        assert float(y[0, 0]) == pytest.approx(5.0, rel=1e-4)
+
+    def test_duplicate_to_timeseries(self):
+        x = jnp.array([[1.0, 2.0]])
+        y, _ = DuplicateToTimeSeriesVertex(timesteps=4).apply({}, {}, [x])
+        assert y.shape == (1, 4, 2)
+
+
+class TestGraphGradcheck:
+    def test_merge_residual_gradcheck(self):
+        conf = (GraphBuilder(seed=11)
+                .add_inputs("in")
+                .set_input_types(I.FeedForwardType(4))
+                .add_layer("d1", L.DenseLayer(n_out=4, activation="tanh"), "in")
+                .add_vertex("res", ElementWiseVertex(op="add"), "d1", "in")
+                .add_layer("d2", L.DenseLayer(n_out=3, activation="tanh"), "res")
+                .add_layer("out", L.OutputLayer(n_out=2, loss="mcxent"), "d2")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf)
+        params, state = g.init(dtype=jnp.float64)
+        rs = np.random.RandomState(5)
+        x = jnp.asarray(rs.randn(4, 4))
+        y = jnp.asarray(np.eye(2)[rs.randint(0, 2, 4)])
+
+        def loss_fn(p):
+            loss, _ = g.loss_fn(p, state, x, y, train=False)
+            return loss
+
+        ok, failures = check_gradients(loss_fn, params, max_params_per_leaf=20)
+        assert ok, failures[:5]
+
+
+class TestGraphSerde:
+    def test_roundtrip(self):
+        conf = _simple_graph()
+        js = conf.to_json()
+        conf2 = GraphConfiguration.from_json(js)
+        assert conf2 == conf
+        g1, g2 = ComputationGraph(conf), ComputationGraph(conf2)
+        g1.init()
+        g2.init()
+        rs = np.random.RandomState(6)
+        x = rs.randn(3, 4)
+        np.testing.assert_allclose(np.asarray(g1.output(x)), np.asarray(g2.output(x)), rtol=1e-6)
