@@ -1,0 +1,169 @@
+"""Transfer learning.
+
+Reference analog: nn/transferlearning/ in /root/reference/deeplearning4j-nn —
+TransferLearning.java (847 LoC: Builder rebuilding a trained net with frozen
+layers / replaced outputs), FineTuneConfiguration.java (global overrides),
+TransferLearningHelper.java (featurization: split at frozen boundary).
+
+TPU-native: "freezing" is functional — frozen layers' gradients are zeroed via
+stop_gradient in the train step (no FrozenLayer wrapper class mutating state);
+the featurize path jit-compiles the frozen prefix once and caches activations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+@dataclasses.dataclass
+class FineTuneConfiguration:
+    """Overrides applied to every layer when fine-tuning (reference:
+    FineTuneConfiguration.java)."""
+
+    updater: object = None
+    l1: float = None
+    l2: float = None
+    dropout: float = None
+    seed: int = None
+
+    def apply_to(self, conf: MultiLayerConfiguration) -> MultiLayerConfiguration:
+        layer_updates = {}
+        for f in ("l1", "l2", "dropout"):
+            v = getattr(self, f)
+            if v is not None:
+                layer_updates[f] = v
+        new_layers = tuple(
+            dataclasses.replace(l, **{k: v for k, v in layer_updates.items()
+                                      if hasattr(l, k)}) if layer_updates else l
+            for l in conf.layers)
+        kwargs = {"layers": new_layers}
+        if self.updater is not None:
+            kwargs["updater"] = self.updater
+        if self.seed is not None:
+            kwargs["seed"] = self.seed
+        return dataclasses.replace(conf, **kwargs)
+
+
+class TransferLearning:
+    """Builder (reference: TransferLearning.Builder)."""
+
+    def __init__(self, net: MultiLayerNetwork):
+        assert net.params is not None, "source network must be initialized/trained"
+        self._src = net
+        self._freeze_until = -1  # layers [0, freeze_until] frozen
+        self._fine_tune = None
+        self._removed_from = None
+        self._appended = []
+        self._replaced = {}
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+        self._fine_tune = ftc
+        return self
+
+    def set_feature_extractor(self, layer_idx):
+        """Freeze layers 0..layer_idx inclusive."""
+        self._freeze_until = layer_idx
+        return self
+
+    def remove_output_layer(self):
+        self._removed_from = len(self._src.conf.layers) - 1
+        return self
+
+    def remove_layers_from(self, layer_idx):
+        self._removed_from = layer_idx
+        return self
+
+    def replace_layer(self, idx, new_layer):
+        self._replaced[idx] = new_layer
+        return self
+
+    def add_layer(self, layer):
+        self._appended.append(layer)
+        return self
+
+    def build(self) -> MultiLayerNetwork:
+        src_conf = self._src.conf
+        keep = len(src_conf.layers) if self._removed_from is None else self._removed_from
+        layers = [self._replaced.get(i, l) for i, l in enumerate(src_conf.layers[:keep])]
+        layers += self._appended
+        conf = dataclasses.replace(src_conf, layers=tuple(layers))
+        if self._fine_tune is not None:
+            conf = self._fine_tune.apply_to(conf)
+        net = MultiLayerNetwork(conf)
+        net.frozen_layers = tuple(range(self._freeze_until + 1))
+        net.init()
+        # copy weights for kept, non-replaced layers (real copies: the new
+        # net's train step donates its buffers, which must not invalidate
+        # the source network's arrays)
+        for i in range(keep):
+            if i not in self._replaced:
+                net.params[i] = jax.tree_util.tree_map(jnp.copy, self._src.params[i])
+                net.state[i] = jax.tree_util.tree_map(jnp.copy, self._src.state[i])
+        net.opt_state = conf.updater.init(net.params)
+        _install_freeze(net)
+        return net
+
+
+def _install_freeze(net):
+    """Wrap the network's train step so frozen layers receive zero updates
+    (reference: FrozenLayer.java semantics — no backprop into frozen params)."""
+    frozen = set(getattr(net, "frozen_layers", ()))
+    if not frozen:
+        return
+    orig_make = net.make_train_step
+
+    def make_train_step(donate=True, jit=True):
+        base = orig_make(donate=False, jit=False)
+
+        def step(params, state, opt_state, x, y, it, rng, mask=None):
+            new_params, new_state, new_opt, loss = base(params, state, opt_state,
+                                                        x, y, it, rng, mask)
+            # restore frozen params exactly (zero effective update)
+            new_params = [params[i] if i in frozen else p
+                          for i, p in enumerate(new_params)]
+            return new_params, new_state, new_opt, loss
+
+        if not jit:
+            return step
+        return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+
+    net.make_train_step = make_train_step
+
+
+class TransferLearningHelper:
+    """Featurization at the frozen boundary (reference:
+    TransferLearningHelper.java): run inputs through the frozen prefix once,
+    then train only the unfrozen tail on cached features."""
+
+    def __init__(self, net: MultiLayerNetwork, frozen_until: int):
+        self.net = net
+        self.frozen_until = frozen_until
+        self._prefix = jax.jit(
+            lambda p, s, x: net.apply_fn(p, s, x, train=False,
+                                         layer_limit=frozen_until + 1)[0])
+
+    def featurize(self, x):
+        return self._prefix(self.net.params, self.net.state, jnp.asarray(x))
+
+    def unfrozen_net(self):
+        """A network over the unfrozen tail layers, sharing params."""
+        conf = self.net.conf
+        tail_layers = conf.layers[self.frozen_until + 1:]
+        types, _ = conf.layer_input_types()
+        tail_input = types[self.frozen_until + 1] if self.frozen_until + 1 < len(types) \
+            else conf.input_type
+        tail_conf = dataclasses.replace(conf, layers=tuple(tail_layers),
+                                        input_type=tail_input)
+        tail = MultiLayerNetwork(tail_conf)
+        tail.params = [jax.tree_util.tree_map(jnp.copy, p)
+                       for p in self.net.params[self.frozen_until + 1:]]
+        tail.state = [jax.tree_util.tree_map(jnp.copy, s)
+                      for s in self.net.state[self.frozen_until + 1:]]
+        tail.opt_state = tail_conf.updater.init(tail.params)
+        return tail

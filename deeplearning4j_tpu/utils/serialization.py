@@ -1,0 +1,93 @@
+"""Model persistence: zip of {config JSON, params, mutable state, updater state}.
+
+Reference analog: util/ModelSerializer.java (/root/reference/deeplearning4j-nn/
+.../util/ModelSerializer.java:51 writeModel, :136 restoreMultiLayerNetwork) —
+zip container with JSON config + raw params + updater state, so optimizer
+momentum survives resume (SURVEY.md §5 checkpoint row). Format is versioned
+for forward-compat (the reference pins it with regression tests §4.4).
+
+Layout inside the zip:
+    format.json     {"format_version": 1, "kind": "multilayer"|"graph",
+                     "iteration": N, "epoch": N}
+    config.json     network configuration (serde JSON)
+    arrays.npz      flat {path -> ndarray} for params/state/opt_state pytrees
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+
+import jax
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _flatten_tree(tree, prefix):
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        flat[prefix + jax.tree_util.keystr(path)] = np.asarray(leaf)
+    return flat
+
+
+def _unflatten_like(template, arrays, prefix):
+    paths = [prefix + jax.tree_util.keystr(p)
+             for p, _ in jax.tree_util.tree_flatten_with_path(template)[0]]
+    treedef = jax.tree_util.tree_structure(template)
+    import jax.numpy as jnp
+    leaves = [jnp.asarray(arrays[p]) for p in paths]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_model(net, path, *, save_updater=True):
+    """Write a MultiLayerNetwork or ComputationGraph checkpoint."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    kind = "graph" if isinstance(net, ComputationGraph) else "multilayer"
+    arrays = {}
+    arrays.update(_flatten_tree(net.params, "params"))
+    arrays.update(_flatten_tree(net.state, "state"))
+    if save_updater and net.opt_state is not None:
+        arrays.update(_flatten_tree(net.opt_state, "opt"))
+    meta = {"format_version": FORMAT_VERSION, "kind": kind,
+            "iteration": net.iteration, "epoch": net.epoch,
+            "has_updater": bool(save_updater and net.opt_state is not None)}
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("format.json", json.dumps(meta))
+        z.writestr("config.json", net.conf.to_json())
+        z.writestr("arrays.npz", buf.getvalue())
+    return path
+
+
+def load_model(path):
+    """Restore a model (auto-detects kind). Returns the network with params,
+    state, opt_state, iteration/epoch restored."""
+    with zipfile.ZipFile(path) as z:
+        meta = json.loads(z.read("format.json"))
+        config_json = z.read("config.json").decode()
+        arrays = dict(np.load(io.BytesIO(z.read("arrays.npz"))))
+    if meta["format_version"] > FORMAT_VERSION:
+        raise ValueError(f"Checkpoint format {meta['format_version']} is newer "
+                         f"than supported {FORMAT_VERSION}")
+    if meta["kind"] == "graph":
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphConfiguration
+        net = ComputationGraph(GraphConfiguration.from_json(config_json))
+    else:
+        from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork(MultiLayerConfiguration.from_json(config_json))
+    net.init()  # build template pytrees (then overwrite)
+    net.params = _unflatten_like(net.params, arrays, "params")
+    net.state = _unflatten_like(net.state, arrays, "state")
+    if meta.get("has_updater"):
+        net.opt_state = _unflatten_like(net.opt_state, arrays, "opt")
+    net.iteration = meta.get("iteration", 0)
+    net.epoch = meta.get("epoch", 0)
+    return net
+
+
+restore_multilayer_network = load_model
+restore_computation_graph = load_model

@@ -1,0 +1,176 @@
+"""Checkpoint/serialization, early stopping, transfer learning tests
+(reference: ModelSerializer tests, EarlyStoppingTests, TransferLearning tests
+in deeplearning4j-core)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+from deeplearning4j_tpu.nn.earlystopping import (BestScoreTermination, DataSetLossCalculator,
+                                                 EarlyStoppingConfiguration,
+                                                 EarlyStoppingTrainer, InMemoryModelSaver,
+                                                 LocalFileModelSaver, MaxEpochsTermination,
+                                                 MaxScoreIterationTermination,
+                                                 ScoreImprovementEpochsTermination)
+from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transfer import (FineTuneConfiguration, TransferLearning,
+                                            TransferLearningHelper)
+from deeplearning4j_tpu.utils.serialization import load_model, save_model
+
+
+def _net_and_data(seed=7):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(32, 4)
+    y = np.eye(2)[rs.randint(0, 2, 32)]
+    conf = NeuralNetConfig(seed=seed, updater=U.Adam(learning_rate=0.01)).list(
+        L.DenseLayer(n_out=8, activation="tanh"),
+        L.OutputLayer(n_out=2, loss="mcxent"),
+        input_type=I.FeedForwardType(4),
+    )
+    return MultiLayerNetwork(conf), x, y
+
+
+class TestSerialization:
+    def test_multilayer_roundtrip(self, tmp_path):
+        net, x, y = _net_and_data()
+        net.fit(x, y, epochs=3)
+        p = tmp_path / "model.zip"
+        save_model(net, str(p))
+        net2 = load_model(str(p))
+        np.testing.assert_allclose(np.asarray(net.output(x)),
+                                   np.asarray(net2.output(x)), rtol=1e-6)
+        assert net2.iteration == net.iteration
+
+    def test_updater_state_survives_resume(self, tmp_path):
+        """Training after restore must equal uninterrupted training
+        (reference: updater state in the zip means momentum survives)."""
+        net, x, y = _net_and_data()
+        net.fit(x, y, epochs=5)
+        p = tmp_path / "ck.zip"
+        save_model(net, str(p))
+        net.fit(x, y, epochs=5)
+        expected = np.asarray(net.output(x))
+
+        resumed = load_model(str(p))
+        resumed.fit(x, y, epochs=5)
+        np.testing.assert_allclose(np.asarray(resumed.output(x)), expected, rtol=1e-4)
+
+    def test_graph_roundtrip(self, tmp_path):
+        conf = (GraphBuilder(updater=U.Adam(learning_rate=0.01), seed=3)
+                .add_inputs("in")
+                .set_input_types(I.FeedForwardType(4))
+                .add_layer("d", L.DenseLayer(n_out=6, activation="relu"), "in")
+                .add_layer("out", L.OutputLayer(n_out=2, loss="mcxent"), "d")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf)
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 4)
+        y = np.eye(2)[rs.randint(0, 2, 8)]
+        g.fit(x, y, epochs=2)
+        p = tmp_path / "graph.zip"
+        save_model(g, str(p))
+        g2 = load_model(str(p))
+        assert isinstance(g2, ComputationGraph)
+        np.testing.assert_allclose(np.asarray(g.output(x)), np.asarray(g2.output(x)),
+                                   rtol=1e-6)
+
+
+class TestEarlyStopping:
+    def test_max_epochs(self):
+        net, x, y = _net_and_data()
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(x, y),
+            epoch_terminations=(MaxEpochsTermination(4),))
+        result = EarlyStoppingTrainer(cfg, net, x, y).fit()
+        assert result.total_epochs == 4
+        assert result.termination_details == "MaxEpochsTermination"
+        assert result.best_epoch >= 1
+
+    def test_best_score_restored(self):
+        net, x, y = _net_and_data()
+        saver = InMemoryModelSaver()
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(x, y),
+            epoch_terminations=(MaxEpochsTermination(6),), saver=saver)
+        result = EarlyStoppingTrainer(cfg, net, x, y).fit()
+        assert saver.best is not None
+        best_net = result.best_model
+        assert best_net.score(x, y) == pytest.approx(result.best_score, rel=0.2)
+
+    def test_score_improvement_termination(self):
+        net, x, y = _net_and_data()
+        # lr=0 -> no improvement -> stops after patience
+        net.conf = net.conf.__class__(**{**net.conf.__dict__,
+                                         "updater": U.Sgd(learning_rate=0.0)})
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(x, y),
+            epoch_terminations=(ScoreImprovementEpochsTermination(2),
+                                MaxEpochsTermination(50)))
+        result = EarlyStoppingTrainer(cfg, net, x, y).fit()
+        assert result.total_epochs <= 5
+        assert result.termination_details == "ScoreImprovementEpochsTermination"
+
+    def test_local_file_saver(self, tmp_path):
+        net, x, y = _net_and_data()
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(x, y),
+            epoch_terminations=(MaxEpochsTermination(2),),
+            saver=LocalFileModelSaver(str(tmp_path)), save_last_model=True)
+        EarlyStoppingTrainer(cfg, net, x, y).fit()
+        assert (tmp_path / "bestModel.zip").exists()
+        assert (tmp_path / "latestModel.zip").exists()
+
+
+class TestTransferLearning:
+    def test_frozen_layers_unchanged(self):
+        net, x, y = _net_and_data()
+        net.fit(x, y, epochs=3)
+        new_net = (TransferLearning(net)
+                   .set_feature_extractor(0)
+                   .build())
+        w_before = np.asarray(new_net.params[0]["W"]).copy()
+        new_net.fit(x, y, epochs=3)
+        np.testing.assert_array_equal(np.asarray(new_net.params[0]["W"]), w_before)
+        # unfrozen output layer DID change
+        assert not np.allclose(np.asarray(new_net.params[1]["W"]),
+                               np.asarray(net.params[1]["W"]))
+
+    def test_replace_output_layer(self):
+        net, x, y = _net_and_data()
+        net.fit(x, y, epochs=2)
+        rs = np.random.RandomState(1)
+        y5 = np.eye(5)[rs.randint(0, 5, 32)]
+        new_net = (TransferLearning(net)
+                   .remove_output_layer()
+                   .add_layer(L.OutputLayer(n_out=5, loss="mcxent"))
+                   .build())
+        # hidden weights copied
+        np.testing.assert_array_equal(np.asarray(new_net.params[0]["W"]),
+                                      np.asarray(net.params[0]["W"]))
+        new_net.fit(x, y5, epochs=2)
+        assert new_net.output(x).shape == (32, 5)
+
+    def test_fine_tune_configuration(self):
+        net, x, y = _net_and_data()
+        net.fit(x, y, epochs=1)
+        new_net = (TransferLearning(net)
+                   .fine_tune_configuration(FineTuneConfiguration(
+                       updater=U.Sgd(learning_rate=0.001), l2=1e-3))
+                   .build())
+        assert isinstance(new_net.conf.updater, U.Sgd)
+        assert new_net.conf.layers[0].l2 == 1e-3
+
+    def test_featurize_helper(self):
+        net, x, y = _net_and_data()
+        net.fit(x, y, epochs=2)
+        helper = TransferLearningHelper(net, frozen_until=0)
+        feats = np.asarray(helper.featurize(x))
+        assert feats.shape == (32, 8)
+        tail = helper.unfrozen_net()
+        preds = tail.output(feats)
+        np.testing.assert_allclose(np.asarray(preds), np.asarray(net.output(x)), rtol=1e-5)
