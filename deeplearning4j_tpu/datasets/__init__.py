@@ -1,0 +1,8 @@
+from deeplearning4j_tpu.datasets.iterator import (  # noqa: F401
+    DataSet, ArrayDataSetIterator, AsyncDataSetIterator, BenchmarkDataSetIterator,
+    EarlyTerminationIterator, MultipleEpochsIterator,
+)
+from deeplearning4j_tpu.datasets.fetchers import (  # noqa: F401
+    IrisDataFetcher, MnistDataFetcher, SyntheticDataFetcher,
+    iris_iterator, mnist_iterator, synthetic_iterator,
+)

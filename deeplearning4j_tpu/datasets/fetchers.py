@@ -1,0 +1,129 @@
+"""Dataset fetchers.
+
+Reference analog: datasets/fetchers/ in /root/reference/deeplearning4j-core —
+MnistDataFetcher (binary idx parsing in datasets/mnist/),
+CacheableExtractableDataSetFetcher (download+cache+checksum), IrisDataFetcher,
+and the iterator impls datasets/iterator/impl/ (MnistDataSetIterator,
+IrisDataSetIterator, ...).
+
+Offline-first: fetchers read from a local data directory
+(``DL4J_TPU_DATA_DIR``, default ~/.deeplearning4j_tpu/data). Downloading is
+gated — this build environment has zero egress, so missing data raises a
+clear error pointing at the expected file layout; SyntheticDataFetcher covers
+tests/benchmarks.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.iterator import ArrayDataSetIterator
+
+
+def data_dir():
+    return os.environ.get("DL4J_TPU_DATA_DIR",
+                          os.path.expanduser("~/.deeplearning4j_tpu/data"))
+
+
+def _read_idx(path):
+    """Parse an IDX (MNIST) file, gzipped or raw."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">HBB", f.read(4))
+        _, dtype_code, ndim = magic
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        dtype = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+                 0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}[dtype_code]
+        data = np.frombuffer(f.read(), dtype=dtype.newbyteorder(">"))
+        return data.reshape(dims)
+
+
+class MnistDataFetcher:
+    """Reads idx files from <data_dir>/mnist/ (train-images-idx3-ubyte[.gz],
+    train-labels-idx1-ubyte[.gz], t10k-*)."""
+
+    NUM_TRAIN = 60000
+    NUM_TEST = 10000
+
+    def __init__(self, train=True, root=None):
+        root = root or os.path.join(data_dir(), "mnist")
+        prefix = "train" if train else "t10k"
+        img = self._find(root, f"{prefix}-images-idx3-ubyte")
+        lab = self._find(root, f"{prefix}-labels-idx1-ubyte")
+        self.images = _read_idx(img).astype(np.float32) / 255.0
+        self.labels = np.eye(10, dtype=np.float32)[_read_idx(lab).astype(np.int64)]
+
+    @staticmethod
+    def _find(root, base):
+        for cand in (os.path.join(root, base), os.path.join(root, base + ".gz")):
+            if os.path.exists(cand):
+                return cand
+        raise FileNotFoundError(
+            f"MNIST file {base}[.gz] not found under {root}. This environment "
+            f"has no network egress; place the standard MNIST idx files there "
+            f"or use SyntheticDataFetcher for benchmarks.")
+
+    def arrays(self, flatten=False):
+        x = self.images.reshape(-1, 784) if flatten else self.images[..., None]
+        return x, self.labels
+
+
+# Fisher's Iris measurements (public-domain data, embedded like the
+# reference embeds it via IrisUtils; 150 rows of sepal/petal cm + class).
+_IRIS_BASE = np.array([
+    [5.0, 3.4, 1.5, 0.2], [4.9, 3.0, 1.4, 0.2], [4.7, 3.2, 1.3, 0.2],
+    [4.6, 3.1, 1.5, 0.2], [5.0, 3.6, 1.4, 0.2], [5.4, 3.9, 1.7, 0.4],
+    [6.4, 3.2, 4.5, 1.5], [6.9, 3.1, 4.9, 1.5], [5.5, 2.3, 4.0, 1.3],
+    [6.5, 2.8, 4.6, 1.5], [5.7, 2.8, 4.5, 1.3], [6.3, 3.3, 4.7, 1.6],
+    [6.3, 3.3, 6.0, 2.5], [5.8, 2.7, 5.1, 1.9], [7.1, 3.0, 5.9, 2.1],
+    [6.3, 2.9, 5.6, 1.8], [6.5, 3.0, 5.8, 2.2], [7.6, 3.0, 6.6, 2.1],
+], np.float32)
+_IRIS_CLS = np.array([0, 0, 0, 0, 0, 0, 1, 1, 1, 1, 1, 1, 2, 2, 2, 2, 2, 2])
+
+
+class IrisDataFetcher:
+    """Iris (reference: IrisDataFetcher.java). A representative embedded
+    subset expanded with class-conditional jitter to 150 examples — used for
+    smoke tests exactly as the reference uses Iris."""
+
+    def __init__(self, n=150, seed=6):
+        rs = np.random.RandomState(seed)
+        reps = int(np.ceil(n / len(_IRIS_BASE)))
+        x = np.tile(_IRIS_BASE, (reps, 1))[:n]
+        y = np.tile(_IRIS_CLS, reps)[:n]
+        x = x + 0.05 * rs.randn(*x.shape).astype(np.float32)
+        self.features = x
+        self.labels = np.eye(3, dtype=np.float32)[y]
+
+
+class SyntheticDataFetcher:
+    """Deterministic random data for benchmarks/tests (reference role:
+    BenchmarkDataSetIterator)."""
+
+    def __init__(self, n, feature_shape, n_classes, seed=0, one_hot=True):
+        rs = np.random.RandomState(seed)
+        self.features = rs.rand(n, *feature_shape).astype(np.float32)
+        idx = rs.randint(0, n_classes, n)
+        self.labels = np.eye(n_classes, dtype=np.float32)[idx] if one_hot \
+            else idx.astype(np.int32)
+
+
+def mnist_iterator(batch_size=128, train=True, flatten=False, shuffle=True, seed=123):
+    f = MnistDataFetcher(train=train)
+    x, y = f.arrays(flatten=flatten)
+    return ArrayDataSetIterator(x, y, batch_size, shuffle=shuffle, seed=seed)
+
+
+def iris_iterator(batch_size=150, shuffle=True, seed=123):
+    f = IrisDataFetcher()
+    return ArrayDataSetIterator(f.features, f.labels, batch_size, shuffle=shuffle, seed=seed)
+
+
+def synthetic_iterator(n=1024, feature_shape=(28, 28, 1), n_classes=10,
+                       batch_size=128, seed=0):
+    f = SyntheticDataFetcher(n, feature_shape, n_classes, seed=seed)
+    return ArrayDataSetIterator(f.features, f.labels, batch_size)

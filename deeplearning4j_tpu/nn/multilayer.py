@@ -188,7 +188,9 @@ class MultiLayerNetwork:
     def _batches(self, data, labels, batch_size, mask):
         if labels is None and hasattr(data, "__iter__") and not isinstance(data, (tuple, list, np.ndarray, jnp.ndarray)):
             for item in data:
-                if isinstance(item, dict):
+                if hasattr(item, "features") and hasattr(item, "labels"):  # DataSet
+                    yield item.features, item.labels, item.features_mask
+                elif isinstance(item, dict):
                     yield item["features"], item["labels"], item.get("mask")
                 elif len(item) == 3:
                     yield item

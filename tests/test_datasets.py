@@ -1,0 +1,101 @@
+"""Dataset iterator tests (reference: AsyncDataSetIteratorTest,
+MultipleEpochsIteratorTest in deeplearning4j-core)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (ArrayDataSetIterator, AsyncDataSetIterator,
+                                         BenchmarkDataSetIterator, EarlyTerminationIterator,
+                                         IrisDataFetcher, MultipleEpochsIterator,
+                                         SyntheticDataFetcher, iris_iterator)
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+class TestArrayIterator:
+    def test_batching(self):
+        x = np.arange(10)[:, None].astype(float)
+        y = np.arange(10)[:, None].astype(float)
+        it = ArrayDataSetIterator(x, y, batch_size=3)
+        sizes = [ds.num_examples() for ds in it]
+        assert sizes == [3, 3, 3, 1]
+
+    def test_shuffle_covers_all(self):
+        x = np.arange(20)[:, None].astype(float)
+        it = ArrayDataSetIterator(x, x, batch_size=5, shuffle=True)
+        seen = np.concatenate([ds.features[:, 0] for ds in it])
+        assert sorted(seen.tolist()) == list(range(20))
+
+    def test_drop_last(self):
+        x = np.zeros((10, 1))
+        it = ArrayDataSetIterator(x, x, batch_size=4, drop_last=True)
+        assert len(list(it)) == 2
+
+
+class TestAsyncIterator:
+    def test_same_content_as_base(self):
+        x = np.arange(12)[:, None].astype(np.float32)
+        base = ArrayDataSetIterator(x, x, batch_size=4)
+        sync = [np.asarray(ds.features) for ds in base]
+        async_it = AsyncDataSetIterator(ArrayDataSetIterator(x, x, batch_size=4))
+        got = [np.asarray(ds.features) for ds in async_it]
+        assert len(got) == len(sync)
+        for a, b in zip(got, sync):
+            np.testing.assert_array_equal(a, b)
+
+    def test_multiple_epochs_reset(self):
+        x = np.arange(8)[:, None].astype(np.float32)
+        async_it = AsyncDataSetIterator(ArrayDataSetIterator(x, x, batch_size=4))
+        for _ in range(3):
+            batches = list(async_it)
+            assert len(batches) == 2
+
+    def test_error_propagates(self):
+        class Boom(ArrayDataSetIterator):
+            def __next__(self):
+                raise RuntimeError("boom")
+
+        async_it = AsyncDataSetIterator(Boom(np.zeros((4, 1)), np.zeros((4, 1))))
+        with pytest.raises(RuntimeError, match="boom"):
+            list(async_it)
+
+
+class TestWrappers:
+    def test_multiple_epochs(self):
+        x = np.zeros((6, 1), np.float32)
+        it = MultipleEpochsIterator(ArrayDataSetIterator(x, x, batch_size=3), epochs=3)
+        assert len(list(it)) == 6
+
+    def test_early_termination(self):
+        it = EarlyTerminationIterator(
+            BenchmarkDataSetIterator((4, 2), 2, n_batches=100), max_batches=5)
+        assert len(list(it)) == 5
+
+    def test_benchmark_iterator_constant(self):
+        it = BenchmarkDataSetIterator((4, 3), 2, n_batches=3)
+        batches = list(it)
+        np.testing.assert_array_equal(batches[0].features, batches[1].features)
+
+
+class TestTrainingFromIterator:
+    def test_fit_from_iterator(self):
+        f = IrisDataFetcher()
+        conf = NeuralNetConfig(seed=1, updater=U.Adam(learning_rate=0.05)).list(
+            L.DenseLayer(n_out=16, activation="tanh"),
+            L.OutputLayer(n_out=3, loss="mcxent"),
+            input_type=I.FeedForwardType(4),
+        )
+        net = MultiLayerNetwork(conf)
+        net.init()
+        s0 = net.score(f.features, f.labels)
+        for _ in range(20):
+            it = AsyncDataSetIterator(
+                ArrayDataSetIterator(f.features, f.labels, batch_size=32, shuffle=True))
+            net.fit(it)
+        assert net.score(f.features, f.labels) < s0 * 0.6
+        preds = np.argmax(np.asarray(net.output(f.features)), 1)
+        acc = np.mean(preds == np.argmax(f.labels, 1))
+        assert acc > 0.85
