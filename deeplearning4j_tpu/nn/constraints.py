@@ -1,0 +1,86 @@
+"""Parameter constraints (projections applied after each update).
+
+Reference analog: nn/conf/constraint/ in /root/reference/deeplearning4j-nn —
+MaxNormConstraint, MinMaxNormConstraint, NonNegativeConstraint,
+UnitNormConstraint; applied by applyConstraints after the optimizer step
+(StochasticGradientDescent.java:97).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.utils.serde import register_config
+
+
+def _param_keys(layer, params, apply_to):
+    if apply_to == "weights":
+        return [k for k in params if k in getattr(layer, "WEIGHT_KEYS", ("W",))]
+    if apply_to == "biases":
+        return [k for k in params if k in getattr(layer, "BIAS_KEYS", ("b",))]
+    return list(params)
+
+
+def _col_norms(w):
+    """L2 norm per output unit (last axis), matching the reference's
+    per-output-neuron norm convention."""
+    axes = tuple(range(w.ndim - 1))
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True) + 1e-12)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class MaxNormConstraint:
+    max_norm: float = 2.0
+    apply_to: str = "weights"
+
+    def apply(self, layer, params, iteration, epoch):
+        out = dict(params)
+        for k in _param_keys(layer, params, self.apply_to):
+            norms = _col_norms(out[k])
+            out[k] = out[k] * jnp.minimum(1.0, self.max_norm / norms)
+        return out
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class MinMaxNormConstraint:
+    min_norm: float = 0.0
+    max_norm: float = 2.0
+    rate: float = 1.0
+    apply_to: str = "weights"
+
+    def apply(self, layer, params, iteration, epoch):
+        out = dict(params)
+        for k in _param_keys(layer, params, self.apply_to):
+            norms = _col_norms(out[k])
+            clipped = jnp.clip(norms, self.min_norm, self.max_norm)
+            target = self.rate * clipped + (1 - self.rate) * norms
+            out[k] = out[k] * (target / norms)
+        return out
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class NonNegativeConstraint:
+    apply_to: str = "all"
+
+    def apply(self, layer, params, iteration, epoch):
+        out = dict(params)
+        for k in _param_keys(layer, params, self.apply_to):
+            out[k] = jnp.maximum(out[k], 0.0)
+        return out
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class UnitNormConstraint:
+    apply_to: str = "weights"
+
+    def apply(self, layer, params, iteration, epoch):
+        out = dict(params)
+        for k in _param_keys(layer, params, self.apply_to):
+            out[k] = out[k] / _col_norms(out[k])
+        return out

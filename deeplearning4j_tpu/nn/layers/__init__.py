@@ -14,3 +14,6 @@ from deeplearning4j_tpu.nn.layers.rnn import (  # noqa: F401
     LSTM, GravesLSTM, GravesBidirectionalLSTM, SimpleRnn, RnnOutputLayer,
     RnnLossLayer, LastTimeStep, Bidirectional,
 )
+from deeplearning4j_tpu.nn.layers.vae import VariationalAutoencoder  # noqa: F401
+from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer  # noqa: F401
+from deeplearning4j_tpu.nn.layers.centerloss import CenterLossOutputLayer  # noqa: F401

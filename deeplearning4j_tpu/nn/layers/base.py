@@ -74,6 +74,7 @@ class ParamLayer(Layer):
     l1_bias: float = dataclasses.field(default=0.0, kw_only=True)
     l2_bias: float = dataclasses.field(default=0.0, kw_only=True)
     constraints: tuple = dataclasses.field(default=(), kw_only=True)
+    weight_noise: object = dataclasses.field(default=None, kw_only=True)
 
     WEIGHT_KEYS = ("W",)
     BIAS_KEYS = ("b",)

@@ -1,0 +1,122 @@
+"""YOLOv2 object-detection output layer.
+
+Reference analog: nn/conf/layers/objdetect/Yolo2OutputLayer.java + nn/layers/
+objdetect/Yolo2OutputLayer.java (721 LoC) + DetectedObject.java in
+/root/reference/deeplearning4j-nn.
+
+Input: conv activations [B, H, W, A*(5+C)] (NHWC; A = anchors, 5 = tx ty tw
+th confidence). Labels: [B, H, W, 5+C] per grid cell — (indicator, cx, cy, w,
+h in grid units) + one-hot class; indicator 1 marks the cell containing an
+object center. Loss (Redmon et al. YOLOv2, same structure as the reference):
+  lambda_coord * position/size MSE (sqrt on w/h)
++ confidence MSE toward IOU (lambda_noobj on empty cells)
++ class cross-entropy on object cells.
+The responsible anchor per object cell is the one with best IOU against the
+ground-truth box — computed with pure array ops (argmax over the anchor
+axis), jit-friendly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import inputs as _inputs
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.utils.serde import register_config
+
+
+def _iou_wh(w1, h1, w2, h2):
+    """IOU of boxes sharing a center."""
+    inter = jnp.minimum(w1, w2) * jnp.minimum(h1, h2)
+    union = w1 * h1 + w2 * h2 - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class Yolo2OutputLayer(Layer):
+    anchors: tuple = ((1.0, 1.0), (2.0, 2.0))  # (w, h) in grid units
+    lambda_coord: float = 5.0
+    lambda_noobj: float = 0.5
+
+    input_family = _inputs.ConvolutionalType
+
+    @property
+    def n_anchors(self):
+        return len(self.anchors)
+
+    def output_type(self, input_type):
+        return input_type
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return x, state
+
+    def _decode(self, x):
+        """Raw conv output -> per-anchor (xy in [0,1], wh in grid units,
+        confidence, class probs)."""
+        b, h, w, _ = x.shape
+        a = self.n_anchors
+        x = x.reshape(b, h, w, a, -1)
+        txy = jax.nn.sigmoid(x[..., 0:2])
+        anchors = jnp.asarray(self.anchors, x.dtype)  # [A, 2]
+        twh = jnp.exp(jnp.clip(x[..., 2:4], -8, 8)) * anchors
+        conf = jax.nn.sigmoid(x[..., 4])
+        cls = jax.nn.softmax(x[..., 5:], axis=-1)
+        return txy, twh, conf, cls
+
+    def compute_loss(self, predictions, labels, mask=None):
+        txy, twh, conf, cls = self._decode(predictions)
+        b, h, w, a, _ = txy.shape
+        indicator = labels[..., 0]                     # [B,H,W]
+        gt_xy = labels[..., 1:3]                       # offsets within cell [0,1]
+        gt_wh = labels[..., 3:5]                       # grid units
+        gt_cls = labels[..., 5:]
+
+        # responsible anchor: best IOU(anchor prior, gt box) per object cell
+        anchors = jnp.asarray(self.anchors, predictions.dtype)
+        prior_iou = _iou_wh(anchors[None, None, None, :, 0], anchors[None, None, None, :, 1],
+                            gt_wh[..., None, 0], gt_wh[..., None, 1])  # [B,H,W,A]
+        best = jnp.argmax(prior_iou, axis=-1)          # [B,H,W]
+        resp = jax.nn.one_hot(best, a, dtype=predictions.dtype) * indicator[..., None]
+
+        # position/size loss (sqrt w/h like the paper & reference)
+        pos = jnp.sum((txy - gt_xy[..., None, :]) ** 2, axis=-1)
+        size = jnp.sum((jnp.sqrt(twh) - jnp.sqrt(gt_wh[..., None, :])) ** 2, axis=-1)
+        loss_coord = self.lambda_coord * jnp.sum(resp * (pos + size))
+
+        # confidence toward IOU(predicted box, gt box)
+        pred_iou = _iou_wh(twh[..., 0], twh[..., 1],
+                           gt_wh[..., None, 0], gt_wh[..., None, 1])
+        loss_obj = jnp.sum(resp * (conf - pred_iou) ** 2)
+        loss_noobj = self.lambda_noobj * jnp.sum((1.0 - resp) * conf**2)
+
+        # class cross-entropy on object cells
+        ce = -jnp.sum(gt_cls[..., None, :] * jnp.log(jnp.clip(cls, 1e-9, 1.0)), axis=-1)
+        loss_cls = jnp.sum(resp * ce)
+
+        return (loss_coord + loss_obj + loss_noobj + loss_cls) / b
+
+    def get_predicted_objects(self, predictions, threshold=0.5):
+        """Detections above a confidence threshold (host-side; reference:
+        YoloUtils.getPredictedObjects). Returns list per batch element of
+        (conf, cx, cy, w, h, class_idx) in grid units."""
+        import numpy as np
+        txy, twh, conf, cls = self._decode(predictions)
+        txy, twh = np.asarray(txy), np.asarray(twh)
+        conf, cls = np.asarray(conf), np.asarray(cls)
+        b, h, w, a = conf.shape
+        out = []
+        for bi in range(b):
+            dets = []
+            ys, xs, ans = np.where(conf[bi] > threshold)
+            for y, x, an in zip(ys, xs, ans):
+                cx = x + txy[bi, y, x, an, 0]
+                cy = y + txy[bi, y, x, an, 1]
+                bw, bh = twh[bi, y, x, an]
+                dets.append((float(conf[bi, y, x, an]), float(cx), float(cy),
+                             float(bw), float(bh), int(np.argmax(cls[bi, y, x, an]))))
+            out.append(dets)
+        return out

@@ -1,0 +1,146 @@
+"""Variational autoencoder layer.
+
+Reference analog: nn/conf/layers/variational/ (7 config files incl.
+VariationalAutoencoder.java, GaussianReconstructionDistribution,
+BernoulliReconstructionDistribution) + nn/layers/variational/
+VariationalAutoencoder.java (1163 LoC) in /root/reference/deeplearning4j-nn.
+
+Encoder MLP -> (mean, logvar) of q(z|x); reparameterized sample; decoder MLP
+-> reconstruction-distribution parameters. Supervised forward (the layer used
+inside a net) outputs the posterior mean, matching the reference's activate().
+``pretrain_loss`` = -ELBO = -E[log p(x|z)] + KL(q(z|x) || N(0,I)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations as _act
+from deeplearning4j_tpu.nn import initializers as _init
+from deeplearning4j_tpu.nn.conf import inputs as _inputs
+from deeplearning4j_tpu.nn.layers.base import ParamLayer
+from deeplearning4j_tpu.nn.layers.core import matmul
+from deeplearning4j_tpu.utils.serde import register_config
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class VariationalAutoencoder(ParamLayer):
+    n_latent: int = 2
+    encoder_layer_sizes: tuple = (64,)
+    decoder_layer_sizes: tuple = (64,)
+    reconstruction: str = "gaussian"  # gaussian (learned diag var) | bernoulli
+    num_samples: int = 1
+    activation: object = dataclasses.field(default="relu", kw_only=True)
+
+    input_family = _inputs.FeedForwardType
+
+    def output_type(self, input_type):
+        return _inputs.FeedForwardType(self.n_latent)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = _inputs.adapted_type(input_type, _inputs.FeedForwardType).size
+        p = {}
+
+        def dense(key, name, a, b):
+            k1, k2 = jax.random.split(key)
+            p[f"{name}_W"] = _init.init_weight(self.weight_init, k1, (a, b), a, b, dtype)
+            p[f"{name}_b"] = jnp.zeros((b,), dtype)
+
+        sizes = [n_in, *self.encoder_layer_sizes]
+        for i in range(len(sizes) - 1):
+            key, sub = jax.random.split(key)
+            dense(sub, f"enc{i}", sizes[i], sizes[i + 1])
+        key, k_mean, k_var = jax.random.split(key, 3)
+        dense(k_mean, "z_mean", sizes[-1], self.n_latent)
+        dense(k_var, "z_logvar", sizes[-1], self.n_latent)
+        dsizes = [self.n_latent, *self.decoder_layer_sizes]
+        for i in range(len(dsizes) - 1):
+            key, sub = jax.random.split(key)
+            dense(sub, f"dec{i}", dsizes[i], dsizes[i + 1])
+        out_dim = 2 * n_in if self.reconstruction == "gaussian" else n_in
+        key, k_out = jax.random.split(key)
+        dense(k_out, "x_out", dsizes[-1], out_dim)
+        return p
+
+    # ---- internals ----
+
+    def _mlp(self, params, prefix, n, h):
+        act = self.activation_fn()
+        for i in range(n):
+            h = act(matmul(h, params[f"{prefix}{i}_W"]) + params[f"{prefix}{i}_b"])
+        return h
+
+    def encode(self, params, x):
+        h = self._mlp(params, "enc", len(self.encoder_layer_sizes), x)
+        mean = matmul(h, params["z_mean_W"]) + params["z_mean_b"]
+        logvar = matmul(h, params["z_logvar_W"]) + params["z_logvar_b"]
+        return mean, logvar
+
+    def decode(self, params, z):
+        h = self._mlp(params, "dec", len(self.decoder_layer_sizes), z)
+        return matmul(h, params["x_out_W"]) + params["x_out_b"]
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        mean, _ = self.encode(params, x)
+        return mean, state
+
+    def reconstruct(self, params, x, rng=None):
+        mean, logvar = self.encode(params, x)
+        z = mean if rng is None else \
+            mean + jnp.exp(0.5 * logvar) * jax.random.normal(rng, mean.shape, mean.dtype)
+        out = self.decode(params, z)
+        if self.reconstruction == "bernoulli":
+            return jax.nn.sigmoid(out)
+        return out[..., :out.shape[-1] // 2]  # gaussian mean half
+
+    def pretrain_loss(self, params, x, rng):
+        """-ELBO averaged over the batch (reference: computeGradientAndScore
+        of the VAE layer in pretrain mode)."""
+        mean, logvar = self.encode(params, x)
+        kl = 0.5 * jnp.sum(jnp.exp(logvar) + mean**2 - 1.0 - logvar, axis=-1)
+        rec = 0.0
+        for s in range(self.num_samples):
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+                eps = jax.random.normal(sub, mean.shape, mean.dtype)
+            else:
+                eps = 0.0
+            z = mean + jnp.exp(0.5 * logvar) * eps
+            out = self.decode(params, z)
+            if self.reconstruction == "gaussian":
+                n_in = out.shape[-1] // 2
+                x_mean, x_logvar = out[..., :n_in], out[..., n_in:]
+                ll = -0.5 * jnp.sum(
+                    x_logvar + (x - x_mean) ** 2 / jnp.exp(x_logvar)
+                    + jnp.log(2 * jnp.pi), axis=-1)
+            else:
+                p = jnp.clip(jax.nn.sigmoid(out), 1e-7, 1 - 1e-7)
+                ll = jnp.sum(x * jnp.log(p) + (1 - x) * jnp.log(1 - p), axis=-1)
+            rec = rec + ll
+        rec = rec / self.num_samples
+        return jnp.mean(kl - rec)
+
+    def reconstruction_probability(self, params, x, rng, num_samples=8):
+        """Monte-Carlo estimate of log p(x) used for anomaly scoring
+        (reference: VariationalAutoencoder.reconstructionProbability)."""
+        mean, logvar = self.encode(params, x)
+        total = None
+        for s in range(num_samples):
+            rng, sub = jax.random.split(rng)
+            eps = jax.random.normal(sub, mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * logvar) * eps
+            out = self.decode(params, z)
+            if self.reconstruction == "gaussian":
+                n_in = out.shape[-1] // 2
+                x_mean, x_logvar = out[..., :n_in], out[..., n_in:]
+                ll = -0.5 * jnp.sum(x_logvar + (x - x_mean) ** 2 / jnp.exp(x_logvar)
+                                    + jnp.log(2 * jnp.pi), axis=-1)
+            else:
+                p = jnp.clip(jax.nn.sigmoid(out), 1e-7, 1 - 1e-7)
+                ll = jnp.sum(x * jnp.log(p) + (1 - x) * jnp.log(1 - p), axis=-1)
+            total = ll if total is None else jnp.logaddexp(total, ll)
+        return total - jnp.log(float(num_samples))

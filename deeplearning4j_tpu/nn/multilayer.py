@@ -98,7 +98,12 @@ class MultiLayerNetwork:
                 rng, sub = jax.random.split(rng)
             else:
                 sub = None
-            x, new_state[i] = layer.apply(params[i], state[i], x, train=train,
+            layer_params = params[i]
+            wn = getattr(layer, "weight_noise", None)
+            if train and wn is not None and sub is not None and layer_params:
+                sub, noise_rng = jax.random.split(sub)
+                layer_params = wn.perturb(noise_rng, layer, layer_params)
+            x, new_state[i] = layer.apply(layer_params, state[i], x, train=train,
                                           rng=sub, **kwargs)
             cur_type = layer.output_type(cur_type)
         return x, new_state
@@ -108,14 +113,24 @@ class MultiLayerNetwork:
         """Score = output-layer loss + L1/L2 penalties (reference:
         computeGradientAndScore at MultiLayerNetwork.java:2255 + calcL1/calcL2).
         Returns (loss, (new_state, predictions))."""
-        preds, new_state = self.apply_fn(params, state, x, train=train, rng=rng,
-                                         mask=mask)
         out_layer = self.conf.layers[-1]
-        if not hasattr(out_layer, "compute_loss"):
-            raise ValueError("Last layer must be an output/loss layer, got "
-                             f"{type(out_layer).__name__}")
         lm = label_mask if label_mask is not None else mask
-        loss = out_layer.compute_loss(preds, y, lm)
+        if hasattr(out_layer, "loss_from_features"):
+            # center-loss style heads need their input features for the loss
+            feats, new_state = self.apply_fn(params, state, x, train=train,
+                                             rng=rng, mask=mask,
+                                             layer_limit=len(self.conf.layers) - 1)
+            loss, preds, out_state = out_layer.loss_from_features(
+                params[-1], state[-1], feats, y, lm, train=train)
+            new_state = list(new_state)
+            new_state[-1] = out_state
+        else:
+            preds, new_state = self.apply_fn(params, state, x, train=train,
+                                             rng=rng, mask=mask)
+            if not hasattr(out_layer, "compute_loss"):
+                raise ValueError("Last layer must be an output/loss layer, got "
+                                 f"{type(out_layer).__name__}")
+            loss = out_layer.compute_loss(preds, y, lm)
         for layer, p in zip(self.conf.layers, params):
             if p:
                 loss = loss + layer.regularization_penalty(p)

@@ -1,0 +1,62 @@
+"""Weight noise (applied to params during training forward passes).
+
+Reference analog: nn/conf/weightnoise/ in /root/reference/deeplearning4j-nn —
+WeightNoise (additive/multiplicative distribution noise), DropConnect
+(per-weight dropout). Functional design: the network perturbs a layer's
+params pytree before apply() when training; the gradient flows through the
+perturbed weights exactly as the reference's noisy-param path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.initializers import Distribution
+from deeplearning4j_tpu.utils.serde import register_config
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class WeightNoise:
+    distribution: Distribution = dataclasses.field(
+        default_factory=lambda: Distribution(kind="normal", mean=0.0, std=0.01))
+    additive: bool = True
+    apply_to_bias: bool = False
+
+    def perturb(self, rng, layer, params):
+        out = {}
+        for k, v in params.items():
+            is_bias = k in getattr(layer, "BIAS_KEYS", ("b",))
+            if is_bias and not self.apply_to_bias:
+                out[k] = v
+                continue
+            rng, sub = jax.random.split(rng)
+            noise = self.distribution.sample(sub, v.shape, v.dtype)
+            out[k] = v + noise if self.additive else v * noise
+        return out
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class DropConnect:
+    """Per-weight bernoulli dropout with inverted scaling (reference:
+    nn/conf/weightnoise/DropConnect.java)."""
+
+    weight_retain_prob: float = 0.5
+    apply_to_bias: bool = False
+
+    def perturb(self, rng, layer, params):
+        out = {}
+        keep = self.weight_retain_prob
+        for k, v in params.items():
+            is_bias = k in getattr(layer, "BIAS_KEYS", ("b",))
+            if is_bias and not self.apply_to_bias:
+                out[k] = v
+                continue
+            rng, sub = jax.random.split(rng)
+            mask = jax.random.bernoulli(sub, keep, v.shape)
+            out[k] = jnp.where(mask, v / keep, 0.0)
+        return out

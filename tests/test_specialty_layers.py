@@ -1,0 +1,249 @@
+"""VAE, YOLO2, CenterLoss, constraints, weight-noise tests (reference:
+VaeGradientCheckTests, YoloGradientCheckTests, TestConstraints in
+deeplearning4j-core)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+from deeplearning4j_tpu.nn.constraints import (MaxNormConstraint, NonNegativeConstraint,
+                                               UnitNormConstraint)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.weightnoise import DropConnect, WeightNoise
+from deeplearning4j_tpu.utils.gradcheck import check_gradients
+
+F64 = jnp.float64
+
+
+class TestVAE:
+    def test_pretrain_loss_decreases(self):
+        rs = np.random.RandomState(0)
+        # learnable structure: noisy repetitions of 4 binary prototypes
+        protos = rs.randint(0, 2, (4, 8)).astype(np.float64)
+        x = jnp.asarray(np.clip(protos[rs.randint(0, 4, 64)]
+                                + 0.05 * rs.randn(64, 8), 0, 1))
+        vae = L.VariationalAutoencoder(n_latent=2, encoder_layer_sizes=(16,),
+                                       decoder_layer_sizes=(16,),
+                                       reconstruction="bernoulli")
+        params = vae.init(jax.random.PRNGKey(0), I.FeedForwardType(8), dtype=F64)
+        upd = U.Adam(learning_rate=0.01)
+        opt = upd.init(params)
+        rng = jax.random.PRNGKey(1)
+
+        @jax.jit
+        def step(params, opt, rng, i):
+            rng, sub = jax.random.split(rng)
+            loss, g = jax.value_and_grad(vae.pretrain_loss)(params, x, sub)
+            ups, opt = upd.update(g, opt, params, i)
+            return jax.tree_util.tree_map(lambda p, u: p + u, params, ups), opt, rng, loss
+
+        losses = []
+        for i in range(60):
+            params, opt, rng, loss = step(params, opt, rng, i)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] - 0.5, (losses[0], losses[-1])
+
+    def test_vae_gradcheck(self):
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.rand(4, 5))
+        vae = L.VariationalAutoencoder(n_latent=2, encoder_layer_sizes=(6,),
+                                       decoder_layer_sizes=(6,),
+                                       reconstruction="gaussian", activation="tanh")
+        params = vae.init(jax.random.PRNGKey(2), I.FeedForwardType(5), dtype=F64)
+
+        def loss_fn(p):
+            return vae.pretrain_loss(p, x, None)  # deterministic (no sampling)
+
+        ok, failures = check_gradients(loss_fn, params, max_params_per_leaf=15)
+        assert ok, failures[:5]
+
+    def test_reconstruction_probability(self):
+        rs = np.random.RandomState(2)
+        x = jnp.asarray(rs.rand(8, 5))
+        vae = L.VariationalAutoencoder(n_latent=2, encoder_layer_sizes=(8,),
+                                       decoder_layer_sizes=(8,), reconstruction="bernoulli")
+        params = vae.init(jax.random.PRNGKey(3), I.FeedForwardType(5))
+        ll = vae.reconstruction_probability(params, x, jax.random.PRNGKey(4))
+        assert ll.shape == (8,)
+        assert bool(jnp.all(ll < 0))
+
+    def test_vae_in_supervised_net(self):
+        rs = np.random.RandomState(3)
+        x = rs.rand(16, 8)
+        y = np.eye(2)[rs.randint(0, 2, 16)]
+        conf = NeuralNetConfig(updater=U.Adam(learning_rate=0.01)).list(
+            L.VariationalAutoencoder(n_latent=4, encoder_layer_sizes=(8,),
+                                     decoder_layer_sizes=(8,)),
+            L.OutputLayer(n_out=2, loss="mcxent"),
+            input_type=I.FeedForwardType(8),
+        )
+        net = MultiLayerNetwork(conf)
+        net.fit(x, y, epochs=3)
+        assert net.output(x).shape == (16, 2)
+
+
+class TestYolo2:
+    def _labels(self, rs, b, h, w, c):
+        labels = np.zeros((b, h, w, 5 + c), np.float64)
+        for bi in range(b):
+            y, x = rs.randint(0, h), rs.randint(0, w)
+            labels[bi, y, x, 0] = 1.0
+            labels[bi, y, x, 1:3] = rs.rand(2)
+            labels[bi, y, x, 3:5] = 0.5 + rs.rand(2) * 2.0
+            labels[bi, y, x, 5 + rs.randint(0, c)] = 1.0
+        return labels
+
+    def test_loss_finite_and_positive(self):
+        rs = np.random.RandomState(0)
+        layer = L.Yolo2OutputLayer(anchors=((1.0, 1.0), (2.5, 2.5)))
+        b, h, w, c = 2, 4, 4, 3
+        preds = jnp.asarray(rs.randn(b, h, w, 2 * (5 + c)))
+        labels = jnp.asarray(self._labels(rs, b, h, w, c))
+        loss = layer.compute_loss(preds, labels)
+        assert float(loss) > 0 and np.isfinite(float(loss))
+
+    def test_loss_grad_flows(self):
+        rs = np.random.RandomState(1)
+        layer = L.Yolo2OutputLayer(anchors=((1.0, 1.0),))
+        b, h, w, c = 1, 3, 3, 2
+        preds = jnp.asarray(rs.randn(b, h, w, 5 + c))
+        labels = jnp.asarray(self._labels(rs, b, h, w, c))
+        g = jax.grad(lambda p: layer.compute_loss(p, labels))(preds)
+        assert float(jnp.sum(jnp.abs(g))) > 0
+
+    def test_yolo_net_trains(self):
+        rs = np.random.RandomState(2)
+        b, c = 8, 2
+        x = rs.rand(b, 8, 8, 1)
+        labels = self._labels(rs, b, 4, 4, c)
+        conf = NeuralNetConfig(updater=U.Adam(learning_rate=1e-3)).list(
+            L.ConvolutionLayer(n_out=8, kernel=(3, 3), padding="same", activation="relu"),
+            L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)),
+            L.ConvolutionLayer(n_out=2 * (5 + c), kernel=(1, 1), padding="same"),
+            L.Yolo2OutputLayer(anchors=((1.0, 1.0), (2.0, 2.0))),
+            input_type=I.ConvolutionalType(8, 8, 1),
+        )
+        net = MultiLayerNetwork(conf)
+        net.init()
+        s0 = net.score(x, labels)
+        net.fit(x, labels, epochs=10)
+        assert net.score(x, labels) < s0
+
+    def test_detection_extraction(self):
+        layer = L.Yolo2OutputLayer(anchors=((1.0, 1.0),))
+        preds = np.zeros((1, 2, 2, 7), np.float32)
+        preds[0, 1, 1, 4] = 5.0  # high confidence logit at cell (1,1)
+        dets = layer.get_predicted_objects(jnp.asarray(preds), threshold=0.5)
+        assert len(dets[0]) == 1
+        conf, cx, cy, w, h, cls = dets[0][0]
+        assert 1.0 <= cx <= 2.0 and 1.0 <= cy <= 2.0
+
+
+class TestCenterLoss:
+    def test_centers_update_and_training(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(32, 4)
+        y = np.eye(3)[rs.randint(0, 3, 32)]
+        conf = NeuralNetConfig(updater=U.Adam(learning_rate=0.01)).list(
+            L.DenseLayer(n_out=8, activation="tanh"),
+            L.CenterLossOutputLayer(n_out=3, lambda_=0.01),
+            input_type=I.FeedForwardType(4),
+        )
+        net = MultiLayerNetwork(conf)
+        net.init()
+        c0 = np.asarray(net.state[1]["centers"]).copy()
+        s0 = net.score(x, y)
+        net.fit(x, y, epochs=10)
+        assert not np.allclose(np.asarray(net.state[1]["centers"]), c0)
+        assert net.score(x, y) < s0
+
+    def test_centerloss_gradcheck(self):
+        rs = np.random.RandomState(1)
+        feats = jnp.asarray(rs.randn(5, 4))
+        y = jnp.asarray(np.eye(3)[rs.randint(0, 3, 5)])
+        layer = L.CenterLossOutputLayer(n_out=3, lambda_=0.1)
+        params = layer.init(jax.random.PRNGKey(0), I.FeedForwardType(4), dtype=F64)
+        state = jax.tree_util.tree_map(lambda a: jnp.asarray(a, F64),
+                                       layer.init_state(I.FeedForwardType(4), dtype=F64))
+
+        def loss_fn(p):
+            loss, _, _ = layer.loss_from_features(p, state, feats, y, train=False)
+            return loss
+
+        ok, failures = check_gradients(loss_fn, params, max_params_per_leaf=20)
+        assert ok, failures[:5]
+
+
+class TestConstraints:
+    def test_max_norm(self):
+        layer = L.DenseLayer(n_out=4)
+        w = jnp.asarray(np.random.RandomState(0).randn(6, 4) * 10)
+        out = MaxNormConstraint(max_norm=1.0).apply(layer, {"W": w, "b": jnp.zeros(4)}, 0, 0)
+        norms = np.linalg.norm(np.asarray(out["W"]), axis=0)
+        assert np.all(norms <= 1.0 + 1e-6)
+        np.testing.assert_array_equal(np.asarray(out["b"]), 0.0)
+
+    def test_non_negative(self):
+        layer = L.DenseLayer(n_out=2)
+        out = NonNegativeConstraint().apply(layer, {"W": jnp.asarray([[-1.0, 2.0]])}, 0, 0)
+        np.testing.assert_array_equal(np.asarray(out["W"]), [[0.0, 2.0]])
+
+    def test_unit_norm(self):
+        layer = L.DenseLayer(n_out=3)
+        w = jnp.asarray(np.random.RandomState(1).randn(5, 3))
+        out = UnitNormConstraint().apply(layer, {"W": w}, 0, 0)
+        norms = np.linalg.norm(np.asarray(out["W"]), axis=0)
+        np.testing.assert_allclose(norms, 1.0, rtol=1e-5)
+
+    def test_constraint_enforced_during_training(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(16, 4)
+        y = np.eye(2)[rs.randint(0, 2, 16)]
+        conf = NeuralNetConfig(updater=U.Sgd(learning_rate=1.0)).list(
+            L.DenseLayer(n_out=8, activation="tanh",
+                         constraints=(MaxNormConstraint(max_norm=0.5),)),
+            L.OutputLayer(n_out=2, loss="mcxent"),
+            input_type=I.FeedForwardType(4),
+        )
+        net = MultiLayerNetwork(conf)
+        net.fit(x, y, epochs=5)
+        norms = np.linalg.norm(np.asarray(net.params[0]["W"]), axis=0)
+        assert np.all(norms <= 0.5 + 1e-5)
+
+
+class TestWeightNoise:
+    def test_dropconnect_changes_train_forward(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 4)
+        y = np.eye(2)[rs.randint(0, 2, 8)]
+        conf = NeuralNetConfig(updater=U.Sgd(learning_rate=0.1)).list(
+            L.DenseLayer(n_out=8, activation="tanh",
+                         weight_noise=DropConnect(weight_retain_prob=0.5)),
+            L.OutputLayer(n_out=2, loss="mcxent"),
+            input_type=I.FeedForwardType(4),
+        )
+        net = MultiLayerNetwork(conf)
+        net.init()
+        # training forward (with rng) differs from deterministic inference
+        out_train1, _ = net.apply_fn(net.params, net.state, jnp.asarray(x),
+                                     train=True, rng=jax.random.PRNGKey(0))
+        out_train2, _ = net.apply_fn(net.params, net.state, jnp.asarray(x),
+                                     train=True, rng=jax.random.PRNGKey(1))
+        out_eval, _ = net.apply_fn(net.params, net.state, jnp.asarray(x), train=False)
+        assert not np.allclose(np.asarray(out_train1), np.asarray(out_train2))
+        net.fit(x, y, epochs=2)
+        assert np.isfinite(net.score(x, y))
+
+    def test_weight_noise_additive(self):
+        from deeplearning4j_tpu.nn.initializers import Distribution
+        layer = L.DenseLayer(n_out=2)
+        wn = WeightNoise(distribution=Distribution(kind="normal", std=0.1))
+        params = {"W": jnp.zeros((3, 2)), "b": jnp.zeros(2)}
+        out = wn.perturb(jax.random.PRNGKey(0), layer, params)
+        assert float(jnp.sum(jnp.abs(out["W"]))) > 0
+        np.testing.assert_array_equal(np.asarray(out["b"]), 0.0)  # bias untouched
