@@ -1,0 +1,116 @@
+"""Smaller zoo models.
+
+Reference analogs in /root/reference/deeplearning4j-zoo/src/main/java/org/
+deeplearning4j/zoo/model/: SimpleCNN.java, AlexNet.java, Darknet19.java,
+TinyYOLO.java, TextGenerationLSTM.java.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+
+
+def simple_cnn(height=48, width=48, channels=3, n_classes=10, updater=None, seed=12345):
+    """(reference: SimpleCNN.java)"""
+    return NeuralNetConfig(seed=seed, updater=updater or U.AdaDelta()).list(
+        L.ConvolutionLayer(n_out=16, kernel=(3, 3), padding="same", activation="relu"),
+        L.BatchNormalization(),
+        L.ConvolutionLayer(n_out=16, kernel=(3, 3), padding="same", activation="relu"),
+        L.BatchNormalization(),
+        L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)),
+        L.DropoutLayer(rate=0.25),
+        L.ConvolutionLayer(n_out=32, kernel=(3, 3), padding="same", activation="relu"),
+        L.BatchNormalization(),
+        L.ConvolutionLayer(n_out=32, kernel=(3, 3), padding="same", activation="relu"),
+        L.BatchNormalization(),
+        L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)),
+        L.DropoutLayer(rate=0.25),
+        L.DenseLayer(n_out=256, activation="relu"),
+        L.DropoutLayer(rate=0.5),
+        L.OutputLayer(n_out=n_classes, loss="mcxent"),
+        input_type=I.ConvolutionalType(height, width, channels),
+    )
+
+
+def alexnet(height=224, width=224, channels=3, n_classes=1000, updater=None, seed=12345):
+    """(reference: AlexNet.java — conv11/5/3 stack + LRN)"""
+    return NeuralNetConfig(seed=seed, updater=updater or U.Nesterovs(learning_rate=0.01)).list(
+        L.ConvolutionLayer(n_out=96, kernel=(11, 11), stride=(4, 4), activation="relu"),
+        L.LocalResponseNormalization(),
+        L.SubsamplingLayer(kernel=(3, 3), stride=(2, 2)),
+        L.ConvolutionLayer(n_out=256, kernel=(5, 5), padding="same", activation="relu"),
+        L.LocalResponseNormalization(),
+        L.SubsamplingLayer(kernel=(3, 3), stride=(2, 2)),
+        L.ConvolutionLayer(n_out=384, kernel=(3, 3), padding="same", activation="relu"),
+        L.ConvolutionLayer(n_out=384, kernel=(3, 3), padding="same", activation="relu"),
+        L.ConvolutionLayer(n_out=256, kernel=(3, 3), padding="same", activation="relu"),
+        L.SubsamplingLayer(kernel=(3, 3), stride=(2, 2)),
+        L.DenseLayer(n_out=4096, activation="relu", dropout=0.5),
+        L.DenseLayer(n_out=4096, activation="relu", dropout=0.5),
+        L.OutputLayer(n_out=n_classes, loss="mcxent"),
+        input_type=I.ConvolutionalType(height, width, channels),
+    )
+
+
+def _darknet_conv(n_out, kernel):
+    return [L.ConvolutionLayer(n_out=n_out, kernel=kernel, padding="same",
+                               has_bias=False, weight_init="relu"),
+            L.BatchNormalization(activation="leakyrelu")]
+
+
+def darknet19(height=224, width=224, channels=3, n_classes=1000, updater=None, seed=12345):
+    """(reference: Darknet19.java — conv/BN/leaky-relu backbone)"""
+    layers = []
+    layers += _darknet_conv(32, (3, 3))
+    layers += [L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2))]
+    layers += _darknet_conv(64, (3, 3))
+    layers += [L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2))]
+    layers += _darknet_conv(128, (3, 3)) + _darknet_conv(64, (1, 1)) + _darknet_conv(128, (3, 3))
+    layers += [L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2))]
+    layers += _darknet_conv(256, (3, 3)) + _darknet_conv(128, (1, 1)) + _darknet_conv(256, (3, 3))
+    layers += [L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2))]
+    layers += (_darknet_conv(512, (3, 3)) + _darknet_conv(256, (1, 1)) +
+               _darknet_conv(512, (3, 3)) + _darknet_conv(256, (1, 1)) +
+               _darknet_conv(512, (3, 3)))
+    layers += [L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2))]
+    layers += (_darknet_conv(1024, (3, 3)) + _darknet_conv(512, (1, 1)) +
+               _darknet_conv(1024, (3, 3)) + _darknet_conv(512, (1, 1)) +
+               _darknet_conv(1024, (3, 3)))
+    layers += [L.ConvolutionLayer(n_out=n_classes, kernel=(1, 1), padding="same"),
+               L.GlobalPoolingLayer(mode="avg"),
+               L.LossLayer(loss="mcxent", activation="softmax")]
+    return NeuralNetConfig(seed=seed, updater=updater or U.Adam(learning_rate=1e-3)).list(
+        *layers, input_type=I.ConvolutionalType(height, width, channels))
+
+
+def tiny_yolo(height=416, width=416, channels=3, n_classes=20,
+              anchors=((1.08, 1.19), (3.42, 4.41), (6.63, 11.38), (9.42, 5.11),
+                       (16.62, 10.52)), updater=None, seed=12345):
+    """(reference: TinyYOLO.java — darknet-tiny backbone + Yolo2OutputLayer)"""
+    layers = []
+    for n_out in (16, 32, 64, 128, 256):
+        layers += _darknet_conv(n_out, (3, 3))
+        layers += [L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2))]
+    layers += _darknet_conv(512, (3, 3))
+    layers += _darknet_conv(1024, (3, 3))
+    layers += _darknet_conv(1024, (3, 3))
+    layers += [L.ConvolutionLayer(n_out=len(anchors) * (5 + n_classes), kernel=(1, 1),
+                                  padding="same"),
+               L.Yolo2OutputLayer(anchors=tuple(anchors))]
+    return NeuralNetConfig(seed=seed, updater=updater or U.Adam(learning_rate=1e-3)).list(
+        *layers, input_type=I.ConvolutionalType(height, width, channels))
+
+
+def text_generation_lstm(vocab_size, hidden=256, seq_len=64, updater=None, seed=12345):
+    """Char-RNN (reference: TextGenerationLSTM.java — stacked GravesLSTM +
+    RnnOutputLayer; BASELINE.md config #4)."""
+    return NeuralNetConfig(seed=seed, updater=updater or U.RmsProp(learning_rate=1e-3)).list(
+        L.GravesLSTM(n_out=hidden),
+        L.GravesLSTM(n_out=hidden),
+        L.RnnOutputLayer(n_out=vocab_size, loss="mcxent"),
+        input_type=I.RecurrentType(vocab_size, seq_len),
+        backprop_type="tbptt", tbptt_fwd_length=seq_len, tbptt_back_length=seq_len,
+    )

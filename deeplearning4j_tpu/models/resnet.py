@@ -1,0 +1,77 @@
+"""ResNet50 as a ComputationGraph.
+
+Reference analog: /root/reference/deeplearning4j-zoo/src/main/java/org/
+deeplearning4j/zoo/model/ResNet50.java (graph of conv/BN/relu bottleneck
+blocks with ElementWise-add shortcuts) — BASELINE.md config #2, the MFU-target
+model.
+
+TPU-first: NHWC, bf16-friendly convs (stride-2 downsampling inside blocks),
+BN with running stats in state; identity vs projection shortcuts exactly as
+ResNet v1. Built programmatically on GraphBuilder.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.graph import ElementWiseVertex, GraphBuilder
+
+
+def _conv_bn(g, name, inp, n_out, kernel, stride=(1, 1), padding="same",
+             activation="relu"):
+    g.add_layer(f"{name}_conv",
+                L.ConvolutionLayer(n_out=n_out, kernel=kernel, stride=stride,
+                                   padding=padding, has_bias=False,
+                                   weight_init="relu"), inp)
+    g.add_layer(f"{name}_bn", L.BatchNormalization(activation=activation),
+                f"{name}_conv")
+    return f"{name}_bn"
+
+
+def _bottleneck(g, name, inp, filters, stride=(1, 1), project=False):
+    """1x1 reduce -> 3x3 -> 1x1 expand (4x) with shortcut add."""
+    f1, f2, f3 = filters, filters, filters * 4
+    x = _conv_bn(g, f"{name}_a", inp, f1, (1, 1), stride=stride)
+    x = _conv_bn(g, f"{name}_b", x, f2, (3, 3))
+    x = _conv_bn(g, f"{name}_c", x, f3, (1, 1), activation="identity")
+    if project:
+        shortcut = _conv_bn(g, f"{name}_proj", inp, f3, (1, 1), stride=stride,
+                            activation="identity")
+    else:
+        shortcut = inp
+    g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), x, shortcut)
+    g.add_layer(f"{name}_relu", L.ActivationLayer(activation="relu"), f"{name}_add")
+    return f"{name}_relu"
+
+
+def resnet50(height=224, width=224, channels=3, n_classes=1000, updater=None,
+             seed=12345):
+    g = GraphBuilder(updater=updater or U.Adam(learning_rate=1e-3), seed=seed)
+    g.add_inputs("input")
+    g.set_input_types(I.ConvolutionalType(height, width, channels))
+
+    x = _conv_bn(g, "stem", "input", 64, (7, 7), stride=(2, 2))
+    g.add_layer("stem_pool", L.SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                                padding="same", mode="max"), x)
+    x = "stem_pool"
+
+    stages = [(64, 3, (1, 1)), (128, 4, (2, 2)), (256, 6, (2, 2)), (512, 3, (2, 2))]
+    for si, (filters, blocks, stride) in enumerate(stages):
+        for bi in range(blocks):
+            x = _bottleneck(g, f"s{si}b{bi}", x, filters,
+                            stride=stride if bi == 0 else (1, 1), project=bi == 0)
+
+    g.add_layer("avgpool", L.GlobalPoolingLayer(mode="avg"), x)
+    g.add_layer("fc", L.OutputLayer(n_out=n_classes, loss="mcxent",
+                                    weight_init="xavier"), "avgpool")
+    g.set_outputs("fc")
+    return g.build()
+
+
+def resnet50_flops_per_example(height=224, width=224, channels=3, n_classes=1000):
+    """Approximate forward FLOPs (2*MACs) for MFU accounting."""
+    # standard figure: ~3.8 GFLOPs fwd at 224x224; scale by area
+    base = 3.8e9 * 2 / 2  # fwd only
+    scale = (height * width) / (224 * 224)
+    return base * scale

@@ -1,0 +1,100 @@
+"""Zoo model tests — shape inference + one tiny train step per model
+(reference: deeplearning4j-zoo TestInstantiation)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import (alexnet, darknet19, lenet, resnet50, simple_cnn,
+                                       text_generation_lstm, tiny_yolo, vgg16)
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+class TestShapes:
+    def test_lenet_shapes(self):
+        conf = lenet()
+        _, out = conf.layer_input_types()
+        assert out == I.FeedForwardType(10)
+
+    def test_vgg16_shapes(self):
+        conf = vgg16(height=64, width=64, n_classes=10)
+        types, out = conf.layer_input_types()
+        assert out == I.FeedForwardType(10)
+
+    def test_alexnet_shapes(self):
+        conf = alexnet(n_classes=100)
+        _, out = conf.layer_input_types()
+        assert out == I.FeedForwardType(100)
+
+    def test_darknet_shapes(self):
+        conf = darknet19(height=64, width=64, n_classes=10)
+        _, out = conf.layer_input_types()
+        assert out == I.FeedForwardType(10)
+
+    def test_resnet50_builds(self):
+        conf = resnet50(height=32, width=32, n_classes=10)
+        types = conf.vertex_types()
+        assert types["fc"] == I.FeedForwardType(10)
+        # stem downsamples twice: 32 -> 16 -> 8; stage strides: 8 -> 8,4,2,1
+        assert types["stem_pool"] == I.ConvolutionalType(8, 8, 64)
+        assert types["s3b2_relu"] == I.ConvolutionalType(1, 1, 2048)
+
+    def test_resnet50_param_count_full_size(self):
+        """ResNet50 at 224x224/1000 classes must have ~25.6M params."""
+        conf = resnet50()
+        g = ComputationGraph(conf)
+        g.init()
+        n = g.num_params()
+        assert 25e6 < n < 26.5e6, n
+
+
+class TestTinyTraining:
+    def test_resnet50_tiny_train_step(self):
+        conf = resnet50(height=32, width=32, n_classes=4)
+        g = ComputationGraph(conf)
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 32, 32, 3).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 2)]
+        g.init()
+        s0 = g.score(x, y)
+        g.fit(x, y, epochs=2)
+        assert np.isfinite(g.score(x, y))
+
+    def test_simple_cnn_trains(self):
+        conf = simple_cnn(height=16, width=16, channels=1, n_classes=3)
+        net = MultiLayerNetwork(conf)
+        rs = np.random.RandomState(1)
+        x = rs.rand(4, 16, 16, 1)
+        y = np.eye(3)[rs.randint(0, 3, 4)]
+        net.fit(x, y, epochs=2)
+        assert np.isfinite(net.score(x, y))
+
+    def test_text_generation_lstm_trains(self):
+        vocab = 12
+        conf = text_generation_lstm(vocab, hidden=16, seq_len=8)
+        net = MultiLayerNetwork(conf)
+        rs = np.random.RandomState(2)
+        idx = rs.randint(0, vocab, (4, 8))
+        x = np.eye(vocab)[idx]
+        y = np.eye(vocab)[np.roll(idx, -1, axis=1)]
+        net.init()
+        s0 = net.score(x, y)
+        net.fit(x, y, epochs=5)
+        assert net.score(x, y) < s0
+
+    def test_tiny_yolo_builds_and_steps(self):
+        conf = tiny_yolo(height=64, width=64, channels=1, n_classes=2,
+                         anchors=((1.0, 1.0), (2.0, 2.0)))
+        net = MultiLayerNetwork(conf)
+        types, out = conf.layer_input_types()
+        assert isinstance(out, I.ConvolutionalType)
+        grid = out.height
+        rs = np.random.RandomState(3)
+        x = rs.rand(2, 64, 64, 1)
+        labels = np.zeros((2, grid, grid, 7), np.float64)
+        labels[:, 0, 0, 0] = 1
+        labels[:, 0, 0, 3:5] = 1.0
+        labels[:, 0, 0, 5] = 1
+        net.fit(x, labels, epochs=1)
+        assert np.isfinite(net.score(x, labels))
