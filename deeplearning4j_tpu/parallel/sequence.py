@@ -1,0 +1,127 @@
+"""Sequence/context parallelism: ring attention over the mesh 'seq' axis.
+
+The reference's only long-sequence mechanism is truncated BPTT + masking
+(SURVEY.md §5); this module provides the TPU-native long-context capability
+the north star requires: sequences sharded across devices on the 'seq' mesh
+axis, with attention computed blockwise while K/V blocks rotate around the
+ring via ppermute (Liu et al. ring attention). Communication rides ICI and
+overlaps with the blockwise matmuls; memory per device is O(T/N).
+
+Numerics: online-softmax accumulation (running max m, denominator l,
+numerator acc) in f32 — mathematically exact vs full attention, verified by
+tests against the single-device reference on the virtual 8-device CPU mesh.
+
+Also provided: all_to_all "Ulysses"-style head-parallel attention — sequence
+is gathered per head group via all_to_all so each device computes full
+attention for a subset of heads. Cheaper at moderate T, ring wins at long T.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.utils import dtypes as _dtypes
+
+
+def _block_attn(q, k, v, *, scale, block_mask=None):
+    """Blockwise logits/numerator for online softmax.
+
+    q: [B,Tq,H,D], k/v: [B,Tk,H,D]. Returns (m_blk [B,H,Tq], num [B,Tq,H,D],
+    den [B,H,Tq]) where m_blk is the block's row max.
+    """
+    cd, ad = _dtypes.compute_dtypes_for(q.dtype)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(cd), k.astype(cd),
+                        preferred_element_type=ad) * scale
+    if block_mask is not None:
+        logits = jnp.where(block_mask, logits, -jnp.inf)
+    m_blk = jnp.max(logits, axis=-1)                         # [B,H,Tq]
+    # guard fully-masked rows
+    m_safe = jnp.where(jnp.isfinite(m_blk), m_blk, 0.0)
+    p = jnp.exp(logits - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(logits), p, 0.0)
+    den = jnp.sum(p, axis=-1)                                # [B,H,Tq]
+    num = jnp.einsum("bhqk,bkhd->bqhd", p.astype(cd), v.astype(cd),
+                     preferred_element_type=ad)              # [B,Tq,H,D]
+    return m_safe, num, den
+
+
+def ring_self_attention(q, k, v, *, axis_name="seq", causal=False, scale=None):
+    """Exact self-attention with q/k/v sharded over ``axis_name`` on the time
+    axis. Call inside shard_map/pjit. Shapes per device: [B, T_local, H, D].
+    """
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    t_local = q.shape[1]
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def make_mask(src_idx):
+        """Causal block mask: query global pos >= key global pos."""
+        if not causal:
+            return None
+        q_pos = my_idx * t_local + jnp.arange(t_local)            # [Tq]
+        k_pos = src_idx * t_local + jnp.arange(t_local)           # [Tk]
+        return (q_pos[:, None] >= k_pos[None, :])[None, None]     # [1,1,Tq,Tk]
+
+    def body(i, carry):
+        k_blk, v_blk, acc, m, l = carry
+        src_idx = (my_idx - i) % n  # which shard this block originated from
+        m_blk, num, den = _block_attn(q, k_blk, v_blk, scale=scale,
+                                      block_mask=make_mask(src_idx))
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)          # rescale old accumulators
+        beta = jnp.exp(m_blk - m_new)       # rescale new block
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + \
+            num * beta.transpose(0, 2, 1)[..., None]
+        l = l * alpha + den * beta
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, acc, m_new, l
+
+    b, t, h, dd = q.shape
+    acc0 = jnp.zeros((b, t, h, dd), jnp.float32)
+    m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    _, _, acc, m, l = jax.lax.fori_loop(0, n, body, (k, v, acc0, m0, l0))
+    l_safe = jnp.maximum(l, 1e-20)
+    return (acc / l_safe.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ulysses_self_attention(q, k, v, *, axis_name="seq", causal=False, scale=None):
+    """All-to-all head-parallel attention: redistribute [B, T/N, H, D] ->
+    [B, T, H/N, D] via all_to_all, compute full attention per head subset,
+    redistribute back (DeepSpeed-Ulysses pattern)."""
+    from deeplearning4j_tpu.nn.layers.attention import dot_product_attention
+
+    # [B, T/N, H, D] -> [B, T, H/N, D]: split heads across devices, gather time
+    def scatter_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    q2, k2, v2 = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    out = dot_product_attention(q2, k2, v2, causal=causal, scale=scale)
+    # inverse: [B, T, H/N, D] -> [B, T/N, H, D]
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def make_ring_attention_fn(mesh: Mesh, *, causal=False, seq_axis="seq"):
+    """shard_map-wrapped ring attention: takes full [B,T,H,D] arrays,
+    returns full attention output, computed sequence-parallel."""
+    from jax import shard_map
+
+    spec = P(None, seq_axis, None, None)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec,
+                       check_vma=False)
+    def fn(q, k, v):
+        return ring_self_attention(q, k, v, axis_name=seq_axis, causal=causal)
+
+    return fn

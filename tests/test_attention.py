@@ -1,0 +1,160 @@
+"""Attention + sequence-parallel tests: ring/Ulysses attention must match
+single-device attention exactly on the virtual 8-device mesh."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+from deeplearning4j_tpu.nn.layers.attention import (LayerNormalization, MultiHeadAttention,
+                                                    TransformerBlock, dot_product_attention)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import MeshSpec, make_mesh
+from deeplearning4j_tpu.parallel.sequence import (make_ring_attention_fn,
+                                                  ring_self_attention,
+                                                  ulysses_self_attention)
+from deeplearning4j_tpu.utils.gradcheck import check_gradients
+
+F64 = jnp.float64
+
+
+def _qkv(rng, b=2, t=16, h=4, d=8, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    return (jax.random.normal(k1, (b, t, h, d), dtype),
+            jax.random.normal(k2, (b, t, h, d), dtype),
+            jax.random.normal(k3, (b, t, h, d), dtype))
+
+
+class TestDotProductAttention:
+    def test_matches_manual_softmax(self, rng):
+        q, k, v = _qkv(rng, b=1, t=4, h=1, d=4, dtype=F64)
+        out = dot_product_attention(q, k, v)
+        logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(4)
+        w = np.exp(logits - logits.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        expect = np.einsum("bhqk,bkhd->bqhd", w, v)
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+    def test_causal_blocks_future(self, rng):
+        q, k, v = _qkv(rng, b=1, t=6, h=1, d=4, dtype=F64)
+        out1 = dot_product_attention(q, k, v, causal=True)
+        # changing future keys/values must not affect past outputs
+        k2 = k.at[:, 3:].set(99.0)
+        v2 = v.at[:, 3:].set(99.0)
+        out2 = dot_product_attention(q, k2, v2, causal=True)
+        np.testing.assert_allclose(np.asarray(out1[:, :3]), np.asarray(out2[:, :3]),
+                                   rtol=1e-6)
+
+    def test_key_mask(self, rng):
+        q, k, v = _qkv(rng, b=2, t=5, h=2, d=4, dtype=F64)
+        mask = jnp.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], F64)
+        out1 = dot_product_attention(q, k, v, mask=mask)
+        k2 = k.at[0, 3:].set(7.0)
+        out2 = dot_product_attention(q, k2, v, mask=mask)
+        np.testing.assert_allclose(np.asarray(out1[0]), np.asarray(out2[0]), rtol=1e-6)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, rng, eight_devices, causal):
+        mesh = make_mesh(MeshSpec(data=1, model=1, seq=8), devices=eight_devices)
+        q, k, v = _qkv(rng, b=2, t=32, h=4, d=8, dtype=jnp.float32)
+        ring_fn = make_ring_attention_fn(mesh, causal=causal)
+        out_ring = ring_fn(q, k, v)
+        out_full = dot_product_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_full),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_single_shard_degenerate(self, rng, eight_devices):
+        """N=1 ring == plain attention."""
+        mesh = make_mesh(MeshSpec(data=8, model=1, seq=1), devices=eight_devices)
+        q, k, v = _qkv(rng, b=2, t=8)
+        ring_fn = make_ring_attention_fn(mesh)
+        np.testing.assert_allclose(np.asarray(ring_fn(q, k, v)),
+                                   np.asarray(dot_product_attention(q, k, v)),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_grads_flow_through_ring(self, rng, eight_devices):
+        mesh = make_mesh(MeshSpec(data=1, model=1, seq=8), devices=eight_devices)
+        q, k, v = _qkv(rng, b=1, t=16, h=2, d=4)
+        ring_fn = make_ring_attention_fn(mesh)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring_fn(q, k, v) ** 2)
+
+        def loss_full(q, k, v):
+            return jnp.sum(dot_product_attention(q, k, v) ** 2)
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ring, g_full):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3,
+                                       atol=1e-4)
+
+
+class TestUlyssesAttention:
+    def test_matches_full_attention(self, rng, eight_devices):
+        mesh = make_mesh(MeshSpec(data=1, model=1, seq=8), devices=eight_devices)
+        q, k, v = _qkv(rng, b=2, t=32, h=8, d=4)  # heads divisible by 8
+        spec = P(None, "seq", None, None)
+        fn = shard_map(
+            functools.partial(ulysses_self_attention, axis_name="seq"),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+        out = fn(q, k, v)
+        expect = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
+
+
+class TestAttentionLayers:
+    def test_mha_shape_and_gradcheck(self, rng):
+        layer = MultiHeadAttention(n_out=8, n_heads=2)
+        it = I.RecurrentType(6, 5)
+        params = layer.init(rng, it, dtype=F64)
+        x = jax.random.normal(rng, (2, 5, 6), F64)
+        y, _ = layer.apply(params, {}, x)
+        assert y.shape == (2, 5, 8)
+
+        from deeplearning4j_tpu.nn import losses
+        lab = jax.random.normal(jax.random.PRNGKey(1), y.shape, F64)
+
+        def loss_fn(p):
+            out, _ = layer.apply(p, {}, x)
+            return losses.mse(out, lab)
+
+        ok, failures = check_gradients(loss_fn, params, max_params_per_leaf=20)
+        assert ok, failures[:5]
+
+    def test_layernorm(self, rng):
+        layer = LayerNormalization()
+        params = layer.init(rng, I.FeedForwardType(6), dtype=F64)
+        x = 5.0 + 3.0 * jax.random.normal(rng, (4, 6), F64)
+        y, _ = layer.apply(params, {}, x)
+        np.testing.assert_allclose(np.asarray(jnp.mean(y, -1)), 0.0, atol=1e-10)
+        np.testing.assert_allclose(np.asarray(jnp.std(y, -1)), 1.0, atol=1e-2)
+
+    def test_transformer_in_network(self):
+        rs = np.random.RandomState(0)
+        t, f = 6, 8
+        x = rs.randn(16, t, f)
+        y_cls = (x[:, :, 0].sum(1) > 0).astype(int)
+        y = np.eye(2)[y_cls]
+        conf = NeuralNetConfig(seed=2, updater=U.Adam(learning_rate=0.01)).list(
+            TransformerBlock(n_out=f, n_heads=2),
+            L.GlobalPoolingLayer(mode="avg"),
+            L.OutputLayer(n_out=2, loss="mcxent"),
+            input_type=I.RecurrentType(f, t),
+        )
+        net = MultiLayerNetwork(conf)
+        net.init()
+        s0 = net.score(x, y)
+        net.fit(x, y, epochs=30)
+        assert net.score(x, y) < s0 * 0.7
