@@ -1,0 +1,7 @@
+from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory, NGramTokenizerFactory  # noqa: F401
+from deeplearning4j_tpu.text.vocab import VocabCache, VocabConstructor, huffman_encode  # noqa: F401
+from deeplearning4j_tpu.text.word2vec import SequenceVectors, Word2Vec  # noqa: F401
+from deeplearning4j_tpu.text.paragraph_vectors import ParagraphVectors  # noqa: F401
+from deeplearning4j_tpu.text.glove import GloVe  # noqa: F401
+from deeplearning4j_tpu.text.serializer import load_word_vectors, save_word_vectors  # noqa: F401
+from deeplearning4j_tpu.text.bow import BagOfWordsVectorizer, TfidfVectorizer  # noqa: F401

@@ -1,0 +1,122 @@
+"""GloVe embeddings.
+
+Reference analog: models/glove/Glove.java (406 LoC) + co-occurrence counting
+(models/glove/count/) in /root/reference/deeplearning4j-nlp-parent/
+deeplearning4j-nlp. Weighted least squares on log co-occurrence with AdaGrad,
+batched over the sparse co-occurrence entries as index arrays — the classic
+GloVe objective, executed as jitted gather/scatter steps.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.text.vocab import VocabConstructor
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+def _glove_step(w, wc, b, bc, gw, gwc, gb, gbc, rows, cols, logx, weight, lr):
+    wi = jnp.take(w, rows, axis=0)
+    wj = jnp.take(wc, cols, axis=0)
+    bi = jnp.take(b, rows)
+    bj = jnp.take(bc, cols)
+    diff = jnp.einsum("bd,bd->b", wi, wj) + bi + bj - logx
+    wdiff = weight * diff
+    loss = 0.5 * jnp.mean(wdiff * diff)
+
+    grad_wi = wdiff[:, None] * wj
+    grad_wj = wdiff[:, None] * wi
+
+    # AdaGrad accumulators
+    gw = gw.at[rows].add(grad_wi**2)
+    gwc = gwc.at[cols].add(grad_wj**2)
+    gb = gb.at[rows].add(wdiff**2)
+    gbc = gbc.at[cols].add(wdiff**2)
+
+    w = w.at[rows].add(-lr * grad_wi / jnp.sqrt(jnp.take(gw, rows, axis=0) + 1e-8))
+    wc = wc.at[cols].add(-lr * grad_wj / jnp.sqrt(jnp.take(gwc, cols, axis=0) + 1e-8))
+    b = b.at[rows].add(-lr * wdiff / jnp.sqrt(jnp.take(gb, rows) + 1e-8))
+    bc = bc.at[cols].add(-lr * wdiff / jnp.sqrt(jnp.take(gbc, cols) + 1e-8))
+    return w, wc, b, bc, gw, gwc, gb, gbc, loss
+
+
+class GloVe:
+    def __init__(self, *, vector_size=50, window=5, min_count=1, x_max=100.0,
+                 alpha=0.75, learning_rate=0.05, epochs=25, batch_size=4096,
+                 seed=123):
+        self.vector_size = vector_size
+        self.window = window
+        self.min_count = min_count
+        self.x_max = x_max
+        self.alpha = alpha
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.vocab = None
+
+    def fit(self, sequences):
+        seq_list = [list(s) for s in sequences]
+        self.vocab = VocabConstructor(self.min_count, build_huffman=False).build(seq_list)
+        v, d = len(self.vocab), self.vector_size
+
+        # co-occurrence with 1/distance weighting (standard GloVe counting)
+        cooc = collections.defaultdict(float)
+        for seq in seq_list:
+            idx = [self.vocab.index_of(t) for t in seq]
+            idx = [i for i in idx if i >= 0]
+            for pos, wi in enumerate(idx):
+                for off in range(1, self.window + 1):
+                    j = pos + off
+                    if j >= len(idx):
+                        break
+                    cooc[(wi, idx[j])] += 1.0 / off
+                    cooc[(idx[j], wi)] += 1.0 / off
+
+        entries = np.array([(r, c, x) for (r, c), x in cooc.items()], np.float64)
+        rows = entries[:, 0].astype(np.int32)
+        cols = entries[:, 1].astype(np.int32)
+        x = entries[:, 2]
+        logx = np.log(x).astype(np.float32)
+        weight = np.minimum(1.0, (x / self.x_max) ** self.alpha).astype(np.float32)
+
+        rs = np.random.RandomState(self.seed)
+        scale = 0.5 / d
+        w = jnp.asarray(rs.uniform(-scale, scale, (v, d)).astype(np.float32))
+        wc = jnp.asarray(rs.uniform(-scale, scale, (v, d)).astype(np.float32))
+        b = jnp.zeros(v, jnp.float32)
+        bc = jnp.zeros(v, jnp.float32)
+        gw = jnp.zeros((v, d), jnp.float32)
+        gwc = jnp.zeros((v, d), jnp.float32)
+        gb = jnp.zeros(v, jnp.float32)
+        gbc = jnp.zeros(v, jnp.float32)
+
+        self.loss_history = []
+        n = len(rows)
+        for epoch in range(self.epochs):
+            perm = rs.permutation(n)
+            for i in range(0, n, self.batch_size):
+                sl = perm[i:i + self.batch_size]
+                w, wc, b, bc, gw, gwc, gb, gbc, loss = _glove_step(
+                    w, wc, b, bc, gw, gwc, gb, gbc,
+                    jnp.asarray(rows[sl]), jnp.asarray(cols[sl]),
+                    jnp.asarray(logx[sl]), jnp.asarray(weight[sl]),
+                    self.learning_rate)
+                self.loss_history.append(float(loss))
+        self.syn0 = w + wc  # standard GloVe: sum of word+context vectors
+        return self
+
+    def get_word_vector(self, word):
+        i = self.vocab.index_of(word)
+        return None if i < 0 else np.asarray(self.syn0[i])
+
+    def similarity(self, w1, w2):
+        a, b = self.get_word_vector(w1), self.get_word_vector(w2)
+        if a is None or b is None:
+            return float("nan")
+        return float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))

@@ -1,0 +1,38 @@
+"""Word-vector serialization (text format, word2vec-compatible).
+
+Reference analog: models/embeddings/loader/WordVectorSerializer.java in
+/root/reference/deeplearning4j-nlp-parent/deeplearning4j-nlp (writeWordVectors
+/ loadTxtVectors).
+"""
+
+from __future__ import annotations
+
+import gzip
+
+import numpy as np
+
+
+def save_word_vectors(model, path):
+    """Write `<word> <v0> <v1> ...` lines with a `<count> <dim>` header."""
+    words = model.vocab.words()
+    vecs = np.asarray(model.syn0)
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "wt", encoding="utf-8") as f:
+        f.write(f"{len(words)} {vecs.shape[1]}\n")
+        for i, w in enumerate(words):
+            f.write(w + " " + " ".join(f"{v:.6f}" for v in vecs[i]) + "\n")
+    return path
+
+
+def load_word_vectors(path):
+    """Returns (words list, matrix [V,D])."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8") as f:
+        header = f.readline().split()
+        count, dim = int(header[0]), int(header[1])
+        words, rows = [], []
+        for line in f:
+            parts = line.rstrip("\n").split(" ")
+            words.append(parts[0])
+            rows.append([float(v) for v in parts[1:dim + 1]])
+    return words, np.asarray(rows, np.float32)

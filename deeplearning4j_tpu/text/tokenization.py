@@ -1,0 +1,78 @@
+"""Tokenizer interfaces.
+
+Reference analog: text/tokenization/ in /root/reference/deeplearning4j-nlp-
+parent/deeplearning4j-nlp — TokenizerFactory SPI (DefaultTokenizerFactory,
+NGramTokenizerFactory) with pluggable TokenPreProcess. Language packs
+(chinese/japanese/korean/uima) are factories of the same interface; here the
+SPI accepts any callable, so external tokenizers plug in the same way.
+"""
+
+from __future__ import annotations
+
+import re
+
+
+class TokenPreProcess:
+    def pre_process(self, token: str) -> str:
+        return token
+
+
+class CommonPreprocessor(TokenPreProcess):
+    """Lowercase + strip punctuation/digits (reference: CommonPreprocessor)."""
+
+    _PUNCT = re.compile(r"[\d\.,:;!?\"'()\[\]{}<>/\\|@#$%^&*+=~`-]+")
+
+    def pre_process(self, token):
+        return self._PUNCT.sub("", token.lower())
+
+
+class Tokenizer:
+    def __init__(self, tokens):
+        self._tokens = list(tokens)
+        self._pos = 0
+
+    def has_more_tokens(self):
+        return self._pos < len(self._tokens)
+
+    def next_token(self):
+        t = self._tokens[self._pos]
+        self._pos += 1
+        return t
+
+    def get_tokens(self):
+        return list(self._tokens)
+
+    def count_tokens(self):
+        return len(self._tokens)
+
+
+class DefaultTokenizerFactory:
+    """Whitespace/regex word tokenizer (reference: DefaultTokenizerFactory)."""
+
+    _WORD = re.compile(r"\S+")
+
+    def __init__(self, preprocessor: TokenPreProcess | None = None):
+        self.preprocessor = preprocessor
+
+    def create(self, text: str) -> Tokenizer:
+        tokens = self._WORD.findall(text)
+        if self.preprocessor is not None:
+            tokens = [self.preprocessor.pre_process(t) for t in tokens]
+            tokens = [t for t in tokens if t]
+        return Tokenizer(tokens)
+
+
+class NGramTokenizerFactory:
+    """Word n-grams (reference: NGramTokenizerFactory)."""
+
+    def __init__(self, n_min=1, n_max=2, preprocessor=None):
+        self.n_min, self.n_max = n_min, n_max
+        self.base = DefaultTokenizerFactory(preprocessor)
+
+    def create(self, text: str) -> Tokenizer:
+        words = self.base.create(text).get_tokens()
+        grams = []
+        for n in range(self.n_min, self.n_max + 1):
+            for i in range(len(words) - n + 1):
+                grams.append(" ".join(words[i:i + n]))
+        return Tokenizer(grams)

@@ -1,0 +1,334 @@
+"""SequenceVectors / Word2Vec: skip-gram + CBOW with negative sampling and
+hierarchical softmax.
+
+Reference analog: models/sequencevectors/SequenceVectors.java (fit:192,
+Hogwild VectorCalculationsThread pool :292-296), models/embeddings/learning/
+impl/elements/SkipGram.java (:271-283 — the hot loop batches into the C++
+AggregateSkipGram kernel), CBOW.java, InMemoryLookupTable.java
+(syn0/syn1/expTable) in /root/reference/deeplearning4j-nlp-parent/
+deeplearning4j-nlp.
+
+TPU-native redesign: the Hogwild thread pool + native batched kernel become a
+single jitted step over large batches of (center, context, negatives) index
+arrays. Forward = gather (jnp.take), update = closed-form SGNS gradients
+applied with scatter-add (.at[].add) — both native XLA TPU ops. Exact
+semantics notes:
+- negative sampling: unigram^0.75 table like the reference;
+- subsampling of frequent words: p_discard = 1 - sqrt(t/f) like word2vec;
+- dynamic window: b ~ U[1, window] per center, like the reference;
+- hierarchical softmax: per-word Huffman codes/points padded to max depth,
+  sigmoid updates along the path — same math, batched dense.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.text.vocab import VocabCache, VocabConstructor
+
+
+def _scatter_mean_update(table, idx, grads, lr):
+    """Apply -lr * (per-row MEAN of grads) at idx. With unique indices this
+    equals per-pair SGD; under collisions (small vocab / large batch) it stays
+    stable where a raw scatter-ADD would multiply the step by the collision
+    count and diverge (the reference's Hogwild applies pairs one at a time)."""
+    d = grads.shape[-1]
+    num = jnp.zeros_like(table).at[idx].add(grads)
+    cnt = jnp.zeros(table.shape[0], grads.dtype).at[idx].add(1.0)
+    return table - lr * num / jnp.maximum(cnt, 1.0)[:, None]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=())
+def _sgns_step(syn0, syn1neg, centers, contexts, negatives, lr):
+    """One batched skip-gram negative-sampling update.
+
+    centers [B], contexts [B], negatives [B,K]; returns (syn0, syn1neg, loss).
+    Closed-form gradients of  -log σ(v·u+) - Σ log σ(-v·u-)  applied via
+    scatter updates (the XLA-native replacement for AggregateSkipGram).
+    """
+    v = jnp.take(syn0, centers, axis=0)            # [B,D]
+    u_pos = jnp.take(syn1neg, contexts, axis=0)    # [B,D]
+    u_neg = jnp.take(syn1neg, negatives, axis=0)   # [B,K,D]
+
+    s_pos = jax.nn.sigmoid(jnp.einsum("bd,bd->b", v, u_pos))          # [B]
+    s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", v, u_neg))        # [B,K]
+
+    g_pos = (s_pos - 1.0)[:, None]                 # d/du+ coefficient
+    g_neg = s_neg[..., None]                       # d/du- coefficient
+
+    grad_v = g_pos * u_pos + jnp.einsum("bk,bkd->bd", s_neg, u_neg)
+    grad_u_pos = g_pos * v
+    grad_u_neg = g_neg * v[:, None, :]
+
+    syn0 = _scatter_mean_update(syn0, centers, grad_v, lr)
+    u_idx = jnp.concatenate([contexts, negatives.reshape(-1)])
+    u_grads = jnp.concatenate([grad_u_pos,
+                               grad_u_neg.reshape(-1, grad_u_neg.shape[-1])])
+    syn1neg = _scatter_mean_update(syn1neg, u_idx, u_grads, lr)
+
+    loss = -jnp.mean(jnp.log(jnp.clip(s_pos, 1e-9, 1.0))
+                     + jnp.sum(jnp.log(jnp.clip(1.0 - s_neg, 1e-9, 1.0)), axis=1))
+    return syn0, syn1neg, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _hs_step(syn0, syn1, centers, points, codes, path_mask, lr):
+    """Hierarchical-softmax skip-gram update.
+
+    points/codes/path_mask: [B, L] padded Huffman paths. Loss:
+    -Σ log σ((1-2*code) * v·u_point).
+    """
+    v = jnp.take(syn0, centers, axis=0)            # [B,D]
+    u = jnp.take(syn1, points, axis=0)             # [B,L,D]
+    sign = 1.0 - 2.0 * codes                       # code 0 -> +1, 1 -> -1
+    dot = jnp.einsum("bd,bld->bl", v, u)
+    s = jax.nn.sigmoid(sign * dot)
+    g = (s - 1.0) * sign * path_mask               # [B,L]
+
+    grad_v = jnp.einsum("bl,bld->bd", g, u)
+    grad_u = g[..., None] * v[:, None, :]
+
+    syn0 = _scatter_mean_update(syn0, centers, grad_v, lr)
+    syn1 = _scatter_mean_update(syn1, points.reshape(-1),
+                                grad_u.reshape(-1, grad_u.shape[-1]), lr)
+    loss = -jnp.sum(jnp.log(jnp.clip(s, 1e-9, 1.0)) * path_mask) / \
+        jnp.maximum(jnp.sum(path_mask), 1.0)
+    return syn0, syn1, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_step(syn0, syn1neg, context_idx, context_mask, targets, negatives, lr):
+    """CBOW-NS: mean of context vectors predicts the target (reference: CBOW.java)."""
+    ctx = jnp.take(syn0, context_idx, axis=0)      # [B,W,D]
+    m = context_mask[..., None]
+    h = jnp.sum(ctx * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)  # [B,D]
+    u_pos = jnp.take(syn1neg, targets, axis=0)
+    u_neg = jnp.take(syn1neg, negatives, axis=0)
+    s_pos = jax.nn.sigmoid(jnp.einsum("bd,bd->b", h, u_pos))
+    s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, u_neg))
+    g_pos = (s_pos - 1.0)[:, None]
+    grad_h = g_pos * u_pos + jnp.einsum("bk,bkd->bd", s_neg, u_neg)
+    counts = jnp.maximum(jnp.sum(context_mask, axis=1, keepdims=True), 1.0)
+    grad_ctx = (grad_h[:, None, :] / counts[..., None]) * m
+    # mask padded slots to index 0 with zero gradient (mean-normalized scatter)
+    syn0 = _scatter_mean_update(syn0, context_idx.reshape(-1),
+                                grad_ctx.reshape(-1, grad_ctx.shape[-1]), lr)
+    u_idx = jnp.concatenate([targets, negatives.reshape(-1)])
+    u_grads = jnp.concatenate([
+        g_pos * h, (s_neg[..., None] * h[:, None, :]).reshape(-1, h.shape[-1])])
+    syn1neg = _scatter_mean_update(syn1neg, u_idx, u_grads, lr)
+    loss = -jnp.mean(jnp.log(jnp.clip(s_pos, 1e-9, 1.0))
+                     + jnp.sum(jnp.log(jnp.clip(1.0 - s_neg, 1e-9, 1.0)), axis=1))
+    return syn0, syn1neg, loss
+
+
+class SequenceVectors:
+    """Generic embedding trainer over element sequences (reference:
+    SequenceVectors.java — Word2Vec, DeepWalk walks, ParagraphVectors all run
+    through this)."""
+
+    def __init__(self, *, vector_size=100, window=5, min_count=5, negative=5,
+                 learning_rate=0.025, min_learning_rate=1e-4, epochs=1,
+                 batch_size=2048, subsample=1e-3, use_hierarchic_softmax=False,
+                 algorithm="skipgram", seed=123):
+        self.vector_size = vector_size
+        self.window = window
+        self.min_count = min_count
+        self.negative = negative
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.subsample = subsample
+        self.use_hs = use_hierarchic_softmax
+        self.algorithm = algorithm
+        self.seed = seed
+        self.vocab: VocabCache | None = None
+        self.syn0 = None
+        self.syn1 = None
+        self._rs = np.random.RandomState(seed)
+
+    # ---- vocab + tables ----
+
+    def build_vocab(self, sequences):
+        self.vocab = VocabConstructor(self.min_count,
+                                      build_huffman=self.use_hs).build(sequences)
+        v, d = len(self.vocab), self.vector_size
+        rs = np.random.RandomState(self.seed)
+        self.syn0 = jnp.asarray((rs.rand(v, d).astype(np.float32) - 0.5) / d)
+        rows = v if not self.use_hs else max(v - 1, 1)
+        self.syn1 = jnp.asarray(np.zeros((rows, d), np.float32))
+        counts = self.vocab.counts().astype(np.float64)
+        probs = counts ** 0.75
+        self._neg_table = (probs / probs.sum()).astype(np.float64)
+        total = counts.sum()
+        freq = counts / total
+        self._keep_prob = np.minimum(1.0, np.sqrt(self.subsample / np.maximum(freq, 1e-12))
+                                     + self.subsample / np.maximum(freq, 1e-12))
+        if self.use_hs:
+            self._max_code = max((len(w.codes) for w in self.vocab._by_index), default=1)
+        return self
+
+    # ---- pair generation (host side) ----
+
+    def _encode(self, seq):
+        idx = [self.vocab.index_of(t) for t in seq]
+        return [i for i in idx if i >= 0]
+
+    def _pairs_from_sequences(self, sequences):
+        centers, contexts = [], []
+        for seq in sequences:
+            idx = self._encode(seq)
+            if self.subsample > 0:
+                idx = [i for i in idx if self._rs.rand() < self._keep_prob[i]]
+            n = len(idx)
+            for pos in range(n):
+                b = self._rs.randint(1, self.window + 1)
+                for off in range(-b, b + 1):
+                    j = pos + off
+                    if off == 0 or j < 0 or j >= n:
+                        continue
+                    centers.append(idx[pos])
+                    contexts.append(idx[j])
+        return np.asarray(centers, np.int32), np.asarray(contexts, np.int32)
+
+    def _draw_negatives(self, shape):
+        return self._rs.choice(len(self._neg_table), size=shape,
+                               p=self._neg_table).astype(np.int32)
+
+    def _cbow_windows(self, sequences):
+        """(context_idx [N,2*window], context_mask, targets [N]) padded windows."""
+        W = 2 * self.window
+        ctx_rows, masks, targets = [], [], []
+        for seq in sequences:
+            idx = self._encode(seq)
+            if self.subsample > 0:
+                idx = [i for i in idx if self._rs.rand() < self._keep_prob[i]]
+            n = len(idx)
+            for pos in range(n):
+                b = self._rs.randint(1, self.window + 1)
+                window = [idx[pos + off] for off in range(-b, b + 1)
+                          if off != 0 and 0 <= pos + off < n]
+                if not window:
+                    continue
+                row = np.zeros(W, np.int32)
+                m = np.zeros(W, np.float32)
+                row[:len(window)] = window
+                m[:len(window)] = 1.0
+                ctx_rows.append(row)
+                masks.append(m)
+                targets.append(idx[pos])
+        if not ctx_rows:
+            z = np.zeros((0, W), np.int32)
+            return z, np.zeros((0, W), np.float32), np.zeros((0,), np.int32)
+        return (np.stack(ctx_rows), np.stack(masks),
+                np.asarray(targets, np.int32))
+
+    # ---- training ----
+
+    def fit(self, sequences):
+        """sequences: iterable (re-iterable) of token lists."""
+        seq_list = [list(s) for s in sequences]
+        if self.vocab is None:
+            self.build_vocab(seq_list)
+        total_steps = max(self.epochs, 1)
+        losses = []
+        for epoch in range(self.epochs):
+            frac = epoch / total_steps
+            lr = max(self.learning_rate * (1 - frac), self.min_learning_rate)
+            if self.algorithm == "cbow" and not self.use_hs:
+                ctx, cmask, targets = self._cbow_windows(seq_list)
+                perm = self._rs.permutation(len(targets))
+                ctx, cmask, targets = ctx[perm], cmask[perm], targets[perm]
+                for i in range(0, len(targets), self.batch_size):
+                    t = targets[i:i + self.batch_size]
+                    if len(t) == 0:
+                        continue
+                    negs = self._draw_negatives((len(t), self.negative))
+                    self.syn0, self.syn1, loss = _cbow_step(
+                        self.syn0, self.syn1, jnp.asarray(ctx[i:i + self.batch_size]),
+                        jnp.asarray(cmask[i:i + self.batch_size]), jnp.asarray(t),
+                        jnp.asarray(negs), lr)
+                    losses.append(float(loss))
+                continue
+            centers, contexts = self._pairs_from_sequences(seq_list)
+            perm = self._rs.permutation(len(centers))
+            centers, contexts = centers[perm], contexts[perm]
+            for i in range(0, len(centers), self.batch_size):
+                c = centers[i:i + self.batch_size]
+                t = contexts[i:i + self.batch_size]
+                if len(c) == 0:
+                    continue
+                if self.use_hs:
+                    pts, codes, mask = self._huffman_batch(t)
+                    self.syn0, self.syn1, loss = _hs_step(
+                        self.syn0, self.syn1, jnp.asarray(c), jnp.asarray(pts),
+                        jnp.asarray(codes), jnp.asarray(mask), lr)
+                else:
+                    negs = self._draw_negatives((len(c), self.negative))
+                    self.syn0, self.syn1, loss = _sgns_step(
+                        self.syn0, self.syn1, jnp.asarray(c), jnp.asarray(t),
+                        jnp.asarray(negs), lr)
+                losses.append(float(loss))
+        self.loss_history = losses
+        return self
+
+    def _huffman_batch(self, targets):
+        L = self._max_code
+        b = len(targets)
+        pts = np.zeros((b, L), np.int32)
+        codes = np.zeros((b, L), np.float32)
+        mask = np.zeros((b, L), np.float32)
+        for r, t in enumerate(targets):
+            vw = self.vocab._by_index[t]
+            k = len(vw.codes)
+            pts[r, :k] = vw.points
+            codes[r, :k] = vw.codes
+            mask[r, :k] = 1.0
+        return pts, codes, mask
+
+    # ---- query API (reference: WordVectors interface) ----
+
+    def get_word_vector(self, word):
+        i = self.vocab.index_of(word)
+        return None if i < 0 else np.asarray(self.syn0[i])
+
+    def has_word(self, word):
+        return self.vocab is not None and word in self.vocab
+
+    def similarity(self, w1, w2):
+        a, b = self.get_word_vector(w1), self.get_word_vector(w2)
+        if a is None or b is None:
+            return float("nan")
+        return float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+    def words_nearest(self, word, top_n=10):
+        i = self.vocab.index_of(word)
+        if i < 0:
+            return []
+        m = np.asarray(self.syn0)
+        norms = m / (np.linalg.norm(m, axis=1, keepdims=True) + 1e-12)
+        sims = norms @ norms[i]
+        order = np.argsort(-sims)
+        return [(self.vocab.word_for(j), float(sims[j]))
+                for j in order if j != i][:top_n]
+
+
+class Word2Vec(SequenceVectors):
+    """(reference: models/word2vec/Word2Vec.java — SequenceVectors over
+    tokenized sentences)."""
+
+    def __init__(self, *, tokenizer_factory=None, **kwargs):
+        super().__init__(**kwargs)
+        from deeplearning4j_tpu.text.tokenization import (CommonPreprocessor,
+                                                          DefaultTokenizerFactory)
+        self.tokenizer_factory = tokenizer_factory or \
+            DefaultTokenizerFactory(CommonPreprocessor())
+
+    def fit_sentences(self, sentences):
+        seqs = [self.tokenizer_factory.create(s).get_tokens() for s in sentences]
+        return self.fit(seqs)

@@ -1,0 +1,171 @@
+"""NLP stack tests (reference: Word2VecTests, ParagraphVectorsTest,
+GloveTest, TfidfVectorizerTest, tokenization tests in deeplearning4j-nlp)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.text import (BagOfWordsVectorizer, DefaultTokenizerFactory,
+                                     GloVe, ParagraphVectors, SequenceVectors,
+                                     TfidfVectorizer, VocabConstructor, Word2Vec,
+                                     huffman_encode, load_word_vectors,
+                                     save_word_vectors)
+from deeplearning4j_tpu.text.tokenization import CommonPreprocessor
+
+
+def _toy_corpus(n=300, seed=0):
+    """Two topic clusters: (cat, dog, pet) and (car, road, drive)."""
+    rs = np.random.RandomState(seed)
+    animals = ["cat", "dog", "pet", "fur", "meow"]
+    vehicles = ["car", "road", "drive", "wheel", "fuel"]
+    seqs = []
+    for _ in range(n):
+        pool = animals if rs.rand() < 0.5 else vehicles
+        seqs.append([pool[rs.randint(len(pool))] for _ in range(8)])
+    return seqs
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        tok = DefaultTokenizerFactory(CommonPreprocessor()).create("Hello, World! 123 foo")
+        assert tok.get_tokens() == ["hello", "world", "foo"]
+
+    def test_tokenizer_iteration(self):
+        tok = DefaultTokenizerFactory().create("a b c")
+        out = []
+        while tok.has_more_tokens():
+            out.append(tok.next_token())
+        assert out == ["a", "b", "c"]
+
+
+class TestVocab:
+    def test_min_count_pruning(self):
+        seqs = [["a"] * 10 + ["b"] * 2 + ["c"]]
+        vocab = VocabConstructor(min_count=2, build_huffman=False).build(seqs)
+        assert "a" in vocab and "b" in vocab and "c" not in vocab
+        assert vocab.index_of("a") == 0  # most frequent first
+
+    def test_huffman_codes_prefix_free(self):
+        seqs = [["w%d" % i] * (i + 1) for i in range(8)]
+        vocab = VocabConstructor(min_count=1).build(seqs)
+        codes = ["".join(map(str, vocab.vocab_word(w).codes)) for w in vocab.words()]
+        assert all(codes)
+        for i, c1 in enumerate(codes):
+            for j, c2 in enumerate(codes):
+                if i != j:
+                    assert not c2.startswith(c1)
+
+    def test_huffman_frequent_words_shorter(self):
+        seqs = [["common"] * 100, ["rare1"], ["rare2"], ["rare3"]]
+        vocab = VocabConstructor(min_count=1).build(seqs)
+        c_common = len(vocab.vocab_word("common").codes)
+        c_rare = len(vocab.vocab_word("rare1").codes)
+        assert c_common <= c_rare
+
+
+class TestWord2Vec:
+    def test_sgns_learns_topic_structure(self):
+        sv = SequenceVectors(vector_size=16, window=3, min_count=1, negative=4,
+                             epochs=20, learning_rate=0.1, batch_size=128,
+                             subsample=0, seed=1)
+        sv.fit(_toy_corpus())
+        within = sv.similarity("cat", "dog")
+        across = sv.similarity("cat", "car")
+        assert within > across + 0.15, (within, across)
+
+    def test_hierarchical_softmax_path(self):
+        sv = SequenceVectors(vector_size=16, window=3, min_count=1, epochs=20,
+                             learning_rate=0.1, batch_size=128,
+                             use_hierarchic_softmax=True, subsample=0, seed=2)
+        sv.fit(_toy_corpus(200))
+        assert sv.loss_history[-1] < sv.loss_history[0]
+        assert sv.similarity("cat", "dog") > sv.similarity("cat", "road")
+
+    def test_cbow(self):
+        sv = SequenceVectors(vector_size=16, window=3, min_count=1, negative=4,
+                             epochs=20, learning_rate=0.1, batch_size=128,
+                             algorithm="cbow", subsample=0, seed=3)
+        sv.fit(_toy_corpus(200))
+        assert sv.similarity("wheel", "fuel") > sv.similarity("wheel", "meow")
+
+    def test_words_nearest(self):
+        sv = SequenceVectors(vector_size=16, window=3, min_count=1, negative=4,
+                             epochs=20, learning_rate=0.1, batch_size=128,
+                             subsample=0, seed=4)
+        sv.fit(_toy_corpus())
+        nearest = [w for w, _ in sv.words_nearest("cat", top_n=4)]
+        animal_hits = len(set(nearest) & {"dog", "pet", "fur", "meow"})
+        assert animal_hits >= 3, nearest
+
+    def test_word2vec_sentences(self):
+        w2v = Word2Vec(vector_size=8, window=2, min_count=1, negative=2,
+                       epochs=2, seed=5)
+        w2v.fit_sentences(["The cat sat on the mat.", "The dog ate my homework."])
+        assert w2v.has_word("cat")
+        assert w2v.get_word_vector("cat").shape == (8,)
+
+    def test_serialization_roundtrip(self, tmp_path):
+        sv = SequenceVectors(vector_size=8, min_count=1, negative=2, epochs=1, seed=6)
+        sv.fit([["a", "b", "c", "a", "b"]])
+        p = str(tmp_path / "vecs.txt")
+        save_word_vectors(sv, p)
+        words, mat = load_word_vectors(p)
+        assert set(words) == {"a", "b", "c"}
+        np.testing.assert_allclose(mat[words.index("a")],
+                                   sv.get_word_vector("a"), atol=1e-5)
+
+
+class TestParagraphVectors:
+    def test_dbow_doc_similarity(self):
+        rs = np.random.RandomState(0)
+        docs = []
+        for i in range(30):
+            pool = ["cat", "dog", "pet"] if i % 2 == 0 else ["car", "road", "drive"]
+            docs.append((f"doc{i}", [pool[rs.randint(3)] for _ in range(12)]))
+        pv = ParagraphVectors(vector_size=12, min_count=1, negative=4, epochs=40,
+                              learning_rate=0.1, batch_size=128, subsample=0, seed=7)
+        pv.fit_documents(docs)
+        same = pv.doc_similarity("doc0", "doc2")      # both animal topics
+        diff = pv.doc_similarity("doc0", "doc1")      # animal vs vehicle
+        assert same > diff, (same, diff)
+
+    def test_infer_vector(self):
+        docs = [("d0", ["cat", "dog"] * 6), ("d1", ["car", "road"] * 6)]
+        pv = ParagraphVectors(vector_size=8, min_count=1, negative=2, epochs=10,
+                              subsample=0, seed=8)
+        pv.fit_documents(docs)
+        v = pv.infer_vector(["cat", "dog", "cat"])
+        assert v.shape == (8,)
+        assert np.all(np.isfinite(v))
+
+    def test_dm_mode_runs(self):
+        docs = [("d0", ["cat", "dog", "pet"] * 4), ("d1", ["car", "road", "drive"] * 4)]
+        pv = ParagraphVectors(vector_size=8, min_count=1, negative=2, epochs=5,
+                              dm=True, subsample=0, seed=9)
+        pv.fit_documents(docs)
+        assert np.all(np.isfinite(pv.get_doc_vector("d0")))
+
+
+class TestGloVe:
+    def test_loss_decreases_and_structure(self):
+        g = GloVe(vector_size=12, window=3, min_count=1, epochs=30,
+                  learning_rate=0.05, seed=10)
+        g.fit(_toy_corpus(200))
+        assert g.loss_history[-1] < g.loss_history[0]
+        assert g.similarity("cat", "dog") > g.similarity("cat", "road")
+
+
+class TestVectorizers:
+    DOCS = ["the cat sat", "the dog sat", "cars drive fast", "the cat and dog"]
+
+    def test_bow_counts(self):
+        bow = BagOfWordsVectorizer(min_count=1)
+        mat = bow.fit_transform(self.DOCS)
+        assert mat.shape[0] == 4
+        cat = bow.vocab.index_of("cat")
+        assert mat[0, cat] == 1 and mat[2, cat] == 0
+
+    def test_tfidf_downweights_common(self):
+        tv = TfidfVectorizer(min_count=1)
+        mat = tv.fit_transform(self.DOCS)
+        the, cars = tv.vocab.index_of("the"), tv.vocab.index_of("cars")
+        assert tv.idf[the] < tv.idf[cars]
