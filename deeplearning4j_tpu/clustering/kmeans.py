@@ -1,0 +1,81 @@
+"""K-means clustering, device-accelerated.
+
+Reference analog: clustering/kmeans/KMeansClustering.java + the clustering
+strategy framework in /root/reference/deeplearning4j-nearestneighbors-parent/
+nearestneighbor-core. Lloyd iterations run as jitted matmul distance +
+segment-sum — the TPU-native form (the reference loops in Java over ND4J
+ops).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _lloyd_step(points, centroids, k):
+    # pairwise squared distances via (a-b)^2 = a^2 - 2ab + b^2 (one matmul)
+    p2 = jnp.sum(points**2, axis=1, keepdims=True)
+    c2 = jnp.sum(centroids**2, axis=1)
+    d2 = p2 - 2.0 * points @ centroids.T + c2
+    assign = jnp.argmin(d2, axis=1)
+    onehot = jax.nn.one_hot(assign, k, dtype=points.dtype)
+    counts = jnp.sum(onehot, axis=0)
+    sums = onehot.T @ points
+    new_centroids = jnp.where(counts[:, None] > 0,
+                              sums / jnp.maximum(counts[:, None], 1.0),
+                              centroids)
+    inertia = jnp.sum(jnp.min(d2, axis=1))
+    return new_centroids, assign, inertia
+
+
+class KMeans:
+    def __init__(self, k, *, max_iterations=100, tol=1e-6, seed=0,
+                 init="kmeans++"):
+        self.k = k
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.seed = seed
+        self.init = init
+        self.centroids = None
+
+    def _init_centroids(self, points, rs):
+        n = len(points)
+        if self.init == "random":
+            return points[rs.choice(n, self.k, replace=False)]
+        # kmeans++
+        centroids = [points[rs.randint(n)]]
+        for _ in range(1, self.k):
+            d2 = np.min(np.stack([np.sum((points - c) ** 2, axis=1)
+                                  for c in centroids]), axis=0)
+            probs = d2 / max(d2.sum(), 1e-12)
+            centroids.append(points[rs.choice(n, p=probs)])
+        return np.stack(centroids)
+
+    def fit(self, points):
+        points = np.asarray(points, np.float32)
+        rs = np.random.RandomState(self.seed)
+        centroids = jnp.asarray(self._init_centroids(points, rs))
+        pts = jnp.asarray(points)
+        prev_inertia = np.inf
+        for it in range(self.max_iterations):
+            centroids, assign, inertia = _lloyd_step(pts, centroids, self.k)
+            inertia = float(inertia)
+            if abs(prev_inertia - inertia) < self.tol * max(abs(prev_inertia), 1.0):
+                break
+            prev_inertia = inertia
+        self.centroids = np.asarray(centroids)
+        self.labels_ = np.asarray(assign)
+        self.inertia_ = inertia
+        self.n_iter_ = it + 1
+        return self
+
+    def predict(self, points):
+        points = np.asarray(points, np.float32)
+        d2 = (np.sum(points**2, 1, keepdims=True)
+              - 2 * points @ self.centroids.T + np.sum(self.centroids**2, 1))
+        return np.argmin(d2, axis=1)

@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.graphlib.graph import Graph  # noqa: F401
+from deeplearning4j_tpu.graphlib.walks import RandomWalkIterator, WeightedWalkIterator  # noqa: F401
+from deeplearning4j_tpu.graphlib.deepwalk import DeepWalk  # noqa: F401

@@ -1,0 +1,55 @@
+"""Random-walk generators.
+
+Reference analog: graph/iterator/RandomWalkIterator.java /
+WeightedWalkIterator.java in /root/reference/deeplearning4j-graph.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class RandomWalkIterator:
+    """Uniform random walks of fixed length from every vertex."""
+
+    def __init__(self, graph, walk_length, *, seed=0, no_edge_handling="self_loop"):
+        self.graph = graph
+        self.walk_length = walk_length
+        self.rs = np.random.RandomState(seed)
+        self.no_edge_handling = no_edge_handling
+
+    def __iter__(self):
+        for start in range(self.graph.n_vertices):
+            yield self.walk_from(start)
+
+    def walk_from(self, start):
+        walk = [start]
+        cur = start
+        for _ in range(self.walk_length - 1):
+            nbrs = self.graph.neighbors(cur)
+            if not nbrs:
+                if self.no_edge_handling == "self_loop":
+                    walk.append(cur)
+                    continue
+                break
+            cur = nbrs[self.rs.randint(len(nbrs))]
+            walk.append(cur)
+        return walk
+
+
+class WeightedWalkIterator(RandomWalkIterator):
+    """Edge-weight-proportional walks."""
+
+    def walk_from(self, start):
+        walk = [start]
+        cur = start
+        for _ in range(self.walk_length - 1):
+            nbrs = self.graph.neighbors_weighted(cur)
+            if not nbrs:
+                walk.append(cur)
+                continue
+            weights = np.array([w for _, w in nbrs])
+            probs = weights / weights.sum()
+            cur = nbrs[self.rs.choice(len(nbrs), p=probs)][0]
+            walk.append(cur)
+        return walk

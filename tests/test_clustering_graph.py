@@ -1,0 +1,123 @@
+"""Clustering (VPTree/KDTree/KMeans/t-SNE/NN-server) + graph (DeepWalk) tests
+(reference: nearestneighbor-core tests, BarnesHutTsneTest, DeepWalk tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, KMeans, TSNE, VPTree
+from deeplearning4j_tpu.clustering.server import NearestNeighborClient, NearestNeighborServer
+from deeplearning4j_tpu.graphlib import DeepWalk, Graph, RandomWalkIterator
+
+
+def _brute_knn(points, q, k):
+    d = np.sqrt(np.sum((points - q) ** 2, axis=1))
+    order = np.argsort(d, kind="stable")[:k]
+    return list(order), list(d[order])
+
+
+class TestTrees:
+    @pytest.mark.parametrize("tree_cls", [VPTree, KDTree])
+    def test_knn_matches_brute_force(self, tree_cls):
+        rs = np.random.RandomState(0)
+        pts = rs.randn(200, 5)
+        tree = tree_cls(pts)
+        for _ in range(10):
+            q = rs.randn(5)
+            idx, dist = tree.knn(q, k=5)
+            bidx, bdist = _brute_knn(pts, q, 5)
+            np.testing.assert_allclose(sorted(dist), sorted(bdist), rtol=1e-9)
+
+    def test_vptree_cosine(self):
+        pts = np.array([[1.0, 0.0], [0.0, 1.0], [0.9, 0.1]])
+        tree = VPTree(pts, distance="cosine")
+        idx, _ = tree.knn(np.array([1.0, 0.05]), k=1)
+        assert idx[0] in (0, 2)
+
+
+class TestKMeans:
+    def test_separates_clusters(self):
+        rs = np.random.RandomState(0)
+        c1 = rs.randn(50, 3) + [10, 0, 0]
+        c2 = rs.randn(50, 3) + [-10, 0, 0]
+        c3 = rs.randn(50, 3) + [0, 10, 0]
+        pts = np.concatenate([c1, c2, c3])
+        km = KMeans(3, seed=1).fit(pts)
+        labels = km.labels_
+        # each true cluster maps to a single predicted cluster
+        for sl in (slice(0, 50), slice(50, 100), slice(100, 150)):
+            assert len(np.unique(labels[sl])) == 1
+        assert km.inertia_ < 1000
+
+    def test_predict_consistent(self):
+        rs = np.random.RandomState(1)
+        pts = rs.randn(100, 4)
+        km = KMeans(4, seed=2).fit(pts)
+        np.testing.assert_array_equal(km.predict(pts), km.labels_)
+
+
+class TestTSNE:
+    def test_preserves_cluster_structure(self):
+        rs = np.random.RandomState(0)
+        a = rs.randn(30, 10) + 8
+        b = rs.randn(30, 10) - 8
+        x = np.concatenate([a, b])
+        ts = TSNE(perplexity=10, n_iter=300, learning_rate=50, seed=3)
+        y = ts.fit_transform(x)
+        assert y.shape == (60, 2)
+        # clusters remain separated in the embedding
+        ca, cb = y[:30].mean(0), y[30:].mean(0)
+        spread = max(y[:30].std(), y[30:].std())
+        assert np.linalg.norm(ca - cb) > 2 * spread
+        assert ts.kl_history[-1] < ts.kl_history[0]
+
+
+class TestNNServer:
+    def test_roundtrip(self):
+        rs = np.random.RandomState(0)
+        pts = rs.randn(50, 4)
+        server = NearestNeighborServer(pts, port=0).start()
+        try:
+            client = NearestNeighborClient(port=server.port)
+            idx, dist = client.knn(pts[7], k=3)
+            assert idx[0] == 7
+            assert dist[0] == pytest.approx(0.0, abs=1e-9)
+        finally:
+            server.stop()
+
+
+class TestGraph:
+    def _barbell(self):
+        """Two dense cliques joined by one edge."""
+        g = Graph(10)
+        for i in range(5):
+            for j in range(i + 1, 5):
+                g.add_edge(i, j)
+                g.add_edge(i + 5, j + 5)
+        g.add_edge(4, 5)
+        return g
+
+    def test_walk_stays_on_graph(self):
+        g = self._barbell()
+        it = RandomWalkIterator(g, walk_length=10, seed=0)
+        for walk in it:
+            assert len(walk) == 10
+            for a, b in zip(walk, walk[1:]):
+                assert b in g.neighbors(a) or b == a
+
+    def test_deepwalk_community_structure(self):
+        g = self._barbell()
+        dw = DeepWalk(vector_size=16, window=3, walk_length=20, walks_per_vertex=8,
+                      epochs=30, learning_rate=0.2, use_hierarchic_softmax=True,
+                      seed=4)
+        dw.fit(g)
+        within = dw.similarity(0, 1)
+        across = dw.similarity(0, 9)
+        assert within > across, (within, across)
+
+    def test_graph_basics(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        g.add_edge(1, 2, weight=2.0)
+        assert g.degree(1) == 2
+        assert g.num_edges() == 2
+        assert set(g.neighbors(1)) == {0, 2}
