@@ -130,6 +130,36 @@ class LSTM(ParamLayer):
         xz = matmul(x_t, params["Wx"]) + params["b"]
         return self._step(params, h_c, xz, None)
 
+    def zero_carry(self, batch, dtype=jnp.float32):
+        z = jnp.zeros((batch, self.n_out), dtype)
+        return (z, z)
+
+    def apply_with_carry(self, params, carry, x, *, mask=None):
+        """Sequence apply that also returns the final (h, c) carry — the
+        TBPTT building block (reference: rnnActivateUsingStoredState /
+        doTruncatedBPTT at MultiLayerNetwork.java:1252-1254)."""
+        b, t, _ = x.shape
+        hsz = self.n_out
+        xz = matmul(x.reshape(b * t, -1), params["Wx"]) + params["b"]
+        xz = xz.reshape(b, t, 4 * hsz).transpose(1, 0, 2)
+        mask_tm = None if mask is None else mask.transpose(1, 0)
+        if carry is None:
+            carry = self.zero_carry(b, xz.dtype)
+
+        if mask_tm is None:
+            def body(c, xz_t):
+                return self._step(params, c, xz_t, None)
+            final, hs = lax.scan(body, carry, xz)
+        else:
+            def body(c, inp):
+                xz_t, m_t = inp
+                return self._step(params, c, xz_t, m_t)
+            final, hs = lax.scan(body, carry, (xz, mask_tm))
+        y = hs.transpose(1, 0, 2)
+        if mask is not None:
+            y = y * mask[..., None].astype(y.dtype)
+        return y, final
+
 
 @register_config
 @dataclasses.dataclass(frozen=True)
@@ -191,6 +221,22 @@ class SimpleRnn(ParamLayer):
         if mask is not None:
             y = y * mask[..., None].astype(y.dtype)
         return y, state
+
+    def zero_carry(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def apply_with_carry(self, params, carry, x, *, mask=None):
+        b = x.shape[0]
+        if carry is None:
+            carry = self.zero_carry(b, x.dtype)
+        y, _ = self.apply(params, {}, x, mask=mask, initial_state=carry)
+        # final hidden = last (mask-aware) output
+        if mask is None:
+            final = y[:, -1, :]
+        else:
+            idx = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=1) - 1, 0)
+            final = y[jnp.arange(b), idx, :]
+        return y, final
 
 
 @register_config

@@ -136,6 +136,112 @@ class MultiLayerNetwork:
                 loss = loss + layer.regularization_penalty(p)
         return loss, (new_state, preds)
 
+    # ------------------------------------------------------------------
+    # truncated BPTT (reference: doTruncatedBPTT, MultiLayerNetwork.java:
+    # 1252-1254 + BackpropType.TruncatedBPTT) — long sequences are split
+    # into tbptt_fwd_length chunks; RNN hidden state carries across chunks
+    # with stop_gradient at the boundary, bounding the backprop window.
+    # ------------------------------------------------------------------
+
+    def _apply_rnn(self, params, state, x, carries, *, train=False, rng=None,
+                   mask=None):
+        """Forward pass threading RNN carries. Returns (y, new_state, new_carries)."""
+        new_state = list(state)
+        new_carries = list(carries)
+        cur_type = self.conf.input_type
+        for i, layer in enumerate(self.conf.layers):
+            fam = layer.input_family
+            if fam is not None and not isinstance(cur_type, fam):
+                x = _inputs.adapt(x, cur_type, fam)
+                cur_type = _inputs.adapted_type(cur_type, fam)
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            if hasattr(layer, "apply_with_carry"):
+                x, new_carries[i] = layer.apply_with_carry(
+                    params[i], carries[i], x, mask=mask)
+            else:
+                kwargs = {"mask": mask} if (self._mask_aware[i] and mask is not None) else {}
+                x, new_state[i] = layer.apply(params[i], state[i], x, train=train,
+                                              rng=sub, **kwargs)
+            cur_type = layer.output_type(cur_type)
+        return x, new_state, new_carries
+
+    def make_tbptt_step(self, jit=True):
+        conf = self.conf
+
+        def tbptt_step(params, state, opt_state, carries, x, y, step, rng, mask=None):
+            carries = jax.tree_util.tree_map(jax.lax.stop_gradient, carries)
+
+            def chunk_loss(params):
+                preds, new_state, new_carries = self._apply_rnn(
+                    params, state, x, carries, train=True, rng=rng, mask=mask)
+                out_layer = conf.layers[-1]
+                loss = out_layer.compute_loss(preds, y, mask)
+                for layer, p in zip(conf.layers, params):
+                    if p:
+                        loss = loss + layer.regularization_penalty(p)
+                return loss, (new_state, new_carries)
+
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                chunk_loss, has_aux=True)(params)
+            grads = _gradnorm.normalize_grads(conf.gradient_normalization, grads,
+                                              conf.gradient_normalization_threshold)
+            updates, new_opt = conf.updater.update(grads, opt_state, params, step)
+            new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            return new_params, new_state, new_opt, new_carries, loss
+
+        return jax.jit(tbptt_step) if jit else tbptt_step
+
+    def _fit_tbptt(self, x, y, mask):
+        if not hasattr(self, "_tbptt_step") or self._tbptt_step is None:
+            self._tbptt_step = self.make_tbptt_step()
+        T = x.shape[1]
+        L = self.conf.tbptt_fwd_length
+        carries = [l.zero_carry(x.shape[0], jnp.asarray(x).dtype)
+                   if hasattr(l, "zero_carry") else None
+                   for l in self.conf.layers]
+        total = 0.0
+        n_chunks = 0
+        for t0 in range(0, T, L):
+            cx = jnp.asarray(x[:, t0:t0 + L])
+            cy = jnp.asarray(y[:, t0:t0 + L])
+            cm = jnp.asarray(mask[:, t0:t0 + L]) if mask is not None else None
+            self._rng, sub = jax.random.split(self._rng)
+            (self.params, self.state, self.opt_state, carries, loss) = \
+                self._tbptt_step(self.params, self.state, self.opt_state,
+                                 carries, cx, cy, self.iteration, sub, cm)
+            total += float(loss)
+            n_chunks += 1
+            self.iteration += 1
+        self.score_value = total / max(n_chunks, 1)
+        return self.score_value
+
+    # ------------------------------------------------------------------
+    # streaming inference (reference: RecurrentLayer.rnnTimeStep contract)
+    # ------------------------------------------------------------------
+
+    def rnn_clear_previous_state(self):
+        self._rnn_stream_state = None
+
+    def rnn_time_step(self, x):
+        """One timestep [B, F] (or a short [B,T,F] chunk) of streaming
+        inference, carrying hidden state between calls."""
+        x = jnp.asarray(x)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None, :]
+        carries = getattr(self, "_rnn_stream_state", None)
+        if carries is None:
+            carries = [l.zero_carry(x.shape[0], x.dtype)
+                       if hasattr(l, "zero_carry") else None
+                       for l in self.conf.layers]
+        y, _, carries = self._apply_rnn(self.params, self.state, x, carries,
+                                        train=False)
+        self._rnn_stream_state = carries
+        return y[:, 0] if squeeze else y
+
     def make_train_step(self, donate=True, jit=True):
         """Build the jitted train step:
         (params, state, opt_state, x, y, step, rng, mask) ->
@@ -187,12 +293,16 @@ class MultiLayerNetwork:
                 x, y = jnp.asarray(x), jnp.asarray(y)
                 m = jnp.asarray(m) if m is not None else None
                 etl_time = time.perf_counter() - etl_start
-                self._rng, step_rng = jax.random.split(self._rng)
-                self.params, self.state, self.opt_state, loss = self._train_step(
-                    self.params, self.state, self.opt_state, x, y,
-                    self.iteration, step_rng, m)
-                self.score_value = loss
-                self.iteration += 1
+                if (self.conf.backprop_type == "tbptt" and x.ndim == 3
+                        and y.ndim == 3 and x.shape[1] > self.conf.tbptt_fwd_length):
+                    loss = self._fit_tbptt(x, y, m)
+                else:
+                    self._rng, step_rng = jax.random.split(self._rng)
+                    self.params, self.state, self.opt_state, loss = self._train_step(
+                        self.params, self.state, self.opt_state, x, y,
+                        self.iteration, step_rng, m)
+                    self.score_value = loss
+                    self.iteration += 1
                 for l in self.listeners:
                     l.iteration_done(self, self.iteration, float(loss), etl_time)
             for l in self.listeners:
