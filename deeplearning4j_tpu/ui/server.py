@@ -1,0 +1,160 @@
+"""Training dashboard web server.
+
+Reference analog: deeplearning4j-ui-parent/deeplearning4j-play/.../
+PlayUIServer.java + module/train/TrainModule.java (overview/model/system
+tabs) + remote/RemoteReceiverModule.java. Here: a dependency-free stdlib
+HTTP server with a self-contained HTML page (inline SVG charts) —
+
+    GET  /            dashboard page
+    GET  /train/sessions             -> session ids
+    GET  /train/overview?session=s   -> score curve + timing
+    GET  /train/model?session=s      -> per-param norms over time
+    POST /remote                     -> remote stats ingestion
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from urllib.parse import parse_qs, urlparse
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu training UI</title>
+<style>body{font-family:sans-serif;margin:2em}svg{border:1px solid #ccc}</style>
+</head><body>
+<h2>Training overview</h2>
+<div id="meta"></div>
+<svg id="score" width="800" height="300"></svg>
+<script>
+async function draw(){
+  const sessions = await (await fetch('/train/sessions')).json();
+  if(!sessions.length){setTimeout(draw,2000);return;}
+  const s = sessions[0];
+  const data = await (await fetch('/train/overview?session='+s)).json();
+  const pts = data.score;
+  document.getElementById('meta').textContent =
+      'session '+s+' — '+pts.length+' iterations';
+  const svg = document.getElementById('score');
+  if(!pts.length){setTimeout(draw,2000);return;}
+  const xs = pts.map(p=>p[0]), ys = pts.map(p=>p[1]);
+  const xmin=Math.min(...xs), xmax=Math.max(...xs);
+  const ymin=Math.min(...ys), ymax=Math.max(...ys);
+  const W=800,H=300,pad=40;
+  const px=x=>pad+(x-xmin)/(xmax-xmin||1)*(W-2*pad);
+  const py=y=>H-pad-(y-ymin)/(ymax-ymin||1)*(H-2*pad);
+  svg.innerHTML='<polyline fill="none" stroke="steelblue" stroke-width="1.5" points="'
+    +pts.map(p=>px(p[0])+','+py(p[1])).join(' ')+'"/>'
+    +'<text x="10" y="20">score (min '+ymin.toFixed(4)+')</text>';
+  setTimeout(draw, 2000);
+}
+draw();
+</script></body></html>"""
+
+
+class UIServer:
+    """(reference: UIServer.getInstance().attach(statsStorage))"""
+
+    _instance = None
+
+    def __init__(self, port=0):
+        self.storages = []
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                q = parse_qs(url.query)
+                if url.path in ("/", "/train", "/train/overview.html"):
+                    body = _PAGE.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if url.path == "/train/sessions":
+                    out = sorted({s for st in server.storages for s in st.sessions()})
+                    self._json(out)
+                    return
+                if url.path == "/train/overview":
+                    session = q.get("session", ["default"])[0]
+                    recs = server._records(session, "stats")
+                    self._json({
+                        "score": [[r["iteration"], r["score"]] for r in recs],
+                        "iter_time_s": [[r["iteration"], r.get("iter_time_s", 0)]
+                                        for r in recs],
+                        "etl_time_s": [[r["iteration"], r.get("etl_time_s", 0)]
+                                       for r in recs]})
+                    return
+                if url.path == "/train/model":
+                    session = q.get("session", ["default"])[0]
+                    recs = server._records(session, "stats")
+                    series = {}
+                    for r in recs:
+                        for name, st in (r.get("params") or {}).items():
+                            series.setdefault(name, []).append(
+                                [r["iteration"], st["l2"], st["mean"], st["std"]])
+                    self._json(series)
+                    return
+                self.send_error(404)
+
+            def do_POST(self):
+                if urlparse(self.path).path != "/remote":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                rec = json.loads(self.rfile.read(length))
+                server._remote_storage().put_record(rec)
+                self._json({"ok": True})
+
+        self._httpd = HTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = None
+        self._remote = None
+
+    @classmethod
+    def get_instance(cls, port=0):
+        if cls._instance is None:
+            cls._instance = cls(port=port).start()
+        return cls._instance
+
+    def _remote_storage(self):
+        if self._remote is None:
+            from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+            self._remote = InMemoryStatsStorage()
+            self.storages.append(self._remote)
+        return self._remote
+
+    def _records(self, session, type_):
+        out = []
+        for st in self.storages:
+            out.extend(st.get_records(session=session, type_=type_))
+        out.sort(key=lambda r: r.get("iteration", 0))
+        return out
+
+    def attach(self, storage):
+        self.storages.append(storage)
+        return self
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+        UIServer._instance = None

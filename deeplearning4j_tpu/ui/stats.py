@@ -1,0 +1,85 @@
+"""Training statistics collection.
+
+Reference analog: deeplearning4j-ui-parent/deeplearning4j-ui-model/.../stats/
+BaseStatsListener.java (iterationDone:304 — score, param/gradient/update
+histograms & norms, memory, GC, hardware info every N iterations), encoded
+with SBE (SbeStatsReport.java). Here the record is a plain dict serialized as
+JSON-lines by the storage layer — compact, inspectable, and streaming-
+friendly; the SBE binary encoding was an artifact of JVM GC pressure that a
+host-side Python collector doesn't have.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.listeners import TrainingListener
+
+
+def _array_stats(tree, histogram_bins=0):
+    """Norms/means/stds per named leaf of a params-like pytree."""
+    import jax
+    out = {}
+    paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in paths:
+        name = jax.tree_util.keystr(path)
+        a = np.asarray(leaf, np.float64).ravel()
+        if a.size == 0:
+            continue
+        rec = {"l2": float(np.linalg.norm(a)),
+               "mean": float(a.mean()),
+               "std": float(a.std()),
+               "min": float(a.min()),
+               "max": float(a.max())}
+        if histogram_bins:
+            counts, edges = np.histogram(a, bins=histogram_bins)
+            rec["hist"] = {"counts": counts.tolist(),
+                           "min": float(edges[0]), "max": float(edges[-1])}
+        out[name] = rec
+    return out
+
+
+class StatsListener(TrainingListener):
+    """Collects per-iteration training telemetry into a StatsStorage."""
+
+    def __init__(self, storage, *, frequency=1, session_id="default",
+                 collect_histograms=False, histogram_bins=20):
+        self.storage = storage
+        self.frequency = frequency
+        self.session_id = session_id
+        self.collect_histograms = collect_histograms
+        self.histogram_bins = histogram_bins
+        self._last_time = None
+        self._init_posted = False
+
+    def _post_init(self, model):
+        info = {"type": "init", "session": self.session_id,
+                "time": time.time(),
+                "num_params": model.num_params() if model.params is not None else 0,
+                "num_layers": len(getattr(model.conf, "layers", ())) or
+                len(getattr(model.conf, "vertices", ()))}
+        self.storage.put_record(info)
+        self._init_posted = True
+
+    def iteration_done(self, model, iteration, score, etl_time=0.0):
+        if not self._init_posted:
+            self._post_init(model)
+        if iteration % self.frequency != 0:
+            return
+        now = time.perf_counter()
+        rec = {"type": "stats", "session": self.session_id,
+               "iteration": iteration, "time": time.time(),
+               "score": float(score), "etl_time_s": float(etl_time)}
+        if self._last_time is not None:
+            rec["iter_time_s"] = now - self._last_time
+        self._last_time = now
+        bins = self.histogram_bins if self.collect_histograms else 0
+        if model.params is not None:
+            rec["params"] = _array_stats(model.params, bins)
+        self.storage.put_record(rec)
+
+    def on_epoch_end(self, model):
+        self.storage.put_record({"type": "epoch_end", "session": self.session_id,
+                                 "epoch": model.epoch, "time": time.time()})

@@ -1,0 +1,81 @@
+"""Stats storage.
+
+Reference analog: deeplearning4j-core api/storage/StatsStorage.java +
+StatsStorageRouter.java + impl/RemoteUIStatsStorageRouter.java (SURVEY.md
+§2.4) and the MapDB/file-backed storages in the UI module. Implementations:
+in-memory, JSON-lines file, HTTP POST router (remote ingestion, the
+RemoteReceiverModule counterpart).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+
+class InMemoryStatsStorage:
+    def __init__(self):
+        self.records = []
+        self._listeners = []
+        self._lock = threading.Lock()
+
+    def put_record(self, record: dict):
+        with self._lock:
+            self.records.append(record)
+        for cb in self._listeners:
+            cb(record)
+
+    def get_records(self, session=None, type_=None):
+        with self._lock:
+            recs = list(self.records)
+        if session is not None:
+            recs = [r for r in recs if r.get("session") == session]
+        if type_ is not None:
+            recs = [r for r in recs if r.get("type") == type_]
+        return recs
+
+    def sessions(self):
+        return sorted({r.get("session", "default") for r in self.records})
+
+    def register_listener(self, cb):
+        self._listeners.append(cb)
+
+
+class FileStatsStorage(InMemoryStatsStorage):
+    """JSON-lines persistence (reference analog: FileStatsStorage on MapDB)."""
+
+    def __init__(self, path):
+        super().__init__()
+        self.path = path
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        self.records.append(json.loads(line))
+        self._fh = open(path, "a")
+
+    def put_record(self, record):
+        super().put_record(record)
+        self._fh.write(json.dumps(record) + "\n")
+        self._fh.flush()
+
+    def close(self):
+        self._fh.close()
+
+
+class RemoteStatsStorageRouter:
+    """POST records to a remote UIServer (reference:
+    RemoteUIStatsStorageRouter → RemoteReceiverModule)."""
+
+    def __init__(self, url):
+        self.url = url.rstrip("/") + "/remote"
+
+    def put_record(self, record):
+        import urllib.request
+        req = urllib.request.Request(
+            self.url, data=json.dumps(record).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            resp.read()

@@ -1,0 +1,90 @@
+"""Stats listener / storage / UI server tests (reference: TestStatsListener,
+TestRemoteReceiver in deeplearning4j-ui-parent)."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   RemoteStatsStorageRouter, StatsListener, UIServer)
+
+
+def _train_with(storage, iterations=5):
+    rs = np.random.RandomState(0)
+    x = rs.randn(32, 4)
+    y = np.eye(2)[rs.randint(0, 2, 32)]
+    net = MultiLayerNetwork(NeuralNetConfig(updater=U.Adam(learning_rate=0.01)).list(
+        L.DenseLayer(n_out=8, activation="tanh"),
+        L.OutputLayer(n_out=2, loss="mcxent"),
+        input_type=I.FeedForwardType(4)))
+    net.add_listener(StatsListener(storage, session_id="test-sess"))
+    net.fit(x, y, epochs=iterations)
+    return net
+
+
+class TestStatsCollection:
+    def test_records_collected(self):
+        storage = InMemoryStatsStorage()
+        _train_with(storage, 5)
+        stats = storage.get_records(type_="stats")
+        assert len(stats) == 5
+        assert all("score" in r and "params" in r for r in stats)
+        assert storage.get_records(type_="init")
+        assert storage.sessions() == ["test-sess"]
+
+    def test_param_norms_present(self):
+        storage = InMemoryStatsStorage()
+        _train_with(storage, 2)
+        rec = storage.get_records(type_="stats")[0]
+        keys = list(rec["params"].keys())
+        assert any("W" in k for k in keys)
+        for st in rec["params"].values():
+            assert st["l2"] >= 0
+
+    def test_file_storage_roundtrip(self, tmp_path):
+        p = str(tmp_path / "stats.jsonl")
+        storage = FileStatsStorage(p)
+        _train_with(storage, 3)
+        storage.close()
+        reloaded = FileStatsStorage(p)
+        assert len(reloaded.get_records(type_="stats")) == 3
+        reloaded.close()
+
+
+class TestUIServer:
+    def test_endpoints(self):
+        storage = InMemoryStatsStorage()
+        _train_with(storage, 4)
+        server = UIServer(port=0).attach(storage).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            sessions = json.loads(urllib.request.urlopen(base + "/train/sessions").read())
+            assert sessions == ["test-sess"]
+            overview = json.loads(urllib.request.urlopen(
+                base + "/train/overview?session=test-sess").read())
+            assert len(overview["score"]) == 4
+            model = json.loads(urllib.request.urlopen(
+                base + "/train/model?session=test-sess").read())
+            assert model
+            page = urllib.request.urlopen(base + "/").read().decode()
+            assert "Training overview" in page
+        finally:
+            server.stop()
+
+    def test_remote_ingestion(self):
+        server = UIServer(port=0).start()
+        try:
+            router = RemoteStatsStorageRouter(f"http://127.0.0.1:{server.port}")
+            router.put_record({"type": "stats", "session": "remote-s",
+                               "iteration": 1, "score": 0.5})
+            base = f"http://127.0.0.1:{server.port}"
+            sessions = json.loads(urllib.request.urlopen(base + "/train/sessions").read())
+            assert "remote-s" in sessions
+        finally:
+            server.stop()
