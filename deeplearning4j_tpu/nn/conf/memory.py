@@ -1,0 +1,177 @@
+"""Memory estimation reports.
+
+Reference analogs: nn/conf/memory/LayerMemoryReport.java and
+NetworkMemoryReport.java (/root/reference/deeplearning4j-nn/src/main/java/org/
+deeplearning4j/nn/conf/memory/) — per-layer and whole-network breakdowns of
+parameter, activation, gradient and updater-state memory for a given
+``MemoryUseMode`` (inference vs training).
+
+TPU-native shape: everything is computed symbolically with ``jax.eval_shape``
+— no device allocation happens. The report accounts HBM the way XLA sees it:
+params + opt state are persistent buffers; activations are the residuals the
+backward pass keeps live (the dominant transient term); gradients alias the
+param pytree. The reference's "workspace" overhead rows have no analog —
+XLA's arena is compiler-managed — so the report instead surfaces the numbers
+that matter for HBM budgeting on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import inputs as _inputs
+
+
+def _nbytes(tree) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(tree))
+
+
+def _count(tree) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerMemoryReport:
+    """Per-layer memory breakdown, in bytes except ``param_count``.
+
+    ``activation_bytes_per_example`` is the layer's output activation; during
+    training it is a residual held until the backward pass consumes it.
+    """
+
+    layer_name: str
+    layer_type: str
+    param_count: int
+    param_bytes: int
+    updater_state_bytes: int
+    activation_bytes_per_example: int
+
+    def training_fixed_bytes(self) -> int:
+        # params + gradients (same size) + updater state
+        return 2 * self.param_bytes + self.updater_state_bytes
+
+    def inference_fixed_bytes(self) -> int:
+        return self.param_bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class NetworkMemoryReport:
+    """Whole-network report (reference: NetworkMemoryReport.java)."""
+
+    layer_reports: tuple
+    input_bytes_per_example: int
+    model_name: str = "network"
+
+    @property
+    def total_param_count(self) -> int:
+        return sum(r.param_count for r in self.layer_reports)
+
+    @property
+    def total_param_bytes(self) -> int:
+        return sum(r.param_bytes for r in self.layer_reports)
+
+    @property
+    def total_updater_state_bytes(self) -> int:
+        return sum(r.updater_state_bytes for r in self.layer_reports)
+
+    def total_memory_bytes(self, batch_size: int, *, training: bool = True) -> int:
+        """Estimated peak HBM for one step at ``batch_size``.
+
+        Training keeps every layer's output activation live (residuals for the
+        backward pass); inference only needs the two largest neighbouring
+        activations at once (XLA double-buffers through the stack).
+        """
+        acts = [r.activation_bytes_per_example for r in self.layer_reports]
+        if training:
+            fixed = sum(r.training_fixed_bytes() for r in self.layer_reports)
+            transient = self.input_bytes_per_example + sum(acts)
+        else:
+            fixed = sum(r.inference_fixed_bytes() for r in self.layer_reports)
+            pairs = [self.input_bytes_per_example] + acts
+            transient = max(
+                (pairs[i] + pairs[i + 1] for i in range(len(pairs) - 1)),
+                default=self.input_bytes_per_example,
+            )
+        return fixed + batch_size * transient
+
+    def to_json(self, indent=2) -> str:
+        return json.dumps(
+            {
+                "model_name": self.model_name,
+                "total_param_count": self.total_param_count,
+                "total_param_bytes": self.total_param_bytes,
+                "total_updater_state_bytes": self.total_updater_state_bytes,
+                "input_bytes_per_example": self.input_bytes_per_example,
+                "layers": [dataclasses.asdict(r) for r in self.layer_reports],
+            },
+            indent=indent,
+        )
+
+    def summary(self, batch_size: int = 32) -> str:
+        lines = [
+            f"{'layer':<28}{'type':<26}{'params':>12}{'act/ex (B)':>12}",
+            "-" * 78,
+        ]
+        for r in self.layer_reports:
+            lines.append(
+                f"{r.layer_name:<28}{r.layer_type:<26}{r.param_count:>12}"
+                f"{r.activation_bytes_per_example:>12}"
+            )
+        lines.append("-" * 78)
+        lines.append(
+            f"total params: {self.total_param_count:,} "
+            f"({self.total_param_bytes / 1e6:.2f} MB), "
+            f"train @ batch {batch_size}: "
+            f"{self.total_memory_bytes(batch_size) / 1e6:.2f} MB, "
+            f"infer @ batch {batch_size}: "
+            f"{self.total_memory_bytes(batch_size, training=False) / 1e6:.2f} MB"
+        )
+        return "\n".join(lines)
+
+
+def _example_bytes(input_type, dtype) -> int:
+    n = 1
+    for d in input_type.shape(batch=1):
+        n *= d
+    return n * jnp.dtype(dtype).itemsize
+
+
+def memory_report(conf, *, dtype=jnp.float32, model_name="network") -> NetworkMemoryReport:
+    """Build a NetworkMemoryReport for a MultiLayerConfiguration.
+
+    Symbolic only — uses ``jax.eval_shape`` over each layer's ``init`` and the
+    network updater's ``init``, so no device memory is touched.
+    """
+    in_types, _ = conf.layer_input_types()
+    key = jax.random.PRNGKey(0)
+    reports = []
+    updater = conf.updater
+    for i, (layer, it) in enumerate(zip(conf.layers, in_types)):
+        p_shapes = jax.eval_shape(lambda l=layer, t=it: l.init(key, t, dtype))
+        try:
+            u_shapes = jax.eval_shape(lambda s=p_shapes: updater.init(s))
+            u_bytes = _nbytes(u_shapes)
+        except Exception:
+            u_bytes = 0
+        out_t = layer.output_type(
+            _inputs.adapted_type(it, layer.input_family) if layer.input_family
+            and not isinstance(it, layer.input_family) else it
+        )
+        reports.append(
+            LayerMemoryReport(
+                layer_name=f"layer_{i}",
+                layer_type=type(layer).__name__,
+                param_count=_count(p_shapes),
+                param_bytes=_nbytes(p_shapes),
+                updater_state_bytes=u_bytes,
+                activation_bytes_per_example=_example_bytes(out_t, dtype),
+            )
+        )
+    return NetworkMemoryReport(
+        layer_reports=tuple(reports),
+        input_bytes_per_example=_example_bytes(conf.input_type, dtype),
+        model_name=model_name,
+    )

@@ -1,0 +1,66 @@
+"""Memory estimation report tests (reference: nn/conf/memory/
+LayerMemoryReport.java + NetworkMemoryReport.java, SURVEY.md §2.1)."""
+
+import json
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.memory import memory_report
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+
+
+def _mlp_conf(updater):
+    return NeuralNetConfig(seed=1, updater=updater).list(
+        L.DenseLayer(n_out=20),
+        L.OutputLayer(n_out=3, loss="mcxent"),
+        input_type=I.feed_forward(10),
+    )
+
+
+def test_param_counts_exact():
+    rep = memory_report(_mlp_conf(U.Sgd(0.1)))
+    # dense: 10*20 + 20; output: 20*3 + 3
+    assert rep.layer_reports[0].param_count == 10 * 20 + 20
+    assert rep.layer_reports[1].param_count == 20 * 3 + 3
+    assert rep.total_param_count == 283
+    assert rep.total_param_bytes == 283 * 4
+
+
+def test_updater_state_scales_with_rule():
+    sgd = memory_report(_mlp_conf(U.Sgd(0.1)))
+    adam = memory_report(_mlp_conf(U.Adam(0.001)))
+    assert sgd.total_updater_state_bytes == 0
+    # Adam: two moments per param
+    assert adam.total_updater_state_bytes == 2 * adam.total_param_bytes
+
+
+def test_training_exceeds_inference_and_scales_with_batch():
+    rep = memory_report(_mlp_conf(U.Adam(0.001)))
+    assert rep.total_memory_bytes(32) > rep.total_memory_bytes(32, training=False)
+    assert rep.total_memory_bytes(64) > rep.total_memory_bytes(32)
+
+
+def test_conv_net_report_and_json():
+    conf = NeuralNetConfig(seed=1, updater=U.Adam(0.001)).list(
+        L.ConvolutionLayer(n_out=8, kernel=(3, 3), padding="same"),
+        L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)),
+        L.DenseLayer(n_out=16),
+        L.OutputLayer(n_out=10),
+        input_type=I.convolutional(28, 28, 1),
+    )
+    rep = memory_report(conf, model_name="lenet-ish")
+    # conv activations at 28x28x8 dominate per-example transient memory
+    assert rep.layer_reports[0].activation_bytes_per_example == 28 * 28 * 8 * 4
+    d = json.loads(rep.to_json())
+    assert d["model_name"] == "lenet-ish"
+    assert len(d["layers"]) == 4
+    assert "total params" in rep.summary()
+
+
+def test_dtype_halves_bytes():
+    rep32 = memory_report(_mlp_conf(U.Sgd(0.1)), dtype=jnp.float32)
+    rep16 = memory_report(_mlp_conf(U.Sgd(0.1)), dtype=jnp.bfloat16)
+    assert rep16.total_param_bytes * 2 == rep32.total_param_bytes
