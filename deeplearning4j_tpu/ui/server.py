@@ -92,7 +92,8 @@ class UIServer:
                     session = q.get("session", ["default"])[0]
                     recs = server._records(session, "stats")
                     self._json({
-                        "score": [[r["iteration"], r["score"]] for r in recs],
+                        "score": [[r["iteration"], r["score"]] for r in recs
+                                  if "score" in r],
                         "iter_time_s": [[r["iteration"], r.get("iter_time_s", 0)]
                                         for r in recs],
                         "etl_time_s": [[r["iteration"], r.get("etl_time_s", 0)]
@@ -104,8 +105,9 @@ class UIServer:
                     series = {}
                     for r in recs:
                         for name, st in (r.get("params") or {}).items():
-                            series.setdefault(name, []).append(
-                                [r["iteration"], st["l2"], st["mean"], st["std"]])
+                            if isinstance(st, dict) and {"l2", "mean", "std"} <= st.keys():
+                                series.setdefault(name, []).append(
+                                    [r["iteration"], st["l2"], st["mean"], st["std"]])
                     self._json(series)
                     return
                 self.send_error(404)
@@ -115,7 +117,22 @@ class UIServer:
                     self.send_error(404)
                     return
                 length = int(self.headers.get("Content-Length", 0))
-                rec = json.loads(self.rfile.read(length))
+                try:
+                    rec = json.loads(self.rfile.read(length))
+                except (ValueError, UnicodeDecodeError):
+                    self._json({"ok": False, "error": "invalid JSON body"}, code=400)
+                    return
+                if not isinstance(rec, dict):
+                    self._json({"ok": False, "error": "record must be a JSON object"},
+                               code=400)
+                    return
+                if rec.get("type") == "stats" and (
+                        not isinstance(rec.get("iteration"), (int, float))
+                        or not isinstance(rec.get("score"), (int, float))):
+                    self._json({"ok": False,
+                                "error": "stats record requires numeric "
+                                         "'iteration' and 'score'"}, code=400)
+                    return
                 server._remote_storage().put_record(rec)
                 self._json({"ok": True})
 
@@ -140,7 +157,8 @@ class UIServer:
     def _records(self, session, type_):
         out = []
         for st in self.storages:
-            out.extend(st.get_records(session=session, type_=type_))
+            out.extend(r for r in st.get_records(session=session, type_=type_)
+                       if isinstance(r, dict))
         out.sort(key=lambda r: r.get("iteration", 0))
         return out
 
@@ -157,4 +175,5 @@ class UIServer:
         self._httpd.shutdown()
         if self._thread:
             self._thread.join(timeout=5)
-        UIServer._instance = None
+        if UIServer._instance is self:
+            UIServer._instance = None

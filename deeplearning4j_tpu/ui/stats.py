@@ -18,26 +18,50 @@ import numpy as np
 from deeplearning4j_tpu.nn.listeners import TrainingListener
 
 
+def _leaf_stats(a):
+    import jax.numpy as jnp
+    a = a.astype(jnp.float32).ravel()
+    return {"l2": jnp.linalg.norm(a), "mean": a.mean(), "std": a.std(),
+            "min": a.min(), "max": a.max()}
+
+
+_jitted_stats = None
+
+
 def _array_stats(tree, histogram_bins=0):
-    """Norms/means/stds per named leaf of a params-like pytree."""
+    """Norms/means/stds per named leaf of a params-like pytree.
+
+    Reductions run on device in one jitted call (XLA fuses them); only the
+    scalars cross to the host — the full-parameter device→host transfer the
+    naive np.asarray path would do each iteration is the kind of per-step
+    host round-trip that kills TPU step time.
+    """
     import jax
+    global _jitted_stats
+    if _jitted_stats is None:
+        _jitted_stats = jax.jit(
+            lambda t: jax.tree_util.tree_map(_leaf_stats, t))
+    stats = jax.device_get(_jitted_stats(tree))
     out = {}
-    paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    paths = jax.tree_util.tree_flatten_with_path(stats)[0]
+    grouped = {}
     for path, leaf in paths:
-        name = jax.tree_util.keystr(path)
-        a = np.asarray(leaf, np.float64).ravel()
-        if a.size == 0:
-            continue
-        rec = {"l2": float(np.linalg.norm(a)),
-               "mean": float(a.mean()),
-               "std": float(a.std()),
-               "min": float(a.min()),
-               "max": float(a.max())}
-        if histogram_bins:
-            counts, edges = np.histogram(a, bins=histogram_bins)
-            rec["hist"] = {"counts": counts.tolist(),
-                           "min": float(edges[0]), "max": float(edges[-1])}
+        # path ends with the stat-name DictKey appended by _leaf_stats
+        name = jax.tree_util.keystr(path[:-1])
+        stat = path[-1].key
+        grouped.setdefault(name, {})[stat] = float(leaf)
+    for name, rec in grouped.items():
         out[name] = rec
+    if histogram_bins:
+        hpaths = jax.tree_util.tree_flatten_with_path(tree)[0]
+        for path, leaf in hpaths:
+            name = jax.tree_util.keystr(path)
+            a = np.asarray(leaf, np.float64).ravel()
+            if a.size == 0 or name not in out:
+                continue
+            counts, edges = np.histogram(a, bins=histogram_bins)
+            out[name]["hist"] = {"counts": counts.tolist(),
+                                 "min": float(edges[0]), "max": float(edges[-1])}
     return out
 
 
@@ -52,6 +76,7 @@ class StatsListener(TrainingListener):
         self.collect_histograms = collect_histograms
         self.histogram_bins = histogram_bins
         self._last_time = None
+        self._pending_times = []
         self._init_posted = False
 
     def _post_init(self, model):
@@ -66,15 +91,20 @@ class StatsListener(TrainingListener):
     def iteration_done(self, model, iteration, score, etl_time=0.0):
         if not self._init_posted:
             self._post_init(model)
+        # track wall time EVERY iteration so iter_time_s is per-iteration even
+        # when frequency > 1 (the reference's BaseStatsListener does the same)
+        now = time.perf_counter()
+        if self._last_time is not None:
+            self._pending_times.append(now - self._last_time)
+        self._last_time = now
         if iteration % self.frequency != 0:
             return
-        now = time.perf_counter()
         rec = {"type": "stats", "session": self.session_id,
                "iteration": iteration, "time": time.time(),
                "score": float(score), "etl_time_s": float(etl_time)}
-        if self._last_time is not None:
-            rec["iter_time_s"] = now - self._last_time
-        self._last_time = now
+        if self._pending_times:
+            rec["iter_time_s"] = sum(self._pending_times) / len(self._pending_times)
+            self._pending_times = []
         bins = self.histogram_bins if self.collect_histograms else 0
         if model.params is not None:
             rec["params"] = _array_stats(model.params, bins)

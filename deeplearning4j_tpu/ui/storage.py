@@ -18,7 +18,7 @@ class InMemoryStatsStorage:
     def __init__(self):
         self.records = []
         self._listeners = []
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
 
     def put_record(self, record: dict):
         with self._lock:
@@ -57,9 +57,11 @@ class FileStatsStorage(InMemoryStatsStorage):
         self._fh = open(path, "a")
 
     def put_record(self, record):
-        super().put_record(record)
-        self._fh.write(json.dumps(record) + "\n")
-        self._fh.flush()
+        line = json.dumps(record) + "\n"
+        with self._lock:  # append + file write atomically, so lines can't interleave
+            super().put_record(record)
+            self._fh.write(line)
+            self._fh.flush()
 
     def close(self):
         self._fh.close()
@@ -67,15 +69,63 @@ class FileStatsStorage(InMemoryStatsStorage):
 
 class RemoteStatsStorageRouter:
     """POST records to a remote UIServer (reference:
-    RemoteUIStatsStorageRouter → RemoteReceiverModule)."""
+    RemoteUIStatsStorageRouter → RemoteReceiverModule).
 
-    def __init__(self, url):
+    Asynchronous like the reference: records go on a bounded queue drained by
+    a daemon thread, so a slow or dead UI server never blocks (or kills) the
+    training loop. Failed posts are retried up to ``max_retries`` then dropped
+    and counted in ``dropped``.
+    """
+
+    def __init__(self, url, *, queue_size=1024, max_retries=3, timeout=5.0):
+        import queue
         self.url = url.rstrip("/") + "/remote"
+        self.timeout = timeout
+        self.max_retries = max_retries
+        self.dropped = 0
+        self._q = queue.Queue(maxsize=queue_size)
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
 
     def put_record(self, record):
+        import queue
+        try:
+            self._q.put_nowait(record)
+        except queue.Full:
+            self.dropped += 1
+
+    def _post(self, record):
         import urllib.request
         req = urllib.request.Request(
             self.url, data=json.dumps(record).encode(),
             headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=5) as resp:
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             resp.read()
+
+    def _drain(self):
+        while True:
+            record = self._q.get()
+            if record is _SHUTDOWN:
+                return
+            for attempt in range(self.max_retries):
+                try:
+                    self._post(record)
+                    break
+                except Exception:
+                    if attempt == self.max_retries - 1:
+                        self.dropped += 1
+
+    def flush(self, timeout=10.0):
+        """Block until the queue has drained (best-effort, for tests/shutdown)."""
+        import time as _time
+        deadline = _time.time() + timeout
+        while not self._q.empty() and _time.time() < deadline:
+            _time.sleep(0.01)
+
+    def close(self):
+        self.flush()
+        self._q.put(_SHUTDOWN)
+        self._thread.join(timeout=5)
+
+
+_SHUTDOWN = object()
