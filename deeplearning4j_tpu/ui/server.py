@@ -91,6 +91,7 @@ class UIServer:
                 if url.path == "/train/overview":
                     session = q.get("session", ["default"])[0]
                     recs = server._records(session, "stats")
+                    recs = [r for r in recs if "iteration" in r]
                     self._json({
                         "score": [[r["iteration"], r["score"]] for r in recs
                                   if "score" in r],
@@ -104,6 +105,8 @@ class UIServer:
                     recs = server._records(session, "stats")
                     series = {}
                     for r in recs:
+                        if "iteration" not in r:
+                            continue
                         for name, st in (r.get("params") or {}).items():
                             if isinstance(st, dict) and {"l2", "mean", "std"} <= st.keys():
                                 series.setdefault(name, []).append(

@@ -21,8 +21,11 @@ from deeplearning4j_tpu.nn.listeners import TrainingListener
 def _leaf_stats(a):
     import jax.numpy as jnp
     a = a.astype(jnp.float32).ravel()
+    if a.size == 0:  # static shape: plain Python branch is fine under jit
+        z = jnp.float32(0)
+        return {"l2": z, "mean": z, "std": z, "min": z, "max": z, "count": 0}
     return {"l2": jnp.linalg.norm(a), "mean": a.mean(), "std": a.std(),
-            "min": a.min(), "max": a.max()}
+            "min": a.min(), "max": a.max(), "count": a.size}
 
 
 _jitted_stats = None
@@ -44,14 +47,14 @@ def _array_stats(tree, histogram_bins=0):
     stats = jax.device_get(_jitted_stats(tree))
     out = {}
     paths = jax.tree_util.tree_flatten_with_path(stats)[0]
-    grouped = {}
     for path, leaf in paths:
         # path ends with the stat-name DictKey appended by _leaf_stats
         name = jax.tree_util.keystr(path[:-1])
         stat = path[-1].key
-        grouped.setdefault(name, {})[stat] = float(leaf)
-    for name, rec in grouped.items():
-        out[name] = rec
+        out.setdefault(name, {})[stat] = float(leaf)
+    # empty leaves are skipped, matching the reference listener's behavior
+    out = {k: {s: v for s, v in rec.items() if s != "count"}
+           for k, rec in out.items() if rec.get("count")}
     if histogram_bins:
         hpaths = jax.tree_util.tree_flatten_with_path(tree)[0]
         for path, leaf in hpaths:

@@ -28,7 +28,7 @@ class InMemoryStatsStorage:
 
     def get_records(self, session=None, type_=None):
         with self._lock:
-            recs = list(self.records)
+            recs = [r for r in self.records if isinstance(r, dict)]
         if session is not None:
             recs = [r for r in recs if r.get("session") == session]
         if type_ is not None:
@@ -36,7 +36,8 @@ class InMemoryStatsStorage:
         return recs
 
     def sessions(self):
-        return sorted({r.get("session", "default") for r in self.records})
+        return sorted({r.get("session", "default") for r in self.records
+                       if isinstance(r, dict)})
 
     def register_listener(self, cb):
         self._listeners.append(cb)
@@ -83,6 +84,7 @@ class RemoteStatsStorageRouter:
         self.timeout = timeout
         self.max_retries = max_retries
         self.dropped = 0
+        self._stopping = False
         self._q = queue.Queue(maxsize=queue_size)
         self._thread = threading.Thread(target=self._drain, daemon=True)
         self._thread.start()
@@ -103,8 +105,14 @@ class RemoteStatsStorageRouter:
             resp.read()
 
     def _drain(self):
+        import queue
         while True:
-            record = self._q.get()
+            try:
+                record = self._q.get(timeout=0.5)
+            except queue.Empty:
+                if self._stopping:
+                    return
+                continue
             if record is _SHUTDOWN:
                 return
             for attempt in range(self.max_retries):
@@ -123,8 +131,13 @@ class RemoteStatsStorageRouter:
             _time.sleep(0.01)
 
     def close(self):
+        import queue
         self.flush()
-        self._q.put(_SHUTDOWN)
+        self._stopping = True  # drain thread exits even if the queue is jammed
+        try:
+            self._q.put_nowait(_SHUTDOWN)
+        except queue.Full:
+            pass
         self._thread.join(timeout=5)
 
 
