@@ -1,0 +1,15 @@
+"""Keras model import (reference: deeplearning4j-modelimport, SURVEY.md §2.6)."""
+
+from deeplearning4j_tpu.modelimport.keras import (
+    KerasImportError,
+    import_keras_model_and_weights,
+    import_keras_sequential_config,
+    import_keras_sequential_model_and_weights,
+)
+
+__all__ = [
+    "KerasImportError",
+    "import_keras_model_and_weights",
+    "import_keras_sequential_config",
+    "import_keras_sequential_model_and_weights",
+]

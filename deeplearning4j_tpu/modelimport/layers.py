@@ -1,0 +1,439 @@
+"""Keras layer -> deeplearning4j_tpu layer mappers.
+
+Reference analog: the ~45 per-layer mappers under deeplearning4j-modelimport/
+.../keras/layers/ plus the version-split config dictionaries
+Keras1LayerConfiguration.java / Keras2LayerConfiguration.java (SURVEY.md
+§2.6). Keras 1 and 2 differ in config key names (output_dim vs units,
+nb_filter vs filters, ...); ``cfg()`` resolves the alias chains so one mapper
+serves both.
+
+Weight layout notes (why import is mostly a straight copy on TPU):
+- Keras TF-backend kernels are HWIO and activations channels_last — exactly
+  this framework's NHWC convention, so conv kernels import untransposed
+  (the reference needs TensorFlowCnnToFeedForwardPreProcessor gymnastics
+  because DL4J is NCHW).
+- Keras LSTM gate order is i, f, c(candidate), o — identical to
+  nn/layers/rnn.py's fused layout; kernel/recurrent_kernel concatenate
+  directly onto Wx/Wh.
+- Theano-ordering (channels_first) models are rejected with a clear error.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import layers as L
+
+
+class KerasImportError(Exception):
+    pass
+
+
+# Keras activation -> ours
+_ACTIVATIONS = {
+    "relu": "relu", "softmax": "softmax", "sigmoid": "sigmoid",
+    "tanh": "tanh", "linear": "identity", "elu": "elu", "selu": "selu",
+    "softplus": "softplus", "softsign": "softsign",
+    "hard_sigmoid": "hardsigmoid", "swish": "swish", "gelu": "gelu",
+    "relu6": "relu6", "exponential": "identity",
+}
+
+# Keras loss -> ours (for training_config round-trip)
+LOSSES = {
+    "categorical_crossentropy": "mcxent",
+    "sparse_categorical_crossentropy": "sparse_mcxent",
+    "binary_crossentropy": "xent",
+    "mean_squared_error": "mse", "mse": "mse",
+    "mean_absolute_error": "mae", "mae": "mae",
+    "hinge": "hinge", "squared_hinge": "squared_hinge",
+    "kullback_leibler_divergence": "kl_divergence",
+    "poisson": "poisson",
+    "cosine_proximity": "cosine_proximity",
+    "mean_squared_logarithmic_error": "mean_squared_log_error",
+    "mean_absolute_percentage_error": "mean_absolute_percentage_error",
+}
+
+
+def activation(name):
+    if name is None:
+        return "identity"
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise KerasImportError(f"Unsupported Keras activation {name!r}")
+
+
+class Cfg:
+    """Alias-resolving view over a Keras layer config dict."""
+
+    def __init__(self, d, keras_version=2):
+        self.d = d
+        self.version = keras_version
+
+    def get(self, *names, default=None):
+        for n in names:
+            if n in self.d:
+                return self.d[n]
+        return default
+
+    def require(self, *names):
+        v = self.get(*names, default=None)
+        if v is None:
+            raise KerasImportError(f"Missing Keras config key (any of {names}): "
+                                   f"have {sorted(self.d)}")
+        return v
+
+
+def _check_channels_last(c: Cfg):
+    fmt = c.get("data_format", "dim_ordering", default="channels_last")
+    if fmt in ("channels_last", "tf", None):
+        return
+    raise KerasImportError(
+        "channels_first/theano dim-ordering models are not supported; "
+        "re-export the model with data_format=channels_last")
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+def _padding(c: Cfg):
+    p = c.get("padding", "border_mode", default="valid")
+    if p not in ("valid", "same"):
+        raise KerasImportError(f"Unsupported Keras padding {p!r}")
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Weight mappers: keras weight-name suffix -> (param_key, transform)
+# Each mapper returns (params_dict, state_dict)
+# ---------------------------------------------------------------------------
+
+
+def _w(weights, *names):
+    for n in names:
+        for key, arr in weights.items():
+            base = key.split("/")[-1].split(":")[0]
+            if base == n:
+                return np.asarray(arr, np.float32)
+    return None
+
+
+def _dense_weights(layer, weights):
+    p = {"W": _w(weights, "kernel", "W")}
+    b = _w(weights, "bias", "b")
+    if b is not None:
+        p["b"] = b
+    return p, {}
+
+
+def _conv_weights(layer, weights):
+    return _dense_weights(layer, weights)  # HWIO kernel + bias, same keys
+
+
+def _separable_conv_weights(layer, weights):
+    p = {"D": _w(weights, "depthwise_kernel"),
+         "P": _w(weights, "pointwise_kernel")}
+    b = _w(weights, "bias")
+    if b is not None:
+        p["b"] = b
+    return p, {}
+
+
+def _bn_weights(layer, weights):
+    p = {}
+    gamma, beta = _w(weights, "gamma"), _w(weights, "beta")
+    if gamma is not None:
+        p["gamma"] = gamma
+    if beta is not None:
+        p["beta"] = beta
+    state = {"mean": _w(weights, "moving_mean"),
+             "var": _w(weights, "moving_variance")}
+    return p, state
+
+
+def _lstm_weights(layer, weights):
+    # Keras: kernel [in,4H], recurrent_kernel [H,4H], bias [4H]; gate order
+    # i,f,c,o == ours (rnn.py fused layout). Keras 1 split per-gate weights
+    # (W_i, U_i, b_i, ...) are concatenated.
+    k = _w(weights, "kernel")
+    if k is not None:
+        p = {"Wx": k, "Wh": _w(weights, "recurrent_kernel")}
+        b = _w(weights, "bias")
+        if b is not None:
+            p["b"] = b
+        return p, {}
+    parts_x, parts_h, parts_b = [], [], []
+    for g in ("i", "f", "c", "o"):
+        parts_x.append(_w(weights, f"W_{g}"))
+        parts_h.append(_w(weights, f"U_{g}"))
+        parts_b.append(_w(weights, f"b_{g}"))
+    if any(v is None for v in parts_x + parts_h + parts_b):
+        raise KerasImportError(f"Unrecognized LSTM weight set: {sorted(weights)}")
+    return {"Wx": np.concatenate(parts_x, 1), "Wh": np.concatenate(parts_h, 1),
+            "b": np.concatenate(parts_b, 0)}, {}
+
+
+def _embedding_weights(layer, weights):
+    return {"W": _w(weights, "embeddings", "W")}, {}
+
+
+def _simple_rnn_weights(layer, weights):
+    p = {"Wx": _w(weights, "kernel"), "Wh": _w(weights, "recurrent_kernel")}
+    b = _w(weights, "bias")
+    if b is not None:
+        p["b"] = b
+    return p, {}
+
+
+# ---------------------------------------------------------------------------
+# Layer mappers. Each returns (layer | None, weight_mapper | None).
+# None layer = structural no-op in this framework (Flatten between CNN and
+# Dense is implicit — nn/conf/inputs.py adapt()).
+# ---------------------------------------------------------------------------
+
+
+def _map_dense(c: Cfg):
+    return (L.DenseLayer(
+        n_out=int(c.require("units", "output_dim")),
+        activation=activation(c.get("activation")),
+        has_bias=bool(c.get("use_bias", "bias", default=True))), _dense_weights)
+
+
+def _map_conv2d(c: Cfg):
+    _check_channels_last(c)
+    return (L.ConvolutionLayer(
+        n_out=int(c.require("filters", "nb_filter")),
+        kernel=_pair(c.get("kernel_size", default=None) or
+                     (c.require("nb_row"), c.require("nb_col"))),
+        stride=_pair(c.get("strides", "subsample", default=(1, 1))),
+        padding=_padding(c),
+        dilation=_pair(c.get("dilation_rate", default=(1, 1))),
+        has_bias=bool(c.get("use_bias", "bias", default=True)),
+        activation=activation(c.get("activation"))), _conv_weights)
+
+
+def _map_conv1d(c: Cfg):
+    k = c.get("kernel_size", "filter_length", default=3)
+    if isinstance(k, (list, tuple)):
+        k = k[0]
+    s = c.get("strides", "subsample_length", default=1)
+    if isinstance(s, (list, tuple)):
+        s = s[0]
+    return (L.Convolution1DLayer(
+        n_out=int(c.require("filters", "nb_filter")),
+        kernel=int(k), stride=int(s), padding=_padding(c),
+        has_bias=bool(c.get("use_bias", "bias", default=True)),
+        activation=activation(c.get("activation"))), _dense_weights)
+
+
+def _map_separable_conv2d(c: Cfg):
+    _check_channels_last(c)
+    return (L.SeparableConvolution2DLayer(
+        n_out=int(c.require("filters", "nb_filter")),
+        kernel=_pair(c.require("kernel_size")),
+        stride=_pair(c.get("strides", default=(1, 1))),
+        padding=_padding(c),
+        depth_multiplier=int(c.get("depth_multiplier", default=1)),
+        has_bias=bool(c.get("use_bias", default=True)),
+        activation=activation(c.get("activation"))), _separable_conv_weights)
+
+
+def _map_conv2d_transpose(c: Cfg):
+    _check_channels_last(c)
+    return (L.Deconvolution2DLayer(
+        n_out=int(c.require("filters", "nb_filter")),
+        kernel=_pair(c.require("kernel_size")),
+        stride=_pair(c.get("strides", default=(1, 1))),
+        padding=_padding(c),
+        has_bias=bool(c.get("use_bias", default=True)),
+        activation=activation(c.get("activation"))), _conv_weights)
+
+
+def _map_maxpool2d(c: Cfg):
+    _check_channels_last(c)
+    pool = _pair(c.get("pool_size", default=(2, 2)))
+    return (L.SubsamplingLayer(
+        kernel=pool, stride=_pair(c.get("strides", default=None) or pool),
+        padding=_padding(c), mode="max"), None)
+
+
+def _map_avgpool2d(c: Cfg):
+    _check_channels_last(c)
+    pool = _pair(c.get("pool_size", default=(2, 2)))
+    return (L.SubsamplingLayer(
+        kernel=pool, stride=_pair(c.get("strides", default=None) or pool),
+        padding=_padding(c), mode="avg"), None)
+
+
+def _map_pool1d(mode):
+    def go(c: Cfg):
+        pool = c.get("pool_size", "pool_length", default=2)
+        if isinstance(pool, (list, tuple)):
+            pool = pool[0]
+        stride = c.get("strides", "stride", default=None)
+        if isinstance(stride, (list, tuple)):
+            stride = stride[0]
+        return (L.Subsampling1DLayer(
+            kernel=int(pool), stride=int(stride or pool),
+            padding=_padding(c), mode=mode), None)
+    return go
+
+
+def _map_global_pool(mode, family):
+    def go(c: Cfg):
+        return (L.GlobalPoolingLayer(mode=mode), None)
+    return go
+
+
+def _map_batchnorm(c: Cfg):
+    axis = c.get("axis", default=-1)
+    if axis not in (-1, 3) and axis is not None:
+        # channels_last => feature axis is the last one
+        raise KerasImportError(
+            f"BatchNormalization axis={axis} unsupported (channels_last only)")
+    return (L.BatchNormalization(
+        decay=float(c.get("momentum", default=0.99)),
+        eps=float(c.get("epsilon", default=1e-3)),
+        use_gamma_beta=bool(c.get("scale", default=True) or
+                            c.get("center", default=True))), _bn_weights)
+
+
+def _seq_or_last(c: Cfg, rnn_layer):
+    """Keras return_sequences=False (the default) keeps only the final step;
+    this framework's RNN layers always emit [B,T,H], so append LastTimeStep."""
+    if c.get("return_sequences", default=False):
+        return rnn_layer
+    return [rnn_layer, L.LastTimeStep()]
+
+
+def _map_lstm(c: Cfg):
+    inner = activation(c.get("recurrent_activation", "inner_activation",
+                             default="hard_sigmoid"))
+    layer = L.LSTM(
+        n_out=int(c.require("units", "output_dim")),
+        activation=activation(c.get("activation", default="tanh")),
+        gate_activation=inner,
+        forget_gate_bias=1.0 if c.get("unit_forget_bias",
+                                      default=True) else 0.0)
+    return (_seq_or_last(c, layer), _lstm_weights)
+
+
+def _map_simple_rnn(c: Cfg):
+    layer = L.SimpleRnn(
+        n_out=int(c.require("units", "output_dim")),
+        activation=activation(c.get("activation", default="tanh")))
+    return (_seq_or_last(c, layer), _simple_rnn_weights)
+
+
+def _map_embedding(c: Cfg):
+    return (L.EmbeddingLayer(
+        n_in=int(c.require("input_dim")),
+        n_out=int(c.require("output_dim"))), _embedding_weights)
+
+
+def _map_dropout(c: Cfg):
+    return (L.DropoutLayer(rate=float(c.get("rate", "p", default=0.5))), None)
+
+
+def _map_alpha_dropout(c: Cfg):
+    return (L.DropoutLayer(rate=float(c.get("rate", "p", default=0.5)),
+                           kind="alpha"), None)
+
+
+def _map_gaussian_dropout(c: Cfg):
+    return (L.DropoutLayer(rate=float(c.get("rate", "p", default=0.5)),
+                           kind="gaussian_dropout"), None)
+
+
+def _map_gaussian_noise(c: Cfg):
+    return (L.DropoutLayer(rate=float(c.get("stddev", "sigma", default=0.1)),
+                           kind="gaussian_noise"), None)
+
+
+def _map_activation(c: Cfg):
+    return (L.ActivationLayer(activation=activation(c.require("activation"))),
+            None)
+
+
+def _map_leaky_relu(c: Cfg):
+    # our leakyrelu uses the catalog's fixed alpha; Keras default is 0.3
+    return (L.ActivationLayer(activation="leakyrelu"), None)
+
+
+def _map_zero_padding2d(c: Cfg):
+    _check_channels_last(c)
+    p = c.get("padding", default=(1, 1))
+    if isinstance(p, (list, tuple)) and len(p) == 2 and \
+            all(isinstance(x, (list, tuple)) for x in p):
+        pad = (int(p[0][0]), int(p[0][1]), int(p[1][0]), int(p[1][1]))
+    else:
+        ph, pw = _pair(p)
+        pad = (ph, ph, pw, pw)
+    return (L.ZeroPaddingLayer(pad=pad), None)
+
+
+def _map_upsampling2d(c: Cfg):
+    _check_channels_last(c)
+    return (L.Upsampling2DLayer(size=_pair(c.get("size", default=(2, 2)))), None)
+
+
+def _map_upsampling1d(c: Cfg):
+    s = c.get("size", "length", default=2)
+    if isinstance(s, (list, tuple)):
+        s = s[0]
+    return (L.Upsampling1DLayer(size=int(s)), None)
+
+
+def _map_noop(c: Cfg):
+    return (None, None)
+
+
+# class_name -> mapper
+MAPPERS = {
+    "Dense": _map_dense,
+    "Conv2D": _map_conv2d, "Convolution2D": _map_conv2d,
+    "Conv1D": _map_conv1d, "Convolution1D": _map_conv1d,
+    "SeparableConv2D": _map_separable_conv2d,
+    "SeparableConvolution2D": _map_separable_conv2d,
+    "Conv2DTranspose": _map_conv2d_transpose,
+    "Deconvolution2D": _map_conv2d_transpose,
+    "MaxPooling2D": _map_maxpool2d,
+    "AveragePooling2D": _map_avgpool2d,
+    "MaxPooling1D": _map_pool1d("max"),
+    "AveragePooling1D": _map_pool1d("avg"),
+    "GlobalMaxPooling2D": _map_global_pool("max", "cnn"),
+    "GlobalAveragePooling2D": _map_global_pool("avg", "cnn"),
+    "GlobalMaxPooling1D": _map_global_pool("max", "rnn"),
+    "GlobalAveragePooling1D": _map_global_pool("avg", "rnn"),
+    "BatchNormalization": _map_batchnorm,
+    "LSTM": _map_lstm,
+    "SimpleRNN": _map_simple_rnn,
+    "Embedding": _map_embedding,
+    "Dropout": _map_dropout,
+    "SpatialDropout1D": _map_dropout,
+    "SpatialDropout2D": _map_dropout,
+    "AlphaDropout": _map_alpha_dropout,
+    "GaussianDropout": _map_gaussian_dropout,
+    "GaussianNoise": _map_gaussian_noise,
+    "Activation": _map_activation,
+    "LeakyReLU": _map_leaky_relu,
+    "ZeroPadding2D": _map_zero_padding2d,
+    "UpSampling2D": _map_upsampling2d,
+    "UpSampling1D": _map_upsampling1d,
+    "Flatten": _map_noop,       # implicit CNN->FF adaptation
+    "Reshape": _map_noop,       # family adaptation handles common cases
+    "InputLayer": _map_noop,
+    "Masking": _map_noop,
+    "Permute": _map_noop,
+}
+
+
+def map_layer(class_name, config, keras_version=2):
+    """Map one Keras layer config. Returns (layer | None, weight_mapper)."""
+    mapper = MAPPERS.get(class_name)
+    if mapper is None:
+        raise KerasImportError(f"Unsupported Keras layer type {class_name!r}")
+    return mapper(Cfg(config, keras_version))

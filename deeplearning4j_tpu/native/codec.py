@@ -4,7 +4,7 @@ Reference analog: EncodingHandler.java:28 + the libnd4j "THRESHOLD"
 NDArrayCompressor (SURVEY.md §2.1 gradient-sharing row, §2.3). Semantics
 preserved: encoding an update extracts the ±τ contribution of every element
 with |g| ≥ τ and leaves the residual behind, so un-sent mass accumulates and
-is sent on a later step; when more than 1/6 of elements flag, a 2-bit-per-
+is sent on a later step; when more than 1/16 of elements flag, a 2-bit-per-
 element bitmap is smaller than the sparse index list and is used instead.
 
 The hot loops are C++ (native/threshold_codec.cc); a NumPy fallback keeps the
@@ -20,9 +20,9 @@ import numpy as np
 
 from deeplearning4j_tpu import native as _native
 
-# sparse message: 1 int32 per flagged element. bitmap: n/16 uint32 words.
-# sparse is smaller iff count < n/16 * 2 = n/8; use a mild margin.
-_SPARSE_FRACTION = 1.0 / 6.0
+# sparse message: 4 bytes per flagged element. bitmap: 2 bits/element = n/4
+# bytes total. Sparse is smaller iff 4*count < n/4, i.e. density < 1/16.
+_SPARSE_FRACTION = 1.0 / 16.0
 
 
 @dataclasses.dataclass

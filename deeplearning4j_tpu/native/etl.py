@@ -32,6 +32,8 @@ def u8_to_f32(src: np.ndarray, scale: float = 1.0 / 255.0, bias: float = 0.0):
 
 
 def one_hot(labels: np.ndarray, num_classes: int) -> np.ndarray:
+    """Out-of-range labels (e.g. -1 padding markers) yield all-zero rows, in
+    both the native kernel and this fallback."""
     labels = np.ascontiguousarray(labels, np.int32)
     if _native.available():
         out = np.empty((labels.size, num_classes), np.float32)
@@ -40,7 +42,10 @@ def one_hot(labels: np.ndarray, num_classes: int) -> np.ndarray:
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             labels.size, num_classes)
         return out
-    return np.eye(num_classes, dtype=np.float32)[labels]
+    out = np.zeros((labels.size, num_classes), np.float32)
+    valid = (labels >= 0) & (labels < num_classes)
+    out[np.nonzero(valid)[0], labels[valid]] = 1.0
+    return out
 
 
 def gather_rows(src: np.ndarray, index: np.ndarray) -> np.ndarray:

@@ -144,13 +144,20 @@ class Hdf5Archive:
         if self._lib.dl4j_h5_make_group(self._h, path.encode()) != 0:
             raise IOError(f"failed creating group {path!r}")
 
+    def _attr_target_check(self, path):
+        if path not in ("/", "") and not self.exists(path):
+            raise IOError(f"cannot write attribute: object {path!r} does not "
+                          f"exist (create the group/dataset first)")
+
     def write_attr_string(self, name: str, value: str, path: str = "/") -> None:
+        self._attr_target_check(path)
         r = self._lib.dl4j_h5_write_attr_str(
             self._h, path.encode(), name.encode(), value.encode())
         if r != 0:
             raise IOError(f"failed writing attribute {name!r} on {path!r}")
 
     def write_attr_strings(self, name: str, values, path: str = "/") -> None:
+        self._attr_target_check(path)
         joined = "\n".join(values)
         r = self._lib.dl4j_h5_write_attr_strs(
             self._h, path.encode(), name.encode(), joined.encode())

@@ -16,8 +16,10 @@ import threading
 
 class FancyBlockingQueue:
     def __init__(self, capacity: int = 256):
+        import collections
         self.capacity = capacity
         self._tokens = {}
+        self._tok_order = collections.deque()
         self._counter = itertools.count(1)
         self._tok_lock = threading.Lock()
         self._n_consumers_cache = 0
@@ -35,20 +37,24 @@ class FancyBlockingQueue:
             self._closed = False
 
     # -- native-token plumbing ------------------------------------------------
+    # Tokens are garbage-collected by age, not refcount: the native queue's
+    # backpressure bounds any consumer's lag to `capacity`, so a token older
+    # than 2*capacity publishes can no longer be pending anywhere. This is
+    # race-free against concurrent register_consumer (a refcount of "expected
+    # deliveries" is not — registration and put can interleave either way).
     def _store(self, obj) -> int:
         with self._tok_lock:
             tok = next(self._counter)
-            # expected deliveries = consumers registered at publish time
-            self._tokens[tok] = [obj, 0, max(self._n_consumers_cache, 1)]
+            self._tokens[tok] = obj
+            self._tok_order.append(tok)
+            while len(self._tok_order) > 2 * self.capacity + 8:
+                old = self._tok_order.popleft()
+                self._tokens.pop(old, None)
             return tok
 
     def _fetch(self, tok: int):
         with self._tok_lock:
-            entry = self._tokens[tok]
-            entry[1] += 1
-            if entry[1] >= entry[2]:
-                del self._tokens[tok]
-            return entry[0]
+            return self._tokens.get(tok)
 
     # -- API ------------------------------------------------------------------
     def register_consumer(self) -> int:
@@ -69,6 +75,8 @@ class FancyBlockingQueue:
         return len(self._cursors)
 
     def put(self, obj, timeout: float | None = None) -> bool:
+        if obj is None:
+            raise ValueError("FancyBlockingQueue cannot carry None")
         if self._native:
             tok = self._store(obj)
             r = self._lib.dl4j_fbq_put(
@@ -92,13 +100,17 @@ class FancyBlockingQueue:
         timed out."""
         if self._native:
             import ctypes
-            out = ctypes.c_int64()
-            r = self._lib.dl4j_fbq_poll(
-                self._h, consumer, -1 if timeout is None else int(timeout * 1000),
-                ctypes.byref(out))
-            if r != 0:
-                return None
-            return self._fetch(int(out.value))
+            while True:
+                out = ctypes.c_int64()
+                r = self._lib.dl4j_fbq_poll(
+                    self._h, consumer,
+                    -1 if timeout is None else int(timeout * 1000),
+                    ctypes.byref(out))
+                if r != 0:
+                    return None
+                obj = self._fetch(int(out.value))
+                if obj is not None:  # None = token aged out (can't occur
+                    return obj       # within the capacity bound; re-poll)
         with self._lock:
             while True:
                 idx = self._cursors[consumer] - self._head_seq

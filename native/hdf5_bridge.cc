@@ -234,6 +234,21 @@ static herr_t list_cb(hid_t loc, const char* name, const void*, void* op) {
       h5::oget_info_by_name_(ctx->loc, full.c_str(), &info, H5P_DEFAULT) >= 0) {
     kind = info.type == 0 ? 'g' : info.type == 1 ? 'd' : '?';
   }
+  if (kind == '?') {
+    // ABI-proof fallback (H5Oget_info_by_name is versioned differently in
+    // hdf5 >= 1.12): probe by opening as dataset, then as group
+    hid_t probe = h5::dopen_(ctx->loc, full.c_str(), H5P_DEFAULT);
+    if (probe >= 0) {
+      kind = 'd';
+      h5::dclose_(probe);
+    } else {
+      probe = h5::gopen_(ctx->loc, full.c_str(), H5P_DEFAULT);
+      if (probe >= 0) {
+        kind = 'g';
+        h5::gclose_(probe);
+      }
+    }
+  }
   ctx->out += kind;
   ctx->out += ' ';
   ctx->out += name;
@@ -253,7 +268,6 @@ int64_t dl4j_h5_list(hid_t file, const char* path, char* out, int64_t cap,
   hid_t grp = h5::gopen_(file, path[0] ? path : "/", H5P_DEFAULT);
   if (grp < 0) return -1;
   hsize_t idx = 0;
-  ctx.base = (std::strcmp(path, "/") == 0 || path[0] == 0) ? "" : path;
   herr_t r = h5::literate_(grp, H5_INDEX_NAME, H5_ITER_INC, &idx, list_cb,
                            &ctx);
   h5::gclose_(grp);
@@ -277,7 +291,12 @@ int dl4j_h5_dataset_info(hid_t file, const char* path, int* ndim,
   hid_t sp = h5::dget_space_(ds);
   hid_t ty = h5::dget_type_(ds);
   int nd = h5::sget_ndims_(sp);
-  if (nd > 8) nd = 8;
+  if (nd > 8) {  // out-param holds 8 dims; refuse higher ranks cleanly
+    h5::tclose_(ty);
+    h5::sclose_(sp);
+    h5::dclose_(ds);
+    return -4;
+  }
   hsize_t hdims[8] = {0};
   h5::sget_dims_(sp, hdims, nullptr);
   for (int i = 0; i < nd; ++i) dims[i] = (int64_t)hdims[i];
@@ -321,6 +340,7 @@ int dl4j_h5_read_i64(hid_t file, const char* path, int64_t* out, int64_t n) {
 int dl4j_h5_write_f32(hid_t file, const char* path, const float* data,
                       const int64_t* dims, int ndim) {
   if (!h5::init()) return -1;
+  if (ndim < 0 || ndim > 8) return -4;
   std::string leaf;
   hid_t parent = ensure_parent_groups(file, path, &leaf);
   if (parent < 0) return -1;
@@ -498,7 +518,7 @@ int dl4j_h5_write_attr_strs(hid_t file, const char* obj_path, const char* name,
   }
   size_t maxlen = 1;
   for (auto& s : items) maxlen = s.size() > maxlen ? s.size() : maxlen;
-  std::vector<char> buf(items.size() * maxlen, 0);
+  std::vector<char> buf(items.size() * maxlen + 1, 0);  // +1: non-null ptr for n=0
   for (size_t i = 0; i < items.size(); ++i)
     std::memcpy(buf.data() + i * maxlen, items[i].data(), items[i].size());
   hid_t obj = h5::oopen_(file, obj_path[0] ? obj_path : "/", H5P_DEFAULT);
@@ -510,7 +530,8 @@ int dl4j_h5_write_attr_strs(hid_t file, const char* obj_path, const char* name,
   hid_t at = h5::acreate_(obj, name, ty, sp, H5P_DEFAULT, H5P_DEFAULT);
   herr_t r = -1;
   if (at >= 0) {
-    r = h5::awrite_(at, ty, buf.data());
+    // zero-length arrays: create the attribute but skip the (empty) write
+    r = n == 0 ? 0 : h5::awrite_(at, ty, buf.data());
     h5::aclose_(at);
   }
   h5::sclose_(sp);
