@@ -262,6 +262,11 @@ def import_keras_model_and_weights(path: str):
             # flatten keras's [[["src", node_idx, tensor_idx, {}], ...]] form
             srcs = []
             if inbound:
+                if len(inbound) > 1:
+                    raise KerasImportError(
+                        f"Layer {name!r} is applied {len(inbound)} times "
+                        "(shared layer); shared-layer functional models are "
+                        "not supported")
                 node = inbound[0]
                 if isinstance(node, dict):  # keras 3 style {"args": ...}
                     raise KerasImportError("Keras 3 saved-model configs are "

@@ -113,10 +113,12 @@ def _padding(c: Cfg):
 
 
 def _w(weights, *names):
+    """Find a weight by Keras 2 name (``.../kernel:0``) or Keras 1 name
+    (underscore-suffixed, e.g. ``dense_1_W``)."""
     for n in names:
         for key, arr in weights.items():
             base = key.split("/")[-1].split(":")[0]
-            if base == n:
+            if base == n or base.endswith("_" + n):
                 return np.asarray(arr, np.float32)
     return None
 
@@ -282,7 +284,7 @@ def _map_pool1d(mode):
     return go
 
 
-def _map_global_pool(mode, family):
+def _map_global_pool(mode):
     def go(c: Cfg):
         return (L.GlobalPoolingLayer(mode=mode), None)
     return go
@@ -359,8 +361,9 @@ def _map_activation(c: Cfg):
 
 
 def _map_leaky_relu(c: Cfg):
-    # our leakyrelu uses the catalog's fixed alpha; Keras default is 0.3
-    return (L.ActivationLayer(activation="leakyrelu"), None)
+    alpha = float(c.get("alpha", "negative_slope", default=0.3))
+    return (L.ActivationLayer(activation=("leakyrelu", {"alpha": alpha})),
+            None)
 
 
 def _map_zero_padding2d(c: Cfg):
@@ -404,10 +407,10 @@ MAPPERS = {
     "AveragePooling2D": _map_avgpool2d,
     "MaxPooling1D": _map_pool1d("max"),
     "AveragePooling1D": _map_pool1d("avg"),
-    "GlobalMaxPooling2D": _map_global_pool("max", "cnn"),
-    "GlobalAveragePooling2D": _map_global_pool("avg", "cnn"),
-    "GlobalMaxPooling1D": _map_global_pool("max", "rnn"),
-    "GlobalAveragePooling1D": _map_global_pool("avg", "rnn"),
+    "GlobalMaxPooling2D": _map_global_pool("max"),
+    "GlobalAveragePooling2D": _map_global_pool("avg"),
+    "GlobalMaxPooling1D": _map_global_pool("max"),
+    "GlobalAveragePooling1D": _map_global_pool("avg"),
     "BatchNormalization": _map_batchnorm,
     "LSTM": _map_lstm,
     "SimpleRNN": _map_simple_rnn,

@@ -42,9 +42,10 @@ def one_hot(labels: np.ndarray, num_classes: int) -> np.ndarray:
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             labels.size, num_classes)
         return out
-    out = np.zeros((labels.size, num_classes), np.float32)
-    valid = (labels >= 0) & (labels < num_classes)
-    out[np.nonzero(valid)[0], labels[valid]] = 1.0
+    flat = labels.reshape(-1)
+    out = np.zeros((flat.size, num_classes), np.float32)
+    valid = (flat >= 0) & (flat < num_classes)
+    out[np.nonzero(valid)[0], flat[valid]] = 1.0
     return out
 
 

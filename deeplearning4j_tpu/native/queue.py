@@ -82,8 +82,15 @@ class FancyBlockingQueue:
             r = self._lib.dl4j_fbq_put(
                 self._h, tok, -1 if timeout is None else int(timeout * 1000))
             if r != 0:
+                # full rollback: leaving the failed token in _tok_order would
+                # make the age-out window count put *attempts*, letting
+                # repeated failed puts evict tokens of messages still queued
                 with self._tok_lock:
                     self._tokens.pop(tok, None)
+                    try:
+                        self._tok_order.remove(tok)
+                    except ValueError:
+                        pass
             return r == 0
         with self._lock:
             while not self._closed and len(self._buf) >= self.capacity:

@@ -128,7 +128,16 @@ _CATALOG = {
 
 
 def get(name):
-    """Resolve an activation by name (or pass a callable through)."""
+    """Resolve an activation by name (or pass a callable through).
+
+    Parameterized spelling: ``("leakyrelu", {"alpha": 0.3})`` (list or tuple,
+    JSON-serde friendly) binds keyword arguments onto the named activation —
+    the analog of DL4J's parameterized IActivation instances (e.g.
+    ActivationLReLU(alpha))."""
+    if isinstance(name, (tuple, list)) and name:
+        import functools
+        kwargs = dict(name[1]) if len(name) > 1 and name[1] else {}
+        return functools.partial(get(name[0]), **kwargs)
     if callable(name):
         return name
     try:

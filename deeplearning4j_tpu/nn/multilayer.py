@@ -242,6 +242,31 @@ class MultiLayerNetwork:
         self._rnn_stream_state = carries
         return y[:, 0] if squeeze else y
 
+    def compute_gradients(self, params, state, x, y, *, rng=None, mask=None):
+        """Loss + normalized/clipped gradients (reference:
+        computeGradientAndScore + gradient normalization inside
+        updateGradientAccordingToParams). Returns (loss, new_state, grads).
+        The distributed masters insert their gradient exchange between this
+        and apply_update."""
+        (loss, (new_state, _)), grads = jax.value_and_grad(
+            self.loss_fn, has_aux=True)(params, state, x, y, train=True,
+                                        rng=rng, mask=mask)
+        grads = _gradnorm.normalize_grads(
+            self.conf.gradient_normalization, grads,
+            self.conf.gradient_normalization_threshold)
+        return loss, new_state, grads
+
+    def apply_update(self, params, opt_state, grads, step):
+        """updater -> parameter add -> constraints (reference:
+        BaseOptimizer.java:187 -> StochasticGradientDescent step :78 ->
+        applyConstraints :97)."""
+        updates, new_opt = self.conf.updater.update(grads, opt_state, params,
+                                                    step)
+        new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        new_params = [l.apply_constraints(p, step, 0) if p else p
+                      for l, p in zip(self.conf.layers, new_params)]
+        return new_params, new_opt
+
     def make_train_step(self, donate=True, jit=True):
         """Build the jitted train step:
         (params, state, opt_state, x, y, step, rng, mask) ->
@@ -250,20 +275,11 @@ class MultiLayerNetwork:
         Mirrors BaseOptimizer.gradientAndScore:171 -> updater :187 ->
         StochasticGradientDescent step :78, fused into one XLA computation.
         """
-        conf = self.conf
-
         def train_step(params, state, opt_state, x, y, step, rng, mask=None):
-            (loss, (new_state, _)), grads = jax.value_and_grad(
-                self.loss_fn, has_aux=True)(params, state, x, y, train=True,
-                                            rng=rng, mask=mask)
-            grads = _gradnorm.normalize_grads(conf.gradient_normalization, grads,
-                                              conf.gradient_normalization_threshold)
-            updates, new_opt = conf.updater.update(grads, opt_state, params, step)
-            new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
-            # constraints are projections applied after the update (reference:
-            # applyConstraints at StochasticGradientDescent.java:97)
-            new_params = [l.apply_constraints(p, step, 0) if p else p
-                          for l, p in zip(conf.layers, new_params)]
+            loss, new_state, grads = self.compute_gradients(
+                params, state, x, y, rng=rng, mask=mask)
+            new_params, new_opt = self.apply_update(params, opt_state, grads,
+                                                    step)
             return new_params, new_state, new_opt, loss
 
         if not jit:

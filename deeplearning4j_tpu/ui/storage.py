@@ -113,21 +113,27 @@ class RemoteStatsStorageRouter:
                 if self._stopping:
                     return
                 continue
-            if record is _SHUTDOWN:
-                return
-            for attempt in range(self.max_retries):
-                try:
-                    self._post(record)
-                    break
-                except Exception:
-                    if attempt == self.max_retries - 1:
-                        self.dropped += 1
+            try:
+                if record is _SHUTDOWN:
+                    return
+                for attempt in range(self.max_retries):
+                    try:
+                        self._post(record)
+                        break
+                    except Exception:
+                        if attempt == self.max_retries - 1:
+                            self.dropped += 1
+            finally:
+                # queue.unfinished_tasks is the flush() barrier: put()
+                # increments it atomically, so a record is "done" only after
+                # its POST completes (or is dropped)
+                self._q.task_done()
 
     def flush(self, timeout=10.0):
         """Block until the queue has drained (best-effort, for tests/shutdown)."""
         import time as _time
         deadline = _time.time() + timeout
-        while not self._q.empty() and _time.time() < deadline:
+        while self._q.unfinished_tasks and _time.time() < deadline:
             _time.sleep(0.01)
 
     def close(self):

@@ -1,0 +1,187 @@
+"""Multi-node distributed training tier (SURVEY.md §2.5 / §3.3).
+
+Mirrors the reference's test strategy for Spark: everything runs against an
+in-process local "cluster" — here the 8-virtual-device CPU mesh (the analog
+of BaseSparkTest's local["N"] Spark context, SURVEY.md §4.5).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.parallel import MeshSpec, make_mesh
+from deeplearning4j_tpu.parallel.distributed import (
+    DistributedMultiLayer,
+    EncodedGradientsAccumulator,
+    ParameterAveragingTrainingMaster,
+    SharedTrainingMaster,
+    initialize_distributed,
+)
+
+
+def _blobs(n=512, d=8, k=3, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(k, d) * 3.0
+    yi = rs.randint(0, k, n)
+    x = (centers[yi] + rs.randn(n, d)).astype(np.float32)
+    y = np.eye(k, dtype=np.float32)[yi]
+    return x, y
+
+
+def _mlp(d=8, k=3, lr=0.1, seed=12345):
+    conf = NeuralNetConfig(seed=seed, updater=Sgd(learning_rate=lr)).list(
+        DenseLayer(n_out=16, activation="tanh"),
+        OutputLayer(n_out=k, activation="softmax", loss="mcxent"),
+        input_type=I.feed_forward(d),
+    )
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(MeshSpec(data=8, model=1), devices=jax.devices()[:8])
+
+
+def test_initialize_distributed_noop_single_process():
+    assert initialize_distributed() is False
+    assert initialize_distributed(num_processes=1) is False
+
+
+class TestParameterAveraging:
+    def test_loss_decreases_and_replicas_consistent(self, mesh8):
+        x, y = _blobs(n=1024)
+        net = _mlp()
+        before = net.score(x, y)
+        master = ParameterAveragingTrainingMaster(
+            mesh8, batch_size_per_worker=8, averaging_frequency=4)
+        spark_like = DistributedMultiLayer(net, master)
+        spark_like.fit(x, y, epochs=4)
+        after = net.score(x, y)
+        assert after < before * 0.7
+        stats = master.training_stats()
+        assert stats["splits"] == 4 * (1024 // (8 * 4 * 8))
+        assert stats["worker_steps"] == stats["splits"] * 8 * 4
+
+    def test_freq1_matches_synchronous_data_parallel(self, mesh8):
+        """averaging_frequency=1 parameter averaging after an SGD step equals
+        one SGD step on the all-worker mean gradient (linearity of SGD) —
+        i.e. the synchronous limit equals exact gradient all-reduce."""
+        x, y = _blobs(n=64, seed=3)
+        net_a = _mlp(lr=0.05, seed=7)
+        net_b = _mlp(lr=0.05, seed=7)
+
+        pa = ParameterAveragingTrainingMaster(
+            mesh8, batch_size_per_worker=8, averaging_frequency=1,
+            average_updaters=True)
+        pa.execute_training(net_a, x, y, epochs=1)
+
+        sh = SharedTrainingMaster(mesh8, batch_size_per_worker=8)
+        sh.execute_training(net_b, x, y, epochs=1)
+
+        for pa_l, sh_l in zip(net_a.params, net_b.params):
+            for k in pa_l:
+                np.testing.assert_allclose(pa_l[k], sh_l[k], rtol=1e-5,
+                                           atol=1e-6)
+
+    def test_requires_full_split(self, mesh8):
+        net = _mlp()
+        master = ParameterAveragingTrainingMaster(
+            mesh8, batch_size_per_worker=8, averaging_frequency=4)
+        x, y = _blobs(n=32)
+        with pytest.raises(ValueError, match="per split"):
+            master.execute_training(net, x, y)
+
+
+class TestSharedTraining:
+    def test_exact_mode_matches_single_device_full_batch(self, mesh8):
+        """threshold=None: psum of per-shard grads == full-batch grad, so
+        distributed training must track single-device full-batch training."""
+        x, y = _blobs(n=64, seed=1)
+        net_d = _mlp(lr=0.05, seed=9)
+        net_s = _mlp(lr=0.05, seed=9)
+
+        master = SharedTrainingMaster(mesh8, batch_size_per_worker=8)
+        master.execute_training(net_d, x, y, epochs=3)
+
+        step = net_s.make_train_step(donate=False)
+        p, s, o = net_s.params, net_s.state, net_s.opt_state
+        rng = jax.random.PRNGKey(net_s.conf.seed + 2)
+        for it in range(3):
+            rng, sub = jax.random.split(rng)
+            p, s, o, _ = step(p, s, o, jnp.asarray(x), jnp.asarray(y), it,
+                              sub, None)
+        for d_l, s_l in zip(net_d.params, p):
+            for k in d_l:
+                np.testing.assert_allclose(np.asarray(d_l[k]),
+                                           np.asarray(s_l[k]),
+                                           rtol=1e-5, atol=1e-6)
+
+    def test_threshold_mode_converges(self, mesh8):
+        x, y = _blobs(n=1024, seed=2)
+        net = _mlp(lr=0.1)
+        before = net.score(x, y)
+        master = SharedTrainingMaster(mesh8, batch_size_per_worker=16,
+                                      threshold=1e-3)
+        master.execute_training(net, x, y, epochs=6)
+        after = net.score(x, y)
+        assert after < before * 0.8
+        assert master.training_stats()["final_threshold"] > 0
+
+
+class TestEncodedGradientsAccumulator:
+    def test_exactly_once_fanout_and_mass_conservation(self):
+        n = 4096
+        acc = EncodedGradientsAccumulator(n, n_workers=2, threshold=1e-3)
+        rs = np.random.RandomState(0)
+        g0 = (rs.randn(n) * 1e-2).astype(np.float32)
+        g1 = (rs.randn(n) * 1e-2).astype(np.float32)
+        assert acc.store_update(0, g0)
+        assert acc.store_update(1, g1)
+
+        t0 = np.zeros(n, np.float32)
+        t1 = np.zeros(n, np.float32)
+        assert acc.apply_updates(0, t0) == 2
+        assert acc.apply_updates(1, t1) == 2
+        # both consumers saw both messages, exactly once -> identical result
+        np.testing.assert_array_equal(t0, t1)
+        # decoded + residual-left-behind == original mass
+        resid = (acc._slots[0].residual + acc._slots[1].residual)
+        np.testing.assert_allclose(t0 + resid, g0 + g1, atol=1e-6)
+        # nothing pending anymore
+        assert not acc.has_anything(0)
+        assert not acc.has_anything(1)
+        acc.close()
+
+    def test_threaded_workers_stay_in_sync(self):
+        import threading
+
+        n, steps, workers = 1024, 20, 4
+        acc = EncodedGradientsAccumulator(n, n_workers=workers,
+                                          threshold=1e-3)
+        params = [np.zeros(n, np.float32) for _ in range(workers)]
+        barrier = threading.Barrier(workers)
+
+        def run(w):
+            rs = np.random.RandomState(100 + w)
+            for _ in range(steps):
+                acc.store_update(w, (rs.randn(n) * 1e-2).astype(np.float32))
+                barrier.wait()
+                acc.apply_updates(w, params[w])
+                barrier.wait()
+
+        ts = [threading.Thread(target=run, args=(w,)) for w in range(workers)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        for w in range(1, workers):
+            np.testing.assert_array_equal(params[0], params[w])
+        assert np.abs(params[0]).sum() > 0
+        acc.close()

@@ -83,6 +83,7 @@ class TestUIServer:
             router = RemoteStatsStorageRouter(f"http://127.0.0.1:{server.port}")
             router.put_record({"type": "stats", "session": "remote-s",
                                "iteration": 1, "score": 0.5})
+            router.flush()
             base = f"http://127.0.0.1:{server.port}"
             sessions = json.loads(urllib.request.urlopen(base + "/train/sessions").read())
             assert "remote-s" in sessions
