@@ -1,0 +1,114 @@
+"""Legacy convex-optimizer stack (CG / LBFGS / line-search GD).
+
+Reference test analog: the reference exercises these through
+TestOptimizers.java-style fits; here each algorithm must drive a convex
+problem to its optimum and train a small network full-batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import solvers
+
+
+def _quadratic():
+    # f(x) = 0.5 x^T A x - b^T x, A SPD; optimum x* = A^-1 b
+    rs = np.random.RandomState(0)
+    m = rs.rand(6, 6)
+    a = m @ m.T + 6 * np.eye(6)
+    b = rs.rand(6)
+    xstar = np.linalg.solve(a, b)
+    a_j, b_j = jnp.asarray(a), jnp.asarray(b)
+
+    def loss(x):
+        return 0.5 * x @ a_j @ x - b_j @ x
+
+    return loss, xstar
+
+
+def _rosenbrock(x):
+    return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1 - x[:-1]) ** 2)
+
+
+@pytest.mark.parametrize("algo", ["line_gradient_descent",
+                                  "conjugate_gradient", "lbfgs"])
+def test_quadratic_converges_to_optimum(algo):
+    loss, xstar = _quadratic()
+    opt = solvers.ALGORITHMS[algo](loss, max_iterations=200, tolerance=1e-12,
+                                   line_search_iterations=10)
+    x, score, _ = opt.optimize(jnp.zeros(6))
+    np.testing.assert_allclose(np.asarray(x), xstar, atol=2e-3)
+
+
+def test_lbfgs_beats_gd_on_rosenbrock():
+    x0 = jnp.zeros(4)
+    gd = solvers.LineGradientDescent(_rosenbrock, max_iterations=60,
+                                     tolerance=0.0, line_search_iterations=12)
+    lb = solvers.LBFGS(_rosenbrock, m=6, max_iterations=60, tolerance=0.0,
+                       line_search_iterations=12)
+    _, f_gd, _ = gd.optimize(x0)
+    _, f_lb, _ = lb.optimize(x0)
+    assert f_lb < f_gd  # curvature info must pay off
+    assert f_lb < 1.0   # near the valley floor
+
+
+def test_cg_restarts_stay_descent():
+    # pathological start: line search + PR restarts must still always descend
+    loss, _ = _quadratic()
+    opt = solvers.ConjugateGradient(loss, max_iterations=30, tolerance=0.0)
+    x, f, _ = opt.optimize(jnp.full(6, 50.0))
+    assert f < float(loss(jnp.full(6, 50.0)))
+
+
+def test_pytree_params_roundtrip():
+    # optimizer must accept arbitrary pytrees, not just flat vectors
+    def loss(p):
+        return jnp.sum((p["w"] - 3.0) ** 2) + jnp.sum((p["b"] + 1.0) ** 2)
+
+    opt = solvers.LBFGS(loss, max_iterations=50, tolerance=1e-12)
+    p, f, _ = opt.optimize({"w": jnp.zeros((2, 2)), "b": jnp.zeros(3)})
+    np.testing.assert_allclose(np.asarray(p["w"]), 3.0, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(p["b"]), -1.0, atol=1e-3)
+
+
+def test_solver_trains_network_full_batch():
+    from deeplearning4j_tpu.nn.conf import inputs as input_types
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rs = np.random.RandomState(42)
+    x = rs.rand(64, 4).astype(np.float32)
+    labels = (x.sum(axis=1) > 2.0).astype(np.int32)
+    y = np.eye(2, dtype=np.float32)[labels]
+
+    conf = NeuralNetConfig(seed=7).list(
+        DenseLayer(n_out=16, activation="tanh"),
+        OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+        input_type=input_types.feed_forward(4))
+    net = MultiLayerNetwork(conf)
+    net.init()
+    loss0, _ = net.loss_fn(net.params, net.state, jnp.asarray(x), jnp.asarray(y),
+                           train=False)
+
+    solver = solvers.Solver(net, algorithm="lbfgs", max_iterations=80,
+                            tolerance=1e-9)
+    score = solver.optimize(jnp.asarray(x), jnp.asarray(y))
+    assert score < float(loss0) * 0.5
+
+    preds = np.asarray(net.output(jnp.asarray(x)))
+    acc = (preds.argmax(axis=1) == labels).mean()
+    assert acc > 0.9
+
+
+def test_step_functions():
+    p = jnp.ones(3)
+    d = jnp.asarray([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(solvers.default_step(p, d, 0.5), [1.5, 2.0, 2.5])
+    np.testing.assert_allclose(solvers.negative_default_step(p, d, 0.5),
+                               [0.5, 0.0, -0.5])
+    np.testing.assert_allclose(solvers.gradient_step(p, d, 0.5), [2.0, 3.0, 4.0])
+    np.testing.assert_allclose(solvers.negative_gradient_step(p, d, 0.5),
+                               [0.0, -1.0, -2.0])
