@@ -115,16 +115,32 @@ def _padding(c: Cfg):
 def _w(weights, *names):
     """Find a weight by Keras 2 name (``.../kernel:0``) or Keras 1 name
     (underscore-suffixed, e.g. ``dense_1_W``)."""
+    # exact-name pass first so e.g. "kernel" never suffix-matches
+    # "recurrent_kernel" regardless of HDF5 key order
     for n in names:
         for key, arr in weights.items():
-            base = key.split("/")[-1].split(":")[0]
-            if base == n or base.endswith("_" + n):
+            if key.split("/")[-1].split(":")[0] == n:
+                return np.asarray(arr, np.float32)
+    for n in names:
+        for key, arr in weights.items():
+            if key.split("/")[-1].split(":")[0].endswith("_" + n):
                 return np.asarray(arr, np.float32)
     return None
 
 
+def _require(weights, *names):
+    """Like _w but a missing weight is an import error, not a silent skip
+    (reference KerasBatchNormalization.setWeights:144-163 et al. throw
+    InvalidKerasConfigurationException on absent required params)."""
+    v = _w(weights, *names)
+    if v is None:
+        raise KerasImportError(
+            f"Required weight {names[0]!r} not found among {sorted(weights)}")
+    return v
+
+
 def _dense_weights(layer, weights):
-    p = {"W": _w(weights, "kernel", "W")}
+    p = {"W": _require(weights, "kernel", "W")}
     b = _w(weights, "bias", "b")
     if b is not None:
         p["b"] = b
@@ -151,8 +167,11 @@ def _bn_weights(layer, weights):
         p["gamma"] = gamma
     if beta is not None:
         p["beta"] = beta
-    state = {"mean": _w(weights, "moving_mean"),
-             "var": _w(weights, "moving_variance")}
+    # Keras 2: moving_mean/moving_variance; Keras 1: running_mean/running_std
+    # (Keras 1's "running_std" holds the variance — the reference maps it 1:1
+    # to GLOBAL_VAR, Keras1LayerConfiguration.java:67)
+    state = {"mean": _require(weights, "moving_mean", "running_mean"),
+             "var": _require(weights, "moving_variance", "running_std")}
     return p, state
 
 
@@ -183,7 +202,9 @@ def _embedding_weights(layer, weights):
 
 
 def _simple_rnn_weights(layer, weights):
-    p = {"Wx": _w(weights, "kernel"), "Wh": _w(weights, "recurrent_kernel")}
+    # Keras 2: kernel/recurrent_kernel/bias; Keras 1: W/U/b
+    p = {"Wx": _require(weights, "kernel", "W"),
+         "Wh": _require(weights, "recurrent_kernel", "U")}
     b = _w(weights, "bias")
     if b is not None:
         p["b"] = b

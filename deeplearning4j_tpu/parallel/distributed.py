@@ -182,8 +182,14 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         it0 = 0
         rng = jax.random.PRNGKey(net.conf.seed + 1)
         loss = None
-        for _ in range(epochs):
-            for s0 in range(0, n - split_examples + 1, split_examples):
+        rem = n % split_examples
+        for ep in range(epochs):
+            # rotate the window each epoch so a ragged tail is not always the
+            # same dropped examples; count what this epoch leaves out
+            start = (ep * rem) % (rem + 1) if rem else 0
+            self._stats["examples_dropped"] = self._stats.get(
+                "examples_dropped", 0) + rem
+            for s0 in range(start, n - split_examples + 1, split_examples):
                 xs = np.asarray(data[s0:s0 + split_examples]).reshape(
                     (w, f, b) + data.shape[1:])
                 ys = np.asarray(labels[s0:s0 + split_examples]).reshape(
@@ -310,8 +316,12 @@ class SharedTrainingMaster(TrainingMaster):
         data_sh = _mesh.data_sharded(mesh)
         rng = jax.random.PRNGKey(net.conf.seed + 2)
         it, loss = 0, None
-        for _ in range(epochs):
-            for s0 in range(0, n - step_examples + 1, step_examples):
+        rem = n % step_examples
+        for ep in range(epochs):
+            start = (ep * rem) % (rem + 1) if rem else 0
+            self._stats["examples_dropped"] = self._stats.get(
+                "examples_dropped", 0) + rem
+            for s0 in range(start, n - step_examples + 1, step_examples):
                 x = jax.device_put(jnp.asarray(data[s0:s0 + step_examples]),
                                    data_sh)
                 y = jax.device_put(jnp.asarray(labels[s0:s0 + step_examples]),

@@ -185,3 +185,21 @@ class TestEncodedGradientsAccumulator:
             np.testing.assert_array_equal(params[0], params[w])
         assert np.abs(params[0]).sum() > 0
         acc.close()
+
+
+def test_ragged_tail_rotates_and_is_counted(mesh8):
+    # n not divisible by the split size: the dropped remainder must be counted
+    # in stats and the start offset must rotate across epochs
+    net = _mlp(d=4, k=2)
+    master = ParameterAveragingTrainingMaster(
+        mesh8, batch_size_per_worker=2, averaging_frequency=1)
+    w = master.n_workers
+    split = w * 2
+    n = split * 3 + 5  # ragged tail of 5
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, n)]
+    master.execute_training(net, x, y, epochs=3)
+    stats = master.training_stats()
+    assert stats["examples_dropped"] == 5 * 3
+    assert stats["splits"] == 3 * 3

@@ -267,3 +267,56 @@ class TestFunctionalImport:
         got = np.asarray(outs["out"])
         want = _softmax((x + np.maximum(x @ w1 + b1, 0)) @ w2 + b2)
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestKeras1Dialect:
+    def test_keras1_bn_running_stats_import(self, tmp_path):
+        # Keras 1 weight names: {layer}_{gamma,beta,running_mean,running_std}
+        # where running_std holds the VARIANCE (reference maps it 1:1 to
+        # GLOBAL_VAR, Keras1LayerConfiguration.java:67)
+        from deeplearning4j_tpu.modelimport import (
+            import_keras_sequential_model_and_weights)
+        rs = np.random.RandomState(5)
+        mean = rs.randn(3).astype(np.float32)
+        var = rs.rand(3).astype(np.float32) + 0.25
+        cfg = _seq_config([
+            {"class_name": "Dense",
+             "config": {"name": "dense_1", "output_dim": 3,
+                        "activation": "linear",
+                        "batch_input_shape": [None, 2]}},
+            {"class_name": "BatchNormalization",
+             "config": {"name": "batchnormalization_1", "epsilon": 1e-3,
+                        "axis": -1}},
+        ])
+        p = str(tmp_path / "bn1.h5")
+        _write_keras_file(p, cfg, {
+            "dense_1": [("dense_1_W", rs.randn(2, 3).astype(np.float32)),
+                        ("dense_1_b", np.zeros(3, np.float32))],
+            "batchnormalization_1": [
+                ("batchnormalization_1_gamma", np.ones(3, np.float32)),
+                ("batchnormalization_1_beta", np.zeros(3, np.float32)),
+                ("batchnormalization_1_running_mean", mean),
+                ("batchnormalization_1_running_std", var)],
+        })
+        net = import_keras_sequential_model_and_weights(p)
+        np.testing.assert_allclose(np.asarray(net.state[1]["mean"]), mean)
+        np.testing.assert_allclose(np.asarray(net.state[1]["var"]), var)
+
+    def test_missing_required_weight_raises(self, tmp_path):
+        from deeplearning4j_tpu.modelimport import (
+            KerasImportError, import_keras_sequential_model_and_weights)
+        cfg = _seq_config([
+            {"class_name": "Dense",
+             "config": {"name": "d", "units": 3, "activation": "linear",
+                        "batch_input_shape": [None, 2]}},
+            {"class_name": "BatchNormalization",
+             "config": {"name": "bn", "epsilon": 1e-3, "axis": -1}},
+        ])
+        p = str(tmp_path / "missing.h5")
+        _write_keras_file(p, cfg, {
+            "d": [("d/kernel:0", np.zeros((2, 3), np.float32))],
+            "bn": [("bn/gamma:0", np.ones(3, np.float32)),
+                   ("bn/beta:0", np.zeros(3, np.float32))],  # no moving stats
+        })
+        with pytest.raises(KerasImportError, match="moving_mean"):
+            import_keras_sequential_model_and_weights(p)
