@@ -3,6 +3,13 @@ from deeplearning4j_tpu.datasets.iterator import (  # noqa: F401
     EarlyTerminationIterator, MultipleEpochsIterator,
 )
 from deeplearning4j_tpu.datasets.fetchers import (  # noqa: F401
-    IrisDataFetcher, MnistDataFetcher, SyntheticDataFetcher,
-    iris_iterator, mnist_iterator, synthetic_iterator,
+    Cifar10DataFetcher, EmnistDataFetcher, IrisDataFetcher, LfwDataFetcher,
+    MnistDataFetcher, SvhnDataFetcher, SyntheticDataFetcher,
+    TinyImageNetFetcher, UciSequenceDataFetcher,
+    cifar10_iterator, emnist_iterator, iris_iterator, mnist_iterator,
+    svhn_iterator, synthetic_iterator, tiny_imagenet_iterator,
+    uci_sequence_iterator,
+)
+from deeplearning4j_tpu.datasets.cacheable import (  # noqa: F401
+    ChecksumError, ensure_extracted, ensure_file,
 )

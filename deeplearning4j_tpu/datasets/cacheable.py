@@ -1,0 +1,94 @@
+"""Download+cache+checksum framework for dataset fetchers.
+
+Reference analog: CacheableExtractableDataSetFetcher
+(/root/reference/deeplearning4j-core/src/main/java/org/deeplearning4j/
+datasets/fetchers/CacheableExtractableDataSetFetcher.java) — download to a
+local cache dir, verify checksum, extract archives, delete-and-fail-hard on
+mismatch (same policy as ZooModel.java:77-83).
+
+Offline-first: this build environment has zero egress, so downloading is
+gated behind ``DL4J_TPU_ALLOW_DOWNLOAD=1``. Without it, a missing file raises
+``FileNotFoundError`` describing the expected layout so users can stage data
+out-of-band (the normal mode on TPU pods, where data lives on a mounted GCS
+bucket anyway).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tarfile
+import zipfile
+
+from deeplearning4j_tpu.datasets import fetchers as _f
+
+
+class ChecksumError(RuntimeError):
+    pass
+
+
+def downloads_allowed():
+    return os.environ.get("DL4J_TPU_ALLOW_DOWNLOAD") == "1"
+
+
+def _md5(path, chunk=1 << 20):
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def ensure_file(relpath, url=None, md5=None, root=None):
+    """Return the local path of ``relpath`` under the data dir, downloading
+    it (gated) if absent. Checksum mismatch deletes the file and raises
+    (reference ZooModel.java:77-83 policy)."""
+    root = root or _f.data_dir()
+    path = os.path.join(root, relpath)
+    if not os.path.exists(path):
+        if url is None or not downloads_allowed():
+            raise FileNotFoundError(
+                f"Dataset file {relpath} not found under {root}. This "
+                f"environment is offline-first: stage the file there manually"
+                + (f" (source: {url})" if url else "")
+                + ", or set DL4J_TPU_ALLOW_DOWNLOAD=1 to fetch it.")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        import urllib.request
+        tmp = path + ".part"
+        urllib.request.urlretrieve(url, tmp)
+        os.replace(tmp, path)
+    if md5 is not None:
+        got = _md5(path)
+        if got != md5:
+            os.remove(path)
+            raise ChecksumError(
+                f"Checksum mismatch for {path}: expected {md5}, got {got}; "
+                f"cached file deleted — re-stage it.")
+    return path
+
+
+def ensure_extracted(relpath, archive_relpath, url=None, md5=None, root=None):
+    """Ensure directory ``relpath`` exists, extracting ``archive_relpath``
+    (zip/tar[.gz]) if needed."""
+    root = root or _f.data_dir()
+    target = os.path.join(root, relpath)
+    if os.path.isdir(target) and os.listdir(target):
+        return target
+    archive = ensure_file(archive_relpath, url=url, md5=md5, root=root)
+    tmp = target + ".extracting"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp, exist_ok=True)
+    if zipfile.is_zipfile(archive):
+        with zipfile.ZipFile(archive) as z:
+            z.extractall(tmp)
+    else:
+        with tarfile.open(archive) as t:
+            t.extractall(tmp, filter="data")
+    os.makedirs(os.path.dirname(target) or root, exist_ok=True)
+    shutil.rmtree(target, ignore_errors=True)
+    os.replace(tmp, target)
+    return target

@@ -1,6 +1,7 @@
 """Dataset iterator tests (reference: AsyncDataSetIteratorTest,
 MultipleEpochsIteratorTest in deeplearning4j-core)."""
 
+import os
 import numpy as np
 import pytest
 
@@ -99,3 +100,201 @@ class TestTrainingFromIterator:
         preds = np.argmax(np.asarray(net.output(f.features)), 1)
         acc = np.mean(preds == np.argmax(f.labels, 1))
         assert acc > 0.85
+
+
+# ---------------------------------------------------------------------------
+# fetcher catalog: each fetcher parses its on-disk format (fixtures authored
+# here in the exact published layouts; reference: datasets/fetchers/*)
+# ---------------------------------------------------------------------------
+
+def _write_idx(path, arr):
+    import gzip
+    import struct
+    codes = {np.uint8: 0x08}
+    with open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, arr.ndim))
+        f.write(struct.pack(">" + "I" * arr.ndim, *arr.shape))
+        f.write(arr.astype(">u1").tobytes())
+
+
+class TestFetcherCatalog:
+    def test_emnist(self, tmp_path):
+        from deeplearning4j_tpu.datasets import EmnistDataFetcher
+        root = tmp_path / "emnist"
+        root.mkdir()
+        imgs = np.random.RandomState(0).randint(0, 256, (20, 28, 28)).astype(np.uint8)
+        labs = np.arange(20).astype(np.uint8) % 47
+        _write_idx(str(root / "emnist-balanced-train-images-idx3-ubyte"), imgs)
+        _write_idx(str(root / "emnist-balanced-train-labels-idx1-ubyte"), labs)
+        f = EmnistDataFetcher(split="balanced", train=True, root=str(root))
+        x, y = f.arrays()
+        assert x.shape == (20, 28, 28, 1) and y.shape == (20, 47)
+        assert f.n_classes == 47
+        np.testing.assert_allclose(y.argmax(1), labs)
+
+    def test_emnist_letters_one_indexed(self, tmp_path):
+        from deeplearning4j_tpu.datasets import EmnistDataFetcher
+        root = tmp_path / "emnist"
+        root.mkdir()
+        imgs = np.zeros((4, 28, 28), np.uint8)
+        labs = np.array([1, 2, 25, 26], np.uint8)  # letters: 1..26
+        _write_idx(str(root / "emnist-letters-test-images-idx3-ubyte"), imgs)
+        _write_idx(str(root / "emnist-letters-test-labels-idx1-ubyte"), labs)
+        f = EmnistDataFetcher(split="letters", train=False, root=str(root))
+        np.testing.assert_allclose(f.labels.argmax(1), [0, 1, 24, 25])
+
+    def test_cifar10(self, tmp_path):
+        from deeplearning4j_tpu.datasets import Cifar10DataFetcher
+        root = tmp_path / "cifar10"
+        root.mkdir()
+        rs = np.random.RandomState(1)
+        n = 7
+        for b in range(1, 6):
+            rec = np.concatenate([
+                rs.randint(0, 10, (n, 1)),
+                rs.randint(0, 256, (n, 3072))], axis=1).astype(np.uint8)
+            (root / f"data_batch_{b}.bin").write_bytes(rec.tobytes())
+        f = Cifar10DataFetcher(train=True, root=str(root))
+        x, y = f.arrays()
+        assert x.shape == (35, 32, 32, 3) and y.shape == (35, 10)
+        assert x.min() >= 0 and x.max() <= 1
+
+    def test_cifar10_channel_order(self, tmp_path):
+        from deeplearning4j_tpu.datasets import Cifar10DataFetcher
+        root = tmp_path / "cifar10"
+        root.mkdir()
+        # one record: red channel all 255, green/blue 0
+        rec = np.zeros(3073, np.uint8)
+        rec[0] = 3
+        rec[1:1025] = 255  # R plane
+        (root / "test_batch.bin").write_bytes(rec.tobytes())
+        f = Cifar10DataFetcher(train=False, root=str(root))
+        x, y = f.arrays()
+        np.testing.assert_allclose(x[0, :, :, 0], 1.0)
+        np.testing.assert_allclose(x[0, :, :, 1:], 0.0)
+        assert y[0].argmax() == 3
+
+    def test_svhn_label_10_is_zero(self, tmp_path):
+        import scipy.io
+        from deeplearning4j_tpu.datasets import SvhnDataFetcher
+        root = tmp_path / "svhn"
+        root.mkdir()
+        rs = np.random.RandomState(2)
+        x = rs.randint(0, 256, (32, 32, 3, 5)).astype(np.uint8)
+        y = np.array([[10], [1], [2], [10], [9]], np.uint8)
+        scipy.io.savemat(str(root / "train_32x32.mat"), {"X": x, "y": y})
+        f = SvhnDataFetcher(train=True, root=str(root))
+        xx, yy = f.arrays()
+        assert xx.shape == (5, 32, 32, 3)
+        np.testing.assert_allclose(yy.argmax(1), [0, 1, 2, 0, 9])
+
+    def test_tiny_imagenet(self, tmp_path):
+        from PIL import Image
+        from deeplearning4j_tpu.datasets import TinyImageNetFetcher
+        root = tmp_path / "tiny-imagenet-200"
+        wnids = ["n001", "n002"]
+        (root).mkdir()
+        (root / "wnids.txt").write_text("\n".join(wnids) + "\n")
+        for w in wnids:
+            d = root / "train" / w / "images"
+            d.mkdir(parents=True)
+            for i in range(3):
+                Image.new("RGB", (64, 64), (i * 40, 0, 0)).save(
+                    str(d / f"{w}_{i}.JPEG"))
+        f = TinyImageNetFetcher(train=True, root=str(root))
+        x, y = f.arrays()
+        assert x.shape == (6, 64, 64, 3) and y.shape == (6, 2)
+        assert y.argmax(1).tolist() == [0, 0, 0, 1, 1, 1]
+
+    def test_tiny_imagenet_val_annotations(self, tmp_path):
+        from PIL import Image
+        from deeplearning4j_tpu.datasets import TinyImageNetFetcher
+        root = tmp_path / "tiny-imagenet-200"
+        root.mkdir()
+        (root / "wnids.txt").write_text("n001\nn002\n")
+        d = root / "val" / "images"
+        d.mkdir(parents=True)
+        Image.new("RGB", (64, 64)).save(str(d / "val_0.JPEG"))
+        Image.new("RGB", (64, 64)).save(str(d / "val_1.JPEG"))
+        (root / "val" / "val_annotations.txt").write_text(
+            "val_0.JPEG\tn002\t0\t0\t1\t1\nval_1.JPEG\tn001\t0\t0\t1\t1\n")
+        f = TinyImageNetFetcher(train=False, root=str(root))
+        assert f.labels.argmax(1).tolist() == [1, 0]
+
+    def test_lfw(self, tmp_path):
+        from PIL import Image
+        from deeplearning4j_tpu.datasets import LfwDataFetcher
+        root = tmp_path / "lfw"
+        for person, n in (("Ada_Lovelace", 3), ("Grace_Hopper", 2)):
+            d = root / person
+            d.mkdir(parents=True)
+            for i in range(n):
+                Image.new("RGB", (250, 250)).save(
+                    str(d / f"{person}_{i:04d}.jpg"))
+        f = LfwDataFetcher(root=str(root), image_size=32)
+        x, y = f.arrays()
+        assert x.shape == (5, 32, 32, 3) and y.shape == (5, 2)
+        assert f.people == ["Ada_Lovelace", "Grace_Hopper"]
+        # min_images filter
+        f2 = LfwDataFetcher(root=str(root), image_size=32,
+                            min_images_per_person=3)
+        assert f2.people == ["Ada_Lovelace"]
+
+    def test_uci_sequence(self, tmp_path):
+        from deeplearning4j_tpu.datasets import UciSequenceDataFetcher
+        root = tmp_path / "uci"
+        root.mkdir()
+        rs = np.random.RandomState(3)
+        rows = rs.rand(600, 60).astype(np.float32)
+        np.savetxt(str(root / "synthetic_control.data"), rows)
+        tr = UciSequenceDataFetcher(train=True, root=str(root))
+        te = UciSequenceDataFetcher(train=False, root=str(root))
+        assert tr.sequences.shape == (450, 60, 1)
+        assert te.sequences.shape == (150, 60, 1)
+        # split is a partition: class counts sum to 100 per class
+        counts = tr.labels.sum(0) + te.labels.sum(0)
+        np.testing.assert_allclose(counts, 100.0)
+
+    def test_missing_raises_with_guidance(self, tmp_path):
+        from deeplearning4j_tpu.datasets import (Cifar10DataFetcher,
+                                                 UciSequenceDataFetcher)
+        with pytest.raises(FileNotFoundError, match="stage"):
+            Cifar10DataFetcher(root=str(tmp_path / "nope"))
+        with pytest.raises(FileNotFoundError, match="[Oo]ffline"):
+            UciSequenceDataFetcher(root=str(tmp_path / "nope"))
+
+
+class TestCacheable:
+    def test_ensure_file_checksum(self, tmp_path):
+        import hashlib
+        from deeplearning4j_tpu.datasets import ChecksumError, ensure_file
+        p = tmp_path / "d" / "f.bin"
+        p.parent.mkdir()
+        p.write_bytes(b"hello")
+        good = hashlib.md5(b"hello").hexdigest()
+        assert ensure_file("d/f.bin", md5=good, root=str(tmp_path)) == str(p)
+        # mismatch deletes the file and raises (ZooModel.java:77-83 policy)
+        p.write_bytes(b"corrupted")
+        with pytest.raises(ChecksumError):
+            ensure_file("d/f.bin", md5=good, root=str(tmp_path))
+        assert not p.exists()
+
+    def test_ensure_file_offline_gating(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.datasets import ensure_file
+        monkeypatch.delenv("DL4J_TPU_ALLOW_DOWNLOAD", raising=False)
+        with pytest.raises(FileNotFoundError, match="DL4J_TPU_ALLOW_DOWNLOAD"):
+            ensure_file("missing.bin", url="http://example.com/x",
+                        root=str(tmp_path))
+
+    def test_ensure_extracted_zip(self, tmp_path):
+        import zipfile
+        from deeplearning4j_tpu.datasets import ensure_extracted
+        arc = tmp_path / "a.zip"
+        with zipfile.ZipFile(str(arc), "w") as z:
+            z.writestr("inner.txt", "payload")
+        out = ensure_extracted("unpacked", "a.zip", root=str(tmp_path))
+        assert open(os.path.join(out, "inner.txt")).read() == "payload"
+        # second call: already extracted, archive not needed
+        arc.unlink()
+        out2 = ensure_extracted("unpacked", "a.zip", root=str(tmp_path))
+        assert out2 == out
