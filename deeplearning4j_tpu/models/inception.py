@@ -1,0 +1,295 @@
+"""Inception-family zoo models: GoogLeNet, InceptionResNetV1, FaceNetNN4Small2.
+
+Reference analogs (/root/reference/deeplearning4j-zoo/src/main/java/org/
+deeplearning4j/zoo/model/):
+
+* ``GoogLeNet.java:123-176`` — inception modules (1x1 / 1x1->3x3 / 1x1->5x5 /
+  maxpool->1x1 branches depth-concatenated) with the exact 3a..5b filter
+  tables at :154-169, LRN stem, 7x7 avg-pool head.
+* ``InceptionResNetV1.java`` + ``helper/InceptionResNetHelper.java`` — stem
+  (:112-165), 5x inception-resnet-A, reduction-A (:170-200), 10x B,
+  reduction-B, 5x C, then the FaceNet-style head: 128-d bottleneck, L2
+  normalize to the embedding hypersphere, center-loss softmax
+  (FaceNetNN4Small2.java:82-91 shows the same head).
+* ``FaceNetNN4Small2.java:83-300`` — NN4-small2 inception variant, same head.
+
+TPU-first: NHWC bf16-friendly convs; depth-concat via MergeVertex (XLA fuses
+the concatenated producers); residual scaling via ScaleVertex +
+ElementWiseVertex add. Exact per-branch filter tables are kept where the
+reference pins them (GoogLeNet); the residual blocks keep the reference's
+block counts and scale factors.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.graph import (ElementWiseVertex, GraphBuilder,
+                                         L2NormalizeVertex, MergeVertex,
+                                         ScaleVertex)
+
+
+def _conv(g, name, inp, n_out, kernel, stride=(1, 1), padding="same",
+          activation="relu", bn=False):
+    g.add_layer(name, L.ConvolutionLayer(
+        n_out=n_out, kernel=kernel, stride=stride, padding=padding,
+        activation="identity" if bn else activation, weight_init="relu"), inp)
+    if bn:
+        g.add_layer(name + "-bn", L.BatchNormalization(activation=activation),
+                    name)
+        return name + "-bn"
+    return name
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet
+# ---------------------------------------------------------------------------
+
+# reference GoogLeNet.java:154-169: {1x1}, {3x3 reduce, 3x3},
+# {5x5 reduce, 5x5}, {pool-proj}
+_GOOGLENET_TABLE = {
+    "3a": ((64,), (96, 128), (16, 32), (32,)),
+    "3b": ((128,), (128, 192), (32, 96), (64,)),
+    "4a": ((192,), (96, 208), (16, 48), (64,)),
+    "4b": ((160,), (112, 224), (24, 64), (64,)),
+    "4c": ((128,), (128, 256), (24, 64), (64,)),
+    "4d": ((112,), (144, 288), (32, 64), (64,)),
+    "4e": ((256,), (160, 320), (32, 128), (128,)),
+    "5a": ((256,), (160, 320), (32, 128), (128,)),
+    "5b": ((384,), (192, 384), (48, 128), (128,)),
+}
+
+
+def _inception(g, name, inp, cfg):
+    """One GoogLeNet inception module (reference GoogLeNet.java:123-138)."""
+    (f1,), (f3r, f3), (f5r, f5), (fp,) = cfg
+    b1 = _conv(g, f"{name}-1x1", inp, f1, (1, 1))
+    r3 = _conv(g, f"{name}-3x3r", inp, f3r, (1, 1))
+    b3 = _conv(g, f"{name}-3x3", r3, f3, (3, 3))
+    r5 = _conv(g, f"{name}-5x5r", inp, f5r, (1, 1))
+    b5 = _conv(g, f"{name}-5x5", r5, f5, (5, 5))
+    g.add_layer(f"{name}-pool", L.SubsamplingLayer(
+        kernel=(3, 3), stride=(1, 1), padding="same", mode="max"), inp)
+    bp = _conv(g, f"{name}-poolproj", f"{name}-pool", fp, (1, 1))
+    g.add_vertex(f"{name}-depthconcat", MergeVertex(), b1, b3, b5, bp)
+    return f"{name}-depthconcat"
+
+
+def googlenet(height=224, width=224, channels=3, n_classes=1000, updater=None,
+              seed=12345):
+    """GoogLeNet / Inception v1 (reference GoogLeNet.java)."""
+    g = GraphBuilder(updater=updater or U.Adam(learning_rate=1e-3), seed=seed)
+    g.add_inputs("input")
+    g.set_input_types(I.ConvolutionalType(height, width, channels))
+
+    x = _conv(g, "cnn1", "input", 64, (7, 7), stride=(2, 2))
+    g.add_layer("max1", L.SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                           padding="same", mode="max"), x)
+    g.add_layer("lrn1", L.LocalResponseNormalization(n=5, alpha=1e-4,
+                                                     beta=0.75), "max1")
+    x = _conv(g, "cnn2", "lrn1", 64, (1, 1))
+    x = _conv(g, "cnn3", x, 192, (3, 3))
+    g.add_layer("lrn2", L.LocalResponseNormalization(n=5, alpha=1e-4,
+                                                     beta=0.75), x)
+    g.add_layer("max2", L.SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                           padding="same", mode="max"), "lrn2")
+    x = "max2"
+    for name in ("3a", "3b"):
+        x = _inception(g, name, x, _GOOGLENET_TABLE[name])
+    g.add_layer("max3", L.SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                           padding="same", mode="max"), x)
+    x = "max3"
+    for name in ("4a", "4b", "4c", "4d", "4e"):
+        x = _inception(g, name, x, _GOOGLENET_TABLE[name])
+    g.add_layer("max4", L.SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                           padding="same", mode="max"), x)
+    x = "max4"
+    for name in ("5a", "5b"):
+        x = _inception(g, name, x, _GOOGLENET_TABLE[name])
+    g.add_layer("avgpool", L.GlobalPoolingLayer(mode="avg"), x)
+    g.add_layer("fc1", L.DenseLayer(n_out=1024, activation="relu",
+                                    dropout=0.4), "avgpool")
+    g.add_layer("output", L.OutputLayer(n_out=n_classes, activation="softmax",
+                                        loss="mcxent"), "fc1")
+    g.set_outputs("output")
+    return g.build()
+
+
+# ---------------------------------------------------------------------------
+# Inception-ResNet v1 (FaceNet backbone)
+# ---------------------------------------------------------------------------
+
+def _res_block(g, name, inp, branches, n_channels, scale):
+    """Inception-resnet block: branches -> concat -> 1x1 linear projection
+    back to n_channels -> scale -> add residual -> relu
+    (reference InceptionResNetHelper.inceptionV1ResA/B/C)."""
+    outs = []
+    for bi, branch in enumerate(branches):
+        cur = inp
+        for li, (f, k) in enumerate(branch):
+            cur = _conv(g, f"{name}-b{bi}-{li}", cur, f, k, bn=True)
+        outs.append(cur)
+    g.add_vertex(f"{name}-merge", MergeVertex(), *outs)
+    proj = _conv(g, f"{name}-proj", f"{name}-merge", n_channels, (1, 1),
+                 activation="identity")
+    g.add_vertex(f"{name}-scale", ScaleVertex(factor=scale), proj)
+    g.add_vertex(f"{name}-add", ElementWiseVertex(op="add"), inp,
+                 f"{name}-scale")
+    g.add_layer(f"{name}", L.ActivationLayer(activation="relu"),
+                f"{name}-add")
+    return name
+
+
+def _irv1_stem(g, channels_label="input"):
+    """InceptionResNetV1.java:112-165 stem."""
+    x = _conv(g, "stem-cnn1", channels_label, 32, (3, 3), stride=(2, 2), bn=True)
+    x = _conv(g, "stem-cnn2", x, 32, (3, 3), bn=True)
+    x = _conv(g, "stem-cnn3", x, 64, (3, 3), bn=True)
+    g.add_layer("stem-pool4", L.SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
+                                                 padding="same", mode="max"), x)
+    x = _conv(g, "stem-cnn5", "stem-pool4", 80, (1, 1), bn=True)
+    x = _conv(g, "stem-cnn6", x, 128, (3, 3), bn=True)
+    x = _conv(g, "stem-cnn7", x, 192, (3, 3), stride=(2, 2), bn=True)
+    return x
+
+
+def _embedding_head(g, x, n_classes, embedding_size, lambda_=2e-4):
+    """avgpool -> bottleneck -> L2 normalize -> center-loss softmax
+    (reference FaceNetNN4Small2.java:82-91)."""
+    g.add_layer("avgpool", L.GlobalPoolingLayer(mode="avg"), x)
+    g.add_layer("bottleneck", L.DenseLayer(n_out=embedding_size,
+                                           activation="identity"), "avgpool")
+    g.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+    g.add_layer("lossLayer", L.CenterLossOutputLayer(
+        n_out=n_classes, lambda_=lambda_, alpha=0.9), "embeddings")
+    g.set_outputs("lossLayer")
+
+
+def inception_resnet_v1(height=160, width=160, channels=3, n_classes=1001,
+                        embedding_size=128, updater=None, seed=12345,
+                        blocks_a=5, blocks_b=10, blocks_c=5):
+    """Inception-ResNet v1 with FaceNet embedding + center-loss head
+    (reference InceptionResNetV1.java; block counts/scales at :167-230:
+    5xA @0.17, 10xB @0.10, 5xC @0.20)."""
+    g = GraphBuilder(updater=updater or U.RmsProp(learning_rate=0.1),
+                     seed=seed)
+    g.add_inputs("input")
+    g.set_input_types(I.ConvolutionalType(height, width, channels))
+    x = _irv1_stem(g)
+
+    for i in range(blocks_a):  # 35x35 blocks
+        x = _res_block(g, f"resnetA{i}", x,
+                       [[(32, (1, 1))],
+                        [(32, (1, 1)), (32, (3, 3))],
+                        [(32, (1, 1)), (32, (3, 3)), (32, (3, 3))]],
+                       192, 0.17)
+    # reduction-A (InceptionResNetV1.java:170-200): stride-2 3x3 conv branch,
+    # 1x1->3x3->3x3 stride-2 branch, maxpool branch
+    ra1 = _conv(g, "reduceA-cnn1", x, 192, (3, 3), stride=(2, 2), bn=True)
+    ra2 = _conv(g, "reduceA-cnn2", x, 128, (1, 1), bn=True)
+    ra2 = _conv(g, "reduceA-cnn3", ra2, 128, (3, 3), bn=True)
+    ra2 = _conv(g, "reduceA-cnn4", ra2, 192, (3, 3), stride=(2, 2), bn=True)
+    g.add_layer("reduceA-pool", L.SubsamplingLayer(
+        kernel=(3, 3), stride=(2, 2), padding="same", mode="max"), x)
+    g.add_vertex("reduceA", MergeVertex(), ra1, ra2, "reduceA-pool")
+    x = "reduceA"
+    n_ch = 192 + 192 + 192  # concat of the three branches
+
+    for i in range(blocks_b):  # 17x17 blocks
+        x = _res_block(g, f"resnetB{i}", x,
+                       [[(128, (1, 1))],
+                        [(128, (1, 1)), (128, (1, 7)), (128, (7, 1))]],
+                       n_ch, 0.10)
+    # reduction-B
+    rb1 = _conv(g, "reduceB-cnn1", x, 256, (1, 1), bn=True)
+    rb1 = _conv(g, "reduceB-cnn2", rb1, 384, (3, 3), stride=(2, 2), bn=True)
+    rb2 = _conv(g, "reduceB-cnn3", x, 256, (1, 1), bn=True)
+    rb2 = _conv(g, "reduceB-cnn4", rb2, 256, (3, 3), stride=(2, 2), bn=True)
+    rb3 = _conv(g, "reduceB-cnn5", x, 256, (1, 1), bn=True)
+    rb3 = _conv(g, "reduceB-cnn6", rb3, 256, (3, 3), bn=True)
+    rb3 = _conv(g, "reduceB-cnn7", rb3, 256, (3, 3), stride=(2, 2), bn=True)
+    g.add_layer("reduceB-pool", L.SubsamplingLayer(
+        kernel=(3, 3), stride=(2, 2), padding="same", mode="max"), x)
+    g.add_vertex("reduceB", MergeVertex(), rb1, rb2, rb3, "reduceB-pool")
+    x = "reduceB"
+    n_ch = 384 + 256 + 256 + n_ch
+
+    for i in range(blocks_c):  # 8x8 blocks
+        x = _res_block(g, f"resnetC{i}", x,
+                       [[(192, (1, 1))],
+                        [(192, (1, 1)), (192, (1, 3)), (192, (3, 1))]],
+                       n_ch, 0.20)
+
+    _embedding_head(g, x, n_classes, embedding_size)
+    return g.build()
+
+
+# ---------------------------------------------------------------------------
+# FaceNet NN4-small2
+# ---------------------------------------------------------------------------
+
+def _nn4_inception(g, name, inp, f3r, f3, f5r, f5, fp, f1=None,
+                   stride=(1, 1), pool_mode="max"):
+    """NN4 inception module (reference FaceNetNN4Small2.java:146-300 blocks:
+    optional 1x1 branch, 1x1->3x3, 1x1->5x5, pool->optional 1x1 proj)."""
+    outs = []
+    if f1:
+        outs.append(_conv(g, f"{name}-1x1", inp, f1, (1, 1), bn=True))
+    if f3:
+        r = _conv(g, f"{name}-3x3r", inp, f3r, (1, 1), bn=True)
+        outs.append(_conv(g, f"{name}-3x3", r, f3, (3, 3), stride=stride,
+                          bn=True))
+    if f5:
+        r = _conv(g, f"{name}-5x5r", inp, f5r, (1, 1), bn=True)
+        outs.append(_conv(g, f"{name}-5x5", r, f5, (5, 5), stride=stride,
+                          bn=True))
+    g.add_layer(f"{name}-pool", L.SubsamplingLayer(
+        kernel=(3, 3), stride=stride if fp is None else (1, 1),
+        padding="same", mode=pool_mode), inp)
+    if fp:
+        outs.append(_conv(g, f"{name}-poolproj", f"{name}-pool", fp, (1, 1),
+                          bn=True))
+    else:
+        outs.append(f"{name}-pool")
+    g.add_vertex(f"{name}", MergeVertex(), *outs)
+    return name
+
+
+def facenet_nn4_small2(height=96, width=96, channels=3, n_classes=5749,
+                       embedding_size=128, updater=None, seed=12345):
+    """FaceNet NN4-small2 (reference FaceNetNN4Small2.java — inception
+    variant sized for 96x96 faces, embedding + center-loss head)."""
+    g = GraphBuilder(updater=updater or U.Adam(learning_rate=1e-3), seed=seed)
+    g.add_inputs("input")
+    g.set_input_types(I.ConvolutionalType(height, width, channels))
+
+    x = _conv(g, "stem-cnn1", "input", 64, (7, 7), stride=(2, 2), bn=True)
+    g.add_layer("stem-pool1", L.SubsamplingLayer(
+        kernel=(3, 3), stride=(2, 2), padding="same", mode="max"), x)
+    g.add_layer("stem-lrn1", L.LocalResponseNormalization(n=5, alpha=1e-4,
+                                                          beta=0.75),
+                "stem-pool1")
+    x = _conv(g, "inception-2-cnn1", "stem-lrn1", 64, (1, 1), bn=True)
+    x = _conv(g, "inception-2-cnn2", x, 192, (3, 3), bn=True)
+    g.add_layer("inception-2-lrn1", L.LocalResponseNormalization(
+        n=5, alpha=1e-4, beta=0.75), x)
+    g.add_layer("inception-2-pool1", L.SubsamplingLayer(
+        kernel=(3, 3), stride=(2, 2), padding="same", mode="max"),
+        "inception-2-lrn1")
+
+    # NN4-small2 table (FaceNetNN4Small2.java blocks 3a..5b)
+    x = _nn4_inception(g, "inception-3a", "inception-2-pool1",
+                       96, 128, 16, 32, 32, f1=64)
+    x = _nn4_inception(g, "inception-3b", x, 96, 128, 32, 64, 64, f1=64)
+    x = _nn4_inception(g, "inception-3c", x, 128, 256, 32, 64, None,
+                       stride=(2, 2))
+    x = _nn4_inception(g, "inception-4a", x, 96, 192, 32, 64, 128, f1=256)
+    x = _nn4_inception(g, "inception-4e", x, 160, 256, 64, 128, None,
+                       stride=(2, 2))
+    x = _nn4_inception(g, "inception-5a", x, 96, 384, 0, None, 96, f1=256,
+                       pool_mode="avg")
+    x = _nn4_inception(g, "inception-5b", x, 96, 384, 0, None, 96, f1=256)
+
+    _embedding_head(g, x, n_classes, embedding_size)
+    return g.build()

@@ -490,13 +490,17 @@ class ComputationGraph:
         self.opt_state = self.conf.updater.init(params)
         return params, state
 
-    def apply_fn(self, params, state, inputs, *, train=False, rng=None, mask=None):
-        """inputs: dict name->array (or single array if one input).
-        Returns (dict of output activations, new_state)."""
+    def _forward_pass(self, params, state, inputs, *, train=False, rng=None,
+                      mask=None, labels=None, label_masks=None):
+        """THE single topological traversal all forward entry points share.
+        Returns (acts, new_state, loss); ``loss`` is None unless ``labels``
+        is given, in which case output-vertex losses accumulate (feature-loss
+        heads like CenterLossOutputLayer receive their input activations)."""
         if not isinstance(inputs, dict):
-            inputs = {self.conf.inputs[0]: inputs}
+            inputs = {self.conf.inputs[0]: jnp.asarray(inputs)}
         acts = dict(inputs)
         new_state = dict(state)
+        loss = 0.0 if labels is not None else None
         for name in self._order:
             v = self._defs[name]
             xs = [acts[i] for i in v.inputs]
@@ -504,9 +508,46 @@ class ComputationGraph:
                 rng, sub = jax.random.split(rng)
             else:
                 sub = None
-            acts[name], new_state[name] = v.vertex.apply(
-                params[name], state[name], xs, train=train, rng=sub, mask=mask)
+            layer = v.vertex.layer if isinstance(v.vertex, LayerVertex) else None
+            if (labels is not None and name in self.conf.outputs
+                    and layer is not None
+                    and hasattr(layer, "loss_from_features")):
+                x = xs[0]
+                if (layer.input_family is _inputs.FeedForwardType
+                        and x.ndim > 2):
+                    x = x.reshape((x.shape[0], -1))
+                lm = (label_masks or {}).get(name)
+                l_i, preds, st = layer.loss_from_features(
+                    params[name], state[name], x, labels[name], lm,
+                    train=train)
+                loss = loss + l_i
+                acts[name], new_state[name] = preds, st
+            else:
+                acts[name], new_state[name] = v.vertex.apply(
+                    params[name], state[name], xs, train=train, rng=sub,
+                    mask=mask)
+                if labels is not None and name in self.conf.outputs:
+                    l_layer = layer if layer is not None else v.vertex
+                    if not hasattr(l_layer, "compute_loss"):
+                        raise ValueError(f"Output vertex {name!r} has no loss")
+                    lm = (label_masks or {}).get(name)
+                    loss = loss + l_layer.compute_loss(acts[name],
+                                                       labels[name], lm)
+        return acts, new_state, loss
+
+    def apply_fn(self, params, state, inputs, *, train=False, rng=None, mask=None):
+        """inputs: dict name->array (or single array if one input).
+        Returns (dict of output activations, new_state)."""
+        acts, new_state, _ = self._forward_pass(params, state, inputs,
+                                                train=train, rng=rng, mask=mask)
         return {o: acts[o] for o in self.conf.outputs}, new_state
+
+    def feed_forward(self, inputs, *, train=False, mask=None):
+        """Activations of EVERY vertex, name->array (reference:
+        ComputationGraph.feedForward:1384 returns the full activation map)."""
+        acts, _, _ = self._forward_pass(self.params, self.state, inputs,
+                                        train=train, mask=mask)
+        return acts
 
     def loss_fn(self, params, state, inputs, labels, *, train=True, rng=None,
                 mask=None, label_masks=None):
@@ -514,20 +555,14 @@ class ComputationGraph:
         computeGradientAndScore:1302)."""
         if not isinstance(labels, dict):
             labels = {self.conf.outputs[0]: labels}
-        outs, new_state = self.apply_fn(params, state, inputs, train=train,
-                                        rng=rng, mask=mask)
-        loss = 0.0
-        for name in self.conf.outputs:
-            v = self._defs[name].vertex
-            layer = v.layer if isinstance(v, LayerVertex) else v
-            if not hasattr(layer, "compute_loss"):
-                raise ValueError(f"Output vertex {name!r} has no loss")
-            lm = (label_masks or {}).get(name)
-            loss = loss + layer.compute_loss(outs[name], labels[name], lm)
+        acts, new_state, loss = self._forward_pass(
+            params, state, inputs, train=train, rng=rng, mask=mask,
+            labels=labels, label_masks=label_masks)
         for name in self._order:
             v = self._defs[name]
             if params[name]:
                 loss = loss + v.vertex.regularization_penalty(params[name])
+        outs = {o: acts[o] for o in self.conf.outputs}
         return loss, (new_state, outs)
 
     def make_train_step(self, donate=True, jit=True):

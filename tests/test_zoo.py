@@ -98,3 +98,107 @@ class TestTinyTraining:
         labels[:, 0, 0, 5] = 1
         net.fit(x, labels, epochs=1)
         assert np.isfinite(net.score(x, labels))
+
+
+class TestInceptionFamily:
+    def test_googlenet_builds_and_forwards(self):
+        from deeplearning4j_tpu.models import googlenet
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        net = ComputationGraph(googlenet(height=64, width=64, n_classes=7))
+        net.init()
+        out = net.output(np.random.rand(2, 64, 64, 3).astype(np.float32))
+        assert np.asarray(out).shape == (2, 7)
+        np.testing.assert_allclose(np.asarray(out).sum(1), 1.0, atol=1e-4)
+        # 9 inception modules present (reference table 3a..5b)
+        names = [v.name for v in net.conf.vertices]
+        for blk in ("3a", "3b", "4a", "4b", "4c", "4d", "4e", "5a", "5b"):
+            assert f"{blk}-depthconcat" in names
+
+    def test_inception_resnet_v1_embedding_head(self):
+        from deeplearning4j_tpu.models import inception_resnet_v1
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = inception_resnet_v1(height=96, width=96, n_classes=5,
+                                   blocks_a=1, blocks_b=1, blocks_c=1)
+        net = ComputationGraph(conf)
+        net.init()
+        x = np.random.rand(2, 96, 96, 3).astype(np.float32)
+        emb = net.feed_forward(x)["embeddings"]
+        # embeddings live on the unit hypersphere (L2NormalizeVertex)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(emb), axis=1), 1.0, atol=1e-4)
+        assert np.asarray(emb).shape == (2, 128)
+
+    def test_facenet_trains_a_step(self):
+        from deeplearning4j_tpu.models import facenet_nn4_small2
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = facenet_nn4_small2(height=32, width=32, n_classes=4)
+        net = ComputationGraph(conf)
+        net.init()
+        x = np.random.rand(4, 32, 32, 3).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)
+        net.fit(x, y, epochs=1, batch_size=4)
+        loss, _ = net.loss_fn(net.params, net.state, x, y, train=False)
+        assert np.isfinite(float(loss))
+
+
+class TestZooRegistry:
+    def test_registry_covers_reference_catalog(self):
+        from deeplearning4j_tpu.models import model_names
+        # reference zoo/model/ listing (SURVEY.md §2.6)
+        for name in ("lenet", "resnet50", "vgg16", "vgg19", "alexnet",
+                     "darknet19", "tinyyolo", "textgenlstm", "simplecnn",
+                     "googlenet", "inceptionresnetv1", "facenetnn4small2"):
+            assert name in model_names()
+
+    def test_build_fresh(self):
+        from deeplearning4j_tpu.models import get_model
+        net = get_model("lenet").build()
+        out = net.output(np.zeros((1, 28, 28, 1), np.float32))
+        assert np.asarray(out).shape == (1, 10)
+
+    def test_init_pretrained_roundtrip(self, tmp_path, monkeypatch):
+        # author a local pretrained artifact, register it, load via the
+        # cache+checksum path (ZooModel.java:40-52 semantics)
+        import hashlib
+        from deeplearning4j_tpu.models import (PretrainedType, get_model,
+                                               register_model)
+        from deeplearning4j_tpu.models.lenet import lenet
+        from deeplearning4j_tpu.utils.serialization import save_model
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+        net = MultiLayerNetwork(lenet())
+        net.init()
+        zoo_dir = tmp_path / "zoo"
+        zoo_dir.mkdir()
+        art = zoo_dir / "lenet_test_mnist.zip"
+        save_model(net, str(art))
+        md5 = hashlib.md5(art.read_bytes()).hexdigest()
+        register_model("lenet_test", lenet, graph=False,
+                       pretrained={PretrainedType.MNIST: (None, md5)})
+        restored = get_model("lenet_test").init_pretrained(PretrainedType.MNIST)
+        a = np.random.rand(2, 28, 28, 1).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(restored.output(a)),
+                                   np.asarray(net.output(a)), atol=1e-6)
+
+    def test_init_pretrained_checksum_mismatch_deletes(self, tmp_path,
+                                                       monkeypatch):
+        from deeplearning4j_tpu.datasets import ChecksumError
+        from deeplearning4j_tpu.models import (PretrainedType, get_model,
+                                               register_model)
+        from deeplearning4j_tpu.models.lenet import lenet
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+        zoo_dir = tmp_path / "zoo"
+        zoo_dir.mkdir()
+        art = zoo_dir / "lenet_bad_mnist.zip"
+        art.write_bytes(b"not a checkpoint")
+        register_model("lenet_bad", lenet, graph=False,
+                       pretrained={PretrainedType.MNIST: (None, "0" * 32)})
+        with pytest.raises(ChecksumError):
+            get_model("lenet_bad").init_pretrained(PretrainedType.MNIST)
+        assert not art.exists()  # ZooModel.java:77-83: delete on mismatch
+
+    def test_missing_pretrained_type_raises(self):
+        from deeplearning4j_tpu.models import get_model
+        with pytest.raises(ValueError, match="no pretrained"):
+            get_model("resnet50").init_pretrained()
