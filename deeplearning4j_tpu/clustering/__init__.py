@@ -1,4 +1,4 @@
 from deeplearning4j_tpu.clustering.vptree import VPTree  # noqa: F401
 from deeplearning4j_tpu.clustering.kdtree import KDTree  # noqa: F401
 from deeplearning4j_tpu.clustering.kmeans import KMeans  # noqa: F401
-from deeplearning4j_tpu.clustering.tsne import TSNE  # noqa: F401
+from deeplearning4j_tpu.clustering.tsne import TSNE, BarnesHutTsne  # noqa: F401

@@ -1,3 +1,5 @@
 from deeplearning4j_tpu.graphlib.graph import Graph  # noqa: F401
-from deeplearning4j_tpu.graphlib.walks import RandomWalkIterator, WeightedWalkIterator  # noqa: F401
-from deeplearning4j_tpu.graphlib.deepwalk import DeepWalk  # noqa: F401
+from deeplearning4j_tpu.graphlib.walks import (  # noqa: F401
+    Node2VecWalkIterator, RandomWalkIterator, WeightedWalkIterator,
+)
+from deeplearning4j_tpu.graphlib.deepwalk import DeepWalk, Node2Vec  # noqa: F401

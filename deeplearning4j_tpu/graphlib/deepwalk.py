@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from deeplearning4j_tpu.graphlib.walks import RandomWalkIterator
+from deeplearning4j_tpu.graphlib.walks import (Node2VecWalkIterator,
+                                                RandomWalkIterator)
 from deeplearning4j_tpu.text.word2vec import SequenceVectors
 
 
@@ -29,12 +30,16 @@ class DeepWalk:
         self.seed = seed
         self.vectors = None
 
-    def fit(self, graph):
+    def _walks(self, graph):
         walks = []
         for rep in range(self.walks_per_vertex):
             it = RandomWalkIterator(graph, self.walk_length, seed=self.seed + rep)
             for walk in it:
                 walks.append([str(v) for v in walk])
+        return walks
+
+    def fit(self, graph):
+        walks = self._walks(graph)
         self._sv = SequenceVectors(
             vector_size=self.vector_size, window=self.window, min_count=1,
             negative=0 if self.use_hs else self.negative,
@@ -55,3 +60,23 @@ class DeepWalk:
         va, vb = self.vectors[a], self.vectors[b]
         return float(np.dot(va, vb) /
                      (np.linalg.norm(va) * np.linalg.norm(vb) + 1e-12))
+
+
+class Node2Vec(DeepWalk):
+    """node2vec graph embeddings (reference: models/node2vec/Node2Vec.java —
+    SequenceVectors over biased p/q walks). p controls return likelihood,
+    q interpolates BFS (<1: outward/DFS-like) vs local (>1) exploration."""
+
+    def __init__(self, *, p=1.0, q=1.0, **kw):
+        super().__init__(**kw)
+        self.p = float(p)
+        self.q = float(q)
+
+    def _walks(self, graph):
+        walks = []
+        for rep in range(self.walks_per_vertex):
+            it = Node2VecWalkIterator(graph, self.walk_length, p=self.p,
+                                      q=self.q, seed=self.seed + rep)
+            for walk in it:
+                walks.append([str(v) for v in walk])
+        return walks
