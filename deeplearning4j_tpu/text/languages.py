@@ -1,0 +1,143 @@
+"""Language-pack tokenizer factories: Chinese, Japanese, Korean.
+
+Reference analog: the deeplearning4j-nlp-{chinese,japanese,korean} modules
+(SURVEY.md §2.6) — ChineseTokenizerFactory (ansj segmenter),
+JapaneseTokenizerFactory (kuromoji morphological analyzer),
+KoreanTokenizerFactory (twitter-korean-text). Those wrap ~20k LoC of
+third-party segmenter code; here the factories implement the same
+``create(text) -> Tokenizer`` SPI with self-contained segmentation:
+
+* dictionary-driven maximum-matching when a user lexicon is supplied (the
+  standard CJK segmentation baseline the heavyweight libraries refine), and
+* script-aware fallback otherwise: CJK-ideograph runs split per character
+  (each Han character is a token — the n-gram-friendly default), kana runs
+  kept whole per script, Hangul/latin/digit runs kept whole.
+
+The factories plug into everything SequenceVectors-based (Word2Vec,
+ParagraphVectors, TF-IDF) exactly like the reference's language packs plug
+into SequenceVectors' TokenizerFactory slot.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+
+from deeplearning4j_tpu.text.tokenization import Tokenizer
+
+
+def _char_class(ch):
+    o = ord(ch)
+    if 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF or 0xF900 <= o <= 0xFAFF:
+        return "han"
+    if 0x3040 <= o <= 0x309F:
+        return "hiragana"
+    if 0x30A0 <= o <= 0x30FF or 0x31F0 <= o <= 0x31FF:
+        return "katakana"
+    if 0xAC00 <= o <= 0xD7AF or 0x1100 <= o <= 0x11FF or 0x3130 <= o <= 0x318F:
+        return "hangul"
+    if ch.isspace():
+        return "space"
+    if ch.isalnum():
+        return "word"
+    return "punct"
+
+
+def _script_runs(text):
+    runs = []
+    cur, cls = "", None
+    for ch in text:
+        c = _char_class(ch)
+        if c == cls:
+            cur += ch
+        else:
+            if cur:
+                runs.append((cur, cls))
+            cur, cls = ch, c
+    if cur:
+        runs.append((cur, cls))
+    return runs
+
+
+class _CjkTokenizerFactoryBase:
+    """Shared CJK factory: optional lexicon maximum-matching + script runs."""
+
+    #: scripts whose runs are split per-character without a lexicon
+    per_char_scripts = ("han",)
+    #: scripts dropped from output
+    drop = ("space", "punct")
+
+    def __init__(self, lexicon=None, preprocessor=None, max_word_len=8):
+        self.lexicon = set(lexicon) if lexicon else None
+        self.preprocessor = preprocessor
+        self.max_word_len = max_word_len
+
+    def _segment_run(self, run, cls):
+        if cls not in self.per_char_scripts:
+            return [run]
+        if self.lexicon:
+            return self._max_match(run)
+        return list(run)
+
+    def _max_match(self, run):
+        """Greedy forward maximum matching against the lexicon; unmatched
+        characters become single-char tokens (the classical CJK baseline)."""
+        out, i, n = [], 0, len(run)
+        while i < n:
+            for ln in range(min(self.max_word_len, n - i), 1, -1):
+                if run[i:i + ln] in self.lexicon:
+                    out.append(run[i:i + ln])
+                    i += ln
+                    break
+            else:
+                out.append(run[i])
+                i += 1
+        return out
+
+    def create(self, text: str) -> Tokenizer:
+        tokens = []
+        for run, cls in _script_runs(unicodedata.normalize("NFKC", text)):
+            if cls in self.drop:
+                continue
+            tokens.extend(self._segment_run(run, cls))
+        if self.preprocessor is not None:
+            tokens = [self.preprocessor.pre_process(t) for t in tokens]
+            tokens = [t for t in tokens if t]
+        return Tokenizer(tokens)
+
+
+class ChineseTokenizerFactory(_CjkTokenizerFactoryBase):
+    """Reference: deeplearning4j-nlp-chinese ChineseTokenizerFactory (ansj).
+    Han runs are lexicon-max-matched (or per-character without a lexicon)."""
+
+    per_char_scripts = ("han",)
+
+
+class JapaneseTokenizerFactory(_CjkTokenizerFactoryBase):
+    """Reference: deeplearning4j-nlp-japanese JapaneseTokenizerFactory
+    (kuromoji). Kanji runs segment like Chinese; kana runs are kept whole per
+    script (a coarse but useful morpheme proxy), and a lexicon (e.g. a
+    user dictionary of surface forms) refines all three scripts."""
+
+    per_char_scripts = ("han", "hiragana", "katakana")
+
+    def _segment_run(self, run, cls):
+        if cls not in self.per_char_scripts:
+            return [run]  # latin/digit/hangul runs stay whole
+        if self.lexicon:
+            return self._max_match(run)
+        if cls == "han":
+            return list(run)
+        return [run]  # whole kana run
+
+
+class KoreanTokenizerFactory(_CjkTokenizerFactoryBase):
+    """Reference: deeplearning4j-nlp-korean KoreanTokenizerFactory
+    (twitter-korean-text). Hangul runs are whitespace-delimited eojeol;
+    a lexicon max-matches morphemes inside each run."""
+
+    per_char_scripts = ("hangul",)
+
+    def _segment_run(self, run, cls):
+        if cls == "hangul" and self.lexicon:
+            return self._max_match(run)
+        return [run]

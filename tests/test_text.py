@@ -169,3 +169,40 @@ class TestVectorizers:
         mat = tv.fit_transform(self.DOCS)
         the, cars = tv.vocab.index_of("the"), tv.vocab.index_of("cars")
         assert tv.idf[the] < tv.idf[cars]
+
+
+class TestLanguagePacks:
+    def test_chinese_per_char_and_lexicon(self):
+        from deeplearning4j_tpu.text.languages import ChineseTokenizerFactory
+        text = "我爱北京天安门"  # 我爱北京天安门
+        plain = ChineseTokenizerFactory().create(text).get_tokens()
+        assert plain == list(text)  # per-character without lexicon
+        lex = ChineseTokenizerFactory(
+            lexicon=["北京", "天安门"])
+        toks = lex.create(text).get_tokens()
+        assert toks == ["我", "爱", "北京",
+                        "天安门"]
+
+    def test_japanese_scripts(self):
+        from deeplearning4j_tpu.text.languages import JapaneseTokenizerFactory
+        # kanji run + hiragana run + katakana run
+        text = "東京にいるトヨタ"
+        toks = JapaneseTokenizerFactory().create(text).get_tokens()
+        assert "トヨタ" in toks       # katakana run whole
+        assert "東" in toks and "京" in toks  # kanji per-char
+
+    def test_korean_eojeol_and_mixed(self):
+        from deeplearning4j_tpu.text.languages import KoreanTokenizerFactory
+        text = "한국어 토큰 test 123"
+        toks = KoreanTokenizerFactory().create(text).get_tokens()
+        assert "한국어" in toks and "토큰" in toks
+        assert "test" in toks and "123" in toks
+
+    def test_plugs_into_word2vec(self):
+        from deeplearning4j_tpu.text.languages import ChineseTokenizerFactory
+        from deeplearning4j_tpu.text.word2vec import Word2Vec
+        docs = ["北京 是 中国 首都"] * 20
+        w2v = Word2Vec(vector_size=8, min_count=1, epochs=1, seed=1,
+                       tokenizer_factory=ChineseTokenizerFactory())
+        w2v.fit(docs)
+        assert w2v.has_word("京")
