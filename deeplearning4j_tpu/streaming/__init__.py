@@ -1,0 +1,7 @@
+from deeplearning4j_tpu.streaming.codec import (  # noqa: F401
+    decode_dataset, decode_ndarray, encode_dataset, encode_ndarray,
+)
+from deeplearning4j_tpu.streaming.pubsub import (  # noqa: F401
+    NDArrayPublisher, NDArraySubscriber, StreamingBroker,
+    StreamingDataSetIterator,
+)

@@ -1,0 +1,233 @@
+"""NDArray pub/sub over TCP + a streaming training iterator.
+
+Reference analog: dl4j-streaming (SURVEY.md §2.5) — Kafka publish/subscribe
+of NDArrays (NDArrayKafkaClient.java), Camel routes feeding Spark-streaming
+training. The TPU-native shape: a dependency-free length-prefixed TCP broker
+(Kafka itself is infrastructure, not framework; when a real Kafka is present
+the same codec bytes go on a topic), and a ``StreamingDataSetIterator`` that
+adapts a subscription into the ordinary iterator contract so ``fit`` can
+consume an unbounded stream with bounded buffering — the role of the
+reference's Camel->Spark-streaming route.
+
+Framing: 4-byte LE length | payload (streaming/codec.py bytes). A topic is
+selected once per connection: subscriber sends ``SUB <topic>\n``, publisher
+sends ``PUB <topic>\n``; the broker fans every publish out to all matching
+subscribers (drop-oldest per-subscriber bounded queues — slow consumers
+never stall the pipeline, matching Kafka's retention semantics rather than
+backpressure).
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from deeplearning4j_tpu.streaming import codec
+
+
+def _send_frame(sock, payload: bytes):
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock):
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (n,) = struct.unpack("<I", head)
+    return _recv_exact(sock, n)
+
+
+class StreamingBroker:
+    """In-process topic broker (the Kafka stand-in)."""
+
+    def __init__(self, host="127.0.0.1", port=0, subscriber_buffer=1024):
+        self.host = host
+        self._srv = socket.create_server((host, port))
+        self.port = self._srv.getsockname()[1]
+        self.subscriber_buffer = subscriber_buffer
+        self._subs = collections.defaultdict(list)  # topic -> [socket]
+        self._send_locks = {}  # socket -> Lock (frame-atomic writes)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = []
+
+    def start(self):
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn):
+        keep_open = False
+        try:
+            line = b""
+            while not line.endswith(b"\n"):
+                ch = conn.recv(1)
+                if not ch:
+                    return
+                line += ch
+            mode, topic = line.decode().strip().split(" ", 1)
+            if mode == "SUB":
+                with self._lock:
+                    self._subs[topic].append(conn)
+                    self._send_locks[conn] = threading.Lock()
+                keep_open = True  # broker pushes to it; ownership transferred
+                return
+            while True:
+                payload = _recv_frame(conn)
+                if payload is None:
+                    return
+                self._fanout(topic, payload)
+        except OSError:
+            pass
+        finally:
+            if not keep_open:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _fanout(self, topic, payload):
+        with self._lock:
+            subs = [(s, self._send_locks[s]) for s in self._subs[topic]]
+        dead = []
+        for s, lock in subs:
+            try:
+                # frame-atomic: concurrent publishers to one subscriber must
+                # not interleave bytes inside a length-prefixed frame
+                with lock:
+                    _send_frame(s, payload)
+            except OSError:
+                dead.append(s)
+        if dead:
+            with self._lock:
+                for s in dead:
+                    if s in self._subs[topic]:
+                        self._subs[topic].remove(s)
+                    self._send_locks.pop(s, None)
+
+    def close(self):
+        self._stop.set()
+        self._srv.close()
+        with self._lock:
+            for subs in self._subs.values():
+                for s in subs:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            self._subs.clear()
+
+
+class NDArrayPublisher:
+    """Publish arrays/datasets to a topic (NDArrayKafkaClient publish role)."""
+
+    def __init__(self, topic, host="127.0.0.1", port=None):
+        self.sock = socket.create_connection((host, port))
+        self.sock.sendall(f"PUB {topic}\n".encode())
+
+    def publish(self, array):
+        _send_frame(self.sock, codec.encode_ndarray(array))
+
+    def publish_dataset(self, features, labels):
+        _send_frame(self.sock, codec.encode_dataset(features, labels))
+
+    def close(self):
+        self.sock.close()
+
+
+class NDArraySubscriber:
+    """Subscribe to a topic; received payloads land in a bounded queue
+    (drop-oldest on overflow)."""
+
+    def __init__(self, topic, host="127.0.0.1", port=None, buffer=1024):
+        self.sock = socket.create_connection((host, port))
+        self.sock.sendall(f"SUB {topic}\n".encode())
+        self.queue = queue.Queue(maxsize=buffer)
+        self._closed = threading.Event()
+        self._t = threading.Thread(target=self._pump, daemon=True)
+        self._t.start()
+
+    def _pump(self):
+        while not self._closed.is_set():
+            try:
+                payload = _recv_frame(self.sock)
+            except OSError:
+                payload = None
+            if payload is None:
+                self._closed.set()
+                return
+            while True:
+                try:
+                    self.queue.put_nowait(payload)
+                    break
+                except queue.Full:
+                    try:
+                        self.queue.get_nowait()  # drop oldest
+                    except queue.Empty:
+                        pass
+
+    def receive(self, timeout=None):
+        """Next payload decoded (ndarray or (features, labels))."""
+        payload = self.queue.get(timeout=timeout)
+        kind, _, _ = codec._unpack(payload)
+        if kind == codec._KIND_DATASET:
+            return codec.decode_dataset(payload)
+        return codec.decode_ndarray(payload)
+
+    def close(self):
+        self._closed.set()
+        self.sock.close()
+
+
+class StreamingDataSetIterator:
+    """Adapt a subscriber into the DataSetIterator contract: pulls
+    (features, labels) payloads until ``num_batches`` arrive (or the stream
+    closes), so ``net.fit`` can train from a live stream (the reference's
+    Camel route -> Spark streaming -> fit pipeline, dl4j-streaming)."""
+
+    def __init__(self, subscriber: NDArraySubscriber, num_batches=None,
+                 timeout=30.0):
+        self.sub = subscriber
+        self.num_batches = num_batches
+        self.timeout = timeout
+
+    def __iter__(self):
+        seen = 0
+        while self.num_batches is None or seen < self.num_batches:
+            try:
+                item = self.sub.receive(timeout=self.timeout)
+            except queue.Empty:
+                return
+            if not isinstance(item, tuple):
+                raise ValueError("Stream carries bare ndarrays, not datasets")
+            yield np.asarray(item[0]), np.asarray(item[1])
+            seen += 1
+
+    def reset(self):
+        pass

@@ -1,0 +1,108 @@
+"""Streaming tier tests (reference: dl4j-streaming Kafka NDArray pub/sub)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.streaming import (NDArrayPublisher, NDArraySubscriber,
+                                          StreamingBroker,
+                                          StreamingDataSetIterator,
+                                          decode_dataset, decode_ndarray,
+                                          encode_dataset, encode_ndarray)
+
+
+class TestCodec:
+    def test_ndarray_roundtrip(self):
+        for dt in (np.float32, np.float64, np.int32, np.uint8):
+            a = (np.random.RandomState(0).rand(3, 4, 5) * 100).astype(dt)
+            b = decode_ndarray(encode_ndarray(a))
+            assert b.dtype == a.dtype
+            np.testing.assert_array_equal(a, b)
+
+    def test_dataset_roundtrip(self):
+        f = np.random.RandomState(1).rand(8, 28, 28, 1).astype(np.float32)
+        l = np.eye(10, dtype=np.float32)[np.arange(8)]
+        f2, l2 = decode_dataset(encode_dataset(f, l))
+        np.testing.assert_array_equal(f, f2)
+        np.testing.assert_array_equal(l, l2)
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(ValueError, match="magic"):
+            decode_ndarray(b"JUNKxxxx")
+
+
+class TestPubSub:
+    def test_publish_subscribe_roundtrip(self):
+        broker = StreamingBroker().start()
+        try:
+            sub = NDArraySubscriber("t1", port=broker.port)
+            time.sleep(0.05)  # let SUB register
+            pub = NDArrayPublisher("t1", port=broker.port)
+            a = np.arange(12, dtype=np.float32).reshape(3, 4)
+            pub.publish(a)
+            got = sub.receive(timeout=5)
+            np.testing.assert_array_equal(got, a)
+            pub.close()
+            sub.close()
+        finally:
+            broker.close()
+
+    def test_topic_isolation(self):
+        broker = StreamingBroker().start()
+        try:
+            sub_a = NDArraySubscriber("a", port=broker.port)
+            sub_b = NDArraySubscriber("b", port=broker.port)
+            time.sleep(0.05)
+            pub = NDArrayPublisher("a", port=broker.port)
+            pub.publish(np.ones(3, np.float32))
+            np.testing.assert_array_equal(sub_a.receive(timeout=5),
+                                          np.ones(3, np.float32))
+            import queue as q
+            with pytest.raises(q.Empty):
+                sub_b.queue.get(timeout=0.2)
+            pub.close(); sub_a.close(); sub_b.close()
+        finally:
+            broker.close()
+
+    def test_streaming_training(self):
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+        from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        broker = StreamingBroker().start()
+        try:
+            sub = NDArraySubscriber("train", port=broker.port)
+            time.sleep(0.05)
+
+            def produce():
+                pub = NDArrayPublisher("train", port=broker.port)
+                rs = np.random.RandomState(0)
+                for _ in range(6):
+                    x = rs.rand(16, 4).astype(np.float32)
+                    y = np.eye(2, dtype=np.float32)[
+                        (x.sum(1) > 2).astype(int)]
+                    pub.publish_dataset(x, y)
+                pub.close()
+
+            t = threading.Thread(target=produce)
+            t.start()
+
+            conf = NeuralNetConfig(seed=1).list(
+                DenseLayer(n_out=8, activation="tanh"),
+                OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+                input_type=I.feed_forward(4))
+            net = MultiLayerNetwork(conf)
+            net.init()
+            it = StreamingDataSetIterator(sub, num_batches=6, timeout=10)
+            n_seen = 0
+            for x, y in it:
+                net.fit(x, y, epochs=1)
+                n_seen += 1
+            assert n_seen == 6
+            t.join()
+            sub.close()
+        finally:
+            broker.close()
