@@ -309,6 +309,7 @@ class MultiLayerNetwork:
                 x, y = jnp.asarray(x), jnp.asarray(y)
                 m = jnp.asarray(m) if m is not None else None
                 etl_time = time.perf_counter() - etl_start
+                self.last_input = x  # for activation-visualizing listeners
                 if (self.conf.backprop_type == "tbptt" and x.ndim == 3
                         and y.ndim == 3 and x.shape[1] > self.conf.tbptt_fwd_length):
                     loss = self._fit_tbptt(x, y, m)

@@ -2,3 +2,6 @@ from deeplearning4j_tpu.ui.stats import StatsListener  # noqa: F401
 from deeplearning4j_tpu.ui.storage import (FileStatsStorage, InMemoryStatsStorage,  # noqa: F401
                                            RemoteStatsStorageRouter)
 from deeplearning4j_tpu.ui.server import UIServer  # noqa: F401
+from deeplearning4j_tpu.ui.visualization import (  # noqa: F401
+    ConvolutionalIterationListener, activations_to_grid,
+)

@@ -89,3 +89,42 @@ class TestUIServer:
             assert "remote-s" in sessions
         finally:
             server.stop()
+
+
+class TestConvVisualization:
+    def test_grid_layout(self):
+        from deeplearning4j_tpu.ui.visualization import activations_to_grid
+        act = np.random.RandomState(0).rand(6, 6, 9).astype(np.float32)
+        grid = activations_to_grid(act)
+        # 9 channels -> 3x3 tiles of 6px + 1px separators
+        assert grid.shape == (3 * 7 - 1, 3 * 7 - 1)
+        assert grid.dtype == np.uint8
+        # each tile min-max normalized to full range
+        assert grid[:6, :6].max() == 255
+
+    def test_listener_renders_conv_layers(self, tmp_path):
+        import os
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.ui.visualization import (
+            ConvolutionalIterationListener)
+
+        conf = NeuralNetConfig(seed=1).list(
+            L.ConvolutionLayer(n_out=4, kernel=(3, 3), padding="same"),
+            L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)),
+            L.OutputLayer(n_out=3, activation="softmax", loss="mcxent"),
+            input_type=I.convolutional(8, 8, 1))
+        net = MultiLayerNetwork(conf)
+        net.init()
+        lst = ConvolutionalIterationListener(frequency=1,
+                                             output_dir=str(tmp_path))
+        net.listeners.append(lst)
+        x = np.random.rand(4, 8, 8, 1).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[np.random.randint(0, 3, 4)]
+        net.fit(x, y, epochs=1)
+        # conv + pool layers captured
+        assert len(lst.history) >= 2
+        pngs = [f for f in os.listdir(str(tmp_path)) if f.endswith(".png")]
+        assert len(pngs) >= 2
