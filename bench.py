@@ -1,67 +1,218 @@
-"""Benchmark: LeNet-MNIST training throughput on one TPU chip.
+"""Benchmarks for the BASELINE.md config matrix.
 
-BASELINE.md config #1 (LeNet MNIST MultiLayerNetwork). The reference publishes
-no in-repo numbers (BASELINE.json published:{}); ``vs_baseline`` is therefore
-measured against REFERENCE_CPU_SAMPLES_PER_SEC, a recorded order-of-magnitude
-estimate for DL4J 0.9 LeNet minibatch training on nd4j-native CPU — to be
-replaced by a real measured reference number when one exists.
+Default (driver-run): config #1, LeNet-MNIST training throughput on one
+chip. Other configs via ``python bench.py <config>`` or ``BENCH_CONFIG``:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+  lenet     LeNet MNIST MLN train samples/sec          (BASELINE.md #1)
+  resnet50  ResNet50 CG train samples/sec + MFU        (BASELINE.md #2)
+  word2vec  SkipGram-negative-sampling words/sec       (BASELINE.md #3)
+  lstm      GravesLSTM char-RNN train tokens/sec       (BASELINE.md #4)
+  parallel  data-parallel LeNet scaling over all chips (BASELINE.md #5)
+
+The reference publishes no in-repo numbers (BASELINE.json published:{});
+``vs_baseline`` compares against recorded order-of-magnitude estimates for
+DL4J 0.9 on nd4j-native CPU (documented per config below) until measured
+reference numbers exist.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
-REFERENCE_CPU_SAMPLES_PER_SEC = 500.0  # documented estimate, see module docstring
+# order-of-magnitude DL4J 0.9 CPU estimates (see module docstring)
+BASELINES = {
+    "lenet": 500.0,       # samples/sec, LeNet minibatch train
+    "resnet50": 2.0,      # samples/sec, ResNet50 batch train on CPU
+    "word2vec": 300e3,    # words/sec, AggregateSkipGram multithreaded
+    "lstm": 20e3,         # tokens/sec, GravesLSTM char-RNN
+    "parallel": 500.0,    # per-chip LeNet baseline (scaling config)
+}
 
-BATCH = 256
-WARMUP = 3
-ITERS = 20
+
+def _timed(step, args, warmup, iters):
+    import jax
+    out = None
+    for _ in range(warmup):
+        out = step(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
 
 
-def main():
+def bench_lenet(batch=256, warmup=3, iters=20):
     import jax
     import jax.numpy as jnp
-
     from deeplearning4j_tpu.models import lenet
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from deeplearning4j_tpu.utils import dtypes
 
     dtypes.bf16_policy()  # bf16 compute on the MXU, f32 params/accum
-
     net = MultiLayerNetwork(lenet())
     net.init()
     step = net.make_train_step(donate=False)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(batch, 28, 28, 1).astype(np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rs.randint(0, 10, batch)])
+    rng = jax.random.PRNGKey(0)
+    p, s, o = net.params, net.state, net.opt_state
+
+    def run(p, s, o):
+        p2, s2, o2, loss = step(p, s, o, x, y, 0, rng, None)
+        return loss
+
+    dt = _timed(run, (p, s, o), warmup, iters)
+    sps = batch / dt
+    return {"metric": "lenet_mnist_train_samples_per_sec",
+            "value": round(sps, 1), "unit": "samples/sec/chip",
+            "vs_baseline": round(sps / BASELINES["lenet"], 2),
+            "step_time_ms": round(1e3 * dt, 2), "batch": batch}
+
+
+def bench_resnet50(batch=64, hw=224, warmup=2, iters=10):
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import resnet50
+    from deeplearning4j_tpu.models.resnet import resnet50_flops_per_example
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.utils import dtypes
+
+    dtypes.bf16_policy()
+    net = ComputationGraph(resnet50(height=hw, width=hw, n_classes=1000))
+    net.init()
+    step = net.make_train_step(donate=False)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(batch, hw, hw, 3).astype(np.float32))
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[rs.randint(0, 1000, batch)])
+    rng = jax.random.PRNGKey(0)
+    p, s, o = net.params, net.state, net.opt_state
+
+    def run(p, s, o):
+        p2, s2, o2, loss = step(p, s, o, x, y, 0, rng, None)
+        return loss
+
+    dt = _timed(run, (p, s, o), warmup, iters)
+    sps = batch / dt
+    # train step ~ 3x fwd FLOPs; v5e peak 197 TFLOP/s bf16
+    flops = 3.0 * resnet50_flops_per_example(hw, hw) * batch
+    peak = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
+    mfu = flops / dt / peak
+    return {"metric": "resnet50_train_samples_per_sec",
+            "value": round(sps, 2), "unit": "samples/sec/chip",
+            "vs_baseline": round(sps / BASELINES["resnet50"], 2),
+            "step_time_ms": round(1e3 * dt, 2), "batch": batch,
+            "mfu": round(mfu, 4)}
+
+
+def bench_lstm(batch=64, seq=128, hidden=512, vocab=96, warmup=2, iters=10):
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import text_generation_lstm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.utils import dtypes
+
+    dtypes.bf16_policy()
+    conf = text_generation_lstm(vocab, hidden=hidden, seq_len=seq)
+    net = MultiLayerNetwork(conf)
+    net.init()
+    step = net.make_train_step(donate=False)
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (batch, seq))
+    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[
+        np.roll(ids, -1, axis=1)])
+    rng = jax.random.PRNGKey(0)
+    p, s, o = net.params, net.state, net.opt_state
+
+    def run(p, s, o):
+        p2, s2, o2, loss = step(p, s, o, x, y, 0, rng, None)
+        return loss
+
+    dt = _timed(run, (p, s, o), warmup, iters)
+    tps = batch * seq / dt
+    return {"metric": "graveslstm_charnn_train_tokens_per_sec",
+            "value": round(tps, 1), "unit": "tokens/sec/chip",
+            "vs_baseline": round(tps / BASELINES["lstm"], 2),
+            "step_time_ms": round(1e3 * dt, 2), "batch": batch, "seq": seq,
+            "hidden": hidden}
+
+
+def bench_word2vec(n_sentences=2000, sent_len=20, vocab=5000):
+    from deeplearning4j_tpu.text.word2vec import Word2Vec
 
     rs = np.random.RandomState(0)
-    x = jnp.asarray(rs.rand(BATCH, 28, 28, 1).astype(np.float32))
-    y = jnp.asarray(np.eye(10, dtype=np.float32)[rs.randint(0, 10, BATCH)])
-    rng = jax.random.PRNGKey(0)
-
-    params, state, opt = net.params, net.state, net.opt_state
-    for i in range(WARMUP):
-        params, state, opt, loss = step(params, state, opt, x, y, i, rng, None)
-    jax.block_until_ready(loss)
-
+    # zipfian corpus
+    ranks = np.arange(1, vocab + 1)
+    probs = (1.0 / ranks); probs /= probs.sum()
+    sents = [" ".join(f"w{w}" for w in rs.choice(vocab, sent_len, p=probs))
+             for _ in range(n_sentences)]
+    w2v = Word2Vec(vector_size=128, min_count=1, negative=5, epochs=1,
+                   seed=1, batch_size=2048)
     t0 = time.perf_counter()
-    for i in range(ITERS):
-        params, state, opt, loss = step(params, state, opt, x, y, i, rng, None)
-    jax.block_until_ready(loss)
+    w2v.fit(sents)
     dt = time.perf_counter() - t0
+    wps = n_sentences * sent_len / dt
+    return {"metric": "word2vec_sgns_words_per_sec",
+            "value": round(wps, 1), "unit": "words/sec",
+            "vs_baseline": round(wps / BASELINES["word2vec"], 2),
+            "total_s": round(dt, 2), "vocab": vocab}
 
-    samples_per_sec = BATCH * ITERS / dt
-    out = {
-        "metric": "lenet_mnist_train_samples_per_sec",
-        "value": round(samples_per_sec, 1),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(samples_per_sec / REFERENCE_CPU_SAMPLES_PER_SEC, 2),
-        "step_time_ms": round(1e3 * dt / ITERS, 2),
-        "batch": BATCH,
-        "device": str(jax.devices()[0]),
-    }
+
+def bench_parallel(batch_per_chip=256, warmup=2, iters=10):
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import lenet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import MeshSpec, ParallelTrainer, make_mesh
+
+    n = len(jax.devices())
+    mesh = make_mesh(MeshSpec(data=n, model=1))
+    net = MultiLayerNetwork(lenet())
+    net.init()
+    trainer = ParallelTrainer(net, mesh)
+    rs = np.random.RandomState(0)
+    b = batch_per_chip * n
+    x = jnp.asarray(rs.rand(b, 28, 28, 1).astype(np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rs.randint(0, 10, b)])
+
+    def run():
+        return trainer.step(x, y)
+
+    for _ in range(warmup):
+        out = run()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    sps = b / dt
+    per_chip = sps / n
+    return {"metric": "parallel_lenet_train_samples_per_sec",
+            "value": round(sps, 1), "unit": f"samples/sec/{n}chips",
+            "vs_baseline": round(per_chip / BASELINES["parallel"], 2),
+            "per_chip": round(per_chip, 1), "n_chips": n,
+            "step_time_ms": round(1e3 * dt, 2)}
+
+
+CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
+           "lstm": bench_lstm, "word2vec": bench_word2vec,
+           "parallel": bench_parallel}
+
+
+def main():
+    import jax
+    name = (sys.argv[1] if len(sys.argv) > 1
+            else os.environ.get("BENCH_CONFIG", "lenet"))
+    out = CONFIGS[name]()
+    out["device"] = str(jax.devices()[0])
     print(json.dumps(out))
 
 

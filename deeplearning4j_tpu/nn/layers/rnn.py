@@ -97,6 +97,26 @@ class LSTM(ParamLayer):
             c = m * c + (1 - m) * c_prev
         return (h, c), h
 
+    def _fused_eligible(self, x, mask):
+        """Fused Pallas sequence kernel applies? (TPU backend only; the
+        dispatch seam mirroring the reference's reflective cuDNN-helper
+        loading at ConvolutionLayer.java:74-84 — here explicit.)"""
+        import os
+        if os.environ.get("DL4J_TPU_FUSED_LSTM", "1") == "0":
+            return False
+        try:
+            from deeplearning4j_tpu.ops import lstm_pallas
+        except ImportError:
+            return False
+        if jax.default_backend() != "tpu":
+            return False
+        return lstm_pallas.supported(
+            x.shape, self.n_out, peephole=self.peephole, mask=mask,
+            gate_activation=self.gate_activation
+            if isinstance(self.gate_activation, str) else None,
+            activation=self.activation
+            if isinstance(self.activation, str) else None)
+
     def apply(self, params, state, x, *, train=False, rng=None, mask=None,
               initial_state=None):
         b, t, _ = x.shape
@@ -111,7 +131,10 @@ class LSTM(ParamLayer):
         else:
             h0, c0 = initial_state
 
-        if mask_tm is None:
+        if mask_tm is None and self._fused_eligible(x, mask):
+            from deeplearning4j_tpu.ops.lstm_pallas import lstm_fused_sequence
+            hs, (hT, cT) = lstm_fused_sequence(xz, params["Wh"], h0, c0)
+        elif mask_tm is None:
             def body(carry, xz_t):
                 return self._step(params, carry, xz_t, None)
             (hT, cT), hs = lax.scan(body, (h0, c0), xz)

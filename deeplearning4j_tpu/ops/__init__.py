@@ -1,0 +1,8 @@
+"""Custom device kernels (Pallas) — the framework's "cuDNN helper" tier.
+
+Reference analog: deeplearning4j-cuda's reflectively-dispatched *Helper
+classes (SURVEY.md §2.2). Here the dispatch seam is explicit: layers consult
+``ops.<kernel>.supported(...)`` and fall back to their pure-XLA path.
+"""
+
+from deeplearning4j_tpu.ops import lstm_pallas  # noqa: F401

@@ -1,0 +1,185 @@
+"""Fused LSTM sequence kernel (Pallas, TPU).
+
+Reference analog: CudnnLSTMHelper
+(/root/reference/deeplearning4j-cuda/src/main/java/org/deeplearning4j/nn/
+layers/recurrent/CudnnLSTMHelper.java, 612 LoC) — the reference's fused-RNN
+fast path over cudnnRNN. SURVEY.md §7 flags LSTM throughput as hard part #1:
+the per-step ``lax.scan`` leaves h/c state and the recurrent weight matrix
+round-tripping HBM every timestep.
+
+Kernel design (TPU-first):
+* The input projections ``x @ Wx + b`` for ALL timesteps are one big MXU
+  matmul done OUTSIDE the kernel (jax), where XLA tiles it best.
+* The kernel runs ``grid=(T,)``; TPU grid steps execute sequentially, so
+  VMEM scratch carries (h, c) across steps — the recurrent weight block
+  [H, 4H] has a constant index_map and therefore stays resident in VMEM for
+  the whole sequence. Per step: one [B,H]x[H,4H] MXU matmul + VPU gate math.
+  HBM traffic per step is just the xz block in and the h block out — the
+  h/c state and Wh never leave the chip.
+* Gate math (sigmoid gates, tanh candidate/output, forget-gate ordering
+  i|f|g|o) matches nn/layers/rnn.py ``LSTM._step`` exactly.
+* Backward: ``jax.custom_vjp`` — the kernel also emits the c-sequence, and
+  the VJP is a reverse-time jax scan over saved (hs, cs, xz), recomputing
+  gate pre-activations (one cheap matmul each step) instead of storing all
+  gates — the standard memory/FLOP trade (same one cudnnRNN makes in
+  CUDNN_RNN_ALGO_STANDARD training mode).
+
+Used by nn/layers/rnn.py when the lowering is beneficial (TPU backend,
+no mask, no peephole, standard activations); everything else stays on the
+reference scan path. ``interpret=True`` lets the same kernel run (slowly) on
+CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU memory-space hints are only available on TPU builds
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+
+def _lstm_seq_kernel(xz_ref, wh_ref, h0_ref, c0_ref,
+                     hs_ref, cs_ref, hT_ref, cT_ref, h_s, c_s):
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[:] = h0_ref[:]
+        c_s[:] = c0_ref[:]
+
+    hsz = h_s.shape[1]
+    z = xz_ref[0] + jnp.dot(h_s[:], wh_ref[:],
+                            preferred_element_type=jnp.float32)
+    zi = z[:, 0 * hsz:1 * hsz]
+    zf = z[:, 1 * hsz:2 * hsz]
+    zg = z[:, 2 * hsz:3 * hsz]
+    zo = z[:, 3 * hsz:4 * hsz]
+    i = jax.nn.sigmoid(zi)
+    f = jax.nn.sigmoid(zf)
+    g = jnp.tanh(zg)
+    o = jax.nn.sigmoid(zo)
+    c = (f * c_s[:] + i * g).astype(c_s.dtype)
+    h = (o * jnp.tanh(c)).astype(h_s.dtype)
+    h_s[:] = h
+    c_s[:] = c
+    hs_ref[0] = h
+    cs_ref[0] = c
+
+    @pl.when(t == nt - 1)
+    def _():
+        hT_ref[:] = h
+        cT_ref[:] = c
+
+
+def _run_kernel(xz, wh, h0, c0, interpret):
+    t, b, four_h = xz.shape
+    hsz = four_h // 4
+    dt = xz.dtype
+    if not _HAS_PLTPU:
+        raise NotImplementedError("Pallas TPU support unavailable")
+    return pl.pallas_call(
+        _lstm_seq_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, four_h), lambda i: (i, 0, 0)),
+            pl.BlockSpec((hsz, four_h), lambda i: (0, 0)),  # resident
+            pl.BlockSpec((b, hsz), lambda i: (0, 0)),
+            pl.BlockSpec((b, hsz), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, hsz), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, b, hsz), lambda i: (i, 0, 0)),
+            pl.BlockSpec((b, hsz), lambda i: (0, 0)),
+            pl.BlockSpec((b, hsz), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, hsz), dt),
+            jax.ShapeDtypeStruct((t, b, hsz), dt),
+            jax.ShapeDtypeStruct((b, hsz), dt),
+            jax.ShapeDtypeStruct((b, hsz), dt),
+        ],
+        scratch_shapes=[pltpu.VMEM((b, hsz), dt), pltpu.VMEM((b, hsz), dt)],
+        interpret=interpret,
+    )(xz, wh, h0, c0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def lstm_fused_sequence(xz, wh, h0, c0, interpret=False):
+    """Run the fused forward. xz: [T, B, 4H] (= x@Wx + b, time-major),
+    wh: [H, 4H], h0/c0: [B, H]. Returns (hs [T,B,H], (hT, cT))."""
+    hs, cs, hT, cT = _run_kernel(xz, wh, h0, c0, interpret)
+    return hs, (hT, cT)
+
+
+def _fwd(xz, wh, h0, c0, interpret):
+    hs, cs, hT, cT = _run_kernel(xz, wh, h0, c0, interpret)
+    return (hs, (hT, cT)), (xz, wh, h0, c0, hs, cs)
+
+
+def _bwd(interpret, res, grads):
+    xz, wh, h0, c0, hs, cs = res
+    dhs, (dhT, dcT) = grads
+    t, b, hsz = hs.shape
+
+    def prev_state(i):
+        h_prev = jnp.where(i == 0, h0, hs[jnp.maximum(i - 1, 0)])
+        c_prev = jnp.where(i == 0, c0, cs[jnp.maximum(i - 1, 0)])
+        return h_prev, c_prev
+
+    def step(carry, i):
+        dh_next, dc_next, dwh = carry
+        h_prev, c_prev = prev_state(i)
+        # recompute gates (cheap: one [B,H]x[H,4H] matmul)
+        z = xz[i] + h_prev @ wh
+        zi, zf, zg, zo = jnp.split(z, 4, axis=-1)
+        ig = jax.nn.sigmoid(zi)
+        fg = jax.nn.sigmoid(zf)
+        gg = jnp.tanh(zg)
+        og = jax.nn.sigmoid(zo)
+        c = cs[i]
+        tc = jnp.tanh(c)
+        dh = dhs[i] + dh_next
+        do = dh * tc
+        dc = dh * og * (1.0 - tc * tc) + dc_next
+        di = dc * gg
+        df = dc * c_prev
+        dg = dc * ig
+        dzi = di * ig * (1.0 - ig)
+        dzf = df * fg * (1.0 - fg)
+        dzg = dg * (1.0 - gg * gg)
+        dzo = do * og * (1.0 - og)
+        dz = jnp.concatenate([dzi, dzf, dzg, dzo], axis=-1)  # [B, 4H]
+        dh_prev = dz @ wh.T
+        dc_prev = dc * fg
+        dwh = dwh + h_prev.T @ dz
+        return (dh_prev, dc_prev, dwh), dz
+
+    init = (dhT, dcT, jnp.zeros_like(wh))
+    (dh0, dc0, dwh), dxz_rev = jax.lax.scan(
+        step, init, jnp.arange(t - 1, -1, -1))
+    dxz = dxz_rev[::-1]
+    return dxz, dwh, dh0, dc0
+
+
+lstm_fused_sequence.defvjp(_fwd, _bwd)
+
+
+def supported(x_shape, hsz, *, peephole, mask, gate_activation, activation):
+    """Whether the fused lowering applies to this configuration."""
+    if peephole or mask is not None:
+        return False
+    if (gate_activation, activation) != ("sigmoid", "tanh"):
+        return False
+    b = x_shape[0]
+    # lane/sublane alignment: H multiple of 128 keeps gate slices tiled;
+    # small B still works (padded sublanes) but B>=8 avoids waste
+    return hsz % 128 == 0 and b >= 8
