@@ -11,13 +11,18 @@ from deeplearning4j_tpu.nn.conf import inputs as I
 from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
 
 
-def lenet(height=28, width=28, channels=1, n_classes=10, updater=None, seed=12345):
+def lenet(height=28, width=28, channels=1, n_classes=10, updater=None, seed=12345,
+          padding="valid"):
+    """Reference parity: LeNet.java specifies no conv padding (DL4J default
+    {0,0} = valid), giving the canonical 431,080-parameter Caffe variant at
+    28x28. ``padding="same"`` is available for tiny smoke shapes (<14px)
+    where valid 5x5 convs would collapse spatial dims to zero."""
     updater = updater or U.Adam(learning_rate=1e-3)
     return NeuralNetConfig(seed=seed, updater=updater).list(
-        L.ConvolutionLayer(n_out=20, kernel=(5, 5), stride=(1, 1), padding="same",
+        L.ConvolutionLayer(n_out=20, kernel=(5, 5), stride=(1, 1), padding=padding,
                            activation="relu", weight_init="xavier"),
         L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2), mode="max"),
-        L.ConvolutionLayer(n_out=50, kernel=(5, 5), stride=(1, 1), padding="same",
+        L.ConvolutionLayer(n_out=50, kernel=(5, 5), stride=(1, 1), padding=padding,
                            activation="relu", weight_init="xavier"),
         L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2), mode="max"),
         L.DenseLayer(n_out=500, activation="relu", weight_init="xavier"),

@@ -31,6 +31,22 @@ from deeplearning4j_tpu.utils.serde import register_config
 DIMNUMS_2D = ("NHWC", "HWIO", "NHWC")
 
 
+def conv(x, w, **kw):
+    """Policy-aware lax.conv_general_dilated.
+
+    Under mixed precision (bf16 compute, f32 accum) jax's conv *transpose*
+    rule rejects the f32-``preferred_element_type`` upcast during autodiff
+    (bf16 operands vs f32 cotangent), so convs compute bf16->bf16 — XLA:TPU's
+    MXU accumulates bf16 convolutions in f32 internally regardless, which is
+    what the cuDNN helpers' CUDNN_DATA_HALF+float-math config did for the
+    reference (CudnnConvolutionHelper.java:389). Full precision (f32/f64,
+    e.g. gradient checks) keeps the explicit accumulation dtype.
+    """
+    cd, ad = _dtypes.compute_dtypes_for(x.dtype)
+    pet = {} if cd != ad else {"preferred_element_type": ad}
+    return lax.conv_general_dilated(x.astype(cd), w.astype(cd), **kw, **pet)
+
+
 def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
@@ -88,17 +104,15 @@ class ConvolutionLayer(ParamLayer):
         return p
 
     def apply(self, params, state, x, *, train=False, rng=None):
-        cd, ad = _dtypes.compute_dtypes_for(x.dtype)
-        z = lax.conv_general_dilated(
-            x.astype(cd), params["W"].astype(cd),
+        z = conv(
+            x, params["W"],
             window_strides=_pair(self.stride),
             padding=_explicit_padding(self.padding, _pair(self.pad)),
             rhs_dilation=_pair(self.dilation),
             dimension_numbers=DIMNUMS_2D,
-            preferred_element_type=ad,
         )
         if self.has_bias:
-            z = z + params["b"]
+            z = z + params["b"].astype(z.dtype)
         return self.activation_fn()(z), state
 
 
@@ -138,17 +152,15 @@ class Convolution1DLayer(ParamLayer):
         return p
 
     def apply(self, params, state, x, *, train=False, rng=None):
-        cd, ad = _dtypes.compute_dtypes_for(x.dtype)
         pad = self.padding.upper() if self.padding in ("same", "valid") else [(self.pad, self.pad)]
-        z = lax.conv_general_dilated(
-            x.astype(cd), params["W"].astype(cd),
+        z = conv(
+            x, params["W"],
             window_strides=(self.stride,), padding=pad,
             rhs_dilation=(self.dilation,),
             dimension_numbers=("NWC", "WIO", "NWC"),
-            preferred_element_type=ad,
         )
         if self.has_bias:
-            z = z + params["b"]
+            z = z + params["b"].astype(z.dtype)
         return self.activation_fn()(z), state
 
 
@@ -173,14 +185,14 @@ class Deconvolution2DLayer(ConvolutionLayer):
         cd, ad = _dtypes.compute_dtypes_for(x.dtype)
         pad = self.padding.upper() if self.padding in ("same", "valid") else \
             [(p, p) for p in _pair(self.pad)]
+        pet = {} if cd != ad else {"preferred_element_type": ad}  # see conv()
         z = lax.conv_transpose(
             x.astype(cd), params["W"].astype(cd),
             strides=_pair(self.stride), padding=pad,
-            dimension_numbers=DIMNUMS_2D,
-            preferred_element_type=ad,
+            dimension_numbers=DIMNUMS_2D, **pet,
         )
         if self.has_bias:
-            z = z + params["b"]
+            z = z + params["b"].astype(z.dtype)
         return self.activation_fn()(z), state
 
 
@@ -226,23 +238,20 @@ class SeparableConvolution2DLayer(ParamLayer):
         return p
 
     def apply(self, params, state, x, *, train=False, rng=None):
-        cd, ad = _dtypes.compute_dtypes_for(x.dtype)
         cin = x.shape[-1]
-        z = lax.conv_general_dilated(
-            x.astype(cd), params["D"].astype(cd),
+        z = conv(
+            x, params["D"],
             window_strides=_pair(self.stride),
             padding=_explicit_padding(self.padding, _pair(self.pad)),
             dimension_numbers=DIMNUMS_2D, feature_group_count=cin,
-            preferred_element_type=ad,
         )
-        z = lax.conv_general_dilated(
-            z.astype(cd), params["P"].astype(cd),
+        z = conv(
+            z, params["P"],
             window_strides=(1, 1), padding="VALID",
             dimension_numbers=DIMNUMS_2D,
-            preferred_element_type=ad,
         )
         if self.has_bias:
-            z = z + params["b"]
+            z = z + params["b"].astype(z.dtype)
         return self.activation_fn()(z), state
 
 
@@ -435,6 +444,12 @@ class BatchNormalization(ParamLayer):
 
     def apply(self, params, state, x, *, train=False, rng=None):
         axes = tuple(range(x.ndim - 1))  # all but channel/feature
+        # batch statistics in the accumulation dtype: bf16 variance is too
+        # coarse (same reason cudnnBatchNormalization forces float math);
+        # the output is cast back so bf16 activations stay bf16 downstream
+        out_dtype = x.dtype
+        _, ad = _dtypes.compute_dtypes_for(x.dtype)
+        x = x.astype(ad)
         if train:
             mean = jnp.mean(x, axis=axes)
             var = jnp.var(x, axis=axes)
@@ -449,7 +464,7 @@ class BatchNormalization(ParamLayer):
         y = (x - mean) * inv
         if self.use_gamma_beta:
             y = y * params["gamma"] + params["beta"]
-        return self.activation_fn()(y), new_state
+        return self.activation_fn()(y).astype(out_dtype), new_state
 
 
 @register_config

@@ -101,14 +101,11 @@ class LSTM(ParamLayer):
         """Fused Pallas sequence kernel applies? (TPU backend only; the
         dispatch seam mirroring the reference's reflective cuDNN-helper
         loading at ConvolutionLayer.java:74-84 — here explicit.)"""
-        import os
-        if os.environ.get("DL4J_TPU_FUSED_LSTM", "1") == "0":
-            return False
         try:
             from deeplearning4j_tpu.ops import lstm_pallas
         except ImportError:
             return False
-        if jax.default_backend() != "tpu":
+        if not lstm_pallas.enabled():  # env flag + TPU backend, one place
             return False
         return lstm_pallas.supported(
             x.shape, self.n_out, peephole=self.peephole, mask=mask,
@@ -132,8 +129,9 @@ class LSTM(ParamLayer):
             h0, c0 = initial_state
 
         if mask_tm is None and self._fused_eligible(x, mask):
-            from deeplearning4j_tpu.ops.lstm_pallas import lstm_fused_sequence
-            hs, (hT, cT) = lstm_fused_sequence(xz, params["Wh"], h0, c0)
+            from deeplearning4j_tpu.ops.lstm_pallas import fused_sequence_padded
+            hs, (hT, cT) = fused_sequence_padded(
+                xz, params["Wh"], h0, c0, wp=params.get("Wp"))
         elif mask_tm is None:
             def body(carry, xz_t):
                 return self._step(params, carry, xz_t, None)

@@ -173,13 +173,213 @@ def _bwd(interpret, res, grads):
 lstm_fused_sequence.defvjp(_fwd, _bwd)
 
 
+# ---------------------------------------------------------------------------
+# Peephole (GravesLSTM) variant
+# ---------------------------------------------------------------------------
+# Reference: GravesLSTM.java / LSTMHelpers.java:68 with hasPeepholeConnections
+# — diagonal peephole weights feed c_{t-1} into the i/f gates and c_t into the
+# o gate. wp is [3, H] (rows: i, f, o), resident in VMEM like Wh.
+
+def _lstm_seq_kernel_peephole(xz_ref, wh_ref, wp_ref, h0_ref, c0_ref,
+                              hs_ref, cs_ref, hT_ref, cT_ref, h_s, c_s):
+    t = pl.program_id(0)
+    nt = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[:] = h0_ref[:]
+        c_s[:] = c0_ref[:]
+
+    hsz = h_s.shape[1]
+    c_prev = c_s[:]
+    z = xz_ref[0] + jnp.dot(h_s[:], wh_ref[:],
+                            preferred_element_type=jnp.float32)
+    zi = z[:, 0 * hsz:1 * hsz] + wp_ref[0] * c_prev
+    zf = z[:, 1 * hsz:2 * hsz] + wp_ref[1] * c_prev
+    zg = z[:, 2 * hsz:3 * hsz]
+    zo = z[:, 3 * hsz:4 * hsz]
+    i = jax.nn.sigmoid(zi)
+    f = jax.nn.sigmoid(zf)
+    g = jnp.tanh(zg)
+    c = (f * c_prev + i * g).astype(c_s.dtype)
+    o = jax.nn.sigmoid(zo + wp_ref[2] * c)
+    h = (o * jnp.tanh(c)).astype(h_s.dtype)
+    h_s[:] = h
+    c_s[:] = c
+    hs_ref[0] = h
+    cs_ref[0] = c
+
+    @pl.when(t == nt - 1)
+    def _():
+        hT_ref[:] = h
+        cT_ref[:] = c
+
+
+def _run_kernel_peephole(xz, wh, wp, h0, c0, interpret):
+    t, b, four_h = xz.shape
+    hsz = four_h // 4
+    dt = xz.dtype
+    if not _HAS_PLTPU:
+        raise NotImplementedError("Pallas TPU support unavailable")
+    return pl.pallas_call(
+        _lstm_seq_kernel_peephole,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, b, four_h), lambda i: (i, 0, 0)),
+            pl.BlockSpec((hsz, four_h), lambda i: (0, 0)),  # resident
+            pl.BlockSpec((3, hsz), lambda i: (0, 0)),       # resident
+            pl.BlockSpec((b, hsz), lambda i: (0, 0)),
+            pl.BlockSpec((b, hsz), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, hsz), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, b, hsz), lambda i: (i, 0, 0)),
+            pl.BlockSpec((b, hsz), lambda i: (0, 0)),
+            pl.BlockSpec((b, hsz), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, hsz), dt),
+            jax.ShapeDtypeStruct((t, b, hsz), dt),
+            jax.ShapeDtypeStruct((b, hsz), dt),
+            jax.ShapeDtypeStruct((b, hsz), dt),
+        ],
+        scratch_shapes=[pltpu.VMEM((b, hsz), dt), pltpu.VMEM((b, hsz), dt)],
+        interpret=interpret,
+    )(xz, wh, wp, h0, c0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def lstm_fused_sequence_peephole(xz, wh, wp, h0, c0, interpret=False):
+    """Peephole forward. xz: [T, B, 4H], wh: [H, 4H], wp: [3, H] (i|f|o
+    rows), h0/c0: [B, H]. Returns (hs [T,B,H], (hT, cT))."""
+    hs, cs, hT, cT = _run_kernel_peephole(xz, wh, wp, h0, c0, interpret)
+    return hs, (hT, cT)
+
+
+def _fwd_p(xz, wh, wp, h0, c0, interpret):
+    hs, cs, hT, cT = _run_kernel_peephole(xz, wh, wp, h0, c0, interpret)
+    return (hs, (hT, cT)), (xz, wh, wp, h0, c0, hs, cs)
+
+
+def _bwd_p(interpret, res, grads):
+    xz, wh, wp, h0, c0, hs, cs = res
+    dhs, (dhT, dcT) = grads
+    t, b, hsz = hs.shape
+
+    def prev_state(i):
+        h_prev = jnp.where(i == 0, h0, hs[jnp.maximum(i - 1, 0)])
+        c_prev = jnp.where(i == 0, c0, cs[jnp.maximum(i - 1, 0)])
+        return h_prev, c_prev
+
+    def step(carry, i):
+        dh_next, dc_next, dwh, dwp = carry
+        h_prev, c_prev = prev_state(i)
+        # recompute gates (cheap: one [B,H]x[H,4H] matmul)
+        z = xz[i] + h_prev @ wh
+        zi, zf, zg, zo = jnp.split(z, 4, axis=-1)
+        ig = jax.nn.sigmoid(zi + wp[0] * c_prev)
+        fg = jax.nn.sigmoid(zf + wp[1] * c_prev)
+        gg = jnp.tanh(zg)
+        c = cs[i]
+        og = jax.nn.sigmoid(zo + wp[2] * c)
+        tc = jnp.tanh(c)
+        dh = dhs[i] + dh_next
+        do = dh * tc
+        dzo = do * og * (1.0 - og)
+        # c feeds o through the peephole, so dc picks up dzo * wp_o
+        dc = dh * og * (1.0 - tc * tc) + dc_next + dzo * wp[2]
+        di = dc * gg
+        df = dc * c_prev
+        dg = dc * ig
+        dzi = di * ig * (1.0 - ig)
+        dzf = df * fg * (1.0 - fg)
+        dzg = dg * (1.0 - gg * gg)
+        dz = jnp.concatenate([dzi, dzf, dzg, dzo], axis=-1)  # [B, 4H]
+        # c_prev feeds i/f through the peepholes
+        dh_prev = dz @ wh.T
+        dc_prev = dc * fg + dzi * wp[0] + dzf * wp[1]
+        dwh = dwh + h_prev.T @ dz
+        dwp = dwp + jnp.stack([jnp.sum(dzi * c_prev, axis=0),
+                               jnp.sum(dzf * c_prev, axis=0),
+                               jnp.sum(dzo * c, axis=0)])
+        return (dh_prev, dc_prev, dwh, dwp), dz
+
+    init = (dhT, dcT, jnp.zeros_like(wh), jnp.zeros_like(wp))
+    (dh0, dc0, dwh, dwp), dxz_rev = jax.lax.scan(
+        step, init, jnp.arange(t - 1, -1, -1))
+    dxz = dxz_rev[::-1]
+    return dxz, dwh, dwp, dh0, dc0
+
+
+lstm_fused_sequence_peephole.defvjp(_fwd_p, _bwd_p)
+
+
+def pad_hidden(hsz):
+    """Smallest lane-aligned hidden size >= hsz (128-multiple)."""
+    return -(-hsz // 128) * 128
+
+
+def fused_sequence_padded(xz, wh, h0, c0, wp=None, interpret=False):
+    """Dispatch wrapper that lane-pads H to a 128-multiple when needed.
+
+    Padding is exact, not approximate: padded xz/Wh/Wp/h0/c0 lanes are zero,
+    so padded cells compute c=sigmoid(0)*0+sigmoid(0)*tanh(0)=0 and h=0 for
+    every step — the real lanes never see them (Wh rows for padded lanes are
+    zero). The pad/slice ops live OUTSIDE the custom_vjp, so autodiff routes
+    gradients through them transparently.
+
+    xz is [T, B, 4H] with gates packed i|f|g|o along the last axis.
+    """
+    t, b, four_h = xz.shape
+    hsz = four_h // 4
+    hp = pad_hidden(hsz)
+    if hp == hsz:
+        if wp is None:
+            return lstm_fused_sequence(xz, wh, h0, c0, interpret)
+        return lstm_fused_sequence_peephole(xz, wh, wp, h0, c0, interpret)
+
+    dpad = hp - hsz
+    # re-lay the packed 4H axis as [4, H] blocks, pad each gate block
+    xzp = jnp.pad(xz.reshape(t, b, 4, hsz), ((0, 0), (0, 0), (0, 0), (0, dpad)))
+    xzp = xzp.reshape(t, b, 4 * hp)
+    whp = jnp.pad(wh.reshape(hsz, 4, hsz),
+                  ((0, dpad), (0, 0), (0, dpad))).reshape(hp, 4 * hp)
+    h0p = jnp.pad(h0, ((0, 0), (0, dpad)))
+    c0p = jnp.pad(c0, ((0, 0), (0, dpad)))
+    if wp is None:
+        hsp, (hTp, cTp) = lstm_fused_sequence(xzp, whp, h0p, c0p, interpret)
+    else:
+        wpp = jnp.pad(wp, ((0, 0), (0, dpad)))
+        hsp, (hTp, cTp) = lstm_fused_sequence_peephole(xzp, whp, wpp, h0p,
+                                                       c0p, interpret)
+    return hsp[:, :, :hsz], (hTp[:, :hsz], cTp[:, :hsz])
+
+
+def enabled():
+    """Whether the fused dispatch seam is live for this process: env flag on
+    AND a TPU backend (CPU always takes the reference scan path outside
+    interpret-mode tests)."""
+    import os
+    if os.environ.get("DL4J_TPU_FUSED_LSTM", "1") == "0":
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 def supported(x_shape, hsz, *, peephole, mask, gate_activation, activation):
-    """Whether the fused lowering applies to this configuration."""
-    if peephole or mask is not None:
+    """Whether the fused lowering applies to this configuration.
+
+    Peepholes (GravesLSTM) are handled by the dedicated kernel; non-128
+    hidden sizes by exact lane padding (``fused_sequence_padded``). Only
+    masking and non-standard activations fall back to the scan path.
+    """
+    del peephole  # both variants have fused kernels
+    if mask is not None:
         return False
     if (gate_activation, activation) != ("sigmoid", "tanh"):
         return False
     b = x_shape[0]
-    # lane/sublane alignment: H multiple of 128 keeps gate slices tiled;
-    # small B still works (padded sublanes) but B>=8 avoids waste
-    return hsz % 128 == 0 and b >= 8
+    # B>=8 fills MXU sublanes; hsz>=96 bounds lane-padding waste at <=33%
+    return hsz >= 96 and b >= 8

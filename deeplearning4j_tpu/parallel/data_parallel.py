@@ -126,6 +126,8 @@ class ParallelTrainer:
                        donate_argnums=(0, 1, 2) if donate else ())
 
     def step(self, x, y, mask=None):
+        if self.params is None:
+            self.init()
         if self._step_fn is None:
             self._step_fn = self._build_step(self.donate)
         x = jax.device_put(jnp.asarray(x), _mesh.data_sharded(self.mesh))

@@ -31,6 +31,36 @@ import numpy as np
 from deeplearning4j_tpu.text.vocab import VocabCache, VocabConstructor
 
 
+class AliasTable:
+    """Walker's alias method: O(n) build, O(1) sampling from a discrete
+    distribution. Replaces np.random.choice(p=unigram^0.75) — which re-scans
+    the whole vocab per batch — as the host-side analog of the reference's
+    precomputed negative-sampling table (InMemoryLookupTable.java table/
+    makeTable)."""
+
+    def __init__(self, probs):
+        probs = np.asarray(probs, np.float64)
+        n = len(probs)
+        scaled = probs * n / probs.sum()
+        self.prob = np.zeros(n, np.float64)
+        self.alias = np.zeros(n, np.int64)
+        small = [i for i in range(n) if scaled[i] < 1.0]
+        large = [i for i in range(n) if scaled[i] >= 1.0]
+        while small and large:
+            s, l = small.pop(), large.pop()
+            self.prob[s] = scaled[s]
+            self.alias[s] = l
+            scaled[l] -= 1.0 - scaled[s]
+            (small if scaled[l] < 1.0 else large).append(l)
+        for i in small + large:
+            self.prob[i] = 1.0
+
+    def draw(self, rs, shape):
+        idx = rs.randint(0, len(self.prob), size=shape)
+        accept = rs.random_sample(np.shape(idx)) < self.prob[idx]
+        return np.where(accept, idx, self.alias[idx]).astype(np.int32)
+
+
 def _scatter_mean_update(table, idx, grads, lr):
     """Apply -lr * (per-row MEAN of grads) at idx. With unique indices this
     equals per-pair SGD; under collisions (small vocab / large batch) it stays
@@ -42,8 +72,7 @@ def _scatter_mean_update(table, idx, grads, lr):
     return table - lr * num / jnp.maximum(cnt, 1.0)[:, None]
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnames=())
-def _sgns_step(syn0, syn1neg, centers, contexts, negatives, lr):
+def _sgns_math(syn0, syn1neg, centers, contexts, negatives, lr):
     """One batched skip-gram negative-sampling update.
 
     centers [B], contexts [B], negatives [B,K]; returns (syn0, syn1neg, loss).
@@ -75,8 +104,7 @@ def _sgns_step(syn0, syn1neg, centers, contexts, negatives, lr):
     return syn0, syn1neg, loss
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _hs_step(syn0, syn1, centers, points, codes, path_mask, lr):
+def _hs_math(syn0, syn1, centers, points, codes, path_mask, lr):
     """Hierarchical-softmax skip-gram update.
 
     points/codes/path_mask: [B, L] padded Huffman paths. Loss:
@@ -100,8 +128,7 @@ def _hs_step(syn0, syn1, centers, points, codes, path_mask, lr):
     return syn0, syn1, loss
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _cbow_step(syn0, syn1neg, context_idx, context_mask, targets, negatives, lr):
+def _cbow_math(syn0, syn1neg, context_idx, context_mask, targets, negatives, lr):
     """CBOW-NS: mean of context vectors predicts the target (reference: CBOW.java)."""
     ctx = jnp.take(syn0, context_idx, axis=0)      # [B,W,D]
     m = context_mask[..., None]
@@ -124,6 +151,30 @@ def _cbow_step(syn0, syn1neg, context_idx, context_mask, targets, negatives, lr)
     loss = -jnp.mean(jnp.log(jnp.clip(s_pos, 1e-9, 1.0))
                      + jnp.sum(jnp.log(jnp.clip(1.0 - s_neg, 1e-9, 1.0)), axis=1))
     return syn0, syn1neg, loss
+
+
+def _epoch_scan(math_fn):
+    """Wrap a per-batch update into a whole-epoch lax.scan: all full batches
+    execute inside ONE jitted computation, eliminating per-step dispatch +
+    host sync (the role of the reference's Hogwild thread pool feeding the
+    native batched kernel, SequenceVectors.java:292-296)."""
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def epoch(syn0, syn1, batches, lr):
+        def body(carry, batch):
+            s0, s1, loss = math_fn(*carry, *batch, lr)
+            return (s0, s1), loss
+        (syn0, syn1), losses = jax.lax.scan(body, (syn0, syn1), batches)
+        return syn0, syn1, losses
+    return epoch
+
+
+# per-batch jitted steps (tail batches, tests) + whole-epoch scans
+_sgns_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_sgns_math)
+_hs_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_hs_math)
+_cbow_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_cbow_math)
+_sgns_epoch = _epoch_scan(_sgns_math)
+_hs_epoch = _epoch_scan(_hs_math)
+_cbow_epoch = _epoch_scan(_cbow_math)
 
 
 class SequenceVectors:
@@ -165,131 +216,184 @@ class SequenceVectors:
         counts = self.vocab.counts().astype(np.float64)
         probs = counts ** 0.75
         self._neg_table = (probs / probs.sum()).astype(np.float64)
+        self._neg_alias = AliasTable(self._neg_table)
         total = counts.sum()
         freq = counts / total
         self._keep_prob = np.minimum(1.0, np.sqrt(self.subsample / np.maximum(freq, 1e-12))
                                      + self.subsample / np.maximum(freq, 1e-12))
         if self.use_hs:
             self._max_code = max((len(w.codes) for w in self.vocab._by_index), default=1)
+            # whole-vocab Huffman path tables: batch lookup = one fancy index
+            L = self._max_code
+            self._hs_pts = np.zeros((v, L), np.int32)
+            self._hs_codes = np.zeros((v, L), np.float32)
+            self._hs_mask = np.zeros((v, L), np.float32)
+            for r, vw in enumerate(self.vocab._by_index):
+                k = len(vw.codes)
+                self._hs_pts[r, :k] = vw.points
+                self._hs_codes[r, :k] = vw.codes
+                self._hs_mask[r, :k] = 1.0
         return self
 
-    # ---- pair generation (host side) ----
+    # ---- pair generation (host side, fully vectorized) ----
+    #
+    # The reference feeds its C++ AggregateSkipGram kernel from multiple
+    # Hogwild threads (SkipGram.java:271-283). Here the host pipeline is
+    # whole-array numpy: the corpus is one flat index array + sequence-id
+    # array; pairs for all centers fall out of O(window) shifted comparisons.
+    # No Python loop ever touches an individual token.
 
     def _encode(self, seq):
         idx = [self.vocab.index_of(t) for t in seq]
         return [i for i in idx if i >= 0]
 
-    def _pairs_from_sequences(self, sequences):
+    def _encode_corpus(self, sequences):
+        """Flatten to (flat_idx [N], seq_id [N]); computed once per fit."""
+        enc = [self._encode(s) for s in sequences]
+        flat = np.asarray([i for e in enc for i in e], np.int32)
+        seq_id = np.repeat(np.arange(len(enc), dtype=np.int32),
+                           [len(e) for e in enc])
+        return flat, seq_id
+
+    def _subsampled(self, flat, seq_id):
+        """Per-epoch frequent-word subsampling (word2vec p_keep)."""
+        if self.subsample <= 0 or len(flat) == 0:
+            return flat, seq_id
+        keep = self._rs.random_sample(len(flat)) < self._keep_prob[flat]
+        return flat[keep], seq_id[keep]
+
+    def _pairs_from_corpus(self, flat, seq_id):
+        """All (center, context) skip-gram pairs with per-center dynamic
+        window b ~ U[1, window], as O(window) shifted array ops."""
+        n = len(flat)
+        if n < 2:
+            z = np.zeros((0,), np.int32)
+            return z, z
+        b = self._rs.randint(1, self.window + 1, size=n)
         centers, contexts = [], []
-        for seq in sequences:
-            idx = self._encode(seq)
-            if self.subsample > 0:
-                idx = [i for i in idx if self._rs.rand() < self._keep_prob[i]]
-            n = len(idx)
-            for pos in range(n):
-                b = self._rs.randint(1, self.window + 1)
-                for off in range(-b, b + 1):
-                    j = pos + off
-                    if off == 0 or j < 0 or j >= n:
-                        continue
-                    centers.append(idx[pos])
-                    contexts.append(idx[j])
-        return np.asarray(centers, np.int32), np.asarray(contexts, np.int32)
+        for off in range(1, self.window + 1):
+            same = seq_id[:-off] == seq_id[off:]
+            # center at pos, context at pos+off (window of the center rules)
+            m = same & (b[:-off] >= off)
+            centers.append(flat[:-off][m]); contexts.append(flat[off:][m])
+            # center at pos+off, context at pos
+            m = same & (b[off:] >= off)
+            centers.append(flat[off:][m]); contexts.append(flat[:-off][m])
+        return (np.concatenate(centers).astype(np.int32),
+                np.concatenate(contexts).astype(np.int32))
+
+    def _pairs_from_sequences(self, sequences):
+        flat, seq_id = self._encode_corpus(sequences)
+        return self._pairs_from_corpus(*self._subsampled(flat, seq_id))
 
     def _draw_negatives(self, shape):
-        return self._rs.choice(len(self._neg_table), size=shape,
-                               p=self._neg_table).astype(np.int32)
+        return self._neg_alias.draw(self._rs, shape)
 
-    def _cbow_windows(self, sequences):
-        """(context_idx [N,2*window], context_mask, targets [N]) padded windows."""
+    def _cbow_windows_from_corpus(self, flat, seq_id):
+        """Padded CBOW windows as one gather: positions [N,1] + offsets
+        [1,2W], masked where out-of-sequence or beyond the dynamic window."""
         W = 2 * self.window
-        ctx_rows, masks, targets = [], [], []
-        for seq in sequences:
-            idx = self._encode(seq)
-            if self.subsample > 0:
-                idx = [i for i in idx if self._rs.rand() < self._keep_prob[i]]
-            n = len(idx)
-            for pos in range(n):
-                b = self._rs.randint(1, self.window + 1)
-                window = [idx[pos + off] for off in range(-b, b + 1)
-                          if off != 0 and 0 <= pos + off < n]
-                if not window:
-                    continue
-                row = np.zeros(W, np.int32)
-                m = np.zeros(W, np.float32)
-                row[:len(window)] = window
-                m[:len(window)] = 1.0
-                ctx_rows.append(row)
-                masks.append(m)
-                targets.append(idx[pos])
-        if not ctx_rows:
+        n = len(flat)
+        if n == 0:
             z = np.zeros((0, W), np.int32)
             return z, np.zeros((0, W), np.float32), np.zeros((0,), np.int32)
-        return (np.stack(ctx_rows), np.stack(masks),
-                np.asarray(targets, np.int32))
+        b = self._rs.randint(1, self.window + 1, size=n)
+        offs = np.concatenate([np.arange(-self.window, 0),
+                               np.arange(1, self.window + 1)])  # [2W]
+        pos = np.arange(n)[:, None]                              # [N,1]
+        j = pos + offs[None, :]                                  # [N,2W]
+        jc = np.clip(j, 0, n - 1)
+        valid = ((j >= 0) & (j < n)
+                 & (seq_id[jc] == seq_id[:, None])
+                 & (np.abs(offs)[None, :] <= b[:, None]))
+        has_ctx = valid.any(axis=1)
+        ctx = np.where(valid, flat[jc], 0).astype(np.int32)[has_ctx]
+        mask = valid.astype(np.float32)[has_ctx]
+        return ctx, mask, flat[has_ctx]
+
+    def _cbow_windows(self, sequences):
+        flat, seq_id = self._encode_corpus(sequences)
+        return self._cbow_windows_from_corpus(*self._subsampled(flat, seq_id))
 
     # ---- training ----
 
     def fit(self, sequences):
-        """sequences: iterable (re-iterable) of token lists."""
+        """sequences: iterable (re-iterable) of token lists.
+
+        Host/device overlap comes free from jax's async dispatch: losses stay
+        on device until the epoch ends (a per-step ``float(loss)`` would
+        force a sync and serialize host batch prep against device steps —
+        the reference gets the same overlap from its prefetch threads).
+        """
         seq_list = [list(s) for s in sequences]
         if self.vocab is None:
             self.build_vocab(seq_list)
+        corpus = self._encode_corpus(seq_list)  # once, not per epoch
         total_steps = max(self.epochs, 1)
         losses = []
         for epoch in range(self.epochs):
             frac = epoch / total_steps
             lr = max(self.learning_rate * (1 - frac), self.min_learning_rate)
             if self.algorithm == "cbow" and not self.use_hs:
-                ctx, cmask, targets = self._cbow_windows(seq_list)
+                ctx, cmask, targets = self._cbow_windows_from_corpus(
+                    *self._subsampled(*corpus))
                 perm = self._rs.permutation(len(targets))
                 ctx, cmask, targets = ctx[perm], cmask[perm], targets[perm]
-                for i in range(0, len(targets), self.batch_size):
-                    t = targets[i:i + self.batch_size]
-                    if len(t) == 0:
-                        continue
-                    negs = self._draw_negatives((len(t), self.negative))
-                    self.syn0, self.syn1, loss = _cbow_step(
-                        self.syn0, self.syn1, jnp.asarray(ctx[i:i + self.batch_size]),
-                        jnp.asarray(cmask[i:i + self.batch_size]), jnp.asarray(t),
-                        jnp.asarray(negs), lr)
-                    losses.append(float(loss))
+                negs = self._draw_negatives((len(targets), self.negative))
+                losses += self._run_batched(
+                    _cbow_epoch, _cbow_step, (ctx, cmask, targets, negs), lr)
                 continue
-            centers, contexts = self._pairs_from_sequences(seq_list)
+            centers, contexts = self._pairs_from_corpus(
+                *self._subsampled(*corpus))
             perm = self._rs.permutation(len(centers))
             centers, contexts = centers[perm], contexts[perm]
-            for i in range(0, len(centers), self.batch_size):
-                c = centers[i:i + self.batch_size]
-                t = contexts[i:i + self.batch_size]
-                if len(c) == 0:
-                    continue
-                if self.use_hs:
-                    pts, codes, mask = self._huffman_batch(t)
-                    self.syn0, self.syn1, loss = _hs_step(
-                        self.syn0, self.syn1, jnp.asarray(c), jnp.asarray(pts),
-                        jnp.asarray(codes), jnp.asarray(mask), lr)
-                else:
-                    negs = self._draw_negatives((len(c), self.negative))
-                    self.syn0, self.syn1, loss = _sgns_step(
-                        self.syn0, self.syn1, jnp.asarray(c), jnp.asarray(t),
-                        jnp.asarray(negs), lr)
-                losses.append(float(loss))
-        self.loss_history = losses
+            if self.use_hs:
+                pts, codes, mask = self._huffman_batch(contexts)
+                losses += self._run_batched(
+                    _hs_epoch, _hs_step, (centers, pts, codes, mask), lr)
+            else:
+                negs = self._draw_negatives((len(centers), self.negative))
+                losses += self._run_batched(
+                    _sgns_epoch, _sgns_step, (centers, contexts, negs), lr)
+        self.loss_history = [float(l) for l in losses]  # one sync, at the end
         return self
 
+    # batches per scanned jit call; fixed so the scan compiles ONCE and is
+    # reused across epochs/corpora (a whole-epoch scan would bake the corpus
+    # size into the compiled shape)
+    SCAN_CHUNK = 32
+
+    def _run_batched(self, epoch_fn, step_fn, arrays, lr):
+        """Split aligned arrays into SCAN_CHUNK-sized groups of [B, ...] full
+        batches, each group executed as ONE scanned jit call; leftover full
+        batches and the ragged tail go through the per-step jit. Returns the
+        list of (device) per-batch losses."""
+        n = len(arrays[0])
+        bs = self.batch_size
+        ck = self.SCAN_CHUNK
+        losses = []
+        i = 0
+        while n - i >= ck * bs:
+            batches = tuple(jnp.asarray(
+                a[i:i + ck * bs].reshape(ck, bs, *a.shape[1:]))
+                for a in arrays)
+            self.syn0, self.syn1, ls = epoch_fn(self.syn0, self.syn1,
+                                                batches, lr)
+            losses += list(ls)
+            i += ck * bs
+        while i < n:
+            tail = tuple(jnp.asarray(a[i:i + bs]) for a in arrays)
+            self.syn0, self.syn1, loss = step_fn(self.syn0, self.syn1,
+                                                 *tail, lr)
+            losses.append(loss)
+            i += bs
+        return losses
+
     def _huffman_batch(self, targets):
-        L = self._max_code
-        b = len(targets)
-        pts = np.zeros((b, L), np.int32)
-        codes = np.zeros((b, L), np.float32)
-        mask = np.zeros((b, L), np.float32)
-        for r, t in enumerate(targets):
-            vw = self.vocab._by_index[t]
-            k = len(vw.codes)
-            pts[r, :k] = vw.points
-            codes[r, :k] = vw.codes
-            mask[r, :k] = 1.0
-        return pts, codes, mask
+        """Padded Huffman paths for a batch — one fancy index into the
+        precomputed whole-vocab tables (built in build_vocab)."""
+        return (self._hs_pts[targets], self._hs_codes[targets],
+                self._hs_mask[targets])
 
     # ---- query API (reference: WordVectors interface) ----
 

@@ -23,6 +23,8 @@ from deeplearning4j_tpu.parallel.sequence import (make_ring_attention_fn,
                                                   ulysses_self_attention)
 from deeplearning4j_tpu.utils.gradcheck import check_gradients
 
+pytestmark = pytest.mark.slow  # heavy tier: 8-dev mesh / zoo models / solvers
+
 F64 = jnp.float64
 
 

@@ -8,6 +8,8 @@ from deeplearning4j_tpu.clustering import KDTree, KMeans, TSNE, VPTree
 from deeplearning4j_tpu.clustering.server import NearestNeighborClient, NearestNeighborServer
 from deeplearning4j_tpu.graphlib import DeepWalk, Graph, RandomWalkIterator
 
+pytestmark = pytest.mark.slow  # heavy tier: 8-dev mesh / zoo models / solvers
+
 
 def _brute_knn(points, q, k):
     d = np.sqrt(np.sum((points - q) ** 2, axis=1))

@@ -25,6 +25,8 @@ from deeplearning4j_tpu.parallel.distributed import (
     initialize_distributed,
 )
 
+pytestmark = pytest.mark.slow  # heavy tier: 8-dev mesh / zoo models / solvers
+
 
 def _blobs(n=512, d=8, k=3, seed=0):
     rs = np.random.RandomState(seed)

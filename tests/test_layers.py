@@ -183,6 +183,7 @@ class TestGradientChecks:
         x = jax.random.normal(rng, (2, 6, 6, 2), F64)
         _gradcheck_layer(layer, I.ConvolutionalType(6, 6, 2), x)
 
+    @pytest.mark.slow
     def test_separable_conv(self, rng):
         layer = L.SeparableConvolution2DLayer(n_out=4, kernel=(3, 3), activation="tanh")
         x = jax.random.normal(rng, (2, 5, 5, 2), F64)

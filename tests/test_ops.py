@@ -22,6 +22,92 @@ def _ref_scan(xz, wh, h0, c0):
     return hs, (hT, cT)
 
 
+def _ref_scan_peephole(xz, wh, wp, h0, c0):
+    """GravesLSTM semantics: c_{t-1} peeps into i/f, c_t into o
+    (LSTMHelpers.java:68 with hasPeepholeConnections)."""
+    def step(carry, xz_t):
+        h, c_prev = carry
+        z = xz_t + h @ wh
+        zi, zf, zg, zo = jnp.split(z, 4, -1)
+        i = jax.nn.sigmoid(zi + wp[0] * c_prev)
+        f = jax.nn.sigmoid(zf + wp[1] * c_prev)
+        c = f * c_prev + i * jnp.tanh(zg)
+        o = jax.nn.sigmoid(zo + wp[2] * c)
+        h = o * jnp.tanh(c)
+        return (h, c), h
+    (hT, cT), hs = jax.lax.scan(step, (h0, c0), xz)
+    return hs, (hT, cT)
+
+
+class TestFusedPeepholeLstmKernel:
+    def _inputs(self, T=3, B=8, H=128, seed=5):
+        xz, wh, h0, c0 = _inputs(T=T, B=B, H=H, seed=seed)
+        rs = np.random.RandomState(seed + 100)
+        wp = jnp.asarray(rs.randn(3, H).astype(np.float32) * 0.1)
+        return xz, wh, wp, h0, c0
+
+    def test_forward_matches_scan(self):
+        xz, wh, wp, h0, c0 = self._inputs()
+        hs_p, (hT_p, cT_p) = lstm_pallas.lstm_fused_sequence_peephole(
+            xz, wh, wp, h0, c0, True)
+        hs_r, (hT_r, cT_r) = _ref_scan_peephole(xz, wh, wp, h0, c0)
+        np.testing.assert_allclose(np.asarray(hs_p), np.asarray(hs_r),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cT_p), np.asarray(cT_r),
+                                   atol=1e-5)
+
+    def test_gradients_match_scan(self):
+        xz, wh, wp, h0, c0 = self._inputs(seed=6)
+
+        def make_loss(fn):
+            def loss(xz, wh, wp, h0, c0):
+                hs, (hT, cT) = fn(xz, wh, wp, h0, c0)
+                return (jnp.sum(hs ** 2) + jnp.sum(jnp.tanh(hT))
+                        + 0.5 * jnp.sum(cT ** 2))
+            return loss
+
+        gp = jax.grad(make_loss(
+            lambda *a: lstm_pallas.lstm_fused_sequence_peephole(*a, True)),
+            argnums=(0, 1, 2, 3, 4))(xz, wh, wp, h0, c0)
+        gr = jax.grad(make_loss(_ref_scan_peephole),
+                      argnums=(0, 1, 2, 3, 4))(xz, wh, wp, h0, c0)
+        for p, r, name in zip(gp, gr, ("dxz", "dwh", "dwp", "dh0", "dc0")):
+            np.testing.assert_allclose(np.asarray(p), np.asarray(r),
+                                       atol=2e-5, err_msg=name)
+
+    def test_padded_peephole_matches_scan(self):
+        xz, wh, wp, h0, c0 = self._inputs(H=100, seed=7)
+        hs_p, (hT_p, cT_p) = lstm_pallas.fused_sequence_padded(
+            xz, wh, h0, c0, wp=wp, interpret=True)
+        hs_r, (hT_r, cT_r) = _ref_scan_peephole(xz, wh, wp, h0, c0)
+        np.testing.assert_allclose(np.asarray(hs_p), np.asarray(hs_r),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cT_p), np.asarray(cT_r),
+                                   atol=1e-5)
+
+    def test_matches_graveslstm_layer_semantics(self):
+        """The kernel must agree with the GravesLSTM layer's scan path — the
+        contract ValidateCudnnLSTM.java pins for the reference fast path."""
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.nn.conf import inputs as I
+
+        layer = L.GravesLSTM(n_out=128)
+        params = layer.init(jax.random.PRNGKey(0), I.RecurrentType(16, 4))
+        rs = np.random.RandomState(8)
+        x = jnp.asarray(rs.randn(8, 4, 16).astype(np.float32) * 0.5)
+        y_scan, _ = layer.apply(params, {}, x)
+
+        b, t, _ = x.shape
+        xz = (x.reshape(b * t, -1) @ params["Wx"] + params["b"]) \
+            .reshape(b, t, -1).transpose(1, 0, 2)
+        h0 = jnp.zeros((b, 128), jnp.float32)
+        c0 = jnp.zeros((b, 128), jnp.float32)
+        hs, _ = lstm_pallas.lstm_fused_sequence_peephole(
+            xz, params["Wh"], params["Wp"], h0, c0, True)
+        np.testing.assert_allclose(np.asarray(hs.transpose(1, 0, 2)),
+                                   np.asarray(y_scan), atol=1e-5)
+
+
 def _inputs(T=4, B=8, H=128, seed=0):
     rs = np.random.RandomState(seed)
     xz = jnp.asarray(rs.randn(T, B, 4 * H).astype(np.float32) * 0.1)
@@ -70,14 +156,42 @@ class TestFusedLstmKernel:
         ok = dict(peephole=False, mask=None, gate_activation="sigmoid",
                   activation="tanh")
         assert lstm_pallas.supported((8, 16, 32), 128, **ok)
-        assert not lstm_pallas.supported((8, 16, 32), 100, **ok)  # H%128
+        assert lstm_pallas.supported((8, 16, 32), 100, **ok)   # lane-padded
+        assert not lstm_pallas.supported((8, 16, 32), 64, **ok)  # too small
         assert not lstm_pallas.supported((4, 16, 32), 128, **ok)  # B<8
-        assert not lstm_pallas.supported(
-            (8, 16, 32), 128, **{**ok, "peephole": True})
+        assert lstm_pallas.supported(
+            (8, 16, 32), 128, **{**ok, "peephole": True})  # peephole kernel
         assert not lstm_pallas.supported(
             (8, 16, 32), 128, **{**ok, "mask": np.ones((8, 16))})
         assert not lstm_pallas.supported(
             (8, 16, 32), 128, **{**ok, "activation": "relu"})
+
+    def test_padded_dispatch_matches_unpadded_exactly(self):
+        # H=100 -> padded to 128; padding is exact (zero lanes stay zero)
+        xz, wh, h0, c0 = _inputs(T=3, B=8, H=100, seed=3)
+        hs_p, (hT_p, cT_p) = lstm_pallas.fused_sequence_padded(
+            xz, wh, h0, c0, interpret=True)
+        hs_r, (hT_r, cT_r) = _ref_scan(xz, wh, h0, c0)
+        np.testing.assert_allclose(np.asarray(hs_p), np.asarray(hs_r),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cT_p), np.asarray(cT_r),
+                                   atol=1e-5)
+
+    def test_padded_gradients_match_scan(self):
+        xz, wh, h0, c0 = _inputs(T=3, B=8, H=100, seed=4)
+
+        def make_loss(fn):
+            def loss(xz, wh, h0, c0):
+                hs, (hT, cT) = fn(xz, wh, h0, c0)
+                return jnp.sum(hs ** 2) + jnp.sum(jnp.tanh(hT)) + jnp.sum(cT ** 2)
+            return loss
+
+        gp = jax.grad(make_loss(lambda *a: lstm_pallas.fused_sequence_padded(
+            *a, interpret=True)), argnums=(0, 1, 2, 3))(xz, wh, h0, c0)
+        gr = jax.grad(make_loss(_ref_scan), argnums=(0, 1, 2, 3))(xz, wh, h0, c0)
+        for p, r, name in zip(gp, gr, ("dxz", "dwh", "dh0", "dc0")):
+            np.testing.assert_allclose(np.asarray(p), np.asarray(r),
+                                       atol=2e-5, err_msg=name)
 
     def test_layer_never_dispatches_fused_on_cpu(self):
         # dispatch seam: CPU backend must stay on the scan path

@@ -14,6 +14,8 @@ from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.parallel import MeshSpec, ParallelInference, ParallelTrainer, make_mesh
 
+pytestmark = pytest.mark.slow  # heavy tier: 8-dev mesh / zoo models / solvers
+
 
 def _net(seed=7, n_in=4, n_out=2, hidden=16):
     conf = NeuralNetConfig(seed=seed, updater=U.Adam(learning_rate=0.01)).list(

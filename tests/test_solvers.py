@@ -12,6 +12,8 @@ import pytest
 
 from deeplearning4j_tpu.nn import solvers
 
+pytestmark = pytest.mark.slow  # heavy tier: 8-dev mesh / zoo models / solvers
+
 
 def _quadratic():
     # f(x) = 0.5 x^T A x - b^T x, A SPD; optimum x* = A^-1 b

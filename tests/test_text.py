@@ -63,6 +63,7 @@ class TestVocab:
 
 
 class TestWord2Vec:
+    @pytest.mark.slow
     def test_sgns_learns_topic_structure(self):
         sv = SequenceVectors(vector_size=16, window=3, min_count=1, negative=4,
                              epochs=20, learning_rate=0.1, batch_size=128,
@@ -72,6 +73,7 @@ class TestWord2Vec:
         across = sv.similarity("cat", "car")
         assert within > across + 0.15, (within, across)
 
+    @pytest.mark.slow
     def test_hierarchical_softmax_path(self):
         sv = SequenceVectors(vector_size=16, window=3, min_count=1, epochs=20,
                              learning_rate=0.1, batch_size=128,
@@ -87,6 +89,7 @@ class TestWord2Vec:
         sv.fit(_toy_corpus(200))
         assert sv.similarity("wheel", "fuel") > sv.similarity("wheel", "meow")
 
+    @pytest.mark.slow
     def test_words_nearest(self):
         sv = SequenceVectors(vector_size=16, window=3, min_count=1, negative=4,
                              epochs=20, learning_rate=0.1, batch_size=128,

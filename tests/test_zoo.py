@@ -10,12 +10,23 @@ from deeplearning4j_tpu.nn.conf import inputs as I
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
+pytestmark = pytest.mark.slow  # heavy tier: 8-dev mesh / zoo models / solvers
+
 
 class TestShapes:
     def test_lenet_shapes(self):
         conf = lenet()
         _, out = conf.layer_input_types()
         assert out == I.FeedForwardType(10)
+
+    def test_lenet_caffe_param_count(self):
+        # LeNet.java uses unpadded (valid) 5x5 convs -> the canonical Caffe
+        # variant: 520 + 25,050 + 800*500+500 + 500*10+10 = 431,080 params
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork(lenet())
+        net.init()
+        n = sum(int(np.prod(v.shape)) for p in net.params for v in p.values())
+        assert n == 431080, n
 
     def test_vgg16_shapes(self):
         conf = vgg16(height=64, width=64, n_classes=10)
