@@ -202,3 +202,238 @@ class TestCalibration:
         c = EvaluationCalibration()
         c.eval(labels, preds)
         assert c.expected_calibration_error(1) > 0.3
+
+
+class TestEvaluationParity:
+    """Reference edge-semantics (Evaluation.java) added in round 2."""
+
+    def test_cost_array_changes_decision(self):
+        # probs argmax class 0, but cost weights favor class 1:
+        # argmax(prob * cost) per Evaluation.java:374-377
+        e = Evaluation(cost_array=[1.0, 5.0])
+        labels = _onehot([1, 1], 2)
+        preds = np.array([[0.7, 0.3], [0.9, 0.1]])
+        e.eval(labels, preds)
+        # 0.3*5 > 0.7*1 -> class 1; 0.1*5 < 0.9*1 -> class 0
+        assert e.true_positives(1) == 1 and e.false_negatives(1) == 1
+        with pytest.raises(ValueError):
+            Evaluation(cost_array=[-1.0, 1.0])
+
+    def test_single_column_binary_case(self):
+        # 1-column labels -> 2-class confusion (Evaluation.java:324-351)
+        e = Evaluation()
+        labels = np.array([[1.0], [0.0], [1.0], [0.0]])
+        preds = np.array([[0.9], [0.2], [0.4], [0.7]])
+        e.eval(labels, preds)
+        assert e.n_classes == 2
+        assert e.true_positives(1) == 1   # 0.9 on label 1
+        assert e.false_negatives(1) == 1  # 0.4 on label 1
+        assert e.false_positives(1) == 1  # 0.7 on label 0
+        assert e.true_negatives(1) == 1   # 0.2 on label 0
+
+    def test_binary_decision_threshold_two_columns(self):
+        e = Evaluation(binary_decision_threshold=0.8)
+        labels = _onehot([1, 1], 2)
+        preds = np.array([[0.3, 0.7], [0.1, 0.9]])  # argmax would say 1, 1
+        e.eval(labels, preds)
+        # 0.7 < 0.8 -> class 0 (fn); 0.9 >= 0.8 -> class 1 (tp)
+        assert e.true_positives(1) == 1 and e.false_negatives(1) == 1
+        e3 = Evaluation(binary_decision_threshold=0.5)
+        with pytest.raises(ValueError):
+            e3.eval(_onehot([0, 1, 2], 3), np.eye(3))
+
+    def test_top_n_tie_is_favorable(self):
+        # ties on the true-class probability count as correct
+        # (strictly-greater count < topN, Evaluation.java:436-453)
+        e = Evaluation(top_n=2)
+        labels = _onehot([2], 3)
+        preds = np.array([[0.4, 0.3, 0.3]])  # class 1 ties class 2
+        e.eval(labels, preds)
+        assert e.top_n_accuracy() == 1.0
+
+    def test_macro_excludes_zero_over_zero(self):
+        # class 2 never actual nor predicted -> precision 0/0 -> excluded
+        e = Evaluation(n_classes=3)
+        e.eval(_onehot([0, 1], 3), _onehot([0, 1], 3).astype(float))
+        assert e.precision() == 1.0
+        assert e.average_precision_num_classes_excluded() == 1
+        assert e.average_recall_num_classes_excluded() == 1
+        assert e.average_f1_num_classes_excluded() == 1
+        # per-class edge_case value is honored
+        assert e.precision(2, edge_case=-1.0) == -1.0
+
+    def test_micro_vs_macro(self):
+        e = Evaluation(n_classes=3)
+        rs = np.random.RandomState(1)
+        labels = _onehot(rs.randint(0, 3, 60), 3)
+        preds = rs.dirichlet(np.ones(3), 60)
+        e.eval(labels, preds)
+        from deeplearning4j_tpu.eval.classification import MICRO
+        # micro precision == micro recall == accuracy for multiclass argmax
+        assert e.precision(averaging=MICRO) == pytest.approx(e.accuracy())
+        assert e.recall(averaging=MICRO) == pytest.approx(e.accuracy())
+        assert e.f_beta(1.0, averaging=MICRO) == pytest.approx(e.accuracy())
+
+    def test_f_beta_binary_special_case(self):
+        # 2 classes: f1() reports class-1 F-beta (Evaluation.java:1050-1060)
+        e = Evaluation(n_classes=2)
+        labels = _onehot([0, 0, 1, 1, 1], 2)
+        preds = _onehot([0, 1, 1, 0, 0], 2).astype(float)  # tp=1 fp=1 fn=2
+        e.eval(labels, preds)
+        assert e.f1() == pytest.approx(e.f_beta(1.0, 1))
+        # precision (1/2) != recall (1/3) so beta matters
+        assert e.f_beta(2.0, 1) != pytest.approx(e.f_beta(0.5, 1))
+
+    def test_g_measure_and_false_alarm(self):
+        e = Evaluation(n_classes=2)
+        labels = _onehot([0, 0, 1, 1], 2)
+        preds = _onehot([0, 1, 1, 1], 2).astype(float)
+        e.eval(labels, preds)
+        p1, r1 = e.precision(1), e.recall(1)
+        assert e.g_measure(1) == pytest.approx(np.sqrt(p1 * r1))
+        far = (e.false_positive_rate() + e.false_negative_rate()) / 2
+        assert e.false_alarm_rate() == pytest.approx(far)
+
+    def test_merge_and_reset(self):
+        rs = np.random.RandomState(2)
+        labels = _onehot(rs.randint(0, 3, 40), 3)
+        preds = rs.dirichlet(np.ones(3), 40)
+        whole = Evaluation()
+        whole.eval(labels, preds)
+        a, b = Evaluation(), Evaluation()
+        a.eval(labels[:25], preds[:25])
+        b.eval(labels[25:], preds[25:])
+        a.merge(b)
+        assert a.accuracy() == whole.accuracy()
+        assert np.array_equal(a.confusion.matrix, whole.confusion.matrix)
+        a.reset()
+        assert a.total_examples == 0 and a.confusion is None
+
+    def test_prediction_metadata(self):
+        e = Evaluation()
+        labels = _onehot([0, 1, 1], 2)
+        preds = _onehot([0, 0, 1], 2).astype(float)
+        e.eval(labels, preds, record_meta_data=["rec0", "rec1", "rec2"])
+        errors = e.get_prediction_errors()
+        assert len(errors) == 1 and errors[0].meta == "rec1"
+        assert errors[0].actual == 1 and errors[0].predicted == 0
+        by_actual = e.get_predictions_by_actual_class(1)
+        assert {p.meta for p in by_actual} == {"rec1", "rec2"}
+        assert [p.meta for p in e.get_predictions(1, 0)] == ["rec1"]
+
+    def test_eval_single(self):
+        e = Evaluation(n_classes=3)
+        e.eval_single(0, 0)
+        e.eval_single(1, 2)
+        assert e.accuracy() == pytest.approx(0.5)
+        assert e.confusion.get_count(2, 1) == 1
+
+    def test_confusion_exports(self):
+        e = Evaluation(labels=["cat", "dog"])
+        e.eval(_onehot([0, 1, 1], 2), _onehot([0, 1, 0], 2).astype(float))
+        csv = e.confusion.to_csv()
+        assert "Actual Class" in csv and "cat" in csv and "Total" in csv
+        # totals: row cat = 1, row dog = 2
+        assert ",cat,1,0,1" in csv and "dog,1,1,2" in csv
+        html = e.confusion.to_html()
+        assert html.startswith("<table>") and "count-element" in html
+        txt = e.confusion_to_string()
+        assert "Predicted" in txt and "Actual" in txt
+
+    def test_stats_warnings(self):
+        e = Evaluation(n_classes=3)
+        e.eval(_onehot([0, 1], 3), _onehot([0, 1], 3).astype(float))
+        assert "excluded" in e.stats()
+        assert "excluded" not in e.stats(suppress_warnings=True)
+
+    def test_matthews_averaging(self):
+        from deeplearning4j_tpu.eval.classification import MICRO
+        e = Evaluation()
+        rs = np.random.RandomState(3)
+        labels = _onehot(rs.randint(0, 3, 50), 3)
+        e.eval(labels, rs.dirichlet(np.ones(3), 50))
+        per_class = [e.matthews_correlation(i) for i in range(3)]
+        assert e.matthews_correlation() == pytest.approx(np.mean(per_class))
+        assert -1.0 <= e.matthews_correlation(averaging=MICRO) <= 1.0
+
+
+class TestEvaluationBinaryParity:
+    def test_full_metric_surface(self):
+        eb = EvaluationBinary(labels=["a", "b"])
+        labels = np.array([[1, 0], [1, 1], [0, 1], [0, 0]])
+        preds = np.array([[0.9, 0.1], [0.8, 0.9], [0.3, 0.7], [0.2, 0.4]])
+        eb.eval(labels, preds)
+        assert eb.total_count(0) == 4
+        assert eb.accuracy(0) == 1.0
+        assert eb.f1(0) == 1.0
+        assert eb.matthews_correlation(0) == pytest.approx(1.0)
+        assert eb.g_measure(1) > 0
+        assert eb.false_positive_rate(0) == 0.0
+        assert "a:" in eb.stats() and "tp=" in eb.stats()
+
+    def test_merge(self):
+        rs = np.random.RandomState(4)
+        labels = (rs.rand(30, 3) > 0.5).astype(float)
+        preds = rs.rand(30, 3)
+        whole = EvaluationBinary()
+        whole.eval(labels, preds)
+        a, b = EvaluationBinary(), EvaluationBinary()
+        a.eval(labels[:10], preds[:10])
+        b.eval(labels[10:], preds[10:])
+        a.merge(b)
+        assert np.array_equal(a.tp, whole.tp) and np.array_equal(a.fn, whole.fn)
+        assert a.average_f1() == pytest.approx(whole.average_f1())
+
+
+class TestCalibrationParity:
+    def _eval(self):
+        rs = np.random.RandomState(5)
+        labels = _onehot(rs.randint(0, 3, 200), 3)
+        preds = rs.dirichlet(np.ones(3), 200)
+        ec = EvaluationCalibration()
+        ec.eval(labels, preds)
+        return ec, labels, preds
+
+    def test_curve_objects(self):
+        ec, labels, preds = self._eval()
+        rd = ec.get_reliability_diagram(0)
+        assert len(rd.mean_predicted_value) == 10
+        h = ec.get_residual_plot_all_classes()
+        assert h.bin_counts.sum() == 200 * 3  # one residual per (row, class)
+        assert h.n_bins == 50
+        assert h.bin_lower_bounds()[0] == 0.0
+        assert h.bin_upper_bounds()[-1] == pytest.approx(1.0)
+
+    def test_per_class_residual_partition(self):
+        ec, labels, preds = self._eval()
+        per_class = sum(ec.get_residual_plot(c).bin_counts.sum()
+                        for c in range(3))
+        assert per_class == ec.get_residual_plot_all_classes().bin_counts.sum()
+
+    def test_probability_histograms(self):
+        ec, labels, preds = self._eval()
+        assert ec.get_probability_histogram_all_classes().bin_counts.sum() == 200 * 3
+        # per-label-class histogram counts rows with that true label
+        for c in range(3):
+            assert ec.get_probability_histogram(c).bin_counts.sum() == \
+                ec.get_label_counts_each_class()[c]
+
+    def test_counts_and_stats(self):
+        ec, labels, preds = self._eval()
+        assert ec.get_label_counts_each_class().sum() == 200
+        assert ec.get_prediction_counts_each_class().sum() == 200
+        assert "ECE" in ec.stats()
+
+    def test_merge(self):
+        rs = np.random.RandomState(6)
+        labels = _onehot(rs.randint(0, 3, 100), 3)
+        preds = rs.dirichlet(np.ones(3), 100)
+        whole = EvaluationCalibration()
+        whole.eval(labels, preds)
+        a, b = EvaluationCalibration(), EvaluationCalibration()
+        a.eval(labels[:40], preds[:40])
+        b.eval(labels[40:], preds[40:])
+        a.merge(b)
+        assert a.expected_calibration_error() == pytest.approx(
+            whole.expected_calibration_error())
+        assert np.array_equal(a.residual_hist, whole.residual_hist)
