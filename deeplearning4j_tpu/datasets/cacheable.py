@@ -62,12 +62,27 @@ def ensure_file(relpath, url=None, md5=None, root=None):
         urllib.request.urlretrieve(url, tmp)
         os.replace(tmp, path)
     if md5 is not None:
+        # memoize verification in a sidecar marker so repeated fetcher
+        # construction doesn't re-hash multi-GB archives every call; the
+        # marker binds to (md5, size, mtime_ns) so any in-place modification
+        # invalidates it and the mismatch path still fires
+        st = os.stat(path)
+        stamp = f"{md5} {st.st_size} {st.st_mtime_ns}"
+        marker = path + ".md5ok"
+        if os.path.exists(marker):
+            with open(marker) as f:
+                if f.read().strip() == stamp:
+                    return path
         got = _md5(path)
         if got != md5:
             os.remove(path)
+            if os.path.exists(marker):
+                os.remove(marker)
             raise ChecksumError(
                 f"Checksum mismatch for {path}: expected {md5}, got {got}; "
                 f"cached file deleted — re-stage it.")
+        with open(marker, "w") as f:
+            f.write(stamp)
     return path
 
 

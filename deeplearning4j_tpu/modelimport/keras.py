@@ -8,8 +8,11 @@ Hdf5Archive.java (native HDF5 reads), KerasModelUtils weight copying
 
 TPU-native differences from the reference:
 - HDF5 access goes through the C++ bridge (deeplearning4j_tpu/native/h5.py).
-- No dim-ordering preprocessors: Keras TF models are channels_last/HWIO,
-  which is already this framework's native layout (see layers.py docstring).
+- No runtime dim-ordering preprocessors: Keras TF models are
+  channels_last/HWIO, already this framework's native layout; Theano/
+  channels_first models are converted ONCE at import (kernel transposition
+  + flatten-row permutation) so the running network is always NHWC (see
+  layers.py docstring).
 - The result is a ready MultiLayerNetwork / ComputationGraph with params as
   device pytrees, jit-compiled on first use.
 """
@@ -60,8 +63,13 @@ def _layer_list(model_cfg: dict):
     raise KerasImportError(f"Unsupported Keras model class {cls!r}")
 
 
-def _input_type_from_shape(shape):
-    """Keras batch_input_shape (batch, ...) -> InputType."""
+def _input_type_from_shape(shape, dim_ordering="tf"):
+    """Keras batch_input_shape (batch, ...) -> InputType. channels_first
+    models declare (batch, C, H, W); the network itself always runs NHWC —
+    the importer's job is weight re-layout, not runtime transposition
+    (reference: TensorFlowCnnToFeedForwardPreProcessor.java + the
+    dim-ordering branches in KerasModel; here the transposition happens
+    once at import)."""
     dims = [d for d in shape[1:]]
     if len(dims) == 1:
         return I.feed_forward(int(dims[0]))
@@ -69,9 +77,59 @@ def _input_type_from_shape(shape):
         t, f = dims
         return I.recurrent(int(f), None if t is None else int(t))
     if len(dims) == 3:
-        h, w, ch = dims
+        if dim_ordering == "th":
+            ch, h, w = dims
+        else:
+            h, w, ch = dims
         return I.convolutional(int(h), int(w), int(ch))
     raise KerasImportError(f"Unsupported input shape {shape}")
+
+
+def _model_dim_ordering(keras_layers, backend=None, keras_version=2):
+    """Model-wide dim ordering: any layer declaring channels_first/th makes
+    the model channels_first (Keras forbids mixing); otherwise Keras-1
+    models saved from the Theano backend default to 'th'."""
+    explicit = None
+    for kl in keras_layers:
+        lcfg = kl.get("config", {}) or {}
+        fmt = lcfg.get("data_format", lcfg.get("dim_ordering"))
+        if fmt in ("channels_first", "th"):
+            return "th"
+        if fmt in ("channels_last", "tf"):
+            explicit = "tf"
+    if explicit is None and keras_version == 1 and backend == "theano":
+        return "th"
+    return "tf"
+
+
+def _backend(archive):
+    try:
+        return archive.read_attr_string("backend")
+    except IOError:
+        return None
+
+
+def _cnn_flatten_permutation(h, w, c):
+    """Row permutation taking a Keras channels_first flatten (C-major:
+    index = c*H*W + h*W + w) to this framework's NHWC flatten (index =
+    h*W*C + w*C + c). Apply as W_ours = W_keras[perm]."""
+    return np.arange(c * h * w).reshape(c, h, w).transpose(1, 2, 0).reshape(-1)
+
+
+def _permute_flattened_dense(mapped_params, in_type, layer_desc):
+    """If a dense-family kernel consumes implicitly-flattened conv features
+    from a channels_first model, re-order its input rows."""
+    W = mapped_params.get("W")
+    if W is None or W.ndim != 2:
+        return mapped_params
+    h, w, c = in_type.height, in_type.width, in_type.channels
+    if W.shape[0] != h * w * c:
+        raise KerasImportError(
+            f"{layer_desc}: dense kernel rows {W.shape[0]} do not match "
+            f"flattened conv input {h}x{w}x{c}")
+    out = dict(mapped_params)
+    out["W"] = np.ascontiguousarray(W[_cnn_flatten_permutation(h, w, c)])
+    return out
 
 
 def _training_loss(archive):
@@ -132,13 +190,29 @@ def _assign_params(layer, mapped_params, init_params, layer_desc):
     return out
 
 
+def _pre_adaptation_types(conf):
+    """Per-layer input types BEFORE family adaptation — i.e. what the layer
+    actually receives from upstream, so a FeedForward layer fed conv
+    activations shows the ConvolutionalType being implicitly flattened."""
+    cur = conf.input_type
+    out = []
+    for layer in conf.layers:
+        out.append(cur)
+        fam = layer.input_family
+        if fam is not None and not isinstance(cur, fam):
+            cur = I.adapted_type(cur, fam)
+        cur = layer.output_type(cur)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Sequential
 # ---------------------------------------------------------------------------
 
 
 def import_keras_sequential_config(model_config_json: str,
-                                   keras_version: int = 2):
+                                   keras_version: int = 2,
+                                   dim_ordering: str | None = None):
     """Keras Sequential config JSON -> (MultiLayerConfiguration,
     [(layer_index_or_None, keras_name, weight_mapper)])."""
     model_cfg = json.loads(model_config_json) if isinstance(
@@ -147,6 +221,9 @@ def import_keras_sequential_config(model_config_json: str,
     if cls != "Sequential":
         raise KerasImportError("use import_keras_model_and_weights for "
                                f"{cls!r} models")
+    if dim_ordering is None:
+        dim_ordering = _model_dim_ordering(keras_layers,
+                                           keras_version=keras_version)
     layers = []
     records = []  # (our_layer_index | None, keras_layer_name, weight_mapper)
     input_type = None
@@ -158,8 +235,8 @@ def import_keras_sequential_config(model_config_json: str,
         if input_type is None and shape is not None:
             if "input_shape" in lcfg and "batch_input_shape" not in lcfg:
                 shape = [None] + list(shape)
-            input_type = _input_type_from_shape(shape)
-        layer, wmap = map_layer(lcls, lcfg, keras_version)
+            input_type = _input_type_from_shape(shape, dim_ordering)
+        layer, wmap = map_layer(lcls, lcfg, keras_version, dim_ordering)
         if layer is None:
             records.append((None, name, wmap))
             continue
@@ -182,8 +259,11 @@ def import_keras_sequential_model_and_weights(path: str) -> MultiLayerNetwork:
     importKerasSequentialModelAndWeights:143)."""
     with _open(path) as archive:
         version = _keras_version(archive)
+        model_cfg = _model_config(archive)
+        _, keras_layers = _layer_list(model_cfg)
+        ordering = _model_dim_ordering(keras_layers, _backend(archive), version)
         conf, records = import_keras_sequential_config(
-            json.dumps(_model_config(archive)), version)
+            json.dumps(model_cfg), version, dim_ordering=ordering)
         loss = _training_loss(archive)
         if loss is not None and conf.layers:
             last = conf.layers[-1]
@@ -198,6 +278,7 @@ def import_keras_sequential_model_and_weights(path: str) -> MultiLayerNetwork:
         net.init()
         params = list(net.params)
         state = list(net.state)
+        pre_types = _pre_adaptation_types(conf) if ordering == "th" else None
         for idx, keras_name, wmap in records:
             if idx is None or wmap is None:
                 continue
@@ -205,6 +286,13 @@ def import_keras_sequential_model_and_weights(path: str) -> MultiLayerNetwork:
             if not weights:
                 continue
             mapped_p, mapped_s = wmap(conf.layers[idx], weights)
+            if (pre_types is not None
+                    and isinstance(pre_types[idx], I.ConvolutionalType)
+                    and conf.layers[idx].input_family is I.FeedForwardType):
+                # dense consuming implicitly-flattened conv features: Keras
+                # flattened C-major, we flatten HWC-major
+                mapped_p = _permute_flattened_dense(
+                    mapped_p, pre_types[idx], f"layer {idx} ({keras_name})")
             params[idx] = _assign_params(conf.layers[idx], mapped_p,
                                          params[idx],
                                          f"layer {idx} ({keras_name})")
@@ -247,6 +335,7 @@ def import_keras_model_and_weights(path: str):
         if cls == "Sequential":
             raise KerasImportError("use import_keras_sequential_model_and_weights "
                                    "for Sequential models")
+        ordering = _model_dim_ordering(keras_layers, _backend(archive), version)
         cfg = model_cfg["config"]
         builder = GraphBuilder(updater=_updaters.Sgd(0.01))
         input_names = [inp[0] for inp in cfg.get("input_layers", [])]
@@ -276,7 +365,7 @@ def import_keras_model_and_weights(path: str):
                     srcs.append(entry[0])
             if lcls == "InputLayer":
                 shape = lcfg.get("batch_input_shape") or lcfg.get("batch_shape")
-                input_types[name] = _input_type_from_shape(shape)
+                input_types[name] = _input_type_from_shape(shape, ordering)
                 continue
             kind = _MERGE_MODES.get(lcls)
             if kind is not None:
@@ -285,7 +374,7 @@ def import_keras_model_and_weights(path: str):
                 else:
                     builder.add_vertex(name, MergeVertex(), *srcs)
                 continue
-            layer, wmap = map_layer(lcls, lcfg, version)
+            layer, wmap = map_layer(lcls, lcfg, version, ordering)
             if layer is None:
                 # structural no-op: alias by inserting an identity activation
                 builder.add_vertex(
@@ -322,6 +411,12 @@ def import_keras_model_and_weights(path: str):
                 continue
             vdef = graph._defs[vname]
             mapped_p, mapped_s = wmap(vdef.vertex.layer, weights)
+            if ordering == "th" and vdef.inputs:
+                src_type = graph._types[vdef.inputs[0]]
+                if (isinstance(src_type, I.ConvolutionalType)
+                        and vdef.vertex.layer.input_family is I.FeedForwardType):
+                    mapped_p = _permute_flattened_dense(
+                        mapped_p, src_type, f"vertex {vname!r}")
             params[vname] = _assign_params(
                 vdef.vertex.layer, mapped_p, params[vname],
                 f"vertex {vname!r}")

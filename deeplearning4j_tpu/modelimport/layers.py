@@ -15,7 +15,12 @@ Weight layout notes (why import is mostly a straight copy on TPU):
 - Keras LSTM gate order is i, f, c(candidate), o — identical to
   nn/layers/rnn.py's fused layout; kernel/recurrent_kernel concatenate
   directly onto Wx/Wh.
-- Theano-ordering (channels_first) models are rejected with a clear error.
+- Theano-ordering (channels_first) models import via one-time weight
+  re-layout: conv kernels OIHW->HWIO (_conv_weights_th), and the first
+  dense after an implicit flatten gets its input rows permuted from
+  C-major to HWC-major (keras.py:_permute_flattened_dense) — replacing the
+  reference's runtime preprocessor pair (TensorFlowCnnToFeedForward /
+  CnnToFeedForwardPreProcessor dim-ordering branches).
 """
 
 from __future__ import annotations
@@ -66,9 +71,12 @@ def activation(name):
 class Cfg:
     """Alias-resolving view over a Keras layer config dict."""
 
-    def __init__(self, d, keras_version=2):
+    def __init__(self, d, keras_version=2, default_dim_ordering="tf"):
         self.d = d
         self.version = keras_version
+        # model-level fallback for layers that omit data_format/dim_ordering
+        # (Keras-1 files rely on the backend's image_dim_ordering default)
+        self.default_dim_ordering = default_dim_ordering
 
     def get(self, *names, default=None):
         for n in names:
@@ -84,13 +92,22 @@ class Cfg:
         return v
 
 
-def _check_channels_last(c: Cfg):
-    fmt = c.get("data_format", "dim_ordering", default="channels_last")
-    if fmt in ("channels_last", "tf", None):
-        return
-    raise KerasImportError(
-        "channels_first/theano dim-ordering models are not supported; "
-        "re-export the model with data_format=channels_last")
+def _data_format(c: Cfg):
+    """'tf' (channels_last) or 'th' (channels_first/Theano ordering).
+
+    Reference analog: the dimOrdering plumbing in KerasConvolution /
+    KerasModel (deeplearning4j-modelimport/.../keras/layers/convolutional/
+    KerasConvolution2D.java + KerasLayerUtils) — Keras-1 models saved with
+    the Theano backend default to 'th' and store conv kernels OIHW with
+    channels-first activations."""
+    fmt = c.get("data_format", "dim_ordering", default=None)
+    if fmt in (None, "default"):
+        return c.default_dim_ordering
+    if fmt in ("channels_last", "tf"):
+        return "tf"
+    if fmt in ("channels_first", "th"):
+        return "th"
+    raise KerasImportError(f"Unknown Keras data_format/dim_ordering {fmt!r}")
 
 
 def _pair(v):
@@ -149,6 +166,23 @@ def _dense_weights(layer, weights):
 
 def _conv_weights(layer, weights):
     return _dense_weights(layer, weights)  # HWIO kernel + bias, same keys
+
+
+def _conv_weights_th(layer, weights):
+    """channels_first conv kernels are stored OIHW (Theano layout:
+    [filters, stack, rows, cols]); transpose to this framework's HWIO.
+    The same (2,3,1,0) permutation maps Theano deconvolution kernels
+    [in, out, rows, cols] onto the Keras-2 transpose layout [H, W, out, in]
+    the Deconvolution2DLayer expects."""
+    k = _require(weights, "kernel", "W")
+    if k.ndim != 4:
+        raise KerasImportError(
+            f"channels_first conv kernel must be rank-4, got {k.shape}")
+    p = {"W": np.ascontiguousarray(np.transpose(k, (2, 3, 1, 0)))}
+    b = _w(weights, "bias", "b")
+    if b is not None:
+        p["b"] = b
+    return p, {}
 
 
 def _separable_conv_weights(layer, weights):
@@ -226,7 +260,7 @@ def _map_dense(c: Cfg):
 
 
 def _map_conv2d(c: Cfg):
-    _check_channels_last(c)
+    wmap = _conv_weights_th if _data_format(c) == "th" else _conv_weights
     return (L.ConvolutionLayer(
         n_out=int(c.require("filters", "nb_filter")),
         kernel=_pair(c.get("kernel_size", default=None) or
@@ -235,7 +269,7 @@ def _map_conv2d(c: Cfg):
         padding=_padding(c),
         dilation=_pair(c.get("dilation_rate", default=(1, 1))),
         has_bias=bool(c.get("use_bias", "bias", default=True)),
-        activation=activation(c.get("activation"))), _conv_weights)
+        activation=activation(c.get("activation"))), wmap)
 
 
 def _map_conv1d(c: Cfg):
@@ -253,7 +287,10 @@ def _map_conv1d(c: Cfg):
 
 
 def _map_separable_conv2d(c: Cfg):
-    _check_channels_last(c)
+    if _data_format(c) == "th":
+        raise KerasImportError(
+            "channels_first SeparableConv2D import is not supported; "
+            "re-export with data_format=channels_last")
     return (L.SeparableConvolution2DLayer(
         n_out=int(c.require("filters", "nb_filter")),
         kernel=_pair(c.require("kernel_size")),
@@ -265,18 +302,18 @@ def _map_separable_conv2d(c: Cfg):
 
 
 def _map_conv2d_transpose(c: Cfg):
-    _check_channels_last(c)
+    wmap = _conv_weights_th if _data_format(c) == "th" else _conv_weights
     return (L.Deconvolution2DLayer(
         n_out=int(c.require("filters", "nb_filter")),
         kernel=_pair(c.require("kernel_size")),
         stride=_pair(c.get("strides", default=(1, 1))),
         padding=_padding(c),
         has_bias=bool(c.get("use_bias", default=True)),
-        activation=activation(c.get("activation"))), _conv_weights)
+        activation=activation(c.get("activation"))), wmap)
 
 
 def _map_maxpool2d(c: Cfg):
-    _check_channels_last(c)
+    _data_format(c)  # validate; pool geometry is layout-independent
     pool = _pair(c.get("pool_size", default=(2, 2)))
     return (L.SubsamplingLayer(
         kernel=pool, stride=_pair(c.get("strides", default=None) or pool),
@@ -284,7 +321,7 @@ def _map_maxpool2d(c: Cfg):
 
 
 def _map_avgpool2d(c: Cfg):
-    _check_channels_last(c)
+    _data_format(c)
     pool = _pair(c.get("pool_size", default=(2, 2)))
     return (L.SubsamplingLayer(
         kernel=pool, stride=_pair(c.get("strides", default=None) or pool),
@@ -388,7 +425,7 @@ def _map_leaky_relu(c: Cfg):
 
 
 def _map_zero_padding2d(c: Cfg):
-    _check_channels_last(c)
+    _data_format(c)
     p = c.get("padding", default=(1, 1))
     if isinstance(p, (list, tuple)) and len(p) == 2 and \
             all(isinstance(x, (list, tuple)) for x in p):
@@ -400,7 +437,7 @@ def _map_zero_padding2d(c: Cfg):
 
 
 def _map_upsampling2d(c: Cfg):
-    _check_channels_last(c)
+    _data_format(c)
     return (L.Upsampling2DLayer(size=_pair(c.get("size", default=(2, 2)))), None)
 
 
@@ -455,9 +492,9 @@ MAPPERS = {
 }
 
 
-def map_layer(class_name, config, keras_version=2):
+def map_layer(class_name, config, keras_version=2, default_dim_ordering="tf"):
     """Map one Keras layer config. Returns (layer | None, weight_mapper)."""
     mapper = MAPPERS.get(class_name)
     if mapper is None:
         raise KerasImportError(f"Unsupported Keras layer type {class_name!r}")
-    return mapper(Cfg(config, keras_version))
+    return mapper(Cfg(config, keras_version, default_dim_ordering))

@@ -75,6 +75,18 @@ STEP_FUNCTIONS = {
     "negative_gradient": negative_gradient_step,
 }
 
+# Step functions are named against the reference's convention of applying
+# them to the RAW gradient (negative_* descend). This optimizer's
+# _direction() hooks return pre-negated DESCENT directions, so the function
+# actually applied is the sign-mirrored one: the user-visible name keeps
+# reference semantics while the math stays in descent form.
+_MIRRORED_STEP_FUNCTIONS = {
+    "default": negative_default_step,
+    "negative_default": default_step,
+    "gradient": negative_gradient_step,
+    "negative_gradient": gradient_step,
+}
+
 
 # ---------------------------------------------------------------------------
 # line search (reference: BackTrackLineSearch.java — NR-style lnsrch)
@@ -162,6 +174,7 @@ class BaseConvexOptimizer:
         self.ls_iterations = line_search_iterations
         self.step_max = step_max
         self.step_function = STEP_FUNCTIONS[step_function]
+        self._apply_step = _MIRRORED_STEP_FUNCTIONS[step_function]
 
     # subclass hooks ---------------------------------------------------
     def _init_aux(self, n, dtype):
@@ -193,10 +206,7 @@ class BaseConvexOptimizer:
             step, f_new = backtrack_line_search(
                 flat_loss, x, f0, g, direction,
                 max_iterations=self.ls_iterations, step_max=self.step_max)
-            # step functions operate on the already-negated descent direction,
-            # so "default" addition applies here; negative variants exist for
-            # score-maximization parity.
-            x_new = default_step(x, direction, step)
+            x_new = self._apply_step(x, direction, step)
             return x_new, f_new, aux
 
         x = flat0

@@ -80,6 +80,9 @@ class StreamingBroker:
                 return
             t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
             t.start()
+            # prune finished connection threads so a long-lived broker does
+            # not accumulate one entry per historical connection
+            self._threads = [th for th in self._threads if th.is_alive()]
             self._threads.append(t)
 
     def _serve(self, conn):
@@ -91,7 +94,10 @@ class StreamingBroker:
                 if not ch:
                     return
                 line += ch
-            mode, topic = line.decode().strip().split(" ", 1)
+            parts = line.decode().strip().split(" ", 1)
+            if len(parts) != 2 or parts[0] not in ("SUB", "PUB"):
+                return  # unknown handshake: drop the connection
+            mode, topic = parts
             if mode == "SUB":
                 with self._lock:
                     self._subs[topic].append(conn)

@@ -71,11 +71,15 @@ class ConvolutionalIterationListener(TrainingListener):
             grids = self._conv_activations(model, x)
         except Exception:
             return
-        from PIL import Image
         for li, grid in grids:
             if self.keep_history:
                 self.history.append((iteration, li, grid))
-            if self.output_dir:
+        if self.output_dir:
+            try:
+                from PIL import Image
+            except ImportError:
+                return  # in-memory history still collected above
+            for li, grid in grids:
                 Image.fromarray(grid).save(os.path.join(
                     self.output_dir, f"iter{iteration:06d}_layer{li}.png"))
 

@@ -209,18 +209,109 @@ class TestSequentialImport:
         with pytest.raises(KerasImportError, match="Lambda"):
             import_keras_sequential_model_and_weights(p)
 
-    def test_channels_first_rejected(self, tmp_path):
+    def test_channels_first_equals_channels_last(self, tmp_path):
+        """A channels_first (Theano-ordering) CNN and the channels_last CNN
+        computing the same function must import to identical predictions:
+        conv kernels transpose OIHW->HWIO and the first post-flatten dense
+        kernel's rows re-order from C-major to HWC-major (reference:
+        dim-ordering branches in KerasConvolution2D + the CnnToFeedForward
+        preprocessors)."""
         from deeplearning4j_tpu.modelimport import (
-            KerasImportError, import_keras_sequential_model_and_weights)
-        cfg = _seq_config([
-            {"class_name": "Conv2D",
-             "config": {"name": "c", "filters": 2, "kernel_size": [3, 3],
-                        "data_format": "channels_first",
-                        "batch_input_shape": [None, 1, 8, 8]}}])
-        p = str(tmp_path / "cf.h5")
-        _write_keras_file(p, cfg, {})
-        with pytest.raises(KerasImportError, match="channels_last"):
-            import_keras_sequential_model_and_weights(p)
+            import_keras_sequential_model_and_weights)
+        rs = np.random.RandomState(7)
+        H = W = 8
+        k_hwio = rs.randn(3, 3, 1, 4).astype(np.float32) * 0.3
+        kb = rs.randn(4).astype(np.float32) * 0.1
+        oh = ow = 6  # valid 3x3 on 8x8
+        d_in = oh * ow * 4
+        w_tf = rs.randn(d_in, 3).astype(np.float32) * 0.2   # rows HWC-major
+        b = rs.randn(3).astype(np.float32) * 0.1
+
+        def conv_cfg(fmt, shape):
+            return {"class_name": "Conv2D",
+                    "config": {"name": "conv", "filters": 4,
+                               "kernel_size": [3, 3], "strides": [1, 1],
+                               "padding": "valid", "activation": "relu",
+                               "use_bias": True, "data_format": fmt,
+                               "batch_input_shape": shape}}
+
+        tail = [{"class_name": "Flatten", "config": {"name": "flatten"}},
+                {"class_name": "Dense",
+                 "config": {"name": "fc", "units": 3,
+                            "activation": "softmax"}}]
+
+        p_tf = str(tmp_path / "tf.h5")
+        _write_keras_file(p_tf, _seq_config(
+            [conv_cfg("channels_last", [None, H, W, 1])] + tail), {
+            "conv": [("conv/kernel:0", k_hwio), ("conv/bias:0", kb)],
+            "flatten": [], "fc": [("fc/kernel:0", w_tf), ("fc/bias:0", b)],
+        })
+
+        # the SAME function stored the Theano way: kernel OIHW, input
+        # (None, C, H, W), dense rows C-major (c*OH*OW + h*OW + w)
+        k_oihw = np.transpose(k_hwio, (3, 2, 0, 1))
+        # perm[i] = HWC-major row j for C-major row i, so w_th[i] = w_tf[j]
+        perm = np.arange(d_in).reshape(oh, ow, 4).transpose(2, 0, 1).reshape(-1)
+        w_th = np.ascontiguousarray(w_tf[perm])
+        p_th = str(tmp_path / "th.h5")
+        _write_keras_file(p_th, _seq_config(
+            [conv_cfg("channels_first", [None, 1, H, W])] + tail), {
+            "conv": [("conv/kernel:0", k_oihw), ("conv/bias:0", kb)],
+            "flatten": [], "fc": [("fc/kernel:0", w_th), ("fc/bias:0", b)],
+        })
+
+        net_tf = import_keras_sequential_model_and_weights(p_tf)
+        net_th = import_keras_sequential_model_and_weights(p_th)
+        x = rs.rand(2, H, W, 1).astype(np.float32)
+        out_tf = np.asarray(net_tf.output(x))
+        out_th = np.asarray(net_th.output(x))
+        np.testing.assert_allclose(out_th, out_tf, rtol=1e-5, atol=1e-6)
+        # and the th import really did transpose the kernel
+        np.testing.assert_allclose(
+            np.asarray(net_th.params[0]["W"]), k_hwio, rtol=1e-6)
+
+    def test_keras1_theano_backend_defaults_channels_first(self, tmp_path):
+        """Keras-1 files with backend=theano and no explicit dim_ordering
+        default to channels_first (KerasModel dim-ordering defaulting)."""
+        from deeplearning4j_tpu.modelimport import (
+            import_keras_sequential_model_and_weights)
+        from deeplearning4j_tpu.native.h5 import Hdf5Archive
+        rs = np.random.RandomState(8)
+        k_oihw = rs.randn(2, 1, 3, 3).astype(np.float32) * 0.3
+        cfg = [  # Keras 1 style: config is a bare list
+            {"class_name": "Convolution2D",
+             "config": {"name": "convolution2d_1", "nb_filter": 2,
+                        "nb_row": 3, "nb_col": 3, "border_mode": "valid",
+                        "activation": "relu",
+                        "batch_input_shape": [None, 1, 6, 6]}}]
+        p = str(tmp_path / "k1.h5")
+        with Hdf5Archive(p, "w") as f:
+            f.write_attr_string("model_config", json.dumps(
+                {"class_name": "Sequential", "config": cfg}))
+            f.write_attr_string("keras_version", "1.2.2")
+            f.write_attr_string("backend", "theano")
+            f.make_group("model_weights")
+            f.write_attr_strings("layer_names", ["convolution2d_1"],
+                                 "model_weights")
+            f.make_group("model_weights/convolution2d_1")
+            f.write_attr_strings(
+                "weight_names",
+                ["convolution2d_1_W", "convolution2d_1_b"],
+                "model_weights/convolution2d_1")
+            f.write_dataset(
+                "model_weights/convolution2d_1/convolution2d_1_W", k_oihw)
+            f.write_dataset(
+                "model_weights/convolution2d_1/convolution2d_1_b",
+                np.zeros(2, np.float32))
+        net = import_keras_sequential_model_and_weights(p)
+        # input interpreted as (C=1, H=6, W=6); kernel OIHW -> HWIO
+        t = net.conf.input_type
+        assert (t.height, t.width, t.channels) == (6, 6, 1)
+        np.testing.assert_allclose(
+            np.asarray(net.params[0]["W"]),
+            np.transpose(k_oihw, (2, 3, 1, 0)), rtol=1e-6)
+        out = np.asarray(net.output(rs.rand(1, 6, 6, 1).astype(np.float32)))
+        assert out.shape == (1, 4, 4, 2)  # NHWC conv activations
 
 
 class TestFunctionalImport:
