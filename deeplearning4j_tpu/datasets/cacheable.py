@@ -81,8 +81,11 @@ def ensure_file(relpath, url=None, md5=None, root=None):
             raise ChecksumError(
                 f"Checksum mismatch for {path}: expected {md5}, got {got}; "
                 f"cached file deleted — re-stage it.")
-        with open(marker, "w") as f:
-            f.write(stamp)
+        try:  # best-effort cache: staged data may live on a read-only mount
+            with open(marker, "w") as f:
+                f.write(stamp)
+        except OSError:
+            pass
     return path
 
 

@@ -263,7 +263,7 @@ def import_keras_sequential_model_and_weights(path: str) -> MultiLayerNetwork:
         _, keras_layers = _layer_list(model_cfg)
         ordering = _model_dim_ordering(keras_layers, _backend(archive), version)
         conf, records = import_keras_sequential_config(
-            json.dumps(model_cfg), version, dim_ordering=ordering)
+            model_cfg, version, dim_ordering=ordering)
         loss = _training_loss(archive)
         if loss is not None and conf.layers:
             last = conf.layers[-1]

@@ -173,7 +173,12 @@ class BaseConvexOptimizer:
         self.tolerance = tolerance
         self.ls_iterations = line_search_iterations
         self.step_max = step_max
-        self.step_function = STEP_FUNCTIONS[step_function]
+        if step_function not in STEP_FUNCTIONS:
+            raise ValueError(f"Unknown step_function {step_function!r}; "
+                             f"choose from {sorted(STEP_FUNCTIONS)}")
+        # name follows reference raw-gradient semantics; the function applied
+        # to the pre-negated descent direction is the sign-mirrored one
+        self.step_function_name = step_function
         self._apply_step = _MIRRORED_STEP_FUNCTIONS[step_function]
 
     # subclass hooks ---------------------------------------------------

@@ -46,14 +46,30 @@ def _layer_param_spec(layer, pname, arr):
     return P(*spec)
 
 
+def _layer_param_items(net, params):
+    """(layer, param_dict) pairs for either container: MultiLayerNetwork
+    keeps a list aligned with conf.layers; ComputationGraph keeps a dict
+    keyed by vertex name (layer may be None for layerless vertices)."""
+    if isinstance(params, dict):
+        def layer_of(name):
+            vdef = net._defs.get(name)
+            v = getattr(vdef, "vertex", None)
+            return getattr(v, "layer", None)
+        return [(layer_of(name), name, params[name]) for name in params]
+    return [(layer, i, p) for i, (layer, p)
+            in enumerate(zip(net.conf.layers, params))]
+
+
 def make_param_shardings(mesh: Mesh, net, params, tensor_parallel=False):
-    """Sharding pytree for the params list-of-dicts."""
+    """Sharding pytree matching the params container (list for
+    MultiLayerNetwork, dict for ComputationGraph)."""
     tp_size = mesh.shape["model"]
-    out = []
-    for layer, p in zip(net.conf.layers, params):
+    items = _layer_param_items(net, params)
+    out = {} if isinstance(params, dict) else [None] * len(items)
+    for layer, key, p in items:
         d = {}
         for k, v in p.items():
-            if tensor_parallel and tp_size > 1:
+            if tensor_parallel and tp_size > 1 and layer is not None:
                 spec = _layer_param_spec(layer, k, v)
                 # only shard when divisible
                 ok = all(s is None or v.shape[i] % tp_size == 0
@@ -61,12 +77,13 @@ def make_param_shardings(mesh: Mesh, net, params, tensor_parallel=False):
                 d[k] = NamedSharding(mesh, spec if ok else P())
             else:
                 d[k] = NamedSharding(mesh, P())
-        out.append(d)
+        out[key] = d
     return out
 
 
 class ParallelTrainer:
-    """Sharded trainer around a MultiLayerNetwork's functional core.
+    """Sharded trainer around a MultiLayerNetwork's or ComputationGraph's
+    functional core (both expose the same make_train_step contract).
 
     Usage:
         trainer = ParallelTrainer(net, mesh)
@@ -92,12 +109,16 @@ class ParallelTrainer:
         params, state = self.net.init(rng)
         self.param_shardings = make_param_shardings(self.mesh, self.net, params,
                                                     self.tensor_parallel)
-        put = lambda tree, sh: jax.tree_util.tree_map(
-            jax.device_put, tree, sh) if isinstance(sh, list) else jax.device_put(tree, sh)
-        self.params = [
-            {k: jax.device_put(v, self.param_shardings[i][k]) for k, v in p.items()}
-            for i, p in enumerate(params)
-        ]
+        if isinstance(params, dict):
+            self.params = {
+                name: {k: jax.device_put(v, self.param_shardings[name][k])
+                       for k, v in p.items()}
+                for name, p in params.items()}
+        else:
+            self.params = [
+                {k: jax.device_put(v, self.param_shardings[i][k])
+                 for k, v in p.items()}
+                for i, p in enumerate(params)]
         repl = NamedSharding(self.mesh, P())
         self.state = jax.device_put(state, repl)
         self.opt_state = jax.device_put(self.net.conf.updater.init(params), repl)

@@ -94,9 +94,9 @@ class StreamingBroker:
                 if not ch:
                     return
                 line += ch
-            parts = line.decode().strip().split(" ", 1)
+            parts = line.decode(errors="replace").strip().split(" ", 1)
             if len(parts) != 2 or parts[0] not in ("SUB", "PUB"):
-                return  # unknown handshake: drop the connection
+                return  # unknown/garbage handshake: drop the connection
             mode, topic = parts
             if mode == "SUB":
                 with self._lock:

@@ -1,0 +1,80 @@
+"""Character-level text generation with a GravesLSTM stack.
+
+Reference analog: dl4j-examples GravesLSTMCharModellingExample /
+TextGenerationLSTM (models/misc.py, BASELINE.md config #4): one-hot chars ->
+stacked GravesLSTM -> per-timestep softmax, trained with TBPTT, then
+free-running sampling via rnn_time_step.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+from deeplearning4j_tpu.models import text_generation_lstm
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+# a public-domain training corpus stand-in (Lincoln, Gettysburg Address)
+CORPUS = (
+    "four score and seven years ago our fathers brought forth on this "
+    "continent a new nation conceived in liberty and dedicated to the "
+    "proposition that all men are created equal now we are engaged in a "
+    "great civil war testing whether that nation or any nation so conceived "
+    "and so dedicated can long endure we are met on a great battle field of "
+    "that war we have come to dedicate a portion of that field as a final "
+    "resting place for those who here gave their lives that that nation "
+    "might live it is altogether fitting and proper that we should do this "
+) * 4
+
+SEQ_LEN = 32
+HIDDEN = 64
+
+
+def one_hot_batches(text, vocab, seq_len):
+    idx = np.array([vocab[ch] for ch in text], np.int64)
+    n = (len(idx) - 1) // seq_len
+    xs = idx[:n * seq_len].reshape(n, seq_len)
+    ys = idx[1:n * seq_len + 1].reshape(n, seq_len)
+    eye = np.eye(len(vocab), dtype=np.float32)
+    return eye[xs], eye[ys]
+
+
+def sample(net, vocab, inv_vocab, seed_text="the ", n_chars=80, temp=0.8,
+           rng=np.random.RandomState(7)):
+    net.rnn_clear_previous_state()
+    eye = np.eye(len(vocab), dtype=np.float32)
+    out = None
+    for ch in seed_text:
+        out = net.rnn_time_step(eye[None, None, vocab[ch]])
+    chars = list(seed_text)
+    for _ in range(n_chars):
+        p = np.asarray(out)[0, -1]
+        p = np.exp(np.log(np.maximum(p, 1e-9)) / temp)
+        p /= p.sum()
+        nxt = rng.choice(len(vocab), p=p)
+        chars.append(inv_vocab[nxt])
+        out = net.rnn_time_step(eye[None, None, nxt])
+    return "".join(chars)
+
+
+def main():
+    vocab = {ch: i for i, ch in enumerate(sorted(set(CORPUS)))}
+    inv_vocab = {i: ch for ch, i in vocab.items()}
+    x, y = one_hot_batches(CORPUS, vocab, SEQ_LEN)
+    print(f"vocab {len(vocab)}, {len(x)} sequences of {SEQ_LEN}")
+
+    conf = text_generation_lstm(len(vocab), hidden=HIDDEN, seq_len=SEQ_LEN,
+                                updater=U.Adam(learning_rate=3e-3))
+    net = MultiLayerNetwork(conf)
+    net.init()
+    for epoch in range(3):
+        net.fit(x, y, epochs=1, batch_size=16)
+        print(f"epoch {epoch}: loss {float(net.score(x, y)):.3f}")
+    print("sample:", sample(net, vocab, inv_vocab))
+
+
+if __name__ == "__main__":
+    main()

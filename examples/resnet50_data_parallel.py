@@ -1,0 +1,55 @@
+"""Data-parallel training of the zoo ResNet50 over a device mesh.
+
+Reference analog: dl4j-examples MultiGpuLenetMnistExample + ParallelWrapper
+(ParallelWrapper.java:58) — here the mesh + shard_map replaces the
+replica-thread machinery: one jitted step consumes the global batch sharded
+over the ``data`` axis and psums gradients over ICI.
+
+Runs on whatever devices exist (TPU pod slice or CPU). With no accelerator
+it requests 8 virtual CPU devices so the sharding is still exercised.
+Shapes are kept tiny (32x32, 2 steps) so the walkthrough finishes fast; on
+real hardware raise them to BASELINE.md config #2's 224x224.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+if "XLA_FLAGS" not in os.environ:  # harmless when a real accelerator exists
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.datasets.fetchers import SyntheticDataFetcher  # noqa: E402
+from deeplearning4j_tpu.models import resnet50  # noqa: E402
+from deeplearning4j_tpu.nn import updaters as U  # noqa: E402
+from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: E402
+from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer  # noqa: E402
+
+
+def main():
+    import jax
+    devices = jax.devices()
+    print(f"{len(devices)} device(s): {devices[0].platform}")
+
+    conf = resnet50(height=32, width=32, channels=3, n_classes=10,
+                    updater=U.Sgd(learning_rate=0.01))
+    net = ComputationGraph(conf)
+    net.init()
+
+    per_device_batch = 4
+    global_batch = per_device_batch * len(devices)
+    data = SyntheticDataFetcher(2 * global_batch, (32, 32, 3), 10, seed=3)
+
+    trainer = ParallelTrainer(net)
+    for step in range(2):
+        lo = step * global_batch
+        loss = trainer.step(data.features[lo:lo + global_batch],
+                            data.labels[lo:lo + global_batch])
+        print(f"step {step}: loss {float(loss):.4f} "
+              f"(global batch {global_batch} over {len(devices)} devices)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""2-process jax.distributed worker used by test_distributed_multiprocess.py.
+
+Usage: python distributed_worker.py <process_id> <num_processes> <coord_port>
+
+Each process owns ONE local CPU device; jax.distributed joins them into a
+2-device global mesh and SharedTrainingMaster's psum rides the cross-process
+collective transport — the multi-host execution path the reference exercises
+via local-mode Spark clusters (BaseSparkTest.java:89).
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # exactly one local device per process
+
+import jax  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    from deeplearning4j_tpu.parallel.distributed import (
+        SharedTrainingMaster, initialize_distributed)
+    assert initialize_distributed(coordinator_address=f"127.0.0.1:{port}",
+                                  num_processes=nproc, process_id=pid)
+    assert len(jax.local_devices()) == 1
+    assert len(jax.devices()) == nproc, jax.devices()
+
+    import numpy as np
+    from jax.sharding import Mesh
+    from deeplearning4j_tpu.nn import layers as L, updaters as U
+    from deeplearning4j_tpu.nn.conf import inputs as I
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rs = np.random.RandomState(0)  # same data on every process
+    x = rs.randn(32, 6).astype(np.float32)
+    y = np.eye(3)[rs.randint(0, 3, 32)].astype(np.float32)
+
+    conf = NeuralNetConfig(seed=11, updater=U.Sgd(learning_rate=0.1)).list(
+        L.DenseLayer(n_out=8, activation="tanh"),
+        L.OutputLayer(n_out=3, loss="mcxent"),
+        input_type=I.FeedForwardType(6))
+    net = MultiLayerNetwork(conf)
+    net.init()
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    master = SharedTrainingMaster(mesh, batch_size_per_worker=8,
+                                  threshold=None)  # exact psum mode
+    loss = master.execute_training(net, x, y, epochs=3)
+
+    leaves = jax.tree_util.tree_leaves(net.params)
+    checksum = float(sum(np.abs(np.asarray(l)).sum() for l in leaves))
+    print(json.dumps({"process": pid, "loss": loss, "checksum": checksum,
+                      "n_devices": len(jax.devices())}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

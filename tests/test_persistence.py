@@ -2,6 +2,7 @@
 (reference: ModelSerializer tests, EarlyStoppingTests, TransferLearning tests
 in deeplearning4j-core)."""
 
+import os
 import numpy as np
 import pytest
 
@@ -174,3 +175,40 @@ class TestTransferLearning:
         tail = helper.unfrozen_net()
         preds = tail.output(feats)
         np.testing.assert_allclose(np.asarray(preds), np.asarray(net.output(x)), rtol=1e-5)
+
+
+class TestCheckpointRegression:
+    """Golden-file regression: checkpoints committed in a PREVIOUS round must
+    keep loading byte-for-byte (reference analog: regressiontest/
+    RegressionTest050.java—080 pinning 0.5.0—0.8.0 zips). Regenerate only on
+    an intentional FORMAT_VERSION bump via make_checkpoint_fixtures.py."""
+
+    FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+    def _check(self, name):
+        import json
+        from deeplearning4j_tpu.utils.serialization import (
+            FORMAT_VERSION, load_model)
+        with open(os.path.join(self.FIXTURES, "checkpoint_manifest.json")) as f:
+            manifest = json.load(f)
+        v = manifest["format_version"]
+        assert v <= FORMAT_VERSION, \
+            "committed fixtures are newer than the loader"
+        net = load_model(os.path.join(self.FIXTURES, f"{name}_v{v}.zip"))
+        x = np.load(os.path.join(self.FIXTURES, f"{name}_v{v}_input.npy"))
+        want = np.load(os.path.join(self.FIXTURES, f"{name}_v{v}_expected.npy"))
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        # Adam state must have survived (resume-equivalence contract)
+        assert net.opt_state is not None
+        return net
+
+    def test_mlp_adam_fixture(self):
+        self._check("mlp_adam")
+
+    def test_cnn_adam_fixture(self):
+        self._check("cnn_adam")
+
+    def test_lstm_adam_fixture(self):
+        net = self._check("lstm_adam")
+        assert net.iteration > 0  # training progress restored
