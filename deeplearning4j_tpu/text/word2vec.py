@@ -61,18 +61,25 @@ class AliasTable:
         return np.where(accept, idx, self.alias[idx]).astype(np.int32)
 
 
-def _scatter_mean_update(table, idx, grads, lr):
+def _scatter_mean_update(table, idx, grads, lr, axis=None):
     """Apply -lr * (per-row MEAN of grads) at idx. With unique indices this
     equals per-pair SGD; under collisions (small vocab / large batch) it stays
     stable where a raw scatter-ADD would multiply the step by the collision
-    count and diverge (the reference's Hogwild applies pairs one at a time)."""
-    d = grads.shape[-1]
+    count and diverge (the reference's Hogwild applies pairs one at a time).
+
+    ``axis``: inside shard_map, psum the scatter numerator/denominator over
+    the mesh axis BEFORE dividing — the update over a batch sharded across
+    devices is then exactly the single-device update over the global batch
+    (distributed Word2Vec, see SequenceVectors(mesh=...))."""
     num = jnp.zeros_like(table).at[idx].add(grads)
     cnt = jnp.zeros(table.shape[0], grads.dtype).at[idx].add(1.0)
+    if axis is not None:
+        num = jax.lax.psum(num, axis)
+        cnt = jax.lax.psum(cnt, axis)
     return table - lr * num / jnp.maximum(cnt, 1.0)[:, None]
 
 
-def _sgns_math(syn0, syn1neg, centers, contexts, negatives, lr):
+def _sgns_math(syn0, syn1neg, centers, contexts, negatives, lr, axis=None):
     """One batched skip-gram negative-sampling update.
 
     centers [B], contexts [B], negatives [B,K]; returns (syn0, syn1neg, loss).
@@ -93,18 +100,20 @@ def _sgns_math(syn0, syn1neg, centers, contexts, negatives, lr):
     grad_u_pos = g_pos * v
     grad_u_neg = g_neg * v[:, None, :]
 
-    syn0 = _scatter_mean_update(syn0, centers, grad_v, lr)
+    syn0 = _scatter_mean_update(syn0, centers, grad_v, lr, axis)
     u_idx = jnp.concatenate([contexts, negatives.reshape(-1)])
     u_grads = jnp.concatenate([grad_u_pos,
                                grad_u_neg.reshape(-1, grad_u_neg.shape[-1])])
-    syn1neg = _scatter_mean_update(syn1neg, u_idx, u_grads, lr)
+    syn1neg = _scatter_mean_update(syn1neg, u_idx, u_grads, lr, axis)
 
     loss = -jnp.mean(jnp.log(jnp.clip(s_pos, 1e-9, 1.0))
                      + jnp.sum(jnp.log(jnp.clip(1.0 - s_neg, 1e-9, 1.0)), axis=1))
+    if axis is not None:
+        loss = jax.lax.pmean(loss, axis)
     return syn0, syn1neg, loss
 
 
-def _hs_math(syn0, syn1, centers, points, codes, path_mask, lr):
+def _hs_math(syn0, syn1, centers, points, codes, path_mask, lr, axis=None):
     """Hierarchical-softmax skip-gram update.
 
     points/codes/path_mask: [B, L] padded Huffman paths. Loss:
@@ -120,15 +129,19 @@ def _hs_math(syn0, syn1, centers, points, codes, path_mask, lr):
     grad_v = jnp.einsum("bl,bld->bd", g, u)
     grad_u = g[..., None] * v[:, None, :]
 
-    syn0 = _scatter_mean_update(syn0, centers, grad_v, lr)
+    syn0 = _scatter_mean_update(syn0, centers, grad_v, lr, axis)
     syn1 = _scatter_mean_update(syn1, points.reshape(-1),
-                                grad_u.reshape(-1, grad_u.shape[-1]), lr)
+                                grad_u.reshape(-1, grad_u.shape[-1]), lr,
+                                axis)
     loss = -jnp.sum(jnp.log(jnp.clip(s, 1e-9, 1.0)) * path_mask) / \
         jnp.maximum(jnp.sum(path_mask), 1.0)
+    if axis is not None:
+        loss = jax.lax.pmean(loss, axis)
     return syn0, syn1, loss
 
 
-def _cbow_math(syn0, syn1neg, context_idx, context_mask, targets, negatives, lr):
+def _cbow_math(syn0, syn1neg, context_idx, context_mask, targets, negatives, lr,
+               axis=None):
     """CBOW-NS: mean of context vectors predicts the target (reference: CBOW.java)."""
     ctx = jnp.take(syn0, context_idx, axis=0)      # [B,W,D]
     m = context_mask[..., None]
@@ -143,13 +156,16 @@ def _cbow_math(syn0, syn1neg, context_idx, context_mask, targets, negatives, lr)
     grad_ctx = (grad_h[:, None, :] / counts[..., None]) * m
     # mask padded slots to index 0 with zero gradient (mean-normalized scatter)
     syn0 = _scatter_mean_update(syn0, context_idx.reshape(-1),
-                                grad_ctx.reshape(-1, grad_ctx.shape[-1]), lr)
+                                grad_ctx.reshape(-1, grad_ctx.shape[-1]), lr,
+                                axis)
     u_idx = jnp.concatenate([targets, negatives.reshape(-1)])
     u_grads = jnp.concatenate([
         g_pos * h, (s_neg[..., None] * h[:, None, :]).reshape(-1, h.shape[-1])])
-    syn1neg = _scatter_mean_update(syn1neg, u_idx, u_grads, lr)
+    syn1neg = _scatter_mean_update(syn1neg, u_idx, u_grads, lr, axis)
     loss = -jnp.mean(jnp.log(jnp.clip(s_pos, 1e-9, 1.0))
                      + jnp.sum(jnp.log(jnp.clip(1.0 - s_neg, 1e-9, 1.0)), axis=1))
+    if axis is not None:
+        loss = jax.lax.pmean(loss, axis)
     return syn0, syn1neg, loss
 
 
@@ -177,6 +193,49 @@ _hs_epoch = _epoch_scan(_hs_math)
 _cbow_epoch = _epoch_scan(_cbow_math)
 
 
+def _dist_fns(math_fn, mesh):
+    """shard_map'd (step, epoch) pair: index batches shard over the mesh
+    ``data`` axis, embedding tables stay replicated, and the scatter
+    numerator/denominator psum inside the kernel — every device applies the
+    identical update, equal to the single-device update over the global
+    batch.
+
+    Reference analog: dl4j-spark-nlp Word2Vec/ParagraphVectors
+    (spark/dl4j-spark-nlp/.../Word2Vec.java — per-epoch parameter averaging
+    over Spark workers). The TPU redesign pools gradients every BATCH over
+    ICI instead of averaging parameters every EPOCH over the driver, which
+    is both cheaper (one psum per step) and exact.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    axis_math = functools.partial(math_fn, axis="data")
+
+    def step(syn0, syn1, *rest):
+        batch, lr = rest[:-1], rest[-1]
+        return axis_math(syn0, syn1, *batch, lr)
+
+    def epoch(syn0, syn1, batches, lr):
+        def body(carry, batch):
+            s0, s1, loss = axis_math(*carry, *batch, lr)
+            return (s0, s1), loss
+        (syn0, syn1), losses = jax.lax.scan(body, (syn0, syn1), batches)
+        return syn0, syn1, losses
+
+    def make(fn, scan_dim):
+        def sharded(syn0, syn1, *rest):
+            batch, lr = rest[:-1], rest[-1]
+            spec = P(None, "data") if scan_dim else P("data")
+            f = jax.shard_map(
+                fn, mesh=mesh,
+                in_specs=(P(), P()) + tuple(spec for _ in batch) + (P(),),
+                out_specs=(P(), P(), P()),
+                check_vma=False)
+            return f(syn0, syn1, *batch, lr)
+        return jax.jit(sharded, donate_argnums=(0, 1))
+
+    return make(step, False), make(epoch, True)
+
+
 class SequenceVectors:
     """Generic embedding trainer over element sequences (reference:
     SequenceVectors.java — Word2Vec, DeepWalk walks, ParagraphVectors all run
@@ -185,7 +244,9 @@ class SequenceVectors:
     def __init__(self, *, vector_size=100, window=5, min_count=5, negative=5,
                  learning_rate=0.025, min_learning_rate=1e-4, epochs=1,
                  batch_size=2048, subsample=1e-3, use_hierarchic_softmax=False,
-                 algorithm="skipgram", seed=123):
+                 algorithm="skipgram", seed=123, mesh=None):
+        self.mesh = mesh  # jax Mesh with a "data" axis -> distributed fit
+        self._dist_cache = {}
         self.vector_size = vector_size
         self.window = window
         self.min_count = min_count
@@ -367,7 +428,28 @@ class SequenceVectors:
         """Split aligned arrays into SCAN_CHUNK-sized groups of [B, ...] full
         batches, each group executed as ONE scanned jit call; leftover full
         batches and the ragged tail go through the per-step jit. Returns the
-        list of (device) per-batch losses."""
+        list of (device) per-batch losses.
+
+        With a mesh, batches shard over the ``data`` axis (psum-pooled
+        scatter stats — see _dist_fns); ragged tails truncate to a multiple
+        of the axis size (at most n_devices-1 pairs dropped per epoch,
+        recorded in ``examples_dropped``)."""
+        if self.mesh is not None:
+            key = id(epoch_fn)
+            if key not in self._dist_cache:
+                base = {id(_sgns_epoch): _sgns_math, id(_hs_epoch): _hs_math,
+                        id(_cbow_epoch): _cbow_math}[key]
+                self._dist_cache[key] = _dist_fns(base, self.mesh)
+            step_fn, epoch_fn = (self._dist_cache[key][0],
+                                 self._dist_cache[key][1])
+            nd = self.mesh.shape["data"]
+            n_keep = (len(arrays[0]) // nd) * nd
+            self.examples_dropped = getattr(self, "examples_dropped", 0) + \
+                (len(arrays[0]) - n_keep)
+            arrays = tuple(a[:n_keep] for a in arrays)
+            if self.batch_size % nd:
+                raise ValueError(f"batch_size {self.batch_size} must divide "
+                                 f"by mesh data axis {nd}")
         n = len(arrays[0])
         bs = self.batch_size
         ck = self.SCAN_CHUNK
