@@ -67,15 +67,16 @@ def _scatter_mean_update(table, idx, grads, lr, axis=None):
     stable where a raw scatter-ADD would multiply the step by the collision
     count and diverge (the reference's Hogwild applies pairs one at a time).
 
-    ``axis``: inside shard_map, psum the scatter numerator/denominator over
-    the mesh axis BEFORE dividing — the update over a batch sharded across
-    devices is then exactly the single-device update over the global batch
-    (distributed Word2Vec, see SequenceVectors(mesh=...))."""
+    ``axis``: inside shard_map, all_gather the (idx, grads) pairs over the
+    mesh axis first, then scatter the GLOBAL batch locally — every device
+    applies the identical update, equal to the single-device update over the
+    global batch. Communication is O(batch * dim), independent of vocab size
+    (a psum of the dense tables would be O(vocab * dim) per step)."""
+    if axis is not None:
+        idx = jax.lax.all_gather(idx, axis, tiled=True)
+        grads = jax.lax.all_gather(grads, axis, tiled=True)
     num = jnp.zeros_like(table).at[idx].add(grads)
     cnt = jnp.zeros(table.shape[0], grads.dtype).at[idx].add(1.0)
-    if axis is not None:
-        num = jax.lax.psum(num, axis)
-        cnt = jax.lax.psum(cnt, axis)
     return table - lr * num / jnp.maximum(cnt, 1.0)[:, None]
 
 
@@ -169,12 +170,9 @@ def _cbow_math(syn0, syn1neg, context_idx, context_mask, targets, negatives, lr,
     return syn0, syn1neg, loss
 
 
-def _epoch_scan(math_fn):
-    """Wrap a per-batch update into a whole-epoch lax.scan: all full batches
-    execute inside ONE jitted computation, eliminating per-step dispatch +
-    host sync (the role of the reference's Hogwild thread pool feeding the
-    native batched kernel, SequenceVectors.java:292-296)."""
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
+def _epoch_body(math_fn):
+    """Whole-epoch scan body over stacked batches (shared by the jitted
+    single-device path and the shard_map'd distributed path)."""
     def epoch(syn0, syn1, batches, lr):
         def body(carry, batch):
             s0, s1, loss = math_fn(*carry, *batch, lr)
@@ -182,6 +180,15 @@ def _epoch_scan(math_fn):
         (syn0, syn1), losses = jax.lax.scan(body, (syn0, syn1), batches)
         return syn0, syn1, losses
     return epoch
+
+
+def _epoch_scan(math_fn):
+    """Wrap a per-batch update into a whole-epoch lax.scan: all full batches
+    execute inside ONE jitted computation, eliminating per-step dispatch +
+    host sync (the role of the reference's Hogwild thread pool feeding the
+    native batched kernel, SequenceVectors.java:292-296)."""
+    return functools.partial(jax.jit, donate_argnums=(0, 1))(
+        _epoch_body(math_fn))
 
 
 # per-batch jitted steps (tail batches, tests) + whole-epoch scans
@@ -195,16 +202,16 @@ _cbow_epoch = _epoch_scan(_cbow_math)
 
 def _dist_fns(math_fn, mesh):
     """shard_map'd (step, epoch) pair: index batches shard over the mesh
-    ``data`` axis, embedding tables stay replicated, and the scatter
-    numerator/denominator psum inside the kernel — every device applies the
-    identical update, equal to the single-device update over the global
-    batch.
+    ``data`` axis, embedding tables stay replicated, and the kernels
+    all_gather (idx, grads) pairs before scattering — every device applies
+    the identical update, equal to the single-device update over the global
+    batch, with O(batch * dim) traffic per step.
 
-    Reference analog: dl4j-spark-nlp Word2Vec/ParagraphVectors
-    (spark/dl4j-spark-nlp/.../Word2Vec.java — per-epoch parameter averaging
-    over Spark workers). The TPU redesign pools gradients every BATCH over
-    ICI instead of averaging parameters every EPOCH over the driver, which
-    is both cheaper (one psum per step) and exact.
+    Reference analog: dl4j-spark-nlp Word2Vec (spark/dl4j-spark-nlp/.../
+    Word2Vec.java — per-epoch parameter averaging over Spark workers). The
+    TPU redesign pools gradients every BATCH over ICI instead of averaging
+    parameters every EPOCH over the driver, which is exact rather than
+    approximate.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -214,12 +221,7 @@ def _dist_fns(math_fn, mesh):
         batch, lr = rest[:-1], rest[-1]
         return axis_math(syn0, syn1, *batch, lr)
 
-    def epoch(syn0, syn1, batches, lr):
-        def body(carry, batch):
-            s0, s1, loss = axis_math(*carry, *batch, lr)
-            return (s0, s1), loss
-        (syn0, syn1), losses = jax.lax.scan(body, (syn0, syn1), batches)
-        return syn0, syn1, losses
+    epoch = _epoch_body(axis_math)
 
     def make(fn, scan_dim):
         def sharded(syn0, syn1, *rest):
@@ -246,7 +248,12 @@ class SequenceVectors:
                  batch_size=2048, subsample=1e-3, use_hierarchic_softmax=False,
                  algorithm="skipgram", seed=123, mesh=None):
         self.mesh = mesh  # jax Mesh with a "data" axis -> distributed fit
+        if mesh is not None and batch_size % mesh.shape["data"]:
+            raise ValueError(
+                f"batch_size {batch_size} must divide by the mesh data "
+                f"axis size {mesh.shape['data']}")
         self._dist_cache = {}
+        self.examples_dropped = 0
         self.vector_size = vector_size
         self.window = window
         self.min_count = min_count
@@ -387,6 +394,7 @@ class SequenceVectors:
         the reference gets the same overlap from its prefetch threads).
         """
         seq_list = [list(s) for s in sequences]
+        self.examples_dropped = 0
         if self.vocab is None:
             self.build_vocab(seq_list)
         corpus = self._encode_corpus(seq_list)  # once, not per epoch
@@ -402,7 +410,8 @@ class SequenceVectors:
                 ctx, cmask, targets = ctx[perm], cmask[perm], targets[perm]
                 negs = self._draw_negatives((len(targets), self.negative))
                 losses += self._run_batched(
-                    _cbow_epoch, _cbow_step, (ctx, cmask, targets, negs), lr)
+                    _cbow_epoch, _cbow_step, (ctx, cmask, targets, negs),
+                    lr, math_fn=_cbow_math)
                 continue
             centers, contexts = self._pairs_from_corpus(
                 *self._subsampled(*corpus))
@@ -411,11 +420,13 @@ class SequenceVectors:
             if self.use_hs:
                 pts, codes, mask = self._huffman_batch(contexts)
                 losses += self._run_batched(
-                    _hs_epoch, _hs_step, (centers, pts, codes, mask), lr)
+                    _hs_epoch, _hs_step, (centers, pts, codes, mask),
+                    lr, math_fn=_hs_math)
             else:
                 negs = self._draw_negatives((len(centers), self.negative))
                 losses += self._run_batched(
-                    _sgns_epoch, _sgns_step, (centers, contexts, negs), lr)
+                    _sgns_epoch, _sgns_step, (centers, contexts, negs),
+                    lr, math_fn=_sgns_math)
         self.loss_history = [float(l) for l in losses]  # one sync, at the end
         return self
 
@@ -424,7 +435,7 @@ class SequenceVectors:
     # size into the compiled shape)
     SCAN_CHUNK = 32
 
-    def _run_batched(self, epoch_fn, step_fn, arrays, lr):
+    def _run_batched(self, epoch_fn, step_fn, arrays, lr, math_fn=None):
         """Split aligned arrays into SCAN_CHUNK-sized groups of [B, ...] full
         batches, each group executed as ONE scanned jit call; leftover full
         batches and the ragged tail go through the per-step jit. Returns the
@@ -435,21 +446,13 @@ class SequenceVectors:
         of the axis size (at most n_devices-1 pairs dropped per epoch,
         recorded in ``examples_dropped``)."""
         if self.mesh is not None:
-            key = id(epoch_fn)
-            if key not in self._dist_cache:
-                base = {id(_sgns_epoch): _sgns_math, id(_hs_epoch): _hs_math,
-                        id(_cbow_epoch): _cbow_math}[key]
-                self._dist_cache[key] = _dist_fns(base, self.mesh)
-            step_fn, epoch_fn = (self._dist_cache[key][0],
-                                 self._dist_cache[key][1])
+            if math_fn not in self._dist_cache:
+                self._dist_cache[math_fn] = _dist_fns(math_fn, self.mesh)
+            step_fn, epoch_fn = self._dist_cache[math_fn]
             nd = self.mesh.shape["data"]
             n_keep = (len(arrays[0]) // nd) * nd
-            self.examples_dropped = getattr(self, "examples_dropped", 0) + \
-                (len(arrays[0]) - n_keep)
+            self.examples_dropped += len(arrays[0]) - n_keep
             arrays = tuple(a[:n_keep] for a in arrays)
-            if self.batch_size % nd:
-                raise ValueError(f"batch_size {self.batch_size} must divide "
-                                 f"by mesh data axis {nd}")
         n = len(arrays[0])
         bs = self.batch_size
         ck = self.SCAN_CHUNK
