@@ -146,11 +146,20 @@ def _train_bench(raw_step, p, s, o, args, warmup, iters):
     for _ in range(warmup):
         p, s, o, loss = run_once(p, s, o)
     jax.block_until_ready(loss)
+    # BENCH_PROFILE=<dir>: capture an xprof/TensorBoard trace of the timed
+    # window (per-op device time, HBM traffic, MXU utilization — the data
+    # behind any MFU improvement claim)
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
     for _ in range(iters):
         p, s, o, loss = run_once(p, s, o)
     jax.block_until_ready(loss)
     dt = (time.perf_counter() - t0) / iters
+    if profile_dir:
+        jax.profiler.stop_trace()
+        info["profile_dir"] = profile_dir
     info["final_loss"] = float(jax.device_get(loss))
     return dt, info
 

@@ -111,3 +111,67 @@ class EvaluativeListener(TrainingListener):
             self.results.append((iteration, self.evaluator(preds, self.labels)))
         else:
             self.results.append((iteration, preds))
+
+
+class ProfilerListener(TrainingListener):
+    """Capture a jax.profiler trace for a window of training iterations.
+
+    SURVEY.md §5 tracing row: the reference ships OpProfiler / per-op timing
+    inside libnd4j; on TPU the authoritative per-op timeline is XLA's own
+    profiler (xprof/TensorBoard "trace_viewer"). This listener brackets
+    iterations [start_iteration, start_iteration + n_iterations) in
+    jax.profiler.start_trace / stop_trace; point TensorBoard at ``log_dir``
+    (or xprof) to see per-op device time, HBM traffic, and MXU utilization.
+
+    Also snapshots jax.profiler.device_memory_profile() at trace end when
+    ``memory_profile=True`` (pprof format, <log_dir>/memory.pprof).
+    """
+
+    def __init__(self, log_dir, *, start_iteration=10, n_iterations=5,
+                 memory_profile=False, print_fn=None):
+        self.log_dir = str(log_dir)
+        self.start_iteration = start_iteration
+        self.n_iterations = n_iterations
+        self.memory_profile = memory_profile
+        self.print_fn = print_fn or (lambda s: logger.info(s))
+        self._active = False
+        self.completed = False
+        self.traced_iterations = 0
+
+    def iteration_done(self, model, iteration, score, etl_time=0.0):
+        import jax
+        # iteration_done(i) fires AFTER iteration i's step: open the trace
+        # once iteration start-1 has finished so iteration `start` itself is
+        # the first one captured (the window spans epoch boundaries)
+        if (not self._active and not self.completed
+                and iteration >= self.start_iteration - 1):
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            self._t0 = time.perf_counter()
+            return
+        if self._active:
+            self.traced_iterations += 1
+            if self.traced_iterations >= self.n_iterations:
+                # block on the last result so device work lands in the trace
+                jax.block_until_ready(
+                    jax.tree_util.tree_leaves(
+                        getattr(model, "params", []))[:1])
+                self.close()
+
+    def close(self):
+        """Stop the trace. Called automatically when the window completes;
+        call explicitly if training can end before the window does."""
+        if not self._active:
+            return
+        import jax
+        jax.profiler.stop_trace()
+        self._active = False
+        self.completed = True
+        if self.memory_profile:
+            import os
+            prof = jax.profiler.device_memory_profile()
+            with open(os.path.join(self.log_dir, "memory.pprof"), "wb") as f:
+                f.write(prof)
+        self.print_fn(
+            f"profiler trace: {self.traced_iterations} iterations in "
+            f"{time.perf_counter() - self._t0:.2f}s -> {self.log_dir}")

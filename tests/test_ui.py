@@ -1,6 +1,7 @@
 """Stats listener / storage / UI server tests (reference: TestStatsListener,
 TestRemoteReceiver in deeplearning4j-ui-parent)."""
 
+import os
 import json
 import urllib.request
 
@@ -128,3 +129,36 @@ class TestConvVisualization:
         assert len(lst.history) >= 2
         pngs = [f for f in os.listdir(str(tmp_path)) if f.endswith(".png")]
         assert len(pngs) >= 2
+
+
+class TestProfilerListener:
+    def test_trace_window_produces_artifacts(self, tmp_path):
+        """ProfilerListener brackets a window of iterations in a
+        jax.profiler trace (SURVEY §5 tracing row)."""
+        from deeplearning4j_tpu.nn import layers as L, updaters as U
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.listeners import ProfilerListener
+
+        conf = NeuralNetConfig(seed=1, updater=U.Sgd(learning_rate=0.1)).list(
+            L.DenseLayer(n_out=8, activation="tanh"),
+            L.OutputLayer(n_out=2, loss="mcxent"),
+            input_type=I.FeedForwardType(4))
+        net = MultiLayerNetwork(conf)
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 4).astype(np.float32)
+        y = np.eye(2)[rs.randint(0, 2, 64)].astype(np.float32)
+        log_dir = str(tmp_path / "trace")
+        pl = ProfilerListener(log_dir, start_iteration=2, n_iterations=5)
+        net.add_listener(pl)
+        # 4 iterations/epoch x 3 epochs: the trace window [2, 7) spans the
+        # epoch boundary and must not be truncated by it
+        net.fit(x, y, epochs=3, batch_size=16)
+        assert pl.completed and not pl._active
+        assert pl.traced_iterations == 5
+        # the trace writes TensorBoard plugin files under log_dir
+        found = []
+        for root, _, files in os.walk(log_dir):
+            found += files
+        assert found, "no trace artifacts written"
