@@ -138,6 +138,17 @@ class ProfilerListener(TrainingListener):
         self.completed = False
         self.traced_iterations = 0
 
+    def on_epoch_start(self, model):
+        # start_iteration <= 1 means "from the very first step, compile
+        # included" — iteration_done fires post-step, so the only hook that
+        # runs before iteration 1's work is epoch start
+        import jax
+        if (not self._active and not self.completed
+                and self.start_iteration <= 1):
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            self._t0 = time.perf_counter()
+
     def iteration_done(self, model, iteration, score, etl_time=0.0):
         import jax
         # iteration_done(i) fires AFTER iteration i's step: open the trace

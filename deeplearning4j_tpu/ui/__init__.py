@@ -5,3 +5,8 @@ from deeplearning4j_tpu.ui.server import UIServer  # noqa: F401
 from deeplearning4j_tpu.ui.visualization import (  # noqa: F401
     ConvolutionalIterationListener, activations_to_grid,
 )
+from deeplearning4j_tpu.ui.components import (  # noqa: F401
+    ChartHistogram, ChartHorizontalBar, ChartLine, ChartScatter,
+    ChartStackedArea, ChartTimeline, Component, ComponentTable,
+    ComponentText, DecoratorAccordion, Style,
+)

@@ -5,10 +5,15 @@ PlayUIServer.java + module/train/TrainModule.java (overview/model/system
 tabs) + remote/RemoteReceiverModule.java. Here: a dependency-free stdlib
 HTTP server with a self-contained HTML page (inline SVG charts) —
 
-    GET  /            dashboard page
+    GET  /            dashboard page (live-updating score chart)
     GET  /train/sessions             -> session ids
-    GET  /train/overview?session=s   -> score curve + timing
-    GET  /train/model?session=s      -> per-param norms over time
+    GET  /train/overview?session=s   -> score curve + timing (JSON)
+    GET  /train/model?session=s      -> per-param norms over time (JSON)
+    GET  /train/model.html?session=s -> server-rendered model tab: per-layer
+                                        norm/mean/std charts + summary table
+                                        built from ui/components.py (the
+                                        ui-components analog, rendered
+                                        server-side instead of via dl4j-ui.js)
     POST /remote                     -> remote stats ingestion
 """
 
@@ -100,18 +105,21 @@ class UIServer:
                         "etl_time_s": [[r["iteration"], r.get("etl_time_s", 0)]
                                        for r in recs]})
                     return
+                if url.path == "/train/model.html":
+                    session = q.get("session", ["default"])[0]
+                    body = _model_page(server, session).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if url.path == "/train/model":
                     session = q.get("session", ["default"])[0]
                     recs = server._records(session, "stats")
-                    series = {}
-                    for r in recs:
-                        if "iteration" not in r:
-                            continue
-                        for name, st in (r.get("params") or {}).items():
-                            if isinstance(st, dict) and {"l2", "mean", "std"} <= st.keys():
-                                series.setdefault(name, []).append(
-                                    [r["iteration"], st["l2"], st["mean"], st["std"]])
-                    self._json(series)
+                    series, _ = _param_series(recs)
+                    self._json({k: [list(p) for p in v]
+                                for k, v in series.items()})
                     return
                 self.send_error(404)
 
@@ -180,3 +188,98 @@ class UIServer:
             self._thread.join(timeout=5)
         if UIServer._instance is self:
             UIServer._instance = None
+
+
+def _param_series(recs):
+    """{param_name: [(iteration, l2, mean, std)]} + latest histogram per
+    param — shared by the /train/model JSON tab and the HTML model tab."""
+    series, hists = {}, {}
+    for r in recs:
+        if "iteration" not in r:
+            continue
+        for name, st in (r.get("params") or {}).items():
+            if not (isinstance(st, dict) and {"l2", "mean", "std"} <= st.keys()):
+                continue
+            vals = (st["l2"], st["mean"], st["std"])
+            if not all(isinstance(v, (int, float)) for v in vals):
+                continue  # a bad /remote record must not poison the page
+            series.setdefault(name, []).append((r["iteration"],) + vals)
+            h = st.get("hist")
+            if isinstance(h, dict) and h.get("counts"):
+                hists[name] = h
+    return series, hists
+
+
+def _model_page(server, session):
+    """Server-rendered model tab (reference: TrainModule.java model tab),
+    composed from ui/components.py."""
+    import html as _html
+
+    from deeplearning4j_tpu.ui.components import (
+        ChartHistogram, ChartLine, ComponentTable, ComponentText,
+        DecoratorAccordion)
+
+    recs = [r for r in server._records(session, "stats") if "iteration" in r]
+    parts = ["<!DOCTYPE html><html><head>"
+             "<title>model — deeplearning4j_tpu</title></head>"
+             '<body style="font-family:sans-serif;margin:2em">',
+             f"<h2>Model: session {_html.escape(session)}</h2>"]
+    if not recs:
+        parts.append(ComponentText("no stats records yet").render_html())
+        parts.append("</body></html>")
+        return "".join(parts)
+
+    # score curve
+    pts = [(r["iteration"], r["score"]) for r in recs
+           if isinstance(r.get("score"), (int, float))]
+    if pts:
+        parts.append(ChartLine("score vs iteration",
+                               [("score", [p[0] for p in pts],
+                                 [p[1] for p in pts])]).render_svg())
+
+    series, hists = _param_series(recs)
+    rows = []
+    for name, spts in sorted(series.items()):
+        it = [p[0] for p in spts]
+        comps = [ChartLine(f"{name}: parameter L2 norm",
+                           [("l2", it, [p[1] for p in spts])]).render_svg(),
+                 ChartLine(f"{name}: mean ± std",
+                           [("mean", it, [p[2] for p in spts]),
+                            ("std", it, [p[3] for p in spts])]).render_svg()]
+        hist = hists.get(name)
+        if hist:
+            counts = hist["counts"]
+            lo, hi = hist.get("min", 0.0), hist.get("max", 1.0)
+            step = (hi - lo) / max(len(counts), 1)
+            bins = [(lo + i * step, lo + (i + 1) * step, c)
+                    for i, c in enumerate(counts)
+                    if isinstance(c, (int, float))]
+            comps.append(ChartHistogram(
+                f"{name}: latest weight distribution", bins).render_svg())
+        parts.append(DecoratorAccordion(
+            name, [_Raw(c) for c in comps],
+            default_collapsed=True).render_html())
+        last = spts[-1]
+        rows.append([name, f"{last[1]:.4g}", f"{last[2]:.4g}",
+                     f"{last[3]:.4g}"])
+    if rows:
+        parts.append("<h3>Latest parameter stats</h3>")
+        parts.append(ComponentTable(["parameter", "l2", "mean", "std"],
+                                    rows).render_html())
+    parts.append("</body></html>")
+    return "".join(parts)
+
+
+class _Raw:
+    """Adapter letting pre-rendered SVG strings sit inside components."""
+
+    component_type = "raw-markup"
+
+    def __init__(self, markup):
+        self.markup = markup
+
+    def render_html(self):
+        return self.markup
+
+    def to_dict(self):
+        return {"componentType": self.component_type, "markup": self.markup}

@@ -3,6 +3,7 @@ TestRemoteReceiver in deeplearning4j-ui-parent)."""
 
 import os
 import json
+import urllib.parse
 import urllib.request
 
 import numpy as np
@@ -162,3 +163,96 @@ class TestProfilerListener:
         for root, _, files in os.walk(log_dir):
             found += files
         assert found, "no trace artifacts written"
+
+
+class TestUIComponents:
+    """ui/components.py (reference: deeplearning4j-ui-components chart/
+    table/text/decorator classes + their JSON serde)."""
+
+    def test_chart_line_svg_and_roundtrip(self):
+        from deeplearning4j_tpu.ui.components import ChartLine, Component
+        c = ChartLine("score", [("train", [0, 1, 2], [3.0, 2.0, 1.5]),
+                                ("val", [0, 1, 2], [3.2, 2.4, 2.0])])
+        svg = c.render_svg()
+        assert svg.startswith("<svg") and "polyline" in svg and "score" in svg
+        d = c.to_dict()
+        back = Component.from_dict(d)
+        assert back.to_dict() == d
+
+    def test_chart_histogram_of(self):
+        from deeplearning4j_tpu.ui.components import ChartHistogram
+        rs = np.random.RandomState(0)
+        c = ChartHistogram.of("weights", rs.randn(500), n_bins=20)
+        assert len(c.bins) == 20
+        assert sum(b[2] for b in c.bins) == 500
+        assert "<rect" in c.render_svg()
+
+    def test_scatter_bar_stacked_timeline_render(self):
+        from deeplearning4j_tpu.ui.components import (
+            ChartHorizontalBar, ChartScatter, ChartStackedArea, ChartTimeline)
+        assert "circle" in ChartScatter(
+            "s", [("a", [1, 2], [3, 4])]).render_svg()
+        assert "rect" in ChartHorizontalBar(
+            "b", ["x", "y"], [1.0, 2.0]).render_svg()
+        assert "polygon" in ChartStackedArea(
+            "st", [0, 1, 2], [("a", [1, 1, 1]), ("b", [2, 1, 0])]).render_svg()
+        assert "rect" in ChartTimeline(
+            "t", [("lane", [(0.0, 1.0, "etl"), (1.0, 3.0, "step")])]).render_svg()
+
+    def test_table_text_accordion(self):
+        from deeplearning4j_tpu.ui.components import (
+            ComponentTable, ComponentText, Component, DecoratorAccordion)
+        t = ComponentTable(["a", "b"], [["1", "<evil>"]])
+        html = t.render_html()
+        assert "&lt;evil&gt;" in html and "<table" in html
+        acc = DecoratorAccordion("layer0", [ComponentText("hello", bold=True)],
+                                 default_collapsed=True)
+        h = acc.render_html()
+        assert "<details>" in h and "hello" in h and "bold" in h
+        d = acc.to_dict()
+        assert Component.from_dict(d).to_dict() == d
+
+    def test_model_page_endpoint(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+        st = InMemoryStatsStorage()
+        for i in range(5):
+            st.put_record({"type": "stats", "session": "s1", "iteration": i,
+                           "score": 2.0 - 0.1 * i,
+                           "params": {"layer0/W": {
+                               "l2": 1.0 + i * 0.01, "mean": 0.0, "std": 0.05,
+                               "hist": {"counts": [2, 5, 2],
+                                        "min": -0.1, "max": 0.1}}}})
+        srv = UIServer().attach(st).start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/train/model.html?session=s1",
+                timeout=10).read().decode()
+        finally:
+            srv.stop()
+        assert "layer0/W" in body
+        assert "<svg" in body and "<details" in body and "<table" in body
+        assert "weight distribution" in body
+
+    def test_model_page_robust_to_bad_records_and_xss(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+        st = InMemoryStatsStorage()
+        st.put_record({"type": "stats", "session": "s<x>", "iteration": 0,
+                       "score": 1.0,
+                       "params": {"W": {"l2": "corrupt", "mean": 0, "std": 0}}})
+        st.put_record({"type": "stats", "session": "s<x>", "iteration": 1,
+                       "score": float("nan"),
+                       "params": {"W": {"l2": 1.0, "mean": 0.0, "std": 0.1}}})
+        srv = UIServer().attach(st).start()
+        try:
+            body = urllib.request.urlopen(
+                "http://127.0.0.1:%d/train/model.html?session=%s"
+                % (srv.port, urllib.parse.quote("s<x>")),
+                timeout=10).read().decode()
+        finally:
+            srv.stop()
+        assert "<x>" not in body  # session id escaped
+        assert "&lt;x&gt;" in body
+        # corrupt record skipped, finite one charted, NaN didn't blank axes
+        assert "W" in body and "nan" not in body.split("</h2>")[1][:2000]
