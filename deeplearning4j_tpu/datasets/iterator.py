@@ -99,11 +99,16 @@ class AsyncDataSetIterator(DataSetIterator):
     AsyncDataSetIterator.java — queue-based double buffering)."""
 
     def __init__(self, base: DataSetIterator, queue_size=2, device_put=True,
-                 sharding=None):
+                 sharding=None, callback=None):
         self.base = base
         self.queue_size = queue_size
         self.device_put = device_put
         self.sharding = sharding
+        if callback is not None and sharding is not None:
+            raise ValueError(
+                "callback and sharding are mutually exclusive: the callback "
+                "owns device placement (e.g. InterleavedDataSetCallback)")
+        self.callback = callback  # DataSetCallback, e.g. Interleaved round-robin
         self._queue = None
         self._thread = None
         self._error = None
@@ -115,12 +120,16 @@ class AsyncDataSetIterator(DataSetIterator):
     def reset(self):
         self._shutdown()
         self.base.reset()
+        if self.callback is not None:
+            self.callback.reset()
         self._queue = queue.Queue(maxsize=self.queue_size)
         self._error = None
         self._thread = threading.Thread(target=self._producer, daemon=True)
         self._thread.start()
 
     def _put_device(self, ds: DataSet) -> DataSet:
+        if self.callback is not None:
+            return self.callback.call(ds)
         if not self.device_put:
             return ds
         put = (lambda a: jax.device_put(a, self.sharding)) if self.sharding \
@@ -244,3 +253,41 @@ class BenchmarkDataSetIterator(DataSetIterator):
             raise StopIteration
         self._count += 1
         return DataSet(features=self._features, labels=self._labels)
+
+
+class DataSetCallback:
+    """Hook applied to each prefetched batch before it reaches the consumer
+    (reference: datasets/iterator/callbacks/DataSetCallback.java)."""
+
+    def call(self, ds: DataSet) -> DataSet:
+        return ds
+
+    def reset(self):
+        """Called on iterator reset so per-epoch state (e.g. round-robin
+        position) realigns with batch indices."""
+
+
+class InterleavedDataSetCallback(DataSetCallback):
+    """Round-robin prefetched batches across local devices (reference:
+    callbacks/InterleavedDataSetCallback.java — workspace-migrates each
+    incoming batch onto the next device so ParallelWrapper replicas read
+    device-local data). TPU-native: jax.device_put onto
+    jax.local_devices()[i % n] — the replica consuming batch i finds it
+    already resident on its chip, off the step critical path."""
+
+    def __init__(self, devices=None):
+        import jax
+        self.devices = list(devices) if devices else jax.local_devices()
+        self._counter = 0
+
+    def reset(self):
+        self._counter = 0
+
+    def call(self, ds: DataSet) -> DataSet:
+        import jax
+        dev = self.devices[self._counter % len(self.devices)]
+        self._counter += 1
+        put = lambda a: None if a is None else jax.device_put(a, dev)
+        return DataSet(features=put(ds.features), labels=put(ds.labels),
+                       features_mask=put(ds.features_mask),
+                       labels_mask=put(ds.labels_mask))

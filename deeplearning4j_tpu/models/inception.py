@@ -26,8 +26,8 @@ from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn import updaters as U
 from deeplearning4j_tpu.nn.conf import inputs as I
 from deeplearning4j_tpu.nn.graph import (ElementWiseVertex, GraphBuilder,
-                                         L2NormalizeVertex, MergeVertex,
-                                         ScaleVertex)
+                                         GraphBuilderModule, L2NormalizeVertex,
+                                         MergeVertex, ScaleVertex)
 
 
 def _conv(g, name, inp, n_out, kernel, stride=(1, 1), padding="same",
@@ -293,3 +293,21 @@ def facenet_nn4_small2(height=96, width=96, channels=3, n_classes=5749,
 
     _embedding_head(g, x, n_classes, embedding_size)
     return g.build()
+
+
+class InceptionModule(GraphBuilderModule):
+    """GraphBuilderModule packaging the GoogLeNet inception block (reference:
+    the zoo's inception helper consumed through the GraphBuilderModule SPI,
+    nn/conf/module/GraphBuilderModule.java). ``config`` is the filter-bank
+    table ((f1,), (f3r, f3), (f5r, f5), (fp,)) as in GoogLeNet.java:154-169;
+    ``input_size`` is accepted for SPI parity (the conv layers infer their
+    input channels from shape inference)."""
+
+    def module_name(self):
+        return "inception"
+
+    def update_builder(self, builder, layer_name, input_size, config,
+                       input_layer):
+        _inception(builder, f"{self.module_name()}-{layer_name}",
+                   input_layer, config)
+        return builder

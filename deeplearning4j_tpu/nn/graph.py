@@ -445,6 +445,17 @@ class GraphBuilder:
         self._outputs.extend(names)
         return self
 
+    def add_module(self, module, layer_name, input_size, config, input_layer):
+        """Append a reusable graph fragment via the GraphBuilderModule SPI
+        (reference: GraphBuilderModule.updateBuilder)."""
+        return module.update_builder(self, layer_name, input_size, config,
+                                     input_layer)
+
+    def last_vertex_name(self):
+        """Name of the most recently added vertex (modules add their output
+        vertex last, so chains continue from here)."""
+        return self._vertices[-1].name if self._vertices else None
+
     def build(self) -> GraphConfiguration:
         conf = GraphConfiguration(
             inputs=tuple(self._inputs), input_types=tuple(self._input_types),
@@ -645,3 +656,28 @@ class ComputationGraph:
     def add_listener(self, *ls):
         self.listeners.extend(ls)
         return self
+
+
+class GraphBuilderModule:
+    """SPI for reusable graph fragments (reference: nn/conf/module/
+    GraphBuilderModule.java — "plugins and modules to generate configurations
+    and layers"). Implementations append a named sub-graph (e.g. an
+    inception block) to a GraphBuilder and return it, so model definitions
+    compose from modules instead of repeating vertex boilerplate."""
+
+    def module_name(self):
+        """Lowercase module name, used to prefix generated layer names."""
+        raise NotImplementedError
+
+    def update_builder(self, builder, layer_name, input_size, config,
+                       input_layer):
+        """Append this module's layers to ``builder``.
+
+        layer_name: base name for the generated vertices
+        input_size: channel count of ``input_layer``'s activations
+        config: module-specific structure (the reference passes int[][]
+            filter-bank tables)
+        input_layer: name of the vertex the module consumes
+        Returns the builder (with the module's OUTPUT vertex added last, so
+        callers can chain on builder's most recent name)."""
+        raise NotImplementedError

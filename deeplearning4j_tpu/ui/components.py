@@ -301,7 +301,10 @@ class ChartStackedArea(_Chart):
     def render_svg(self):
         if not len(self.x) or not self.series:
             return ChartLine(self.title, [], self.style).render_svg()
-        stack = np.cumsum([y for _, y in self.series], axis=0)
+        # non-finite values stack as 0 so one NaN can't blank the chart
+        # (same defense as ChartLine._bounds)
+        stack = np.cumsum([np.where(np.isfinite(y), y, 0.0)
+                           for _, y in self.series], axis=0)
         parts, sx, sy = _axes(self.style, float(self.x.min()),
                               float(self.x.max()), 0.0,
                               float(stack[-1].max()), self.title)
@@ -360,6 +363,13 @@ class ChartTimeline(_Chart):
                              f'height="{h:.1f}" '
                              f'fill="{_PALETTE[j % len(_PALETTE)]}" '
                              f'fill-opacity="0.8"/>')
+                if label:
+                    parts.append(
+                        f'<text x="{(sx(a) + sx(b)) / 2:.1f}" '
+                        f'y="{y + h / 2 + 3:.1f}" text-anchor="middle" '
+                        f'font-size="{st.font_size - 1}" '
+                        f'font-family="sans-serif" fill="#ffffff">'
+                        f"{_html.escape(label)}</text>")
             parts.append(f'<text x="4" y="{y + h / 2 + 3:.1f}" '
                          f'font-size="{st.font_size}" '
                          f'font-family="sans-serif">'

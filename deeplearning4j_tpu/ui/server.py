@@ -205,7 +205,11 @@ def _param_series(recs):
                 continue  # a bad /remote record must not poison the page
             series.setdefault(name, []).append((r["iteration"],) + vals)
             h = st.get("hist")
-            if isinstance(h, dict) and h.get("counts"):
+            if (isinstance(h, dict) and isinstance(h.get("counts"), list)
+                    and h["counts"]
+                    and all(isinstance(c, (int, float)) for c in h["counts"])
+                    and isinstance(h.get("min", 0.0), (int, float))
+                    and isinstance(h.get("max", 1.0), (int, float))):
                 hists[name] = h
     return series, hists
 
@@ -252,8 +256,7 @@ def _model_page(server, session):
             lo, hi = hist.get("min", 0.0), hist.get("max", 1.0)
             step = (hi - lo) / max(len(counts), 1)
             bins = [(lo + i * step, lo + (i + 1) * step, c)
-                    for i, c in enumerate(counts)
-                    if isinstance(c, (int, float))]
+                    for i, c in enumerate(counts)]
             comps.append(ChartHistogram(
                 f"{name}: latest weight distribution", bins).render_svg())
         parts.append(DecoratorAccordion(

@@ -1,0 +1,120 @@
+"""Time-series / masking utilities.
+
+Reference analog: util/TimeSeriesUtils.java (movingAverage, 2d<->3d
+reshapes, mask-vector reshapes) and util/MaskedReductionUtil.java (masked
+time-series and spatial poolings) in /root/reference/deeplearning4j-nn.
+The layer implementations fold most of this in via jnp broadcasting; these
+standalone helpers exist for user code and for behavior-parity edge cases
+(e.g. masked MAX pooling must ignore masked steps even when all values are
+negative).
+
+Layout note: this framework's time series are [batch, time, features]
+(channels-last everywhere), not the reference's [batch, features, time] —
+the helpers speak the native layout.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_NEG_INF = -3.4e38  # safely below any f32/bf16 activation
+
+
+def moving_average(x, n):
+    """Trailing moving average over the last axis of a 1-D/2-D array; output
+    length shrinks by n-1 (reference: TimeSeriesUtils.movingAverage)."""
+    x = jnp.asarray(x, jnp.float32)
+    c = jnp.cumsum(x, axis=-1)
+    head = c[..., n - 1:n]
+    rest = c[..., n:] - c[..., :-n]
+    return jnp.concatenate([head, rest], axis=-1) / n
+
+
+def reshape_3d_to_2d(x):
+    """[B, T, F] -> [B*T, F] (reference reshape3dTo2d, adapted to BTF)."""
+    b, t, f = x.shape
+    return x.reshape(b * t, f)
+
+
+def reshape_2d_to_3d(x, minibatch_size):
+    """[B*T, F] -> [B, T, F] (reference reshape2dTo3d)."""
+    n, f = x.shape
+    return x.reshape(minibatch_size, n // minibatch_size, f)
+
+
+def reshape_time_series_mask_to_vector(mask):
+    """[B, T] mask -> [B*T] (row-major, aligned with reshape_3d_to_2d)."""
+    return jnp.asarray(mask).reshape(-1)
+
+
+def reshape_vector_to_time_series_mask(vec, minibatch_size):
+    """[B*T] -> [B, T]."""
+    v = jnp.asarray(vec)
+    return v.reshape(minibatch_size, v.shape[0] // minibatch_size)
+
+
+def pull_last_time_step(x, mask=None):
+    """[B, T, F] -> [B, F]: the last UNMASKED step per example (reference:
+    the rnnTimeStep/LastTimeStepVertex semantics)."""
+    x = jnp.asarray(x)
+    if mask is None:
+        return x[:, -1]
+    m = jnp.asarray(mask)
+    idx = jnp.maximum(m.shape[1] - 1 - jnp.argmax(m[:, ::-1] > 0, axis=1), 0)
+    return jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32),
+                               axis=1)[:, 0]
+
+
+def reverse_time_series(x, mask=None):
+    """Reverse along time. With a mask, each example's VALID prefix reverses
+    in place and padding stays at the tail (reference: TimeSeriesUtils
+    reverse used by bidirectional RNNs)."""
+    x = jnp.asarray(x)
+    if mask is None:
+        return x[:, ::-1]
+    m = jnp.asarray(mask) > 0
+    lengths = m.sum(axis=1).astype(jnp.int32)          # [B]
+    t = x.shape[1]
+    pos = jnp.arange(t)[None, :]                       # [1, T]
+    src = jnp.where(pos < lengths[:, None],
+                    lengths[:, None] - 1 - pos, pos)   # [B, T]
+    return jnp.take_along_axis(x, src[..., None].astype(jnp.int32), axis=1)
+
+
+def masked_pooling_time_series(pooling_type, x, mask):
+    """Masked pooling over time: [B, T, F] + [B, T] -> [B, F] (reference:
+    MaskedReductionUtil.maskedPoolingTimeSeries; SUM/AVG/MAX/PNORM minus
+    PNORM's p parameterization which callers apply via **kwargs)."""
+    x = jnp.asarray(x)
+    m = (jnp.asarray(mask) > 0)[..., None]             # [B, T, 1]
+    if pooling_type == "max":
+        return jnp.max(jnp.where(m, x, _NEG_INF), axis=1)
+    if pooling_type == "sum":
+        return jnp.sum(jnp.where(m, x, 0.0), axis=1)
+    if pooling_type == "avg":
+        s = jnp.sum(jnp.where(m, x, 0.0), axis=1)
+        return s / jnp.maximum(m.sum(axis=1), 1)
+    if pooling_type == "pnorm":
+        p = 2.0
+        s = jnp.sum(jnp.where(m, jnp.abs(x) ** p, 0.0), axis=1)
+        return s ** (1.0 / p)
+    raise ValueError(f"Unknown pooling type {pooling_type!r}")
+
+
+def masked_pooling_convolution(pooling_type, x, mask):
+    """Masked spatial pooling: [B, H, W, C] + [B, H, W] -> [B, C]
+    (reference: MaskedReductionUtil.maskedPoolingConvolution, NHWC)."""
+    x = jnp.asarray(x)
+    m = (jnp.asarray(mask) > 0)[..., None]             # [B, H, W, 1]
+    if pooling_type == "max":
+        return jnp.max(jnp.where(m, x, _NEG_INF), axis=(1, 2))
+    if pooling_type == "sum":
+        return jnp.sum(jnp.where(m, x, 0.0), axis=(1, 2))
+    if pooling_type == "avg":
+        s = jnp.sum(jnp.where(m, x, 0.0), axis=(1, 2))
+        return s / jnp.maximum(m.sum(axis=(1, 2)), 1)
+    if pooling_type == "pnorm":
+        p = 2.0
+        s = jnp.sum(jnp.where(m, jnp.abs(x) ** p, 0.0), axis=(1, 2))
+        return s ** (1.0 / p)
+    raise ValueError(f"Unknown pooling type {pooling_type!r}")

@@ -298,3 +298,44 @@ class TestCacheable:
         arc.unlink()
         out2 = ensure_extracted("unpacked", "a.zip", root=str(tmp_path))
         assert out2 == out
+
+
+class TestInterleavedCallback:
+    def test_round_robin_device_placement(self, eight_devices):
+        import jax
+        from deeplearning4j_tpu.datasets.iterator import (
+            ArrayDataSetIterator, AsyncDataSetIterator,
+            InterleavedDataSetCallback)
+        x = np.arange(64.0, dtype=np.float32).reshape(16, 4)
+        y = np.eye(2, dtype=np.float32)[np.arange(16) % 2]
+        base = ArrayDataSetIterator(x, y, batch_size=4, shuffle=False)
+        it = AsyncDataSetIterator(
+            base, callback=InterleavedDataSetCallback(jax.devices()[:2]))
+        devs = [next(iter(ds.features.devices())) for ds in it]
+        assert len(devs) == 4
+        # batches alternate across the two devices
+        assert devs[0] != devs[1] and devs[0] == devs[2]
+
+
+class TestGraphBuilderModule:
+    def test_inception_module_spi(self):
+        from deeplearning4j_tpu.models.inception import InceptionModule
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+
+        g = GraphBuilder()
+        g.add_inputs("in")
+        g.set_input_types(I.convolutional(8, 8, 3))
+        mod = InceptionModule()
+        assert mod.module_name() == "inception"
+        g.add_module(mod, "3a", 3, ((4,), (4, 8), (2, 4), (4,)), "in")
+        top = g.last_vertex_name()
+        assert top.endswith("depthconcat")
+        g.add_layer("out", L.OutputLayer(n_out=2, loss="mcxent"), top)
+        g.set_outputs("out")
+        net = ComputationGraph(g.build())
+        net.init()
+        out = np.asarray(net.output(np.random.RandomState(0)
+                                    .rand(2, 8, 8, 3).astype(np.float32)))
+        assert out.shape == (2, 2)

@@ -91,3 +91,69 @@ class TestRnnTimeStep:
         net.rnn_clear_previous_state()
         again = np.asarray(net.rnn_time_step(x[:, 0]))
         np.testing.assert_allclose(first, again, rtol=1e-6)
+
+
+class TestTimeSeriesUtils:
+    """utils/timeseries.py (reference: TimeSeriesUtils.java +
+    MaskedReductionUtil.java)."""
+
+    def test_moving_average(self):
+        from deeplearning4j_tpu.utils.timeseries import moving_average
+        out = np.asarray(moving_average(np.array([1.0, 2, 3, 4, 5]), 3))
+        np.testing.assert_allclose(out, [2.0, 3.0, 4.0])
+
+    def test_reshape_roundtrip(self):
+        from deeplearning4j_tpu.utils.timeseries import (
+            reshape_2d_to_3d, reshape_3d_to_2d,
+            reshape_time_series_mask_to_vector,
+            reshape_vector_to_time_series_mask)
+        x = np.arange(24.0).reshape(2, 3, 4)
+        back = np.asarray(reshape_2d_to_3d(reshape_3d_to_2d(x), 2))
+        np.testing.assert_array_equal(back, x)
+        m = np.array([[1, 1, 0], [1, 0, 0]], np.float32)
+        v = reshape_time_series_mask_to_vector(m)
+        np.testing.assert_array_equal(
+            np.asarray(reshape_vector_to_time_series_mask(v, 2)), m)
+
+    def test_pull_last_time_step_masked(self):
+        from deeplearning4j_tpu.utils.timeseries import pull_last_time_step
+        x = np.arange(24.0).reshape(2, 4, 3)
+        mask = np.array([[1, 1, 1, 0], [1, 0, 0, 0]], np.float32)
+        out = np.asarray(pull_last_time_step(x, mask))
+        np.testing.assert_array_equal(out[0], x[0, 2])  # last valid = t=2
+        np.testing.assert_array_equal(out[1], x[1, 0])
+        np.testing.assert_array_equal(
+            np.asarray(pull_last_time_step(x)), x[:, -1])
+
+    def test_reverse_time_series_masked(self):
+        from deeplearning4j_tpu.utils.timeseries import reverse_time_series
+        x = np.arange(8.0).reshape(1, 4, 2)
+        mask = np.array([[1, 1, 1, 0]], np.float32)
+        out = np.asarray(reverse_time_series(x, mask))
+        # valid prefix [t0,t1,t2] reversed, padding t3 untouched
+        np.testing.assert_array_equal(out[0, 0], x[0, 2])
+        np.testing.assert_array_equal(out[0, 2], x[0, 0])
+        np.testing.assert_array_equal(out[0, 3], x[0, 3])
+
+    def test_masked_pooling_max_ignores_negative_padding(self):
+        from deeplearning4j_tpu.utils.timeseries import (
+            masked_pooling_time_series)
+        # all-negative valid values: masked steps (0.0) must NOT win the max
+        x = np.full((1, 3, 2), -5.0, np.float32)
+        x[0, 1] = -2.0
+        mask = np.array([[1, 1, 0]], np.float32)
+        out = np.asarray(masked_pooling_time_series("max", x, mask))
+        np.testing.assert_allclose(out[0], [-2.0, -2.0])
+        avg = np.asarray(masked_pooling_time_series("avg", x, mask))
+        np.testing.assert_allclose(avg[0], [-3.5, -3.5])
+
+    def test_masked_pooling_convolution(self):
+        from deeplearning4j_tpu.utils.timeseries import (
+            masked_pooling_convolution)
+        x = np.ones((1, 2, 2, 3), np.float32)
+        x[0, 1, 1] = 9.0
+        mask = np.array([[[1, 1], [1, 0]]], np.float32)  # exclude the 9s
+        out = np.asarray(masked_pooling_convolution("max", x, mask))
+        np.testing.assert_allclose(out[0], [1.0, 1.0, 1.0])
+        s = np.asarray(masked_pooling_convolution("sum", x, mask))
+        np.testing.assert_allclose(s[0], [3.0, 3.0, 3.0])
