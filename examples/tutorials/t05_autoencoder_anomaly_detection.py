@@ -1,0 +1,60 @@
+"""Tutorial 05 — Basic autoencoder: anomaly detection by reconstruction
+error.
+
+Reference tutorial 05: train a bottleneck autoencoder on "normal" data only;
+at inference, reconstruction error ranks how anomalous each input is —
+inputs unlike anything seen in training reconstruct poorly.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import layers as L, updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def main():
+    rs = np.random.RandomState(0)
+    # "normal" data: points on a smooth low-dimensional manifold
+    t = rs.rand(600, 1) * 2 * np.pi
+    normal = np.concatenate(
+        [np.sin(t), np.cos(t), np.sin(2 * t), np.cos(2 * t)], 1
+    ).astype(np.float32) + rs.randn(600, 4).astype(np.float32) * 0.05
+    # anomalies: uniform noise nowhere near the manifold
+    anomalies = (rs.rand(30, 4).astype(np.float32) * 4 - 2)
+
+    # encoder 4 -> 2, decoder 2 -> 4; training target = the input itself
+    conf = NeuralNetConfig(seed=5, updater=U.Adam(learning_rate=0.01)).list(
+        L.DenseLayer(n_out=8, activation="tanh"),
+        L.DenseLayer(n_out=2, activation="tanh"),     # bottleneck
+        L.DenseLayer(n_out=8, activation="tanh"),
+        L.OutputLayer(n_out=4, loss="mse", activation="identity"),
+        input_type=I.FeedForwardType(4),
+    )
+    net = MultiLayerNetwork(conf)
+    net.fit(normal, normal, epochs=60, batch_size=128)
+
+    def recon_error(batch):
+        out = np.asarray(net.output(batch))
+        return np.mean((out - batch) ** 2, axis=1)
+
+    err_norm = recon_error(normal)
+    err_anom = recon_error(anomalies)
+    thresh = np.percentile(err_norm, 99)
+    caught = float(np.mean(err_anom > thresh))
+    print("normal error    : mean %.4f" % err_norm.mean())
+    print("anomaly error   : mean %.4f" % err_anom.mean())
+    print("99th-pct threshold %.4f catches %.0f%% of anomalies"
+          % (thresh, caught * 100))
+    assert err_anom.mean() > 3 * err_norm.mean()
+
+
+if __name__ == "__main__":
+    main()

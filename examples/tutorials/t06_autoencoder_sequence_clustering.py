@@ -1,0 +1,84 @@
+"""Tutorial 06 — Advanced autoencoder: clustering sequences by learned
+embeddings.
+
+Reference tutorial 06 clusters AIS ship trajectories with a seq2seq
+autoencoder. Offline stand-in: synthetic 2-D trajectories from three motion
+regimes (straight, circling, zig-zag). An LSTM encoder compresses each
+trajectory to its final state, a dense decoder reconstructs the flattened
+path; KMeans on the bottleneck then recovers the regimes without labels.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.kmeans import KMeans
+from deeplearning4j_tpu.nn import layers as L, updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+T = 20  # trajectory length
+
+
+def trajectories(n_per=60, seed=0):
+    rs = np.random.RandomState(seed)
+    t = np.linspace(0, 1, T)
+    out, labels = [], []
+    for k in range(3):
+        for _ in range(n_per):
+            if k == 0:      # straight line, random heading
+                a = rs.rand() * 2 * np.pi
+                xy = np.stack([np.cos(a) * t, np.sin(a) * t], 1)
+            elif k == 1:    # circle
+                ph = rs.rand() * 2 * np.pi
+                xy = np.stack([np.cos(4 * np.pi * t + ph),
+                               np.sin(4 * np.pi * t + ph)], 1) * 0.5
+            else:           # zig-zag
+                xy = np.stack([t, 0.3 * np.sign(np.sin(8 * np.pi * t)) * t], 1)
+            out.append(xy + rs.randn(T, 2) * 0.02)
+            labels.append(k)
+    return np.asarray(out, np.float32), np.asarray(labels)
+
+
+def main():
+    x, true_labels = trajectories()
+    flat_targets = x.reshape(len(x), -1)  # decoder target: the whole path
+
+    conf = NeuralNetConfig(seed=3, updater=U.Adam(learning_rate=0.005)).list(
+        L.LSTM(n_out=16, activation="tanh"),
+        L.LSTM(n_out=8, activation="tanh"),
+        L.LastTimeStep(),                      # bottleneck [B, 8]
+        L.DenseLayer(n_out=32, activation="tanh"),
+        L.OutputLayer(n_out=T * 2, loss="mse", activation="identity"),
+        input_type=I.recurrent(2, T),
+    )
+    net = MultiLayerNetwork(conf)
+    net.fit(x, flat_targets, epochs=30, batch_size=60)
+    print("reconstruction loss:", float(net.score(x, flat_targets)))
+
+    # embeddings = the LastTimeStep activation: first 2-D act of width 8
+    acts = net.feed_forward(x)
+    emb = next(np.asarray(a) for a in acts
+               if np.asarray(a).ndim == 2 and np.asarray(a).shape[1] == 8)
+    print("bottleneck embeddings:", emb.shape)
+
+    km = KMeans(3, max_iterations=50, seed=0)
+    km.fit(emb)
+    assign = np.asarray(km.predict(emb))
+    # unsupervised clusters should align with the true regimes (up to
+    # permutation): check majority purity
+    purity = np.mean([
+        np.max(np.bincount(true_labels[assign == c], minlength=3))
+        / max((assign == c).sum(), 1)
+        for c in range(3)])
+    print("cluster purity vs hidden regimes: %.2f" % purity)
+    assert purity > 0.6
+
+
+if __name__ == "__main__":
+    main()

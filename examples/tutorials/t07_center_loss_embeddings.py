@@ -1,0 +1,77 @@
+"""Tutorial 07 — Convolutions: training embeddings with center loss.
+
+Reference tutorial 07 trains a FaceNet-style net where the loss is
+softmax + λ·center-loss: each class keeps a running center in embedding
+space and examples are pulled toward their class center, producing tight,
+separable embedding clusters (the property metric-learning applications
+need). Here: a small CNN on synthetic "identity" image classes.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import layers as L, updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+
+
+def identity_images(n_classes=4, per_class=40, size=12, seed=0):
+    """Each 'identity' = a fixed random template + small jitter."""
+    rs = np.random.RandomState(seed)
+    templates = rs.rand(n_classes, size, size, 1).astype(np.float32)
+    xs, ys = [], []
+    for c in range(n_classes):
+        noise = rs.randn(per_class, size, size, 1).astype(np.float32) * 0.15
+        xs.append(np.clip(templates[c][None] + noise, 0, 1))
+        ys.append(np.full(per_class, c))
+    x = np.concatenate(xs)
+    y = np.eye(n_classes, dtype=np.float32)[np.concatenate(ys)]
+    return x, y
+
+
+def main():
+    x, y = identity_images()
+
+    g = GraphBuilder(updater=U.Adam(learning_rate=0.01), seed=11)
+    g.add_inputs("in")
+    g.set_input_types(I.convolutional(12, 12, 1))
+    g.add_layer("conv", L.ConvolutionLayer(n_out=8, kernel=(3, 3),
+                                           activation="relu"), "in")
+    g.add_layer("pool", L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2),
+                                           mode="max"), "conv")
+    g.add_layer("embed", L.DenseLayer(n_out=16, activation="tanh"), "pool")
+    # CenterLossOutputLayer: softmax + lambda * ||embedding - center_c||^2,
+    # centers updated with rate alpha (reference:
+    # nn/layers/training/CenterLossOutputLayer.java). Keep lambda modest:
+    # too large and every embedding collapses onto its (shrinking) center.
+    g.add_layer("out", L.CenterLossOutputLayer(
+        n_out=4, loss="mcxent", alpha=0.1, lambda_=0.01), "embed")
+    g.set_outputs("out")
+
+    net = ComputationGraph(g.build())
+    net.fit(x, y, epochs=40, batch_size=80)
+
+    # embeddings = the dense layer's activations
+    acts = net.feed_forward(x)
+    emb = np.asarray(acts["embed"])
+    labels = np.argmax(y, 1)
+
+    # center-loss quality measure: intra-class spread vs inter-center spread
+    centers = np.stack([emb[labels == c].mean(0) for c in range(4)])
+    intra = np.mean([np.linalg.norm(emb[labels == c] - centers[c], axis=1).mean()
+                     for c in range(4)])
+    inter = np.mean([np.linalg.norm(centers[a] - centers[b])
+                     for a in range(4) for b in range(a + 1, 4)])
+    print("mean intra-class distance: %.3f" % intra)
+    print("mean inter-center distance: %.3f" % inter)
+    print("separation ratio: %.2fx" % (inter / max(intra, 1e-9)))
+    assert inter > 2 * intra, "center loss should produce tight clusters"
+
+
+if __name__ == "__main__":
+    main()
