@@ -147,3 +147,62 @@ class ROCMultiClass:
 
     def average_auc(self):
         return float(np.mean([r.auc() for r in self._rocs]))
+
+
+def _merge_roc(self, other):
+    """Combine a partial ROC (reference: ROC.merge — exact mode concatenates
+    stored scores; thresholded mode adds histogram counts)."""
+    if self.exact != other.exact:
+        raise ValueError("cannot merge exact and thresholded ROCs")
+    if self.exact:
+        self._scores.extend(other._scores)
+        self._labels.extend(other._labels)
+    else:
+        if self.steps != other.steps:
+            raise ValueError("threshold_steps mismatch")
+        self._pos_hist += other._pos_hist
+        self._neg_hist += other._neg_hist
+    self.n_pos += other.n_pos
+    self.n_neg += other.n_neg
+
+
+def _reset_roc(self):
+    if self.exact:
+        self._scores, self._labels = [], []
+    else:
+        self._pos_hist[:] = 0
+        self._neg_hist[:] = 0
+    self.n_pos = self.n_neg = 0
+
+
+def _stats_roc(self):
+    return f"AUC: [{self.auc():.6f}]" + \
+        (f"\nAUPRC: [{self.auprc():.6f}]" if self.exact else "")
+
+
+ROC.merge = _merge_roc
+ROC.reset = _reset_roc
+ROC.stats = _stats_roc
+
+
+def _merge_multi(self, other):
+    """Merge per-output/per-class ROC collections (reference:
+    ROCBinary.merge / ROCMultiClass.merge)."""
+    if other._rocs is None:
+        return
+    if self._rocs is None:
+        self._rocs = [ROC(self.steps) for _ in other._rocs]
+    if len(self._rocs) != len(other._rocs):
+        raise ValueError("output-count mismatch")
+    for mine, theirs in zip(self._rocs, other._rocs):
+        mine.merge(theirs)
+
+
+def _reset_multi(self):
+    self._rocs = None
+
+
+ROCBinary.merge = _merge_multi
+ROCBinary.reset = _reset_multi
+ROCMultiClass.merge = _merge_multi
+ROCMultiClass.reset = _reset_multi

@@ -437,3 +437,47 @@ class TestCalibrationParity:
         assert a.expected_calibration_error() == pytest.approx(
             whole.expected_calibration_error())
         assert np.array_equal(a.residual_hist, whole.residual_hist)
+
+
+class TestROCMerge:
+    def test_exact_merge_equals_whole(self):
+        rs = np.random.RandomState(7)
+        labels = (rs.rand(200) > 0.5).astype(float)
+        scores = np.clip(labels * 0.4 + rs.rand(200) * 0.6, 0, 1)
+        whole = ROC()
+        whole.eval(labels, scores)
+        a, b = ROC(), ROC()
+        a.eval(labels[:80], scores[:80])
+        b.eval(labels[80:], scores[80:])
+        a.merge(b)
+        assert a.auc() == pytest.approx(whole.auc())
+        assert a.auprc() == pytest.approx(whole.auprc())
+        assert "AUC" in a.stats()
+        a.reset()
+        assert a.n_pos == 0 and not a._scores
+
+    def test_thresholded_merge(self):
+        rs = np.random.RandomState(8)
+        labels = (rs.rand(300) > 0.5).astype(float)
+        scores = np.clip(labels * 0.3 + rs.rand(300) * 0.7, 0, 1)
+        whole = ROC(threshold_steps=20)
+        whole.eval(labels, scores)
+        a, b = ROC(threshold_steps=20), ROC(threshold_steps=20)
+        a.eval(labels[:100], scores[:100])
+        b.eval(labels[100:], scores[100:])
+        a.merge(b)
+        assert a.auc() == pytest.approx(whole.auc())
+        with pytest.raises(ValueError):
+            a.merge(ROC())  # exact vs thresholded
+
+    def test_multiclass_merge(self):
+        rs = np.random.RandomState(9)
+        labels = np.eye(3)[rs.randint(0, 3, 120)]
+        preds = rs.dirichlet(np.ones(3), 120)
+        whole = ROCMultiClass()
+        whole.eval(labels, preds)
+        a, b = ROCMultiClass(), ROCMultiClass()
+        a.eval(labels[:50], preds[:50])
+        b.eval(labels[50:], preds[50:])
+        a.merge(b)
+        assert a.average_auc() == pytest.approx(whole.average_auc())
