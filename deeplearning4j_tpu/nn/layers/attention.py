@@ -60,7 +60,15 @@ class LayerNormalization(ParamLayer):
 
 
 def dot_product_attention(q, k, v, *, mask=None, causal=False, scale=None):
-    """q,k,v: [B, T, H, D]. Returns [B, T, H, D]. bf16 matmuls, f32 softmax."""
+    """q,k,v: [B, T, H, D]. Returns [B, T, H, D]. bf16 matmuls, f32 softmax.
+
+    On TPU, unmasked attention dispatches to the fused flash kernel
+    (ops/attention_pallas.py) — O(T*D) HBM traffic instead of the [B,H,T,T]
+    logits tensor; the dispatch seam mirrors the LSTM fused path."""
+    from deeplearning4j_tpu.ops import attention_pallas as _ap
+    if _ap.enabled() and _ap.supported(q.shape, mask, q.dtype):
+        s = None if scale is None else float(scale)
+        return _ap.flash_attention(q, k, v, causal=causal, scale=s)
     cd, ad = _dtypes.compute_dtypes_for(q.dtype)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, ad))

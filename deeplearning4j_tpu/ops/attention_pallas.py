@@ -1,0 +1,195 @@
+"""Fused (flash) attention kernel (Pallas, TPU).
+
+Reference analog: none — the reference has no attention anywhere
+(SURVEY.md §5 long-context row); this is part of the net-new long-context
+tier (nn/layers/attention.py, parallel/sequence.py). The role matches the
+cuDNN-helper tier though: the naive path materializes the [B, H, T, T]
+logits in HBM, this kernel never does.
+
+Kernel design (FlashAttention-style online softmax, TPU-first):
+* Heads fold into the batch: [B, T, H, D] -> [BH, T, D]; head dim pads to
+  the 128-lane width, sequence pads to the block size.
+* Grid = (BH, T/Bq). Each program owns one query block [Bq, D] resident in
+  VMEM and loops over key/value blocks [Bk, D] with the running
+  (max, sum, acc) online-softmax recurrence — the [Bq, Bk] score tile
+  lives only in VMEM/registers, so HBM traffic is O(T*D) not O(T^2).
+* Causal masking skips entire key blocks above the diagonal (the inner
+  fori_loop upper bound shrinks per query block) and masks the partial
+  block; key padding is masked by position against the true length.
+* The kernel also emits the log-sum-exp per row. Backward is a
+  jax.custom_vjp that RECOMPUTES attention probabilities from (q, k, v,
+  lse) — the flash trade: nothing but lse and the output is saved from the
+  forward, so training memory matches inference.
+
+``interpret=True`` runs the same kernel on CPU for tests (slow);
+``enabled()`` gates the fast path to real TPU backends plus an env flag,
+mirroring ops/lstm_pallas.py's dispatch seam.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LANE = 128
+_NEG_INF = -1e30
+
+
+def enabled():
+    flag = os.environ.get("DL4J_TPU_FUSED_ATTENTION", "1") != "0"
+    if not flag:
+        return False
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def supported(q_shape, mask, dtype):
+    """Fast path applies: no padding mask (the naive path handles masks),
+    head_dim <= 128, float dtype."""
+    b, t, h, d = q_shape
+    if mask is not None:
+        return False
+    if d > _LANE:
+        return False
+    return jnp.issubdtype(dtype, jnp.floating)
+
+
+def _attn_kernel(t_true, causal, scale, block_q, block_k,
+                 q_ref, k_ref, v_ref, o_ref, lse_ref):
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)          # [Bq, D]
+    bq, d = q.shape
+    t_pad = k_ref.shape[1]
+    nk = t_pad // block_k
+    if causal:
+        # highest key block this query block can see
+        nk_eff = jnp.minimum(nk, ((iq + 1) * block_q + block_k - 1) // block_k)
+    else:
+        nk_eff = nk
+
+    row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        col = j * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                     (1, block_k), 1)
+        valid = col < t_true
+        if causal:
+            valid = valid & (col <= row)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)           # fully-masked padding rows
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
+
+
+def _pad_to(x, size, axis):
+    pad = size - x.shape[axis]
+    if pad <= 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _run_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    """q,k,v: [BH, T, D] -> (out [BH, T, D], lse [BH, T])."""
+    bh, t, d = q.shape
+    t_pad = -(-t // max(block_q, block_k)) * max(block_q, block_k)
+    d_pad = -(-d // _LANE) * _LANE
+    qp = _pad_to(_pad_to(q, t_pad, 1), d_pad, 2)
+    kp = _pad_to(_pad_to(k, t_pad, 1), d_pad, 2)
+    vp = _pad_to(_pad_to(v, t_pad, 1), d_pad, 2)
+    grid = (bh, t_pad // block_q)
+    kernel = functools.partial(_attn_kernel, t, causal, scale,
+                               block_q, block_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t_pad, d_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t_pad, d_pad), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_pad, d_pad), q.dtype),
+            jax.ShapeDtypeStruct((bh, t_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :t, :d], lse[:, :t]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _attention(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, _ = _run_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _attention_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _run_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _attention_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    """Recompute P from lse (flash backward, plain-jax formulation):
+    P = exp(S - lse), dV = P^T dO, dS = P*(dO V^T - D), D = rowsum(dO*O)."""
+    q, k, v, out, lse = res
+    f32 = jnp.float32
+    qf, kf, vf = q.astype(f32), k.astype(f32), v.astype(f32)
+    gf, of = g.astype(f32), out.astype(f32)
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    if causal:
+        t = s.shape[-1]
+        cm = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(cm[None], s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])
+    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
+    dp = jnp.einsum("bqd,bkd->bqk", gf, vf)
+    delta = jnp.sum(gf * of, axis=-1, keepdims=True)
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf) * scale
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_attention.defvjp(_attention_fwd, _attention_bwd)
+
+
+def flash_attention(q, k, v, *, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=False):
+    """Fused attention over [B, T, H, D] inputs (same contract as
+    nn/layers/attention.py dot_product_attention minus padding masks)."""
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / float(d) ** 0.5
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    out = _attention(fold(q), fold(k), fold(v), causal, float(scale),
+                     block_q, block_k, interpret)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -199,3 +199,87 @@ class TestFusedLstmKernel:
         layer = L.LSTM(n_out=128)
         x = jnp.zeros((8, 4, 16))
         assert not layer._fused_eligible(x, None)
+
+
+class TestFlashAttention:
+    """ops/attention_pallas.py vs the reference einsum attention
+    (interpret mode on CPU; the dispatch itself is TPU-gated)."""
+
+    def _ref(self, q, k, v, causal=False):
+        import jax.numpy as jnp
+        d = q.shape[-1]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+        if causal:
+            t = logits.shape[-1]
+            logits = jnp.where(jnp.tril(jnp.ones((t, t), bool)), logits,
+                               -jnp.inf)
+        w = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+    def _rand(self, b=2, t=24, h=2, d=8, seed=0):
+        rs = np.random.RandomState(seed)
+        mk = lambda: rs.randn(b, t, h, d).astype(np.float32) * 0.5
+        return mk(), mk(), mk()
+
+    def test_forward_matches_reference(self):
+        from deeplearning4j_tpu.ops.attention_pallas import flash_attention
+        q, k, v = self._rand()
+        out = flash_attention(q, k, v, block_q=8, block_k=8, interpret=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self._ref(q, k, v)),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_causal_matches_reference(self):
+        from deeplearning4j_tpu.ops.attention_pallas import flash_attention
+        q, k, v = self._rand(seed=1)
+        out = flash_attention(q, k, v, causal=True, block_q=8, block_k=8,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self._ref(q, k, v, True)),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_ragged_length_padding(self):
+        # T not a multiple of the block: padded keys must not leak in
+        from deeplearning4j_tpu.ops.attention_pallas import flash_attention
+        q, k, v = self._rand(t=13, seed=2)
+        out = flash_attention(q, k, v, block_q=8, block_k=8, interpret=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self._ref(q, k, v)),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_gradients_match_reference(self):
+        from deeplearning4j_tpu.ops.attention_pallas import flash_attention
+        q, k, v = self._rand(b=1, t=16, h=1, d=8, seed=3)
+
+        def loss_fused(q, k, v):
+            o = flash_attention(q, k, v, causal=True, block_q=8, block_k=8,
+                                interpret=True)
+            return (o * o).sum()
+
+        def loss_ref(q, k, v):
+            o = self._ref(q, k, v, causal=True)
+            return (o * o).sum()
+
+        gf = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=1e-5)
+
+    def test_bf16_inputs(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.ops.attention_pallas import flash_attention
+        q, k, v = self._rand(seed=4)
+        qb, kb, vb = (jnp.asarray(a, jnp.bfloat16) for a in (q, k, v))
+        out = flash_attention(qb, kb, vb, block_q=8, block_k=8,
+                              interpret=True)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(self._ref(q, k, v)),
+            rtol=0.05, atol=0.02)
+
+    def test_supported_gate(self):
+        from deeplearning4j_tpu.ops.attention_pallas import supported
+        assert supported((2, 16, 2, 64), None, np.float32)
+        assert not supported((2, 16, 2, 64), np.ones((2, 16)), np.float32)
+        assert not supported((2, 16, 2, 256), None, np.float32)
