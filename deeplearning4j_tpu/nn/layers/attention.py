@@ -66,9 +66,9 @@ def dot_product_attention(q, k, v, *, mask=None, causal=False, scale=None):
     (ops/attention_pallas.py) — O(T*D) HBM traffic instead of the [B,H,T,T]
     logits tensor; the dispatch seam mirrors the LSTM fused path."""
     from deeplearning4j_tpu.ops import attention_pallas as _ap
-    if _ap.enabled() and _ap.supported(q.shape, mask, q.dtype):
-        s = None if scale is None else float(scale)
-        return _ap.flash_attention(q, k, v, causal=causal, scale=s)
+    if (_ap.enabled() and _ap.supported(q.shape, k.shape, mask, q.dtype)
+            and (scale is None or isinstance(scale, (int, float)))):
+        return _ap.flash_attention(q, k, v, causal=causal, scale=scale)
     cd, ad = _dtypes.compute_dtypes_for(q.dtype)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, ad))

@@ -8,101 +8,122 @@ logits in HBM, this kernel never does.
 
 Kernel design (FlashAttention-style online softmax, TPU-first):
 * Heads fold into the batch: [B, T, H, D] -> [BH, T, D]; head dim pads to
-  the 128-lane width, sequence pads to the block size.
-* Grid = (BH, T/Bq). Each program owns one query block [Bq, D] resident in
-  VMEM and loops over key/value blocks [Bk, D] with the running
-  (max, sum, acc) online-softmax recurrence — the [Bq, Bk] score tile
-  lives only in VMEM/registers, so HBM traffic is O(T*D) not O(T^2).
-* Causal masking skips entire key blocks above the diagonal (the inner
-  fori_loop upper bound shrinks per query block) and masks the partial
-  block; key padding is masked by position against the true length.
+  the 128-lane width, sequence pads to a common multiple of the block
+  sizes.
+* Grid = (BH, T/Bq, T/Bk) with the KEY dimension innermost: each (bh, iq)
+  pair's query block stays VMEM-resident while key/value blocks [Bk, D]
+  stream through, carried by the running (max, sum, acc) online-softmax
+  recurrence held in VMEM scratch — VMEM use is O(Bq*D + Bk*D), so
+  sequence length is bounded by HBM, not VMEM.
+* The [Bq, Bk] score tile lives only in VMEM/registers — HBM traffic is
+  O(T*D) per query block, never O(T^2).
+* Causal masking: key blocks entirely above the diagonal skip their
+  compute via pl.when; the partial block masks by position. Key padding
+  masks against the true length.
 * The kernel also emits the log-sum-exp per row. Backward is a
-  jax.custom_vjp that RECOMPUTES attention probabilities from (q, k, v,
-  lse) — the flash trade: nothing but lse and the output is saved from the
-  forward, so training memory matches inference.
+  jax.custom_vjp that recomputes probabilities from (q, k, v, lse)
+  BLOCKWISE with a lax.scan over key blocks — peak gradient memory is
+  O(BH * T * Bk), not O(BH * T^2).
 
 ``interpret=True`` runs the same kernel on CPU for tests (slow);
 ``enabled()`` gates the fast path to real TPU backends plus an env flag,
-mirroring ops/lstm_pallas.py's dispatch seam.
+sharing the backend check with ops/lstm_pallas.py.
 """
 
 from __future__ import annotations
 
 import functools
+import math
 import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+try:  # TPU memory-space hints are only available on TPU builds
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
 _LANE = 128
 _NEG_INF = -1e30
 
 
-def enabled():
-    flag = os.environ.get("DL4J_TPU_FUSED_ATTENTION", "1") != "0"
-    if not flag:
-        return False
+def backend_is_tpu():
+    """Single backend gate shared by the fused-kernel dispatch seams."""
     try:
-        return jax.devices()[0].platform in ("tpu", "axon")
+        return jax.default_backend() == "tpu"
     except Exception:
         return False
 
 
-def supported(q_shape, mask, dtype):
-    """Fast path applies: no padding mask (the naive path handles masks),
-    head_dim <= 128, float dtype."""
-    b, t, h, d = q_shape
+def enabled():
+    if os.environ.get("DL4J_TPU_FUSED_ATTENTION", "1") == "0":
+        return False
+    return backend_is_tpu()
+
+
+def supported(q_shape, k_shape, mask, dtype):
+    """Fast path applies: self-attention shapes only (q and k share the
+    sequence length — KV-cache decode goes to the naive path), no padding
+    mask, head_dim <= 128, float dtype."""
     if mask is not None:
         return False
-    if d > _LANE:
+    if tuple(q_shape) != tuple(k_shape):
+        return False
+    if q_shape[-1] > _LANE:
         return False
     return jnp.issubdtype(dtype, jnp.floating)
 
 
 def _attn_kernel(t_true, causal, scale, block_q, block_k,
-                 q_ref, k_ref, v_ref, o_ref, lse_ref):
+                 q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s):
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)          # [Bq, D]
-    bq, d = q.shape
-    t_pad = k_ref.shape[1]
-    nk = t_pad // block_k
-    if causal:
-        # highest key block this query block can see
-        nk_eff = jnp.minimum(nk, ((iq + 1) * block_q + block_k - 1) // block_k)
-    else:
-        nk_eff = nk
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    @pl.when(j == 0)
+    def _():
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    bq = q_ref.shape[1]
+    row_max = (iq + 1) * block_q - 1
+    live = (j * block_k <= row_max) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32)                     # [Bq, D]
+        k = k_ref[0].astype(jnp.float32)                     # [Bk, D]
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         col = j * block_k + jax.lax.broadcasted_iota(jnp.int32,
                                                      (1, block_k), 1)
         valid = col < t_true
         if causal:
+            row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                          (bq, 1), 0)
             valid = valid & (col <= row)
         s = jnp.where(valid, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        m_old = m_s[:]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+        alpha = jnp.exp(m_old - m_new)
+        m_s[:] = m_new
+        l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=-1)
+        acc_s[:] = acc_s[:] * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
 
-    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
-    l_safe = jnp.maximum(l, 1e-30)           # fully-masked padding rows
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe)).astype(lse_ref.dtype)
+    @pl.when(j == nk - 1)
+    def _():
+        l_safe = jnp.maximum(l_s[:], 1e-30)  # fully-masked padding rows
+        o_ref[0] = (acc_s[:] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_s[:] + jnp.log(l_safe)).astype(lse_ref.dtype)
 
 
 def _pad_to(x, size, axis):
@@ -117,30 +138,38 @@ def _pad_to(x, size, axis):
 def _run_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     """q,k,v: [BH, T, D] -> (out [BH, T, D], lse [BH, T])."""
     bh, t, d = q.shape
-    t_pad = -(-t // max(block_q, block_k)) * max(block_q, block_k)
+    step = math.lcm(block_q, block_k)
+    t_pad = -(-t // step) * step
     d_pad = -(-d // _LANE) * _LANE
     qp = _pad_to(_pad_to(q, t_pad, 1), d_pad, 2)
     kp = _pad_to(_pad_to(k, t_pad, 1), d_pad, 2)
     vp = _pad_to(_pad_to(v, t_pad, 1), d_pad, 2)
-    grid = (bh, t_pad // block_q)
+    grid = (bh, t_pad // block_q, t_pad // block_k)
     kernel = functools.partial(_attn_kernel, t, causal, scale,
                                block_q, block_k)
+    scratch = [pltpu.VMEM((block_q,), jnp.float32),
+               pltpu.VMEM((block_q,), jnp.float32),
+               pltpu.VMEM((block_q, d_pad), jnp.float32)] if _HAS_PLTPU else [
+        jax.ShapeDtypeStruct((block_q,), jnp.float32),
+        jax.ShapeDtypeStruct((block_q,), jnp.float32),
+        jax.ShapeDtypeStruct((block_q, d_pad), jnp.float32)]
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d_pad), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t_pad, d_pad), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t_pad, d_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d_pad), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t_pad, d_pad), q.dtype),
             jax.ShapeDtypeStruct((bh, t_pad), jnp.float32),
         ],
+        scratch_shapes=scratch,
         interpret=interpret,
     )(qp, kp, vp)
     return out[:, :t, :d], lse[:, :t]
@@ -158,24 +187,45 @@ def _attention_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _attention_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    """Recompute P from lse (flash backward, plain-jax formulation):
-    P = exp(S - lse), dV = P^T dO, dS = P*(dO V^T - D), D = rowsum(dO*O)."""
+    """Blockwise flash backward in jax: scan over KEY blocks recomputing
+    P = exp(S - lse) one [BH, T, Bk] tile at a time. dq accumulates in the
+    carry; dk/dv stack per block. Peak memory O(BH*T*Bk), never O(T^2)."""
     q, k, v, out, lse = res
     f32 = jnp.float32
     qf, kf, vf = q.astype(f32), k.astype(f32), v.astype(f32)
     gf, of = g.astype(f32), out.astype(f32)
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
-    if causal:
-        t = s.shape[-1]
-        cm = jnp.tril(jnp.ones((t, t), bool))
-        s = jnp.where(cm[None], s, _NEG_INF)
-    p = jnp.exp(s - lse[..., None])
-    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
-    dp = jnp.einsum("bqd,bkd->bqk", gf, vf)
-    delta = jnp.sum(gf * of, axis=-1, keepdims=True)
-    ds = p * (dp - delta)
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf) * scale
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
+    bh, t, d = qf.shape
+    bk = block_k
+    t_pad = -(-t // bk) * bk
+    kp = _pad_to(kf, t_pad, 1).reshape(bh, t_pad // bk, bk, d)
+    vp = _pad_to(vf, t_pad, 1).reshape(bh, t_pad // bk, bk, d)
+    # move the block axis to front for scan
+    kp = jnp.moveaxis(kp, 1, 0)                      # [nk, BH, Bk, D]
+    vp = jnp.moveaxis(vp, 1, 0)
+    delta = jnp.sum(gf * of, axis=-1, keepdims=True)  # [BH, T, 1]
+    row = jnp.arange(t)[None, :, None]                # [1, T, 1]
+
+    def body(carry, blk):
+        dq_acc, j = carry
+        k_j, v_j = blk                                # [BH, Bk, D]
+        col = j * bk + jnp.arange(bk)[None, None, :]  # [1, 1, Bk]
+        s = jnp.einsum("bqd,bkd->bqk", qf, k_j) * scale
+        valid = col < t
+        if causal:
+            valid = valid & (col <= row)
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])               # [BH, T, Bk]
+        dv_j = jnp.einsum("bqk,bqd->bkd", p, gf)
+        dp = jnp.einsum("bqd,bkd->bqk", gf, v_j)
+        ds = p * (dp - delta)
+        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, k_j) * scale
+        dk_j = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
+        return (dq_acc, j + 1), (dk_j, dv_j)
+
+    (dq, _), (dk_blocks, dv_blocks) = jax.lax.scan(
+        body, (jnp.zeros_like(qf), 0), (kp, vp))
+    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(bh, t_pad, d)[:, :t]
+    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(bh, t_pad, d)[:, :t]
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -184,8 +234,9 @@ _attention.defvjp(_attention_fwd, _attention_bwd)
 
 def flash_attention(q, k, v, *, causal=False, scale=None, block_q=128,
                     block_k=128, interpret=False):
-    """Fused attention over [B, T, H, D] inputs (same contract as
-    nn/layers/attention.py dot_product_attention minus padding masks)."""
+    """Fused attention over [B, T, H, D] self-attention inputs (same
+    contract as nn/layers/attention.py dot_product_attention minus padding
+    masks and cross-length decode)."""
     b, t, h, d = q.shape
     if scale is None:
         scale = 1.0 / float(d) ** 0.5

@@ -360,12 +360,10 @@ def enabled():
     AND a TPU backend (CPU always takes the reference scan path outside
     interpret-mode tests)."""
     import os
+    from deeplearning4j_tpu.ops.attention_pallas import backend_is_tpu
     if os.environ.get("DL4J_TPU_FUSED_LSTM", "1") == "0":
         return False
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+    return backend_is_tpu()
 
 
 def supported(x_shape, hsz, *, peephole, mask, gate_activation, activation):

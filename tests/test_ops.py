@@ -280,6 +280,19 @@ class TestFlashAttention:
 
     def test_supported_gate(self):
         from deeplearning4j_tpu.ops.attention_pallas import supported
-        assert supported((2, 16, 2, 64), None, np.float32)
-        assert not supported((2, 16, 2, 64), np.ones((2, 16)), np.float32)
-        assert not supported((2, 16, 2, 256), None, np.float32)
+        assert supported((2, 16, 2, 64), (2, 16, 2, 64), None, np.float32)
+        assert not supported((2, 16, 2, 64), (2, 16, 2, 64),
+                             np.ones((2, 16)), np.float32)
+        assert not supported((2, 16, 2, 256), (2, 16, 2, 256), None,
+                             np.float32)
+        # KV-cache decode (tq != tk) must fall back to the naive path
+        assert not supported((2, 1, 2, 64), (2, 16, 2, 64), None, np.float32)
+
+    def test_non_divisor_blocks(self):
+        # t=20 with block_q=8, block_k=6 pads to lcm(8,6)=24
+        from deeplearning4j_tpu.ops.attention_pallas import flash_attention
+        q, k, v = self._rand(t=20, seed=5)
+        out = flash_attention(q, k, v, block_q=8, block_k=6, interpret=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self._ref(q, k, v)),
+                                   rtol=2e-5, atol=2e-6)
