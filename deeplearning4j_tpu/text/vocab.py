@@ -9,6 +9,7 @@ softmax (models/word2vec/Huffman.java, graph variant GraphHuffman.java) in
 from __future__ import annotations
 
 import collections
+import dataclasses as _dc
 import heapq
 
 import numpy as np
@@ -26,6 +27,39 @@ class VocabWord:
 
     def __repr__(self):
         return f"VocabWord({self.word!r}, count={self.count}, idx={self.index})"
+
+
+@_dc.dataclass
+class FlatCorpus:
+    """One np.unique pass over a whole corpus, shared by vocab construction
+    and corpus encoding: tokens[i] == uniq[inverse[i]]."""
+    uniq: object      # [U] distinct tokens (sorted)
+    inverse: object   # [N] index into uniq per corpus token
+    counts: object    # [U]
+    lens: object      # [n_sequences] tokens per sequence
+
+
+def flatten_corpus(sequences):
+    """FlatCorpus for the token sequences, or None when the tokens are not
+    amenable to np.unique (mixed types that don't order, tuple tokens that
+    would form 2-D object arrays, ...) — callers then use dict-loop paths."""
+    seqs = sequences if isinstance(sequences, (list, tuple)) else \
+        list(sequences)
+    lens = np.fromiter((len(s) for s in seqs), np.int64, len(seqs))
+    chunks = [np.asarray(s, object) for s in seqs if len(s)]
+    if not chunks:
+        z = np.zeros(0, object)
+        return FlatCorpus(z, np.zeros(0, np.int64), np.zeros(0, np.int64),
+                          lens)
+    if any(c.ndim != 1 for c in chunks):
+        return None  # tuple/sequence tokens became 2-D object arrays
+    tokens = np.concatenate(chunks)
+    try:
+        uniq, inverse, counts = np.unique(tokens, return_inverse=True,
+                                          return_counts=True)
+    except TypeError:  # unorderable mixed token types
+        return None
+    return FlatCorpus(uniq, inverse, counts, lens)
 
 
 class VocabCache:
@@ -116,17 +150,33 @@ def huffman_encode(vocab: VocabCache):
 
 class VocabConstructor:
     """Build a VocabCache from an iterable of token sequences (reference:
-    VocabConstructor.buildJointVocabulary)."""
+    VocabConstructor.buildJointVocabulary). Counting runs through ONE
+    np.unique pass over the flattened corpus when token types allow."""
 
     def __init__(self, min_count=5, build_huffman=True):
         self.min_count = min_count
         self.build_huffman = build_huffman
 
     def build(self, sequences) -> VocabCache:
+        corpus = flatten_corpus(sequences)
+        if corpus is not None:
+            return self.build_from_counts(corpus.uniq, corpus.counts)
+        # fallback: tokens not orderable/scalar (mixed types, tuples, ...)
         vocab = VocabCache()
         for seq in sequences:
             for tok in seq:
                 vocab.add(tok)
+        vocab.finalize(self.min_count)
+        if self.build_huffman:
+            huffman_encode(vocab)
+        return vocab
+
+    def build_from_counts(self, words, counts) -> VocabCache:
+        """Build from precomputed (word, count) pairs — the flatten/unique
+        pass is shared with corpus encoding (see flatten_corpus)."""
+        vocab = VocabCache()
+        for tok, cnt in zip(words, counts):
+            vocab.add(tok, int(cnt))
         vocab.finalize(self.min_count)
         if self.build_huffman:
             huffman_encode(vocab)

@@ -28,7 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.text.vocab import VocabCache, VocabConstructor
+from deeplearning4j_tpu.text.vocab import (VocabCache, VocabConstructor,
+                                           flatten_corpus)
 
 
 class AliasTable:
@@ -273,9 +274,12 @@ class SequenceVectors:
 
     # ---- vocab + tables ----
 
-    def build_vocab(self, sequences):
-        self.vocab = VocabConstructor(self.min_count,
-                                      build_huffman=self.use_hs).build(sequences)
+    def build_vocab(self, sequences, _flat=None):
+        ctor = VocabConstructor(self.min_count, build_huffman=self.use_hs)
+        if _flat is not None:
+            self.vocab = ctor.build_from_counts(_flat.uniq, _flat.counts)
+        else:
+            self.vocab = ctor.build(sequences)
         v, d = len(self.vocab), self.vector_size
         rs = np.random.RandomState(self.seed)
         self.syn0 = jnp.asarray((rs.rand(v, d).astype(np.float32) - 0.5) / d)
@@ -315,13 +319,31 @@ class SequenceVectors:
         idx = [self.vocab.index_of(t) for t in seq]
         return [i for i in idx if i >= 0]
 
-    def _encode_corpus(self, sequences):
-        """Flatten to (flat_idx [N], seq_id [N]); computed once per fit."""
-        enc = [self._encode(s) for s in sequences]
-        flat = np.asarray([i for e in enc for i in e], np.int32)
-        seq_id = np.repeat(np.arange(len(enc), dtype=np.int32),
-                           [len(e) for e in enc])
-        return flat, seq_id
+    def _encode_corpus(self, sequences, _flat=None):
+        """Flatten to (flat_idx [N], seq_id [N]); computed once per fit.
+
+        Token->index mapping runs through ONE np.unique pass over the whole
+        corpus (shared with vocab construction when fit() builds both) + one
+        dict lookup PER DISTINCT TOKEN, instead of a Python dict hit per
+        token — the encoding half of the reference's multithreaded host
+        pipeline (SequenceVectors VectorCalculationsThread tokenize/lookup
+        stage). Falls back to per-token dict lookups for token types
+        np.unique cannot order."""
+        corpus = _flat if _flat is not None else flatten_corpus(sequences)
+        if corpus is None:  # exotic token types: dict path
+            enc = [self._encode(s) for s in sequences]
+            flat = np.asarray([i for e in enc for i in e], np.int32)
+            seq_id = np.repeat(np.arange(len(enc), dtype=np.int32),
+                               [len(e) for e in enc])
+            return flat, seq_id
+        lut = np.fromiter((self.vocab.index_of(t) for t in corpus.uniq),
+                          np.int32, len(corpus.uniq))
+        flat_all = lut[corpus.inverse] if len(corpus.inverse) else \
+            np.zeros(0, np.int32)
+        seq_id_all = np.repeat(
+            np.arange(len(corpus.lens), dtype=np.int32), corpus.lens)
+        keep = flat_all >= 0  # drop out-of-vocab tokens
+        return flat_all[keep].astype(np.int32), seq_id_all[keep]
 
     def _subsampled(self, flat, seq_id):
         """Per-epoch frequent-word subsampling (word2vec p_keep)."""
@@ -395,9 +417,10 @@ class SequenceVectors:
         """
         seq_list = [list(s) for s in sequences]
         self.examples_dropped = 0
+        flat = flatten_corpus(seq_list)  # ONE pass feeds vocab + encoding
         if self.vocab is None:
-            self.build_vocab(seq_list)
-        corpus = self._encode_corpus(seq_list)  # once, not per epoch
+            self.build_vocab(seq_list, _flat=flat)
+        corpus = self._encode_corpus(seq_list, _flat=flat)  # once, not per epoch
         total_steps = max(self.epochs, 1)
         losses = []
         for epoch in range(self.epochs):
