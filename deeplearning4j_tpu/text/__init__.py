@@ -6,5 +6,7 @@ from deeplearning4j_tpu.text.vocab import VocabCache, VocabConstructor, huffman_
 from deeplearning4j_tpu.text.word2vec import SequenceVectors, Word2Vec  # noqa: F401
 from deeplearning4j_tpu.text.paragraph_vectors import ParagraphVectors  # noqa: F401
 from deeplearning4j_tpu.text.glove import GloVe  # noqa: F401
-from deeplearning4j_tpu.text.serializer import load_word_vectors, save_word_vectors  # noqa: F401
+from deeplearning4j_tpu.text.serializer import (  # noqa: F401
+    StaticWordVectors, load_word2vec_binary, load_word_vectors,
+    save_word2vec_binary, save_word_vectors)
 from deeplearning4j_tpu.text.bow import BagOfWordsVectorizer, TfidfVectorizer  # noqa: F401

@@ -1,13 +1,18 @@
-"""Word-vector serialization (text format, word2vec-compatible).
+"""Word-vector serialization: text and word2vec C binary formats.
 
 Reference analog: models/embeddings/loader/WordVectorSerializer.java in
-/root/reference/deeplearning4j-nlp-parent/deeplearning4j-nlp (writeWordVectors
-/ loadTxtVectors).
+/root/reference/deeplearning4j-nlp-parent/deeplearning4j-nlp
+(writeWordVectors / loadTxtVectors / readBinaryModel — the loader behind
+loadGoogleModel for GoogleNews-vectors-negative300.bin et al.). Loaded
+vectors come back either as raw (words, matrix) or as a queryable
+StaticWordVectors exposing the WordVectors interface surface
+(get_word_vector / similarity / words_nearest).
 """
 
 from __future__ import annotations
 
 import gzip
+import struct
 
 import numpy as np
 
@@ -36,3 +41,101 @@ def load_word_vectors(path):
             words.append(parts[0])
             rows.append([float(v) for v in parts[1:dim + 1]])
     return words, np.asarray(rows, np.float32)
+
+
+def save_word2vec_binary(model, path):
+    """word2vec C binary format (the GoogleNews interchange format the
+    reference reads via readBinaryModel): ASCII `<count> <dim>\\n` header,
+    then per word `<word> ` + dim little-endian float32s + `\\n`."""
+    words = model.vocab.words()
+    vecs = np.asarray(model.syn0, np.float32)
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "wb") as f:
+        f.write(f"{len(words)} {vecs.shape[1]}\n".encode("utf-8"))
+        for i, w in enumerate(words):
+            f.write(w.encode("utf-8") + b" ")
+            f.write(vecs[i].astype("<f4").tobytes())
+            f.write(b"\n")
+    return path
+
+
+def load_word2vec_binary(path):
+    """Read the word2vec C binary format. Returns (words, matrix [V,D]).
+    Tolerates both `vec\\n` and bare `vec` record terminators (tools differ,
+    the reference's reader skips the byte when present)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        header = b""
+        while not header.endswith(b"\n"):
+            ch = f.read(1)
+            if not ch:
+                raise ValueError("truncated word2vec binary header")
+            header += ch
+        count, dim = (int(x) for x in header.split())
+        vec_bytes = dim * 4
+        words, rows = [], []
+        for _ in range(count):
+            w = b""
+            while True:
+                ch = f.read(1)
+                if not ch:
+                    raise ValueError("truncated word2vec binary body")
+                if ch == b" ":
+                    break
+                if ch != b"\n":  # leading newline from the previous record
+                    w += ch
+            buf = f.read(vec_bytes)
+            if len(buf) != vec_bytes:
+                raise ValueError("truncated vector data")
+            words.append(w.decode("utf-8"))
+            rows.append(np.frombuffer(buf, dtype="<f4"))
+    return words, np.asarray(rows, np.float32)
+
+
+class StaticWordVectors:
+    """Queryable lookup over loaded vectors (reference: the WordVectors
+    interface surface returned by WordVectorSerializer loaders)."""
+
+    def __init__(self, words, matrix):
+        self.words = list(words)
+        self.matrix = np.asarray(matrix, np.float32)
+        self._index = {w: i for i, w in enumerate(self.words)}
+        norms = np.linalg.norm(self.matrix, axis=1, keepdims=True)
+        self._unit = self.matrix / np.maximum(norms, 1e-12)
+
+    @classmethod
+    def load(cls, path, binary=None):
+        """Auto-detects text vs binary unless ``binary`` is given."""
+        if binary is None:
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rb") as f:
+                head = f.read(256)
+            # binary bodies contain raw float bytes right after the header
+            line_end = head.find(b"\n")
+            body = head[line_end + 1:line_end + 64]
+            binary = any(b > 0x7f for b in body)
+        words, mat = (load_word2vec_binary(path) if binary
+                      else load_word_vectors(path))
+        return cls(words, mat)
+
+    def has_word(self, word):
+        return word in self._index
+
+    def get_word_vector(self, word):
+        i = self._index.get(word)
+        return None if i is None else self.matrix[i]
+
+    def similarity(self, w1, w2):
+        a, b = self._index.get(w1), self._index.get(w2)
+        if a is None or b is None:
+            return float("nan")
+        return float(self._unit[a] @ self._unit[b])
+
+    def words_nearest(self, word, top_n=10):
+        i = self._index.get(word)
+        if i is None:
+            return []
+        sims = self._unit @ self._unit[i]
+        order = np.argsort(-sims)
+        return [(self.words[j], float(sims[j]))
+                for j in order if j != i][:top_n]

@@ -292,3 +292,51 @@ class TestDistributedWord2Vec:
         with pytest.raises(ValueError, match="divide"):
             SequenceVectors(vector_size=8, min_count=1, batch_size=65,
                             mesh=mesh, seed=1)
+
+
+class TestWordVectorBinaryFormat:
+    """word2vec C binary interchange format (reference:
+    WordVectorSerializer.readBinaryModel / the GoogleNews loader)."""
+
+    def _fit(self):
+        sv = SequenceVectors(vector_size=12, min_count=1, negative=2,
+                             epochs=1, seed=21, subsample=0)
+        sv.fit([["alpha", "beta", "gamma", "delta"] * 5] * 10)
+        return sv
+
+    def test_binary_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.text.serializer import (
+            load_word2vec_binary, save_word2vec_binary)
+        sv = self._fit()
+        p = str(tmp_path / "vecs.bin")
+        save_word2vec_binary(sv, p)
+        words, mat = load_word2vec_binary(p)
+        assert set(words) == {"alpha", "beta", "gamma", "delta"}
+        np.testing.assert_allclose(mat[words.index("beta")],
+                                   sv.get_word_vector("beta"), rtol=1e-6)
+
+    def test_static_word_vectors_autodetect(self, tmp_path):
+        from deeplearning4j_tpu.text.serializer import (
+            StaticWordVectors, save_word2vec_binary)
+        sv = self._fit()
+        pb = str(tmp_path / "vecs.bin")
+        pt = str(tmp_path / "vecs.txt")
+        save_word2vec_binary(sv, pb)
+        save_word_vectors(sv, pt)
+        for p in (pb, pt):
+            wv = StaticWordVectors.load(p)
+            assert wv.has_word("gamma")
+            np.testing.assert_allclose(wv.get_word_vector("gamma"),
+                                       sv.get_word_vector("gamma"),
+                                       rtol=1e-4, atol=1e-5)
+            assert wv.similarity("gamma", "gamma") == pytest.approx(1.0)
+            assert len(wv.words_nearest("alpha", 2)) == 2
+
+    def test_gz_binary(self, tmp_path):
+        from deeplearning4j_tpu.text.serializer import (
+            StaticWordVectors, save_word2vec_binary)
+        sv = self._fit()
+        p = str(tmp_path / "vecs.bin.gz")
+        save_word2vec_binary(sv, p)
+        wv = StaticWordVectors.load(p)
+        assert wv.has_word("delta")
