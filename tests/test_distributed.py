@@ -205,3 +205,52 @@ def test_ragged_tail_rotates_and_is_counted(mesh8):
     stats = master.training_stats()
     assert stats["examples_dropped"] == 5 * 3
     assert stats["splits"] == 3 * 3
+
+
+class TestDataPlumbing:
+    """parallel/data_utils.py (reference: dl4j-spark data/ +
+    HashingBalancedPartitioner)."""
+
+    def test_balanced_assignment_per_class(self):
+        from deeplearning4j_tpu.parallel.data_utils import (
+            balanced_shard_assignment)
+        rs = np.random.RandomState(0)
+        # skewed classes: 80/15/5 split over 300 examples
+        labels = rs.choice(3, 300, p=[0.8, 0.15, 0.05])
+        assign = balanced_shard_assignment(labels, 4, seed=1)
+        assert assign.shape == (300,) and set(assign) <= {0, 1, 2, 3}
+        for cls in range(3):
+            per_shard = np.bincount(assign[labels == cls], minlength=4)
+            assert per_shard.max() - per_shard.min() <= 1, \
+                f"class {cls} unbalanced: {per_shard}"
+
+    def test_rebalance_contiguous_shards(self):
+        from deeplearning4j_tpu.parallel.data_utils import rebalance
+        rs = np.random.RandomState(1)
+        x = rs.rand(103, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.choice(2, 103, p=[0.7, 0.3])]
+        xr, yr, shard_size, dropped = rebalance(x, y, 4, seed=2)
+        assert shard_size == 25 and dropped == 3
+        cls = np.argmax(yr, 1)
+        fractions = [cls[i * 25:(i + 1) * 25].mean() for i in range(4)]
+        assert max(fractions) - min(fractions) < 0.1  # shards look alike
+
+    def test_export_reload_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.parallel.data_utils import (
+            export_batches, load_exported_batches)
+        rs = np.random.RandomState(2)
+        x = rs.rand(50, 3).astype(np.float32)
+        y = rs.rand(50, 2).astype(np.float32)
+        paths = export_batches(x, y, str(tmp_path), batch_size=16)
+        assert len(paths) == 3  # ragged tail not exported
+        back_x = np.concatenate([f for f, _ in
+                                 load_exported_batches(str(tmp_path))])
+        np.testing.assert_array_equal(back_x, x[:48])
+
+    def test_split_dataset(self):
+        from deeplearning4j_tpu.parallel.data_utils import split_dataset
+        x = np.arange(20.0).reshape(10, 2)
+        y = np.arange(10.0)
+        parts = split_dataset(x, y, 4)
+        assert [len(p[0]) for p in parts] == [4, 4, 2]
+        np.testing.assert_array_equal(parts[1][0], x[4:8])
