@@ -1,17 +1,27 @@
-"""Language-pack tokenizer factories: Chinese, Japanese, Korean.
+"""Language-pack tokenizer factories: Chinese, Japanese, Korean (+ sentence
+segmentation, the uima-pack role).
 
-Reference analog: the deeplearning4j-nlp-{chinese,japanese,korean} modules
-(SURVEY.md §2.6) — ChineseTokenizerFactory (ansj segmenter),
+Reference analog: the deeplearning4j-nlp-{chinese,japanese,korean,uima}
+modules (SURVEY.md §2.6) — ChineseTokenizerFactory (ansj segmenter),
 JapaneseTokenizerFactory (kuromoji morphological analyzer),
-KoreanTokenizerFactory (twitter-korean-text). Those wrap ~20k LoC of
-third-party segmenter code; here the factories implement the same
-``create(text) -> Tokenizer`` SPI with self-contained segmentation:
+KoreanTokenizerFactory (twitter-korean-text), UimaTokenizerFactory
+(sentence/token annotators). Those wrap ~20k LoC of third-party segmenter
+code; here the factories implement the same ``create(text) -> Tokenizer``
+SPI with self-contained segmentation:
 
-* dictionary-driven maximum-matching when a user lexicon is supplied (the
-  standard CJK segmentation baseline the heavyweight libraries refine), and
-* script-aware fallback otherwise: CJK-ideograph runs split per character
-  (each Han character is a token — the n-gram-friendly default), kana runs
-  kept whole per script, Hangul/latin/digit runs kept whole.
+* dictionary-driven maximum-matching over an EMBEDDED starter lexicon of
+  high-frequency words (extensible/replaceable with a user lexicon) — the
+  standard CJK segmentation baseline the heavyweight libraries refine;
+* script-aware fallback: unmatched Han characters tokenize per character
+  (the n-gram-friendly default), kana/hangul runs follow per-language rules;
+* Japanese: okurigana attachment (a short hiragana tail after a kanji run
+  joins the kanji token, e.g. 食べ), hiragana runs split on common
+  particles (は/が/を/に/で/と/も/の/から/まで/...);
+* Korean: josa (particle) stripping from eojeol ends (은/는/이/가/을/를/
+  에/의/로/...), emitting the stem — twitter-korean-text's signature
+  normalization;
+* ``split_sentences``: multi-script rule-based sentence segmentation
+  (。！？.!? + closing quotes), the uima SentenceAnnotator role.
 
 The factories plug into everything SequenceVectors-based (Word2Vec,
 ParagraphVectors, TF-IDF) exactly like the reference's language packs plug
@@ -23,6 +33,43 @@ from __future__ import annotations
 import unicodedata
 
 from deeplearning4j_tpu.text.tokenization import Tokenizer
+
+# ---------------------------------------------------------------------------
+# embedded starter lexicons: high-frequency words. Deliberately small —
+# enough to beat the per-character baseline on common text; production use
+# supplies a domain lexicon via the factory argument.
+# ---------------------------------------------------------------------------
+
+_ZH_LEXICON = (
+    "我们 你们 他们 她们 这个 那个 什么 怎么 为什么 因为 所以 但是 可是 "
+    "如果 虽然 然后 现在 时候 今天 明天 昨天 已经 还是 就是 不是 没有 "
+    "可以 应该 需要 知道 觉得 喜欢 工作 学习 学校 老师 学生 朋友 时间 "
+    "问题 地方 国家 中国 世界 大家 东西 事情 孩子 先生 小姐 谢谢 再见 "
+    "电脑 手机 网络 数据 模型 训练 机器 学习 人工 智能").split()
+
+_JA_LEXICON = (
+    "これ それ あれ どれ ここ そこ どこ わたし あなた 私たち 日本 東京 "
+    "学校 先生 学生 友達 時間 問題 仕事 今日 明日 昨日 食べる 飲む 行く "
+    "来る 見る 聞く 話す 読む 書く 思う 言う ありがとう こんにちは "
+    "さようなら データ モデル 学習 機械").split()
+
+_KO_LEXICON = (
+    "우리 너희 그들 이것 그것 저것 여기 거기 어디 무엇 언제 누구 왜 "
+    "어떻게 오늘 내일 어제 시간 문제 일 학교 선생님 학생 친구 한국 "
+    "서울 세계 사람 아이 감사합니다 안녕하세요 데이터 모델 학습 기계").split()
+
+#: common Korean particles (josa), longest first for greedy suffix matching
+_KO_JOSA = sorted(
+    ("은", "는", "이", "가", "을", "를", "에", "의", "와", "과", "도", "만",
+     "로", "으로", "에서", "에게", "한테", "께서", "부터", "까지", "보다",
+     "처럼", "마다", "조차", "밖에", "이나", "나", "라도", "든지"),
+    key=len, reverse=True)
+
+#: common Japanese particles used to split long hiragana runs
+_JA_PARTICLES = sorted(
+    ("は", "が", "を", "に", "で", "と", "も", "の", "へ", "や", "から",
+     "まで", "より", "ので", "のに", "けど", "でも", "だけ", "など", "ね",
+     "よ", "か"), key=len, reverse=True)
 
 
 def _char_class(ch):
@@ -58,16 +105,54 @@ def _script_runs(text):
     return runs
 
 
-class _CjkTokenizerFactoryBase:
-    """Shared CJK factory: optional lexicon maximum-matching + script runs."""
+_SENT_END = set("。！？．.!?")
+_SENT_TRAIL = set("」』）)\"'”’")
 
-    #: scripts whose runs are split per-character without a lexicon
+
+def split_sentences(text):
+    """Rule-based sentence segmentation across scripts (reference: the uima
+    pack's SentenceAnnotator role): break after 。！？.!?, keeping trailing
+    closing quotes/brackets with the finished sentence."""
+    out, cur = [], ""
+    i, n = 0, len(text)
+    while i < n:
+        ch = text[i]
+        cur += ch
+        if ch in _SENT_END:
+            # abbreviation guard for latin '.': next char lowercase/digit
+            if ch == "." and i + 1 < n and (text[i + 1].isalnum()):
+                i += 1
+                continue
+            while i + 1 < n and text[i + 1] in _SENT_TRAIL:
+                cur += text[i + 1]
+                i += 1
+            s = cur.strip()
+            if s:
+                out.append(s)
+            cur = ""
+        i += 1
+    s = cur.strip()
+    if s:
+        out.append(s)
+    return out
+
+
+class _CjkTokenizerFactoryBase:
+    """Shared CJK factory: lexicon maximum-matching + script-run rules."""
+
+    #: scripts whose runs are segmented (vs kept whole)
     per_char_scripts = ("han",)
     #: scripts dropped from output
     drop = ("space", "punct")
+    #: built-in starter lexicon (merged under a user-supplied one)
+    default_lexicon = ()
 
-    def __init__(self, lexicon=None, preprocessor=None, max_word_len=8):
-        self.lexicon = set(lexicon) if lexicon else None
+    def __init__(self, lexicon=None, preprocessor=None, max_word_len=8,
+                 use_default_lexicon=True):
+        self.lexicon = set(self.default_lexicon) if use_default_lexicon \
+            else set()
+        if lexicon:
+            self.lexicon |= set(lexicon)
         self.preprocessor = preprocessor
         self.max_word_len = max_word_len
 
@@ -93,9 +178,12 @@ class _CjkTokenizerFactoryBase:
                 i += 1
         return out
 
+    def _runs(self, text):
+        return _script_runs(unicodedata.normalize("NFKC", text))
+
     def create(self, text: str) -> Tokenizer:
         tokens = []
-        for run, cls in _script_runs(unicodedata.normalize("NFKC", text)):
+        for run, cls in self._runs(text):
             if cls in self.drop:
                 continue
             tokens.extend(self._segment_run(run, cls))
@@ -107,37 +195,119 @@ class _CjkTokenizerFactoryBase:
 
 class ChineseTokenizerFactory(_CjkTokenizerFactoryBase):
     """Reference: deeplearning4j-nlp-chinese ChineseTokenizerFactory (ansj).
-    Han runs are lexicon-max-matched (or per-character without a lexicon)."""
+    Han runs max-match the embedded+user lexicon; unmatched characters
+    tokenize per character."""
 
     per_char_scripts = ("han",)
+    default_lexicon = _ZH_LEXICON
 
 
 class JapaneseTokenizerFactory(_CjkTokenizerFactoryBase):
     """Reference: deeplearning4j-nlp-japanese JapaneseTokenizerFactory
-    (kuromoji). Kanji runs segment like Chinese; kana runs are kept whole per
-    script (a coarse but useful morpheme proxy), and a lexicon (e.g. a
-    user dictionary of surface forms) refines all three scripts."""
+    (kuromoji). Heuristic morphology in place of the full analyzer:
+
+    * a short hiragana tail (<=2 chars) directly after a kanji run attaches
+      to the kanji token (okurigana: 食べ, 思い);
+    * longer hiragana runs split on common particles;
+    * katakana runs (loanwords) stay whole; the lexicon refines everything.
+    """
 
     per_char_scripts = ("han", "hiragana", "katakana")
+    default_lexicon = _JA_LEXICON
+
+    OKURIGANA_MAX = 2
+
+    def create(self, text: str) -> Tokenizer:
+        runs = self._runs(text)
+        tokens = []
+        i = 0
+        while i < len(runs):
+            run, cls = runs[i]
+            if cls in self.drop:
+                i += 1
+                continue
+            if (cls == "han" and i + 1 < len(runs)
+                    and runs[i + 1][1] == "hiragana"
+                    and len(runs[i + 1][0]) <= self.OKURIGANA_MAX
+                    and runs[i + 1][0] not in _JA_PARTICLES):
+                # kanji + short okurigana = one token (e.g. 食べ) — but a
+                # bare particle after kanji (肉を) is a boundary, not a tail
+                tokens.append(run + runs[i + 1][0])
+                i += 2
+                continue
+            tokens.extend(self._segment_run(run, cls))
+            i += 1
+        if self.preprocessor is not None:
+            tokens = [self.preprocessor.pre_process(t) for t in tokens]
+            tokens = [t for t in tokens if t]
+        return Tokenizer(tokens)
 
     def _segment_run(self, run, cls):
-        if cls not in self.per_char_scripts:
-            return [run]  # latin/digit/hangul runs stay whole
-        if self.lexicon:
-            return self._max_match(run)
+        if cls == "katakana":
+            return [run]
+        if cls == "hiragana":
+            return self._split_particles(run)
         if cls == "han":
+            if self.lexicon:
+                return self._max_match(run)
             return list(run)
-        return [run]  # whole kana run
+        return [run]
+
+    def _split_particles(self, run):
+        """Lexicon max-match first; then peel common particles greedily."""
+        if self.lexicon:
+            pieces = self._max_match(run)
+        else:
+            pieces = [run]
+        out = []
+        for piece in pieces:
+            if len(piece) == 1 or piece in self.lexicon:
+                out.append(piece)
+                continue
+            i, n = 0, len(piece)
+            while i < n:
+                for p in _JA_PARTICLES:
+                    if piece.startswith(p, i):
+                        out.append(p)
+                        i += len(p)
+                        break
+                else:
+                    # consume until the next particle boundary
+                    j = i + 1
+                    while j < n and not any(piece.startswith(p, j)
+                                            for p in _JA_PARTICLES):
+                        j += 1
+                    out.append(piece[i:j])
+                    i = j
+        return out
 
 
 class KoreanTokenizerFactory(_CjkTokenizerFactoryBase):
     """Reference: deeplearning4j-nlp-korean KoreanTokenizerFactory
-    (twitter-korean-text). Hangul runs are whitespace-delimited eojeol;
-    a lexicon max-matches morphemes inside each run."""
+    (twitter-korean-text). Hangul runs are eojeol (space-delimited); each
+    eojeol max-matches the lexicon, then common trailing particles (josa)
+    are stripped so '학교에' and '학교는' normalize to '학교' — the
+    behavior that makes Korean embeddings usable without full morphology."""
 
     per_char_scripts = ("hangul",)
+    default_lexicon = _KO_LEXICON
+
+    def __init__(self, lexicon=None, preprocessor=None, max_word_len=8,
+                 use_default_lexicon=True, strip_josa=True,
+                 emit_josa=False):
+        super().__init__(lexicon, preprocessor, max_word_len,
+                         use_default_lexicon)
+        self.strip_josa = strip_josa
+        self.emit_josa = emit_josa
 
     def _segment_run(self, run, cls):
-        if cls == "hangul" and self.lexicon:
-            return self._max_match(run)
-        return [run]
+        if cls != "hangul":
+            return [run]
+        token = run
+        if token in self.lexicon or not self.strip_josa:
+            return [token]
+        for josa in _KO_JOSA:
+            if len(token) > len(josa) and token.endswith(josa):
+                stem = token[:-len(josa)]
+                return [stem, josa] if self.emit_josa else [stem]
+        return [token]

@@ -192,7 +192,42 @@ class TestLanguagePacks:
         text = "東京にいるトヨタ"
         toks = JapaneseTokenizerFactory().create(text).get_tokens()
         assert "トヨタ" in toks       # katakana run whole
-        assert "東" in toks and "京" in toks  # kanji per-char
+        assert "東京" in toks         # embedded lexicon segments the kanji
+        assert "に" in toks           # particle split off the hiragana run
+        bare = JapaneseTokenizerFactory(use_default_lexicon=False)
+        toks2 = bare.create("山川にいる").get_tokens()
+        assert "山" in toks2 and "川" in toks2  # per-char without lexicon
+
+    def test_japanese_okurigana_attachment(self):
+        from deeplearning4j_tpu.text.languages import JapaneseTokenizerFactory
+        # 食べます: kanji 食 + hiragana べます(3) -> no attach; 食べ + る...
+        toks = JapaneseTokenizerFactory(use_default_lexicon=False).create(
+            "肉を食べた").get_tokens()
+        # 食 + short tail べた (2 chars) attaches as okurigana
+        assert "食べた" in toks
+        assert "を" in toks           # particle preserved
+
+    def test_korean_josa_stripping(self):
+        from deeplearning4j_tpu.text.languages import KoreanTokenizerFactory
+        f = KoreanTokenizerFactory()
+        # 학교에 / 학교는 both normalize to the 학교 stem
+        assert f.create("학교에").get_tokens() == ["학교"]
+        assert f.create("학교는").get_tokens() == ["학교"]
+        both = KoreanTokenizerFactory(emit_josa=True).create(
+            "학교는").get_tokens()
+        assert both == ["학교", "는"]
+        raw = KoreanTokenizerFactory(strip_josa=False).create(
+            "학교는").get_tokens()
+        assert raw == ["학교는"]
+
+    def test_sentence_splitting(self):
+        from deeplearning4j_tpu.text.languages import split_sentences
+        out = split_sentences("今日は晴れ。明日は雨？ Yes! It works.")
+        assert out[0].endswith("。") and out[1].endswith("？")
+        assert out[2] == "Yes!" and out[3] == "It works."
+        # closing quote stays with its sentence; e.g. is not a boundary
+        q = split_sentences("彼は「行く。」と言った。")
+        assert q[0].endswith("」")
 
     def test_korean_eojeol_and_mixed(self):
         from deeplearning4j_tpu.text.languages import KoreanTokenizerFactory
