@@ -56,13 +56,22 @@ def rebalance(features, labels, n_shards, seed=0):
     assign = balanced_shard_assignment(labels, n_shards, seed)
     order = np.argsort(assign, kind="stable")
     shard_size = len(labels) // n_shards
-    keep = []
+    shards, overflow = [], []
     pos = 0
     for s in range(n_shards):
         members = order[pos:pos + np.count_nonzero(assign == s)]
         pos += len(members)
-        keep.append(members[:shard_size])
-    kept = np.concatenate(keep)
+        shards.append(list(members[:shard_size]))
+        overflow.extend(members[shard_size:])
+    # per-class round-robin can leave a shard underfull; top it up from the
+    # overflow pool so every shard is EXACTLY shard_size (the pool always
+    # suffices: total >= n_shards * shard_size)
+    for s in range(n_shards):
+        need = shard_size - len(shards[s])
+        if need > 0:
+            shards[s].extend(overflow[:need])
+            overflow = overflow[need:]
+    kept = np.concatenate([np.asarray(s, np.int64) for s in shards])
     dropped = len(labels) - len(kept)
     return features[kept], labels[kept], shard_size, dropped
 

@@ -303,11 +303,24 @@ class KoreanTokenizerFactory(_CjkTokenizerFactoryBase):
     def _segment_run(self, run, cls):
         if cls != "hangul":
             return [run]
-        token = run
-        if token in self.lexicon or not self.strip_josa:
-            return [token]
-        for josa in _KO_JOSA:
-            if len(token) > len(josa) and token.endswith(josa):
-                stem = token[:-len(josa)]
-                return [stem, josa] if self.emit_josa else [stem]
-        return [token]
+        if run in self.lexicon or not self.strip_josa:
+            return [run]  # known word, or raw-eojeol mode
+        # accept a lexicon split only when EVERY piece is a known word or a
+        # particle — a compound of knowns (한국사람) splits, but an unknown
+        # word that merely starts with a known word (한국어) stays whole
+        # (twitter-korean-text keeps unknown eojeol intact)
+        pieces = self._max_match(run) if self.lexicon else [run]
+        if not all(p in self.lexicon or p in _KO_JOSA for p in pieces):
+            pieces = [run]
+        # josa can only close the eojeol: strip from the FINAL piece
+        last = pieces[-1]
+        if last not in self.lexicon:
+            for josa in _KO_JOSA:
+                if last == josa and len(pieces) > 1:
+                    # a whole trailing piece that IS a particle
+                    return pieces if self.emit_josa else pieces[:-1]
+                if len(last) > len(josa) and last.endswith(josa):
+                    stem = last[:-len(josa)]
+                    tail = [stem, josa] if self.emit_josa else [stem]
+                    return pieces[:-1] + tail
+        return pieces

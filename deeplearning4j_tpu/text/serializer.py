@@ -12,7 +12,6 @@ StaticWordVectors exposing the WordVectors interface surface
 from __future__ import annotations
 
 import gzip
-import struct
 
 import numpy as np
 
@@ -59,34 +58,56 @@ def save_word2vec_binary(model, path):
     return path
 
 
+class _BufReader:
+    """Chunked reader: delimiter-scanned word reads + exact-size vector
+    reads, so multi-GB models (GoogleNews et al.) load without a Python
+    call per byte."""
+
+    def __init__(self, f, chunk=1 << 20):
+        self.f = f
+        self.chunk = chunk
+        self.buf = b""
+        self.pos = 0
+
+    def _fill(self):
+        data = self.f.read(self.chunk)
+        self.buf = self.buf[self.pos:] + data
+        self.pos = 0
+        return bool(data)
+
+    def read_until(self, delim):
+        """Bytes up to (not including) delim; consumes the delimiter."""
+        while True:
+            idx = self.buf.find(delim, self.pos)
+            if idx >= 0:
+                out = self.buf[self.pos:idx]
+                self.pos = idx + 1
+                return out
+            if not self._fill():
+                raise ValueError("truncated word2vec binary data")
+
+    def read_exact(self, n):
+        while len(self.buf) - self.pos < n:
+            if not self._fill():
+                raise ValueError("truncated vector data")
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+
 def load_word2vec_binary(path):
     """Read the word2vec C binary format. Returns (words, matrix [V,D]).
     Tolerates both `vec\\n` and bare `vec` record terminators (tools differ,
     the reference's reader skips the byte when present)."""
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
-        header = b""
-        while not header.endswith(b"\n"):
-            ch = f.read(1)
-            if not ch:
-                raise ValueError("truncated word2vec binary header")
-            header += ch
-        count, dim = (int(x) for x in header.split())
+        r = _BufReader(f)
+        count, dim = (int(x) for x in r.read_until(b"\n").split())
         vec_bytes = dim * 4
         words, rows = [], []
         for _ in range(count):
-            w = b""
-            while True:
-                ch = f.read(1)
-                if not ch:
-                    raise ValueError("truncated word2vec binary body")
-                if ch == b" ":
-                    break
-                if ch != b"\n":  # leading newline from the previous record
-                    w += ch
-            buf = f.read(vec_bytes)
-            if len(buf) != vec_bytes:
-                raise ValueError("truncated vector data")
+            w = r.read_until(b" ").lstrip(b"\n")
+            buf = r.read_exact(vec_bytes)
             words.append(w.decode("utf-8"))
             rows.append(np.frombuffer(buf, dtype="<f4"))
     return words, np.asarray(rows, np.float32)
@@ -105,18 +126,18 @@ class StaticWordVectors:
 
     @classmethod
     def load(cls, path, binary=None):
-        """Auto-detects text vs binary unless ``binary`` is given."""
-        if binary is None:
-            opener = gzip.open if path.endswith(".gz") else open
-            with opener(path, "rb") as f:
-                head = f.read(256)
-            # binary bodies contain raw float bytes right after the header
-            line_end = head.find(b"\n")
-            body = head[line_end + 1:line_end + 64]
-            binary = any(b > 0x7f for b in body)
-        words, mat = (load_word2vec_binary(path) if binary
-                      else load_word_vectors(path))
-        return cls(words, mat)
+        """Auto-detects text vs binary unless ``binary`` is given: tries the
+        text parser first and falls back to binary when the body is not
+        parseable text (byte-sniffing heuristics misclassify non-ASCII
+        words, which CJK vocabularies make routine)."""
+        if binary is True:
+            return cls(*load_word2vec_binary(path))
+        if binary is False:
+            return cls(*load_word_vectors(path))
+        try:
+            return cls(*load_word_vectors(path))
+        except (UnicodeDecodeError, ValueError, IndexError):
+            return cls(*load_word2vec_binary(path))
 
     def has_word(self, word):
         return word in self._index

@@ -254,3 +254,12 @@ class TestDataPlumbing:
         parts = split_dataset(x, y, 4)
         assert [len(p[0]) for p in parts] == [4, 4, 2]
         np.testing.assert_array_equal(parts[1][0], x[4:8])
+
+    def test_rebalance_underfull_shard_topped_up(self):
+        from deeplearning4j_tpu.parallel.data_utils import rebalance
+        rs = np.random.RandomState(0)
+        labels = rs.choice(8, 37)  # many classes, few shards: underfull risk
+        x = rs.rand(37, 2).astype(np.float32)
+        xr, yr, shard_size, dropped = rebalance(x, labels, 4, seed=0)
+        assert shard_size == 9
+        assert len(xr) == 4 * 9 and dropped == 1

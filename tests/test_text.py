@@ -375,3 +375,22 @@ class TestWordVectorBinaryFormat:
         save_word2vec_binary(sv, p)
         wv = StaticWordVectors.load(p)
         assert wv.has_word("delta")
+
+
+class TestLanguageAndSerializerReviewFixes:
+    def test_korean_lexicon_max_match_compounds(self):
+        from deeplearning4j_tpu.text.languages import KoreanTokenizerFactory
+        f = KoreanTokenizerFactory(lexicon=["한국", "사람"])
+        assert f.create("한국사람").get_tokens() == ["한국", "사람"]
+        # compound + josa on the tail
+        assert f.create("한국사람은").get_tokens() == ["한국", "사람"]
+
+    def test_static_load_autodetect_cjk_text(self, tmp_path):
+        from deeplearning4j_tpu.text.serializer import StaticWordVectors
+        p = str(tmp_path / "cjk.txt")
+        with open(p, "w", encoding="utf-8") as f:
+            f.write("2 3\n学校 0.5 0.25 0.125\n先生 1.0 2.0 3.0\n")
+        wv = StaticWordVectors.load(p)
+        assert wv.has_word("学校")
+        np.testing.assert_allclose(wv.get_word_vector("先生"),
+                                   [1.0, 2.0, 3.0])
