@@ -498,7 +498,11 @@ class EvaluationBinary:
     outputs (reference: eval/EvaluationBinary.java), with optional decision
     threshold per output and per-output label names."""
 
-    def __init__(self, n_outputs=None, thresholds=None, labels=None):
+    def __init__(self, n_outputs=None, thresholds=None, labels=None,
+                 roc_binary_steps=None):
+        """``roc_binary_steps``: when set (0 = exact mode, N = thresholded),
+        a ROCBinary tracks per-output AUC alongside the counts — mirroring
+        EvaluationBinary(int, Integer rocBinarySteps)."""
         self.n_outputs = n_outputs
         self.thresholds = thresholds
         self.labels = list(labels) if labels else None
@@ -506,6 +510,8 @@ class EvaluationBinary:
         self.fp = None
         self.tn = None
         self.fn = None
+        self._roc = None
+        self._roc_steps = roc_binary_steps
 
     def _ensure(self, c):
         if self.tp is None:
@@ -526,6 +532,22 @@ class EvaluationBinary:
         self.fp += ((p == 1) & (l == 0)).sum(0)
         self.tn += ((p == 0) & (l == 0)).sum(0)
         self.fn += ((p == 0) & (l == 1)).sum(0)
+        if self._roc_steps is not None:
+            if self._roc is None:
+                from deeplearning4j_tpu.eval.roc import ROCBinary
+                self._roc = ROCBinary(self._roc_steps)
+            self._roc.eval(labels, preds)
+
+    def auc(self, i):
+        """Per-output AUC; requires roc_binary_steps at construction."""
+        if self._roc is None:
+            raise ValueError("construct with roc_binary_steps= to track AUC")
+        return self._roc.auc(i)
+
+    def average_auc(self):
+        if self._roc is None:
+            raise ValueError("construct with roc_binary_steps= to track AUC")
+        return self._roc.average_auc()
 
     def merge(self, other):
         if other.tp is None:
@@ -535,6 +557,8 @@ class EvaluationBinary:
         self.fp += other.fp
         self.tn += other.tn
         self.fn += other.fn
+        if self._roc is not None and other._roc is not None:
+            self._roc.merge(other._roc)
 
     def total_count(self, i):
         return int(self.tp[i] + self.fp[i] + self.tn[i] + self.fn[i])

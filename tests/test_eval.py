@@ -481,3 +481,22 @@ class TestROCMerge:
         b.eval(labels[50:], preds[50:])
         a.merge(b)
         assert a.average_auc() == pytest.approx(whole.average_auc())
+
+
+class TestEvaluationBinaryROC:
+    def test_tracks_auc_per_output(self):
+        rs = np.random.RandomState(11)
+        labels = (rs.rand(200, 2) > 0.5).astype(float)
+        # output 0 informative, output 1 random
+        preds = np.stack([np.clip(labels[:, 0] * 0.6 + rs.rand(200) * 0.4, 0, 1),
+                          rs.rand(200)], 1)
+        eb = EvaluationBinary(roc_binary_steps=0)
+        eb.eval(labels, preds)
+        assert eb.auc(0) > 0.9 > eb.auc(1)
+        assert 0.0 <= eb.average_auc() <= 1.0
+
+    def test_auc_requires_opt_in(self):
+        eb = EvaluationBinary()
+        eb.eval(np.array([[1.0]]), np.array([[0.9]]))
+        with pytest.raises(ValueError, match="roc_binary_steps"):
+            eb.auc(0)
