@@ -1,7 +1,7 @@
 """Benchmarks for the BASELINE.md config matrix.
 
 Default (driver-run): streams ONE JSON line per config as each completes
-(lenet, resnet50, lstm, word2vec, parallel), so a late crash can never erase
+(lenet, resnet50, lstm, word2vec, parallel, transformer), so a late crash can never erase
 earlier results, then a final headline summary line
 {"metric", "value", "unit", "vs_baseline", ...}. A single config can be
 selected via ``python bench.py <config>`` or ``BENCH_CONFIG``:
@@ -340,10 +340,57 @@ def bench_parallel(batch_per_chip=256, warmup=2, iters=10):
             "step_time_ms": round(1e3 * dt, 2)}
 
 
+def bench_transformer(batch=32, seq=512, d_model=512, n_layers=6,
+                      n_heads=8, vocab=8192, warmup=2, iters=10):
+    """Decoder-only LM tokens/sec — the net-new long-context config and the
+    fused-attention (ops/attention_pallas.py) A/B target; no BASELINE.md
+    analog exists because the reference has no attention."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import transformer_lm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops import attention_pallas
+    from deeplearning4j_tpu.utils import dtypes
+
+    if _preflight():
+        batch, seq, d_model, n_layers, vocab = 4, 64, 64, 2, 256
+        warmup, iters = 1, 3
+    dtypes.bf16_policy()
+    conf = transformer_lm(vocab, n_layers=n_layers, d_model=d_model,
+                          n_heads=n_heads, seq_len=seq)
+    net = MultiLayerNetwork(conf)
+    net.init()
+    raw = net.make_train_step(donate=True, jit=False)
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (batch, seq))
+    x = jnp.asarray(ids[..., None].astype(np.float32))
+    # one-hot on device: a np.eye(vocab) gather would allocate vocab^2 host
+    # bytes (256 MiB at the default 8192)
+    y = jax.nn.one_hot(jnp.asarray(np.roll(ids, -1, axis=1)), vocab,
+                       dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+
+    dt, info = _train_bench(raw, net.params, net.state, net.opt_state,
+                            (x, y, 0, rng, None), warmup, iters)
+    tps = batch * seq / dt
+    # report whether the fused kernel actually DISPATCHES for these shapes,
+    # not just that the seam is enabled (A/B integrity)
+    q_shape = (batch, seq, n_heads, d_model // n_heads)
+    fused = attention_pallas.enabled() and attention_pallas.supported(
+        q_shape, q_shape, None, jnp.bfloat16)
+    return {"metric": "transformer_lm_train_tokens_per_sec",
+            "value": round(tps, 1), "unit": "tokens/sec/chip",
+            "vs_baseline": None,  # net-new capability: no reference analog
+            "step_time_ms": round(1e3 * dt, 2), "batch": batch, "seq": seq,
+            "d_model": d_model, "n_layers": n_layers,
+            "fused_attention": fused, **info}
+
+
 CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
            "lstm": bench_lstm, "word2vec": bench_word2vec,
-           "parallel": bench_parallel}
-DEFAULT_ORDER = ["lenet", "resnet50", "lstm", "word2vec", "parallel"]
+           "parallel": bench_parallel, "transformer": bench_transformer}
+DEFAULT_ORDER = ["lenet", "resnet50", "lstm", "word2vec", "parallel",
+                 "transformer"]
 
 
 def main():

@@ -5,6 +5,7 @@ from deeplearning4j_tpu.models.resnet import resnet50  # noqa: F401
 from deeplearning4j_tpu.models.vgg import vgg16, vgg19  # noqa: F401
 from deeplearning4j_tpu.models.misc import (  # noqa: F401
     alexnet, darknet19, simple_cnn, text_generation_lstm, tiny_yolo,
+    transformer_lm,
 )
 from deeplearning4j_tpu.models.inception import (  # noqa: F401
     facenet_nn4_small2, googlenet, inception_resnet_v1,

@@ -114,3 +114,22 @@ def text_generation_lstm(vocab_size, hidden=256, seq_len=64, updater=None, seed=
         input_type=I.RecurrentType(vocab_size, seq_len),
         backprop_type="tbptt", tbptt_fwd_length=seq_len, tbptt_back_length=seq_len,
     )
+
+
+def transformer_lm(vocab_size, n_layers=4, d_model=256, n_heads=4,
+                   seq_len=128, mlp_ratio=4, updater=None, seed=12345):
+    """Decoder-only transformer language model (net-new: the reference has
+    no attention — SURVEY.md §5 long-context row; this is the long-context
+    tier's flagship config and the fused-attention bench target). Input:
+    [B, T] (or [B, T, 1]) integer token ids; output: per-timestep vocab
+    softmax trained with cross-entropy."""
+    return NeuralNetConfig(seed=seed,
+                           updater=updater or U.Adam(learning_rate=3e-4)).list(
+        L.EmbeddingSequenceLayer(n_in=vocab_size, n_out=d_model,
+                                 add_positional=True),
+        *[L.TransformerBlock(n_out=d_model, n_heads=n_heads,
+                             mlp_ratio=mlp_ratio, causal=True)
+          for _ in range(n_layers)],
+        L.RnnOutputLayer(n_out=vocab_size, loss="mcxent"),
+        input_type=I.RecurrentType(1, seq_len),
+    )

@@ -1,7 +1,7 @@
 from deeplearning4j_tpu.nn.layers.base import Layer, ParamLayer  # noqa: F401
 from deeplearning4j_tpu.nn.layers.core import (  # noqa: F401
     DenseLayer, OutputLayer, LossLayer, ActivationLayer, DropoutLayer,
-    EmbeddingLayer, AutoEncoder,
+    EmbeddingLayer, EmbeddingSequenceLayer, AutoEncoder,
 )
 from deeplearning4j_tpu.nn.layers.conv import (  # noqa: F401
     ConvolutionLayer, Convolution1DLayer, Deconvolution2DLayer,
@@ -17,3 +17,6 @@ from deeplearning4j_tpu.nn.layers.rnn import (  # noqa: F401
 from deeplearning4j_tpu.nn.layers.vae import VariationalAutoencoder  # noqa: F401
 from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer  # noqa: F401
 from deeplearning4j_tpu.nn.layers.centerloss import CenterLossOutputLayer  # noqa: F401
+from deeplearning4j_tpu.nn.layers.attention import (  # noqa: F401
+    LayerNormalization, MultiHeadAttention, TransformerBlock,
+)

@@ -189,6 +189,52 @@ class EmbeddingLayer(ParamLayer):
 
 @register_config
 @dataclasses.dataclass(frozen=True)
+class EmbeddingSequenceLayer(ParamLayer):
+    """Per-timestep index -> vector lookup for sequence models: [B, T] (or
+    [B, T, 1]) integer ids -> [B, T, n_out], with an optional learned
+    positional embedding added (reference analog: EmbeddingSequenceLayer —
+    the sequence form of EmbeddingLayer; positions are net-new for the
+    transformer tier)."""
+
+    n_in: int = 0   # vocab size
+    n_out: int = 0
+    add_positional: bool = False
+    weight_init: object = dataclasses.field(default="xavier", kw_only=True)
+
+    input_family = _inputs.RecurrentType
+
+    def output_type(self, input_type):
+        return _inputs.RecurrentType(self.n_out, input_type.timesteps)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        import jax
+        k1, k2 = jax.random.split(key)
+        p = {"W": _init.init_weight(self.weight_init, k1,
+                                    (self.n_in, self.n_out),
+                                    self.n_in, self.n_out, dtype)}
+        if self.add_positional:
+            if input_type.timesteps is None:
+                raise ValueError("add_positional requires a fixed timesteps "
+                                 "in the RecurrentType input")
+            p["P"] = _init.init_weight(
+                self.weight_init, k2, (input_type.timesteps, self.n_out),
+                input_type.timesteps, self.n_out, dtype)
+        return p
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3:
+            idx = idx[..., 0]
+        z = jnp.take(params["W"], idx, axis=0)      # [B, T, D]
+        if "P" in params:
+            z = z + params["P"][None, :z.shape[1]]
+        if mask is not None:
+            z = z * mask[..., None].astype(z.dtype)
+        return self.activation_fn()(z), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
 class AutoEncoder(ParamLayer):
     """Denoising autoencoder layer (reference: conf/layers/AutoEncoder.java +
     layers/feedforward/autoencoder/AutoEncoder.java). In supervised stacks it

@@ -120,3 +120,32 @@ class Yolo2OutputLayer(Layer):
                              float(bw), float(bh), int(np.argmax(cls[bi, y, x, an]))))
             out.append(dets)
         return out
+
+
+def box_iou(box1, box2):
+    """IoU of two (cx, cy, w, h) boxes (grid units)."""
+    l1, r1 = box1[0] - box1[2] / 2, box1[0] + box1[2] / 2
+    t1, b1 = box1[1] - box1[3] / 2, box1[1] + box1[3] / 2
+    l2, r2 = box2[0] - box2[2] / 2, box2[0] + box2[2] / 2
+    t2, b2 = box2[1] - box2[3] / 2, box2[1] + box2[3] / 2
+    iw = max(0.0, min(r1, r2) - max(l1, l2))
+    ih = max(0.0, min(b1, b2) - max(t1, t2))
+    inter = iw * ih
+    union = box1[2] * box1[3] + box2[2] * box2[3] - inter
+    return inter / union if union > 0 else 0.0
+
+
+def non_max_suppression(detections, iou_threshold=0.5):
+    """Greedy per-class NMS over (conf, cx, cy, w, h, class_idx) detections
+    (one image's list, as produced by get_predicted_objects): keep the
+    highest-confidence box, drop same-class boxes overlapping it above the
+    IoU threshold, repeat."""
+    remaining = sorted(detections, key=lambda d: -d[0])
+    kept = []
+    while remaining:
+        best = remaining.pop(0)
+        kept.append(best)
+        remaining = [d for d in remaining
+                     if d[5] != best[5]
+                     or box_iou(best[1:5], d[1:5]) < iou_threshold]
+    return kept

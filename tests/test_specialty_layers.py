@@ -247,3 +247,19 @@ class TestWeightNoise:
         out = wn.perturb(jax.random.PRNGKey(0), layer, params)
         assert float(jnp.sum(jnp.abs(out["W"]))) > 0
         np.testing.assert_array_equal(np.asarray(out["b"]), 0.0)  # bias untouched
+
+
+class TestYoloNms:
+    def test_overlapping_same_class_suppressed(self):
+        from deeplearning4j_tpu.nn.layers.objdetect import (
+            box_iou, non_max_suppression)
+        dets = [(0.9, 5.0, 5.0, 2.0, 2.0, 1),   # winner
+                (0.8, 5.2, 5.1, 2.0, 2.0, 1),   # overlaps winner, same class
+                (0.7, 5.1, 5.0, 2.0, 2.0, 2),   # overlaps but other class
+                (0.6, 1.0, 1.0, 2.0, 2.0, 1)]   # far away, same class
+        kept = non_max_suppression(dets, iou_threshold=0.5)
+        confs = [d[0] for d in kept]
+        assert 0.9 in confs and 0.8 not in confs
+        assert 0.7 in confs and 0.6 in confs
+        assert box_iou((5, 5, 2, 2), (5, 5, 2, 2)) == 1.0
+        assert box_iou((0, 0, 1, 1), (5, 5, 1, 1)) == 0.0
