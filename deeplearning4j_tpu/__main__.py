@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.cli import main
+
+raise SystemExit(main())

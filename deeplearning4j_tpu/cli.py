@@ -1,0 +1,167 @@
+"""Command-line entry points: data-parallel training + UI server + bench.
+
+Reference analog: parallelism/main/ParallelWrapperMain.java (JCommander
+flags --modelPath/--workers/--averagingFrequency/--modelOutputPath/--uiUrl)
+and PlayUIServer's CLI. Invoke as::
+
+    python -m deeplearning4j_tpu train --model-path ckpt.zip \\
+        --data features.npy --labels labels.npy --epochs 2 \\
+        --averaging-frequency 5 --model-output-path out.zip
+    python -m deeplearning4j_tpu train --zoo lenet --data x.npy --labels y.npy
+    python -m deeplearning4j_tpu ui --port 9000
+    python -m deeplearning4j_tpu bench lenet
+
+"workers" in the reference = replica threads on N GPUs; here the worker
+count IS the mesh data axis (defaults to every local device), and
+averaging-frequency selects between the per-step gradient-sharing master
+(frequency 1, exact psum) and the local-SGD parameter-averaging master
+(frequency k > 1) — the same semantics ParallelWrapper exposes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu",
+        description="TPU-native dl4j: train / serve UI / bench")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    t = sub.add_parser("train", help="data-parallel training over the mesh")
+    src = t.add_mutually_exclusive_group(required=True)
+    src.add_argument("--model-path", help="checkpoint zip to resume")
+    src.add_argument("--zoo", help="zoo model name (e.g. lenet)")
+    t.add_argument("--data", required=True, help=".npy features")
+    t.add_argument("--labels", required=True, help=".npy labels (one-hot)")
+    t.add_argument("--epochs", type=int, default=1)
+    t.add_argument("--workers", type=int, default=0,
+                   help="mesh data-axis size (0 = all local devices)")
+    t.add_argument("--batch-size-per-worker", type=int, default=32)
+    t.add_argument("--averaging-frequency", type=int, default=1,
+                   help="1 = per-step gradient psum; k>1 = local SGD with "
+                        "parameter averaging every k steps")
+    t.add_argument("--no-average-updaters", action="store_true")
+    t.add_argument("--model-output-path", help="save checkpoint here")
+    t.add_argument("--ui-port", type=int,
+                   help="start the training dashboard on this port")
+    t.add_argument("--report-score", action="store_true")
+
+    u = sub.add_parser("ui", help="standalone training dashboard server")
+    u.add_argument("--port", type=int, default=9000)
+
+    b = sub.add_parser("bench", help="run a BASELINE.md bench config")
+    b.add_argument("config", nargs="?", default="all")
+    return p
+
+
+def _load_model(args):
+    if args.model_path:
+        from deeplearning4j_tpu.utils.serialization import load_model
+        return load_model(args.model_path)
+    from deeplearning4j_tpu.models import zoo
+    try:
+        builder = zoo.get_model(args.zoo).builder
+    except KeyError:
+        raise SystemExit(
+            f"unknown zoo model {args.zoo!r}; known: {zoo.model_names()}")
+    conf = builder()
+    from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    net = (ComputationGraph(conf) if isinstance(conf, GraphConfiguration)
+           else MultiLayerNetwork(conf))
+    net.init()
+    return net
+
+
+def _cmd_train(args):
+    import jax
+    from jax.sharding import Mesh
+    from deeplearning4j_tpu.parallel.distributed import (
+        DistributedMultiLayer, ParameterAveragingTrainingMaster,
+        SharedTrainingMaster)
+
+    x = np.load(args.data)
+    y = np.load(args.labels)
+    n_devices = len(jax.devices())
+    n_workers = args.workers or n_devices
+    if n_workers > n_devices:
+        raise SystemExit(f"--workers {n_workers} exceeds the {n_devices} "
+                         f"available device(s)")
+    mesh = Mesh(np.array(jax.devices()[:n_workers]), ("data",))
+    net = _load_model(args)
+
+    ui_server = None
+    if args.ui_port:
+        from deeplearning4j_tpu.ui import (InMemoryStatsStorage,
+                                           StatsListener, UIServer)
+        storage = InMemoryStatsStorage()
+        if hasattr(net, "add_listener"):
+            net.add_listener(StatsListener(storage, session_id="cli"))
+        ui_server = UIServer(port=args.ui_port).attach(storage).start()
+        print(f"dashboard: http://127.0.0.1:{ui_server.port}/")
+
+    if args.averaging_frequency <= 1:
+        master = SharedTrainingMaster(
+            mesh, batch_size_per_worker=args.batch_size_per_worker,
+            threshold=None)
+    else:
+        master = ParameterAveragingTrainingMaster(
+            mesh, batch_size_per_worker=args.batch_size_per_worker,
+            averaging_frequency=args.averaging_frequency,
+            average_updaters=not args.no_average_updaters)
+    dist = DistributedMultiLayer(net, master)
+    loss = dist.fit(x, y, epochs=args.epochs)
+    if args.report_score and loss is not None:
+        print(f"final loss: {loss}")
+    print(f"training stats: {master.training_stats()}")
+
+    if args.model_output_path:
+        from deeplearning4j_tpu.utils.serialization import save_model
+        save_model(net, args.model_output_path)
+        print(f"saved: {args.model_output_path}")
+    if ui_server is not None:
+        ui_server.stop()
+    return 0
+
+
+def _cmd_ui(args):
+    from deeplearning4j_tpu.ui import UIServer
+    server = UIServer(port=args.port).start()
+    print(f"UI server on http://127.0.0.1:{server.port}/ (Ctrl-C to stop)")
+    try:
+        import time
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def _cmd_bench(args):
+    import os
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [sys.executable, os.path.join(repo, "bench.py")]
+    if args.config != "all":
+        cmd.append(args.config)
+    return subprocess.call(cmd)
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
+    if args.command == "train":
+        return _cmd_train(args)
+    if args.command == "ui":
+        return _cmd_ui(args)
+    if args.command == "bench":
+        return _cmd_bench(args)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

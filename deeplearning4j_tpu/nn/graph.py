@@ -576,19 +576,35 @@ class ComputationGraph:
         outs = {o: acts[o] for o in self.conf.outputs}
         return loss, (new_state, outs)
 
-    def make_train_step(self, donate=True, jit=True):
+    def compute_gradients(self, params, state, inputs, labels, *, rng=None,
+                          mask=None):
+        """Loss + normalized gradients (MultiLayerNetwork.compute_gradients
+        contract — the distributed masters insert their gradient exchange
+        between this and apply_update)."""
         conf = self.conf
+        (loss, (new_state, _)), grads = jax.value_and_grad(
+            self.loss_fn, has_aux=True)(params, state, inputs, labels,
+                                        train=True, rng=rng, mask=mask)
+        if conf.gradient_normalization not in (None, "none"):
+            grads = {k: _gradnorm.normalize_layer_grads(
+                conf.gradient_normalization, g,
+                conf.gradient_normalization_threshold)
+                if g else g for k, g in grads.items()}
+        return loss, new_state, grads
 
+    def apply_update(self, params, opt_state, grads, step):
+        updates, new_opt = self.conf.updater.update(grads, opt_state, params,
+                                                    step)
+        new_params = jax.tree_util.tree_map(lambda p, u: p + u, params,
+                                            updates)
+        return new_params, new_opt
+
+    def make_train_step(self, donate=True, jit=True):
         def train_step(params, state, opt_state, inputs, labels, step, rng, mask=None):
-            (loss, (new_state, _)), grads = jax.value_and_grad(
-                self.loss_fn, has_aux=True)(params, state, inputs, labels,
-                                            train=True, rng=rng, mask=mask)
-            if conf.gradient_normalization not in (None, "none"):
-                grads = {k: _gradnorm.normalize_layer_grads(
-                    conf.gradient_normalization, g, conf.gradient_normalization_threshold)
-                    if g else g for k, g in grads.items()}
-            updates, new_opt = conf.updater.update(grads, opt_state, params, step)
-            new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            loss, new_state, grads = self.compute_gradients(
+                params, state, inputs, labels, rng=rng, mask=mask)
+            new_params, new_opt = self.apply_update(params, opt_state, grads,
+                                                    step)
             return new_params, new_state, new_opt, loss
 
         if not jit:

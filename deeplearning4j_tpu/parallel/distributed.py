@@ -179,9 +179,10 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         state = _put(_stack_worker_dim(net.state, w), mesh, "data")
         opt = _put(_stack_worker_dim(net.opt_state, w), mesh, "data")
 
-        it0 = 0
+        it0 = int(getattr(net, "iteration", 0))  # resume-aware schedules
         rng = jax.random.PRNGKey(net.conf.seed + 1)
         loss = None
+        listeners = list(getattr(net, "listeners", []))
         rem = n % split_examples
         for ep in range(epochs):
             # rotate the window each epoch so a ragged tail is not always the
@@ -204,6 +205,8 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 it0 += f
                 self._stats["splits"] += 1
                 self._stats["worker_steps"] += w * f
+                for l in listeners:  # per-split callback (one host sync)
+                    l.iteration_done(net, it0, float(jax.device_get(loss)))
         # replicas are identical post-average for params/opt; state (e.g. BN
         # running stats) stays per-worker in the reference too — fold by mean
         first = lambda t: tree_map(lambda a: np.asarray(jax.device_get(a[0])), t)
@@ -217,6 +220,8 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         net.params = first(params)
         net.opt_state = first(opt) if self.average_updaters else fold(opt)
         net.state = fold(state)
+        net.iteration = it0  # training position survives re-save/resume
+        net.epoch = int(getattr(net, "epoch", 0)) + epochs
         return None if loss is None else float(jax.device_get(loss))
 
 
@@ -315,7 +320,9 @@ class SharedTrainingMaster(TrainingMaster):
                           else 0.0, jnp.float32)
         data_sh = _mesh.data_sharded(mesh)
         rng = jax.random.PRNGKey(net.conf.seed + 2)
-        it, loss = 0, None
+        it = int(getattr(net, "iteration", 0))  # resume-aware schedules
+        loss = None
+        listeners = list(getattr(net, "listeners", []))
         rem = n % step_examples
         for ep in range(epochs):
             start = (ep * rem) % (rem + 1) if rem else 0
@@ -331,8 +338,12 @@ class SharedTrainingMaster(TrainingMaster):
                     params, state, opt, resid, tau, x, y, it, sub)
                 it += 1
                 self._stats["steps"] += 1
+                for l in listeners:  # per-step callback (forces a host sync)
+                    l.iteration_done(net, it, float(jax.device_get(loss)))
         get = lambda t: tree_map(lambda a: np.asarray(jax.device_get(a)), t)
         net.params, net.state, net.opt_state = get(params), get(state), get(opt)
+        net.iteration = it  # training position survives re-save/resume
+        net.epoch = int(getattr(net, "epoch", 0)) + epochs
         self._stats["final_threshold"] = float(jax.device_get(tau))
         return None if loss is None else float(jax.device_get(loss))
 

@@ -24,11 +24,33 @@ def register_config(cls):
     return cls
 
 
+def _prime_catalog():
+    """Import every module that registers config classes, so deserialization
+    works as a user's FIRST framework call (checkpoint resume, CLI). Lazy —
+    importing here at module load would create an import cycle."""
+    import importlib
+    for mod in ("deeplearning4j_tpu.nn.layers", "deeplearning4j_tpu.nn.graph",
+                "deeplearning4j_tpu.nn.constraints",
+                "deeplearning4j_tpu.nn.weightnoise",
+                "deeplearning4j_tpu.nn.conf.inputs",
+                "deeplearning4j_tpu.nn.updaters"):
+        try:
+            importlib.import_module(mod)
+        except ImportError:
+            pass
+
+
 def lookup(name: str) -> type:
     try:
         return _REGISTRY[name]
     except KeyError:
-        raise KeyError(f"Unknown config type {name!r}. Registered: {sorted(_REGISTRY)}") from None
+        pass
+    _prime_catalog()  # registry may simply not be populated yet
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"Unknown config type {name!r}. "
+                       f"Registered: {sorted(_REGISTRY)}") from None
 
 
 def config_to_dict(obj):

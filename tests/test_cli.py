@@ -1,0 +1,58 @@
+"""CLI tests (reference: parallelism/main/ParallelWrapperMain.java — the
+standalone train entry point; here python -m deeplearning4j_tpu)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.cli import main
+from deeplearning4j_tpu.nn import layers as L, updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.utils.serialization import load_model, save_model
+
+pytestmark = pytest.mark.slow  # 8-device mesh training
+
+
+def _stage(tmp_path, n=192):
+    rs = np.random.RandomState(0)
+    x = rs.randn(n, 6).astype(np.float32)
+    y = np.eye(3)[rs.randint(0, 3, n)].astype(np.float32)
+    xp, yp = str(tmp_path / "x.npy"), str(tmp_path / "y.npy")
+    np.save(xp, x)
+    np.save(yp, y)
+    net = MultiLayerNetwork(
+        NeuralNetConfig(seed=1, updater=U.Adam(learning_rate=0.01)).list(
+            L.DenseLayer(n_out=8, activation="tanh"),
+            L.OutputLayer(n_out=3, loss="mcxent"),
+            input_type=I.FeedForwardType(6)))
+    net.init()
+    mp = str(tmp_path / "model.zip")
+    save_model(net, mp)
+    return xp, yp, mp
+
+
+def test_train_resume_and_save(tmp_path, eight_devices):
+    xp, yp, mp = _stage(tmp_path)
+    out = str(tmp_path / "out.zip")
+    rc = main(["train", "--model-path", mp, "--data", xp, "--labels", yp,
+               "--epochs", "2", "--batch-size-per-worker", "4",
+               "--model-output-path", out])
+    assert rc == 0
+    resumed = load_model(out)
+    assert resumed.opt_state is not None  # Adam state survived the CLI
+
+
+def test_train_parameter_averaging_mode(tmp_path, eight_devices):
+    xp, yp, mp = _stage(tmp_path)
+    rc = main(["train", "--model-path", mp, "--data", xp, "--labels", yp,
+               "--epochs", "1", "--batch-size-per-worker", "4",
+               "--averaging-frequency", "3", "--workers", "4"])
+    assert rc == 0
+
+
+def test_unknown_zoo_model_exits(tmp_path):
+    xp = str(tmp_path / "x.npy")
+    np.save(xp, np.zeros((4, 2), np.float32))
+    with pytest.raises(SystemExit):
+        main(["train", "--zoo", "not-a-model", "--data", xp, "--labels", xp])
