@@ -251,3 +251,21 @@ class TestConfigSerde:
         n2 = MultiLayerNetwork(conf2)
         n2.init()  # same seed -> same params
         np.testing.assert_allclose(np.asarray(n1.output(x)), np.asarray(n2.output(x)), rtol=1e-6)
+
+
+class TestSimpleResults:
+    def test_rank_classification_result(self):
+        from deeplearning4j_tpu.nn.simple import RankClassificationResult
+        out = np.array([[0.1, 0.7, 0.2], [0.5, 0.2, 0.3]])
+        r = RankClassificationResult(out, labels=["a", "b", "c"])
+        assert r.max_labels() == ["b", "a"]
+        assert r.ranked_labels(0) == ["b", "c", "a"]
+        assert r.probability_for_label(1, "c") == pytest.approx(0.3)
+        # vector input is promoted to one row
+        r1 = RankClassificationResult(np.array([0.2, 0.8]))
+        assert r1.max_label(0) == "1"
+
+    def test_binary_classification_result(self):
+        from deeplearning4j_tpu.nn.simple import BinaryClassificationResult
+        assert BinaryClassificationResult(0.7).is_positive
+        assert not BinaryClassificationResult(0.7, threshold=0.8).is_positive
