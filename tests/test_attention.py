@@ -202,3 +202,11 @@ class TestTransformerLM:
         out2 = np.asarray(net.output(ids2))
         np.testing.assert_allclose(out1[0, :-1], out2[0, :-1],
                                    rtol=1e-5, atol=1e-6)
+
+    def test_transformer_lm_config_roundtrip(self):
+        from deeplearning4j_tpu.models import transformer_lm
+        from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+        conf = transformer_lm(100, n_layers=2, d_model=32, n_heads=2,
+                              seq_len=16)
+        js = conf.to_json()
+        assert MultiLayerConfiguration.from_json(js).to_json() == js
