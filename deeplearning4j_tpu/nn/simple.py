@@ -23,7 +23,7 @@ class RankClassificationResult:
             raise ValueError("only vectors and matrices are supported")
         self.probabilities = outcome.astype(np.float32)
         self.ranked_indices = np.argsort(-outcome, axis=1, kind="stable")
-        self.labels = (list(labels) if labels
+        self.labels = (list(labels) if labels is not None
                        else [str(i) for i in range(outcome.shape[1])])
 
     def ranked_labels(self, row):
