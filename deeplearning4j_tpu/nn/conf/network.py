@@ -39,6 +39,10 @@ class MultiLayerConfiguration:
     tbptt_back_length: int = 20
     seed: int = 12345
     mini_batch: bool = True  # reference: miniBatch flag (score averaging)
+    # remat each layer's forward during backprop: HBM for FLOPs (SURVEY §0
+    # "jax.checkpoint / rematerialisation" bullet; no reference analog —
+    # workspaces solved a different memory problem)
+    gradient_checkpointing: bool = False
 
     def to_json(self, indent=2):
         return serde.to_json(self, indent=indent)
@@ -84,7 +88,8 @@ class NeuralNetConfig:
     gradient_normalization_threshold: float = 1.0
 
     def list(self, *layers, input_type=None, backprop_type="standard",
-             tbptt_fwd_length=20, tbptt_back_length=20) -> MultiLayerConfiguration:
+             tbptt_fwd_length=20, tbptt_back_length=20,
+             gradient_checkpointing=False) -> MultiLayerConfiguration:
         cascaded = tuple(self._cascade(l) for l in layers)
         return MultiLayerConfiguration(
             layers=cascaded, input_type=input_type,
@@ -93,6 +98,7 @@ class NeuralNetConfig:
             gradient_normalization_threshold=self.gradient_normalization_threshold,
             backprop_type=backprop_type, tbptt_fwd_length=tbptt_fwd_length,
             tbptt_back_length=tbptt_back_length, seed=self.seed,
+            gradient_checkpointing=gradient_checkpointing,
         )
 
     def _cascade(self, layer):

@@ -362,6 +362,8 @@ class GraphConfiguration:
     gradient_normalization: str = "none"
     gradient_normalization_threshold: float = 1.0
     seed: int = 12345
+    # remat each vertex's forward during backprop: HBM for FLOPs
+    gradient_checkpointing: bool = False
 
     def to_json(self, indent=2):
         return serde.to_json(self, indent=indent)
@@ -415,7 +417,8 @@ class GraphBuilder:
     """Fluent builder (reference: ComputationGraphConfiguration.GraphBuilder)."""
 
     def __init__(self, updater=None, seed=12345, gradient_normalization="none",
-                 gradient_normalization_threshold=1.0):
+                 gradient_normalization_threshold=1.0,
+                 gradient_checkpointing=False):
         self._inputs = []
         self._input_types = []
         self._vertices = []
@@ -424,6 +427,7 @@ class GraphBuilder:
         self._seed = seed
         self._gn = gradient_normalization
         self._gnt = gradient_normalization_threshold
+        self._remat = gradient_checkpointing
 
     def add_inputs(self, *names):
         self._inputs.extend(names)
@@ -462,7 +466,8 @@ class GraphBuilder:
             vertices=tuple(self._vertices), outputs=tuple(self._outputs),
             updater=self._updater, seed=self._seed,
             gradient_normalization=self._gn,
-            gradient_normalization_threshold=self._gnt)
+            gradient_normalization_threshold=self._gnt,
+            gradient_checkpointing=self._remat)
         conf.topological_order()  # validate
         return conf
 
@@ -534,9 +539,13 @@ class ComputationGraph:
                 loss = loss + l_i
                 acts[name], new_state[name] = preds, st
             else:
-                acts[name], new_state[name] = v.vertex.apply(
-                    params[name], state[name], xs, train=train, rng=sub,
-                    mask=mask)
+                def run(p, s, x_list, r, m, _v=v.vertex):
+                    return _v.apply(p, s, x_list, train=train, rng=r, mask=m)
+
+                if self.conf.gradient_checkpointing:
+                    run = jax.checkpoint(run)  # remat: HBM for FLOPs
+                acts[name], new_state[name] = run(
+                    params[name], state[name], xs, sub, mask)
                 if labels is not None and name in self.conf.outputs:
                     l_layer = layer if layer is not None else v.vertex
                     if not hasattr(l_layer, "compute_loss"):

@@ -103,8 +103,15 @@ class MultiLayerNetwork:
             if train and wn is not None and sub is not None and layer_params:
                 sub, noise_rng = jax.random.split(sub)
                 layer_params = wn.perturb(noise_rng, layer, layer_params)
-            x, new_state[i] = layer.apply(layer_params, state[i], x, train=train,
-                                          rng=sub, **kwargs)
+
+            def run(p, s, xx, r, _layer=layer, _kwargs=kwargs):
+                return _layer.apply(p, s, xx, train=train, rng=r, **_kwargs)
+
+            if self.conf.gradient_checkpointing:
+                # remat: drop this layer's activations after the forward and
+                # recompute them during backprop — HBM for FLOPs
+                run = jax.checkpoint(run)
+            x, new_state[i] = run(layer_params, state[i], x, sub)
             cur_type = layer.output_type(cur_type)
         return x, new_state
 

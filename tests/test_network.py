@@ -269,3 +269,105 @@ class TestSimpleResults:
         from deeplearning4j_tpu.nn.simple import BinaryClassificationResult
         assert BinaryClassificationResult(0.7).is_positive
         assert not BinaryClassificationResult(0.7, threshold=0.8).is_positive
+
+
+class TestGradientCheckpointing:
+    """conf.gradient_checkpointing: remat each layer's forward during
+    backprop (SURVEY §0 HBM bullet). Gradients must be bit-compatible with
+    the non-remat path — remat changes memory, never math."""
+
+    def _pair(self, ckpt):
+        conf = NeuralNetConfig(seed=4, updater=U.Sgd(learning_rate=0.1)).list(
+            L.DenseLayer(n_out=16, activation="tanh"),
+            L.DenseLayer(n_out=16, activation="relu"),
+            L.OutputLayer(n_out=3, loss="mcxent"),
+            input_type=I.FeedForwardType(5),
+            gradient_checkpointing=ckpt)
+        return MultiLayerNetwork(conf)
+
+    def test_gradients_match_non_remat(self):
+        import jax
+        rs = np.random.RandomState(0)
+        x = rs.randn(16, 5).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 16)]
+        plain, remat = self._pair(False), self._pair(True)
+        plain.init()
+        remat.init()
+        remat.params = plain.params  # identical weights
+        _, _, g1 = plain.compute_gradients(plain.params, plain.state, x, y)
+        _, _, g2 = remat.compute_gradients(remat.params, remat.state, x, y)
+        for a, b in zip(jax.tree_util.tree_leaves(g1),
+                        jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_trains_under_jit(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(32, 5).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 32)]
+        net = self._pair(True)
+        net.fit(x, y, epochs=5, batch_size=32)
+        s = float(net.score(x, y))
+        assert np.isfinite(s)
+
+    def test_gradients_match_with_dropout_and_mask(self):
+        """The rng/mask paths are the ones remat could break: recomputed
+        forwards must replay the SAME dropout mask (rng is an operand) and
+        see the SAME mask array."""
+        import jax
+
+        def build(ckpt):
+            conf = NeuralNetConfig(seed=6,
+                                   updater=U.Sgd(learning_rate=0.1)).list(
+                L.LSTM(n_out=8, activation="tanh", dropout=0.3),
+                L.RnnOutputLayer(n_out=2, loss="mcxent"),
+                input_type=I.recurrent(3, 5),
+                gradient_checkpointing=ckpt)
+            return MultiLayerNetwork(conf)
+
+        rs = np.random.RandomState(3)
+        x = rs.randn(6, 5, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, (6, 5))]
+        mask = (rs.rand(6, 5) > 0.3).astype(np.float32)
+        plain, remat = build(False), build(True)
+        plain.init()
+        remat.init()
+        remat.params = plain.params
+        rng = jax.random.PRNGKey(9)
+        _, _, g1 = plain.compute_gradients(plain.params, plain.state, x, y,
+                                           rng=rng, mask=mask)
+        _, _, g2 = remat.compute_gradients(remat.params, remat.state, x, y,
+                                           rng=rng, mask=mask)
+        for a, b in zip(jax.tree_util.tree_leaves(g1),
+                        jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_graph_remat_matches(self):
+        import jax
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+
+        def build(ckpt=False):
+            b = GraphBuilder(updater=U.Sgd(learning_rate=0.1), seed=5,
+                             gradient_checkpointing=ckpt)
+            b.add_inputs("in")
+            b.set_input_types(I.FeedForwardType(4))
+            b.add_layer("h", L.DenseLayer(n_out=8, activation="tanh"), "in")
+            b.add_layer("out", L.OutputLayer(n_out=2, loss="mcxent"), "h")
+            b.set_outputs("out")
+            return b.build()
+
+        rs = np.random.RandomState(2)
+        x = rs.randn(8, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 8)]
+        g1 = ComputationGraph(build())
+        g1.init()
+        g2 = ComputationGraph(build(ckpt=True))
+        g2.init()
+        g2.params = g1.params
+        _, _, gr1 = g1.compute_gradients(g1.params, g1.state, x, y)
+        _, _, gr2 = g2.compute_gradients(g2.params, g2.state, x, y)
+        for a, b in zip(jax.tree_util.tree_leaves(gr1),
+                        jax.tree_util.tree_leaves(gr2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
