@@ -19,6 +19,7 @@ TUTORIALS = [
     "examples/tutorials/t06_autoencoder_sequence_clustering.py",
     "examples/tutorials/t07_center_loss_embeddings.py",
     "examples/tutorials/t08_rnn_sequence_classification.py",
+    "examples/tutorials/t09_transformer_language_model.py",
 ]
 EXAMPLES = [
     "examples/lenet_mnist.py",
