@@ -333,11 +333,32 @@ def bench_parallel(batch_per_chip=256, warmup=2, iters=10):
     dt = (time.perf_counter() - t0) / iters
     sps = b / dt
     per_chip = sps / n
-    return {"metric": "parallel_lenet_train_samples_per_sec",
-            "value": round(sps, 1), "unit": f"samples/sec/{n}chips",
-            "vs_baseline": round(per_chip / BASELINES["parallel"], 2),
-            "per_chip": round(per_chip, 1), "n_chips": n,
-            "step_time_ms": round(1e3 * dt, 2)}
+
+    rec = {"metric": "parallel_lenet_train_samples_per_sec",
+           "value": round(sps, 1), "unit": f"samples/sec/{n}chips",
+           "vs_baseline": round(per_chip / BASELINES["parallel"], 2),
+           "per_chip": round(per_chip, 1), "n_chips": n,
+           "step_time_ms": round(1e3 * dt, 2)}
+    if n > 1:
+        # scaling efficiency vs a single-device run of the same per-chip
+        # batch (BASELINE.md config #5's "scaling efficiency vs 1 chip")
+        net1 = MultiLayerNetwork(lenet())
+        net1.init()
+        mesh1 = make_mesh(MeshSpec(data=1, model=1),
+                          devices=jax.devices()[:1])
+        tr1 = ParallelTrainer(net1, mesh1)
+        x1, y1 = x[:batch_per_chip], y[:batch_per_chip]
+        for _ in range(warmup):
+            out = tr1.step(x1, y1)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = tr1.step(x1, y1)
+        jax.block_until_ready(out)
+        single_sps = batch_per_chip / ((time.perf_counter() - t0) / iters)
+        rec["single_chip_samples_per_sec"] = round(single_sps, 1)
+        rec["scaling_efficiency"] = round(per_chip / single_sps, 3)
+    return rec
 
 
 def bench_transformer(batch=32, seq=512, d_model=512, n_layers=6,
