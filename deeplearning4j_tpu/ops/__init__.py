@@ -5,4 +5,4 @@ classes (SURVEY.md §2.2). Here the dispatch seam is explicit: layers consult
 ``ops.<kernel>.supported(...)`` and fall back to their pure-XLA path.
 """
 
-from deeplearning4j_tpu.ops import lstm_pallas  # noqa: F401
+from deeplearning4j_tpu.ops import attention_pallas, lstm_pallas  # noqa: F401
