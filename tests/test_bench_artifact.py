@@ -1,0 +1,37 @@
+"""Driver-artifact regression test: the bench must stream parseable JSON
+records for every config and end with a headline line, even with no TPU —
+the exact contract BENCH_r{N}.json depends on (round-1 postmortem: rc=1,
+zero numbers)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_full_sweep_streams_records():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_PREFLIGHT"] = "1"
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, timeout=900, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    records = [json.loads(line) for line in r.stdout.strip().splitlines()]
+    by_config = {rec["config"]: rec for rec in records if "config" in rec}
+    for config in ("lenet", "resnet50", "lstm", "word2vec", "parallel",
+                   "transformer"):
+        assert config in by_config, f"no record for {config}"
+        rec = by_config[config]
+        assert "FAILED" not in rec.get("metric", ""), rec
+        assert rec["value"] > 0
+    headline = records[-1]
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(headline)
+    # MFU headline prefers resnet50
+    assert headline["config"] == "resnet50"
