@@ -70,8 +70,11 @@ def resnet50(height=224, width=224, channels=3, n_classes=1000, updater=None,
 
 
 def resnet50_flops_per_example(height=224, width=224, channels=3, n_classes=1000):
-    """Approximate forward FLOPs (2*MACs) for MFU accounting."""
-    # standard figure: ~3.8 GFLOPs fwd at 224x224; scale by area
-    base = 3.8e9 * 2 / 2  # fwd only
+    """Approximate forward FLOPs (2*MACs) for MFU accounting.
+
+    2 x the standard ~4.1 GMAC figure at 224x224; round-2 cross-check: XLA
+    cost_analysis reports 22.6 GFLOP/example for the full train step, and
+    3 x this fwd estimate = 24.6 — the two agree within 9%."""
+    base = 2 * 4.1e9  # fwd only, FLOPs = 2*MACs
     scale = (height * width) / (224 * 224)
     return base * scale

@@ -219,10 +219,12 @@ class MultiLayerNetwork:
             (self.params, self.state, self.opt_state, carries, loss) = \
                 self._tbptt_step(self.params, self.state, self.opt_state,
                                  carries, cx, cy, self.iteration, sub, cm)
-            total += float(loss)
+            # accumulate ON DEVICE: a per-chunk float(loss) would pay one
+            # host round-trip per TBPTT chunk and serialize dispatch
+            total = total + loss
             n_chunks += 1
             self.iteration += 1
-        self.score_value = total / max(n_chunks, 1)
+        self.score_value = float(total) / max(n_chunks, 1)
         return self.score_value
 
     # ------------------------------------------------------------------

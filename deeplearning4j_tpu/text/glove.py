@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.utils.hostsync import fetch_losses
 from deeplearning4j_tpu.text.vocab import VocabConstructor
 
 
@@ -96,7 +97,7 @@ class GloVe:
         gb = jnp.zeros(v, jnp.float32)
         gbc = jnp.zeros(v, jnp.float32)
 
-        self.loss_history = []
+        losses = []
         n = len(rows)
         for epoch in range(self.epochs):
             perm = rs.permutation(n)
@@ -107,7 +108,8 @@ class GloVe:
                     jnp.asarray(rows[sl]), jnp.asarray(cols[sl]),
                     jnp.asarray(logx[sl]), jnp.asarray(weight[sl]),
                     self.learning_rate)
-                self.loss_history.append(float(loss))
+                losses.append(loss)  # stays on device until the end
+        self.loss_history = fetch_losses(losses)
         self.syn0 = w + wc  # standard GloVe: sum of word+context vectors
         return self
 

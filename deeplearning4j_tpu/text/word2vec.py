@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.utils.hostsync import fetch_losses
 from deeplearning4j_tpu.text.vocab import (VocabCache, VocabConstructor,
                                            flatten_corpus)
 
@@ -450,7 +451,7 @@ class SequenceVectors:
                 losses += self._run_batched(
                     _sgns_epoch, _sgns_step, (centers, contexts, negs),
                     lr, math_fn=_sgns_math)
-        self.loss_history = [float(l) for l in losses]  # one sync, at the end
+        self.loss_history = fetch_losses(losses)
         return self
 
     # batches per scanned jit call; fixed so the scan compiles ONCE and is

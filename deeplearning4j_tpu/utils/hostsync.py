@@ -1,0 +1,24 @@
+"""Host-side synchronization helpers.
+
+Round-2 TPU measurement finding: on remote/tunneled backends (the axon TPU
+plugin) a per-value ``float(device_array)`` pays one full host<->device
+round-trip (~70 ms over the tunnel) PER CALL, and ``jax.block_until_ready``
+returns before device work completes — so training loops must keep losses on
+device and fetch them in one batched transfer at the end.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def fetch_losses(losses):
+    """One batched host fetch of a list of device scalars -> list[float].
+
+    ``jax.device_get`` on the whole list starts every transfer
+    asynchronously before awaiting any of them — a single effective
+    round-trip, vs one per element for per-item ``float()``.
+    """
+    if not losses:
+        return []
+    return [float(v) for v in jax.device_get(losses)]
