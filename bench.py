@@ -133,6 +133,20 @@ def _measure_rtt(retries=3):
     return best
 
 
+def _timed_window(loop, iters, rtt):
+    """One timed window under the shared sync discipline: ``loop()`` runs all
+    ``iters`` dispatches and returns the value whose host fetch is the
+    barrier. Returns (dt_per_iter, suspect) — suspect when the window is
+    dominated by the sync round-trip so the subtraction is within jitter."""
+    import jax
+
+    t0 = time.perf_counter()
+    val = loop()
+    jax.device_get(val)
+    elapsed = time.perf_counter() - t0
+    return max(elapsed - rtt, 1e-9) / iters, elapsed < 2.0 * rtt
+
+
 def _train_bench(raw_step, p, s, o, args, warmup, iters):
     """AOT-compile a donated train step, time it with state threaded through
     (so donation is real), and return (dt_per_iter, xla_info).
@@ -181,15 +195,15 @@ def _train_bench(raw_step, p, s, o, args, warmup, iters):
     profile_dir = os.environ.get("BENCH_PROFILE")
     if profile_dir:
         jax.profiler.start_trace(profile_dir)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        p, s, o, loss = run_once(p, s, o)
-    final_loss = float(jax.device_get(loss))  # the barrier (see docstring)
-    elapsed = time.perf_counter() - t0
-    dt = max(elapsed - rtt, 1e-9) / iters
-    if elapsed < 2.0 * rtt:
-        # the window is dominated by the sync round-trip: the subtraction is
-        # within jitter of the measurement — flag rather than report garbage
+    def loop():
+        nonlocal p, s, o, loss
+        for _ in range(iters):
+            p, s, o, loss = run_once(p, s, o)
+        return loss
+
+    dt, suspect = _timed_window(loop, iters, rtt)
+    final_loss = float(jax.device_get(loss))
+    if suspect:
         info["timing_suspect"] = True
     if profile_dir:
         jax.profiler.stop_trace()
@@ -368,13 +382,14 @@ def bench_parallel(batch_per_chip=256, warmup=2, iters=50):
         out = run()
     jax.device_get(out)  # block_until_ready lies over the tunnel (see _train_bench)
     rtt = _measure_rtt()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = run()
-    jax.device_get(out)
-    elapsed = time.perf_counter() - t0
-    dt = max(elapsed - rtt, 1e-9) / iters
-    suspect = elapsed < 2.0 * rtt
+
+    def loop():
+        out = None
+        for _ in range(iters):
+            out = run()
+        return out
+
+    dt, suspect = _timed_window(loop, iters, rtt)
     sps = b / dt
     per_chip = sps / n
 
@@ -397,19 +412,25 @@ def bench_parallel(batch_per_chip=256, warmup=2, iters=50):
         for _ in range(warmup):
             out = tr1.step(x1, y1)
         jax.device_get(out)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = tr1.step(x1, y1)
-        jax.device_get(out)
-        single_sps = batch_per_chip / (
-            max(time.perf_counter() - t0 - rtt, 1e-9) / iters)
+
+        def loop1():
+            out = None
+            for _ in range(iters):
+                out = tr1.step(x1, y1)
+            return out
+
+        dt1, suspect1 = _timed_window(loop1, iters, rtt)
+        single_sps = batch_per_chip / dt1
         rec["single_chip_samples_per_sec"] = round(single_sps, 1)
         rec["scaling_efficiency"] = round(per_chip / single_sps, 3)
+        if suspect1:
+            rec["timing_suspect"] = True
     return rec
 
 
 def bench_transformer(batch=32, seq=512, d_model=512, n_layers=6,
-                      n_heads=8, vocab=8192, warmup=2, iters=30):
+                      n_heads=8, vocab=8192, warmup=2, iters=30,
+                      metric="transformer_lm_train_tokens_per_sec"):
     """Decoder-only LM tokens/sec — the net-new long-context config and the
     fused-attention (ops/attention_pallas.py) A/B target; no BASELINE.md
     analog exists because the reference has no attention."""
@@ -446,7 +467,7 @@ def bench_transformer(batch=32, seq=512, d_model=512, n_layers=6,
     q_shape = (batch, seq, n_heads, d_model // n_heads)
     fused = attention_pallas.enabled() and attention_pallas.supported(
         q_shape, q_shape, None, jnp.bfloat16)
-    return {"metric": "transformer_lm_train_tokens_per_sec",
+    return {"metric": metric,
             "value": round(tps, 1), "unit": "tokens/sec/chip",
             "vs_baseline": None,  # net-new capability: no reference analog
             "step_time_ms": round(1e3 * dt, 2), "batch": batch, "seq": seq,
@@ -454,11 +475,24 @@ def bench_transformer(batch=32, seq=512, d_model=512, n_layers=6,
             "fused_attention": fused, **info}
 
 
+def bench_longcontext():
+    """Long-sequence decoder LM: seq 4096 is past the measured flash-attention
+    crossover, so this config exercises the fused kernel (the naive path's
+    [B,H,T,T] logits would be ~1 GiB/layer here)."""
+    kw = dict(batch=4, seq=4096, iters=10,
+              metric="transformer_lm_4k_train_tokens_per_sec")
+    if _preflight():
+        # tiny shapes already applied inside bench_transformer
+        kw = dict(metric="transformer_lm_4k_train_tokens_per_sec")
+    return bench_transformer(**kw)
+
+
 CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
            "lstm": bench_lstm, "word2vec": bench_word2vec,
-           "parallel": bench_parallel, "transformer": bench_transformer}
+           "parallel": bench_parallel, "transformer": bench_transformer,
+           "longcontext": bench_longcontext}
 DEFAULT_ORDER = ["lenet", "resnet50", "lstm", "word2vec", "parallel",
-                 "transformer"]
+                 "transformer", "longcontext"]
 
 
 def main():

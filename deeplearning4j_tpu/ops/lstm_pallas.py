@@ -53,12 +53,16 @@ def _lstm_seq_kernel(xz_ref, wh_ref, h0_ref, c0_ref,
 
     @pl.when(t == 0)
     def _():
-        h_s[:] = h0_ref[:]
-        c_s[:] = c0_ref[:]
+        h_s[:] = h0_ref[:].astype(h_s.dtype)
+        c_s[:] = c0_ref[:].astype(c_s.dtype)
 
+    # h/c scratch is f32 (cell-state accumulation across T must not round to
+    # bf16 each step); the recurrent matmul runs in the INPUT dtype (bf16
+    # under the mixed policy — 4x the f32 MXU rate) with f32 accumulation
     hsz = h_s.shape[1]
-    z = xz_ref[0] + jnp.dot(h_s[:], wh_ref[:],
-                            preferred_element_type=jnp.float32)
+    z = xz_ref[0].astype(jnp.float32) + jnp.dot(
+        h_s[:].astype(wh_ref.dtype), wh_ref[:],
+        preferred_element_type=jnp.float32)
     zi = z[:, 0 * hsz:1 * hsz]
     zf = z[:, 1 * hsz:2 * hsz]
     zg = z[:, 2 * hsz:3 * hsz]
@@ -67,17 +71,17 @@ def _lstm_seq_kernel(xz_ref, wh_ref, h0_ref, c0_ref,
     f = jax.nn.sigmoid(zf)
     g = jnp.tanh(zg)
     o = jax.nn.sigmoid(zo)
-    c = (f * c_s[:] + i * g).astype(c_s.dtype)
-    h = (o * jnp.tanh(c)).astype(h_s.dtype)
+    c = f * c_s[:] + i * g
+    h = o * jnp.tanh(c)
     h_s[:] = h
     c_s[:] = c
-    hs_ref[0] = h
-    cs_ref[0] = c
+    hs_ref[0] = h.astype(hs_ref.dtype)
+    cs_ref[0] = c.astype(cs_ref.dtype)
 
     @pl.when(t == nt - 1)
     def _():
-        hT_ref[:] = h
-        cT_ref[:] = c
+        hT_ref[:] = h.astype(hT_ref.dtype)
+        cT_ref[:] = c.astype(cT_ref.dtype)
 
 
 def _run_kernel(xz, wh, h0, c0, interpret):
@@ -107,7 +111,8 @@ def _run_kernel(xz, wh, h0, c0, interpret):
             jax.ShapeDtypeStruct((b, hsz), dt),
             jax.ShapeDtypeStruct((b, hsz), dt),
         ],
-        scratch_shapes=[pltpu.VMEM((b, hsz), dt), pltpu.VMEM((b, hsz), dt)],
+        scratch_shapes=[pltpu.VMEM((b, hsz), jnp.float32),
+                        pltpu.VMEM((b, hsz), jnp.float32)],
         interpret=interpret,
     )(xz, wh, h0, c0)
 
@@ -135,39 +140,50 @@ def _bwd(interpret, res, grads):
         c_prev = jnp.where(i == 0, c0, cs[jnp.maximum(i - 1, 0)])
         return h_prev, c_prev
 
+    # matmuls run in the residual dtype (bf16 under the policy) with f32
+    # accumulation; elementwise gate math and the dwh accumulator stay f32.
+    # dxz stacks in the INPUT dtype — the f32 [T,B,4H] stack was 38% of the
+    # whole train step's device time in the round-2 profile.
+    f32 = jnp.float32
+    cd = xz.dtype
+
     def step(carry, i):
         dh_next, dc_next, dwh = carry
         h_prev, c_prev = prev_state(i)
         # recompute gates (cheap: one [B,H]x[H,4H] matmul)
-        z = xz[i] + h_prev @ wh
+        z = xz[i].astype(f32) + jnp.matmul(h_prev, wh,
+                                           preferred_element_type=f32)
         zi, zf, zg, zo = jnp.split(z, 4, axis=-1)
         ig = jax.nn.sigmoid(zi)
         fg = jax.nn.sigmoid(zf)
         gg = jnp.tanh(zg)
         og = jax.nn.sigmoid(zo)
-        c = cs[i]
+        c = cs[i].astype(f32)
         tc = jnp.tanh(c)
-        dh = dhs[i] + dh_next
+        dh = dhs[i].astype(f32) + dh_next
         do = dh * tc
         dc = dh * og * (1.0 - tc * tc) + dc_next
         di = dc * gg
-        df = dc * c_prev
+        df = dc * c_prev.astype(f32)
         dg = dc * ig
         dzi = di * ig * (1.0 - ig)
         dzf = df * fg * (1.0 - fg)
         dzg = dg * (1.0 - gg * gg)
         dzo = do * og * (1.0 - og)
-        dz = jnp.concatenate([dzi, dzf, dzg, dzo], axis=-1)  # [B, 4H]
-        dh_prev = dz @ wh.T
+        dz = jnp.concatenate([dzi, dzf, dzg, dzo], axis=-1)  # [B, 4H] f32
+        dzc = dz.astype(cd)
+        dh_prev = jnp.matmul(dzc, wh.T, preferred_element_type=f32)
         dc_prev = dc * fg
-        dwh = dwh + h_prev.T @ dz
-        return (dh_prev, dc_prev, dwh), dz
+        dwh = dwh + jnp.matmul(h_prev.T, dzc, preferred_element_type=f32)
+        return (dh_prev, dc_prev, dwh), dzc
 
-    init = (dhT, dcT, jnp.zeros_like(wh))
+    init = (dhT.astype(f32), dcT.astype(f32),
+            jnp.zeros(wh.shape, f32))
     (dh0, dc0, dwh), dxz_rev = jax.lax.scan(
         step, init, jnp.arange(t - 1, -1, -1))
     dxz = dxz_rev[::-1]
-    return dxz, dwh, dh0, dc0
+    return (dxz, dwh.astype(wh.dtype), dh0.astype(h0.dtype),
+            dc0.astype(c0.dtype))
 
 
 lstm_fused_sequence.defvjp(_fwd, _bwd)
@@ -187,32 +203,35 @@ def _lstm_seq_kernel_peephole(xz_ref, wh_ref, wp_ref, h0_ref, c0_ref,
 
     @pl.when(t == 0)
     def _():
-        h_s[:] = h0_ref[:]
-        c_s[:] = c0_ref[:]
+        h_s[:] = h0_ref[:].astype(h_s.dtype)
+        c_s[:] = c0_ref[:].astype(c_s.dtype)
 
+    # f32 h/c scratch + input-dtype recurrent matmul: see _lstm_seq_kernel
     hsz = h_s.shape[1]
     c_prev = c_s[:]
-    z = xz_ref[0] + jnp.dot(h_s[:], wh_ref[:],
-                            preferred_element_type=jnp.float32)
-    zi = z[:, 0 * hsz:1 * hsz] + wp_ref[0] * c_prev
-    zf = z[:, 1 * hsz:2 * hsz] + wp_ref[1] * c_prev
+    z = xz_ref[0].astype(jnp.float32) + jnp.dot(
+        h_s[:].astype(wh_ref.dtype), wh_ref[:],
+        preferred_element_type=jnp.float32)
+    wp = wp_ref[:].astype(jnp.float32)
+    zi = z[:, 0 * hsz:1 * hsz] + wp[0] * c_prev
+    zf = z[:, 1 * hsz:2 * hsz] + wp[1] * c_prev
     zg = z[:, 2 * hsz:3 * hsz]
     zo = z[:, 3 * hsz:4 * hsz]
     i = jax.nn.sigmoid(zi)
     f = jax.nn.sigmoid(zf)
     g = jnp.tanh(zg)
-    c = (f * c_prev + i * g).astype(c_s.dtype)
-    o = jax.nn.sigmoid(zo + wp_ref[2] * c)
-    h = (o * jnp.tanh(c)).astype(h_s.dtype)
+    c = f * c_prev + i * g
+    o = jax.nn.sigmoid(zo + wp[2] * c)
+    h = o * jnp.tanh(c)
     h_s[:] = h
     c_s[:] = c
-    hs_ref[0] = h
-    cs_ref[0] = c
+    hs_ref[0] = h.astype(hs_ref.dtype)
+    cs_ref[0] = c.astype(cs_ref.dtype)
 
     @pl.when(t == nt - 1)
     def _():
-        hT_ref[:] = h
-        cT_ref[:] = c
+        hT_ref[:] = h.astype(hT_ref.dtype)
+        cT_ref[:] = c.astype(cT_ref.dtype)
 
 
 def _run_kernel_peephole(xz, wh, wp, h0, c0, interpret):
@@ -243,7 +262,8 @@ def _run_kernel_peephole(xz, wh, wp, h0, c0, interpret):
             jax.ShapeDtypeStruct((b, hsz), dt),
             jax.ShapeDtypeStruct((b, hsz), dt),
         ],
-        scratch_shapes=[pltpu.VMEM((b, hsz), dt), pltpu.VMEM((b, hsz), dt)],
+        scratch_shapes=[pltpu.VMEM((b, hsz), jnp.float32),
+                        pltpu.VMEM((b, hsz), jnp.float32)],
         interpret=interpret,
     )(xz, wh, wp, h0, c0)
 
@@ -271,44 +291,54 @@ def _bwd_p(interpret, res, grads):
         c_prev = jnp.where(i == 0, c0, cs[jnp.maximum(i - 1, 0)])
         return h_prev, c_prev
 
+    # same dtype discipline as _bwd: input-dtype matmuls + f32 gate math
+    f32 = jnp.float32
+    cd = xz.dtype
+    wpf = wp.astype(f32)
+
     def step(carry, i):
         dh_next, dc_next, dwh, dwp = carry
         h_prev, c_prev = prev_state(i)
+        c_prev = c_prev.astype(f32)
         # recompute gates (cheap: one [B,H]x[H,4H] matmul)
-        z = xz[i] + h_prev @ wh
+        z = xz[i].astype(f32) + jnp.matmul(h_prev, wh,
+                                           preferred_element_type=f32)
         zi, zf, zg, zo = jnp.split(z, 4, axis=-1)
-        ig = jax.nn.sigmoid(zi + wp[0] * c_prev)
-        fg = jax.nn.sigmoid(zf + wp[1] * c_prev)
+        ig = jax.nn.sigmoid(zi + wpf[0] * c_prev)
+        fg = jax.nn.sigmoid(zf + wpf[1] * c_prev)
         gg = jnp.tanh(zg)
-        c = cs[i]
-        og = jax.nn.sigmoid(zo + wp[2] * c)
+        c = cs[i].astype(f32)
+        og = jax.nn.sigmoid(zo + wpf[2] * c)
         tc = jnp.tanh(c)
-        dh = dhs[i] + dh_next
+        dh = dhs[i].astype(f32) + dh_next
         do = dh * tc
         dzo = do * og * (1.0 - og)
         # c feeds o through the peephole, so dc picks up dzo * wp_o
-        dc = dh * og * (1.0 - tc * tc) + dc_next + dzo * wp[2]
+        dc = dh * og * (1.0 - tc * tc) + dc_next + dzo * wpf[2]
         di = dc * gg
         df = dc * c_prev
         dg = dc * ig
         dzi = di * ig * (1.0 - ig)
         dzf = df * fg * (1.0 - fg)
         dzg = dg * (1.0 - gg * gg)
-        dz = jnp.concatenate([dzi, dzf, dzg, dzo], axis=-1)  # [B, 4H]
+        dz = jnp.concatenate([dzi, dzf, dzg, dzo], axis=-1)  # [B, 4H] f32
+        dzc = dz.astype(cd)
         # c_prev feeds i/f through the peepholes
-        dh_prev = dz @ wh.T
-        dc_prev = dc * fg + dzi * wp[0] + dzf * wp[1]
-        dwh = dwh + h_prev.T @ dz
+        dh_prev = jnp.matmul(dzc, wh.T, preferred_element_type=f32)
+        dc_prev = dc * fg + dzi * wpf[0] + dzf * wpf[1]
+        dwh = dwh + jnp.matmul(h_prev.T, dzc, preferred_element_type=f32)
         dwp = dwp + jnp.stack([jnp.sum(dzi * c_prev, axis=0),
                                jnp.sum(dzf * c_prev, axis=0),
                                jnp.sum(dzo * c, axis=0)])
-        return (dh_prev, dc_prev, dwh, dwp), dz
+        return (dh_prev, dc_prev, dwh, dwp), dzc
 
-    init = (dhT, dcT, jnp.zeros_like(wh), jnp.zeros_like(wp))
+    init = (dhT.astype(f32), dcT.astype(f32), jnp.zeros(wh.shape, f32),
+            jnp.zeros(wp.shape, f32))
     (dh0, dc0, dwh, dwp), dxz_rev = jax.lax.scan(
         step, init, jnp.arange(t - 1, -1, -1))
     dxz = dxz_rev[::-1]
-    return dxz, dwh, dwp, dh0, dc0
+    return (dxz, dwh.astype(wh.dtype), dwp.astype(wp.dtype),
+            dh0.astype(h0.dtype), dc0.astype(c0.dtype))
 
 
 lstm_fused_sequence_peephole.defvjp(_fwd_p, _bwd_p)
@@ -379,5 +409,9 @@ def supported(x_shape, hsz, *, peephole, mask, gate_activation, activation):
     if (gate_activation, activation) != ("sigmoid", "tanh"):
         return False
     b = x_shape[0]
-    # B>=8 fills MXU sublanes; hsz>=96 bounds lane-padding waste at <=33%
-    return hsz >= 96 and b >= 8
+    # B>=8 fills MXU sublanes; hsz>=96 bounds lane-padding waste at <=33%.
+    # Upper bound (measured, v5e round 2): the kernel wins vs XLA's scan at
+    # H<=512 (1.3x at B=64, 1.9x at B=256) but loses at H=1024 (0.7x) and
+    # VMEM-OOMs at H=2048 — the resident [H,4H] Wh block outgrows the 16 MiB
+    # scoped budget. Larger hidden sizes take the scan path.
+    return 96 <= hsz and pad_hidden(hsz) <= 512 and b >= 8

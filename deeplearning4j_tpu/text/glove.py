@@ -97,7 +97,8 @@ class GloVe:
         gb = jnp.zeros(v, jnp.float32)
         gbc = jnp.zeros(v, jnp.float32)
 
-        losses = []
+        self.loss_history = []  # reset up front: a mid-fit failure must not
+        losses = []             # leave a previous fit's history behind
         n = len(rows)
         for epoch in range(self.epochs):
             perm = rs.permutation(n)

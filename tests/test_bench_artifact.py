@@ -26,7 +26,7 @@ def test_bench_full_sweep_streams_records():
     records = [json.loads(line) for line in r.stdout.strip().splitlines()]
     by_config = {rec["config"]: rec for rec in records if "config" in rec}
     for config in ("lenet", "resnet50", "lstm", "word2vec", "parallel",
-                   "transformer"):
+                   "transformer", "longcontext"):
         assert config in by_config, f"no record for {config}"
         rec = by_config[config]
         assert "FAILED" not in rec.get("metric", ""), rec

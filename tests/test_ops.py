@@ -165,6 +165,10 @@ class TestFusedLstmKernel:
             (8, 16, 32), 128, **{**ok, "mask": np.ones((8, 16))})
         assert not lstm_pallas.supported(
             (8, 16, 32), 128, **{**ok, "activation": "relu"})
+        # measured upper bound: H>512 loses to XLA's scan (and the resident
+        # Wh block VMEM-OOMs at H=2048)
+        assert not lstm_pallas.supported((8, 16, 32), 1024, **ok)
+        assert lstm_pallas.supported((8, 16, 32), 512, **ok)
 
     def test_padded_dispatch_matches_unpadded_exactly(self):
         # H=100 -> padded to 128; padding is exact (zero lanes stay zero)
@@ -280,13 +284,21 @@ class TestFlashAttention:
 
     def test_supported_gate(self):
         from deeplearning4j_tpu.ops.attention_pallas import supported
-        assert supported((2, 16, 2, 64), (2, 16, 2, 64), None, np.float32)
+        assert supported((2, 16, 2, 64), (2, 16, 2, 64), None, np.float32,
+                         min_seq=0)
         assert not supported((2, 16, 2, 64), (2, 16, 2, 64),
-                             np.ones((2, 16)), np.float32)
+                             np.ones((2, 16)), np.float32, min_seq=0)
         assert not supported((2, 16, 2, 256), (2, 16, 2, 256), None,
-                             np.float32)
+                             np.float32, min_seq=0)
         # KV-cache decode (tq != tk) must fall back to the naive path
-        assert not supported((2, 1, 2, 64), (2, 16, 2, 64), None, np.float32)
+        assert not supported((2, 1, 2, 64), (2, 16, 2, 64), None, np.float32,
+                             min_seq=0)
+        # short sequences go to XLA's naive path (measured crossover: the
+        # kernel only wins from ~1024 tokens)
+        assert not supported((2, 512, 2, 64), (2, 512, 2, 64), None,
+                             np.float32)
+        assert supported((2, 2048, 2, 64), (2, 2048, 2, 64), None,
+                         np.float32)
 
     def test_non_divisor_blocks(self):
         # t=20 with block_q=8, block_k=6 pads to lcm(8,6)=24
