@@ -136,15 +136,16 @@ def _measure_rtt(retries=3):
 def _timed_window(loop, iters, rtt):
     """One timed window under the shared sync discipline: ``loop()`` runs all
     ``iters`` dispatches and returns the value whose host fetch is the
-    barrier. Returns (dt_per_iter, suspect) — suspect when the window is
-    dominated by the sync round-trip so the subtraction is within jitter."""
+    barrier. Returns (dt_per_iter, suspect, host_val) — suspect when the
+    window is dominated by the sync round-trip so the subtraction is within
+    jitter; host_val is the fetched barrier value (callers must not fetch it
+    again: each fetch is a ~70 ms round-trip over the tunnel)."""
     import jax
 
     t0 = time.perf_counter()
-    val = loop()
-    jax.device_get(val)
+    host_val = jax.device_get(loop())
     elapsed = time.perf_counter() - t0
-    return max(elapsed - rtt, 1e-9) / iters, elapsed < 2.0 * rtt
+    return max(elapsed - rtt, 1e-9) / iters, elapsed < 2.0 * rtt, host_val
 
 
 def _train_bench(raw_step, p, s, o, args, warmup, iters):
@@ -201,8 +202,8 @@ def _train_bench(raw_step, p, s, o, args, warmup, iters):
             p, s, o, loss = run_once(p, s, o)
         return loss
 
-    dt, suspect = _timed_window(loop, iters, rtt)
-    final_loss = float(jax.device_get(loss))
+    dt, suspect, final_loss = _timed_window(loop, iters, rtt)
+    final_loss = float(final_loss)
     if suspect:
         info["timing_suspect"] = True
     if profile_dir:
@@ -389,7 +390,7 @@ def bench_parallel(batch_per_chip=256, warmup=2, iters=50):
             out = run()
         return out
 
-    dt, suspect = _timed_window(loop, iters, rtt)
+    dt, suspect, _ = _timed_window(loop, iters, rtt)
     sps = b / dt
     per_chip = sps / n
 
@@ -419,7 +420,7 @@ def bench_parallel(batch_per_chip=256, warmup=2, iters=50):
                 out = tr1.step(x1, y1)
             return out
 
-        dt1, suspect1 = _timed_window(loop1, iters, rtt)
+        dt1, suspect1, _ = _timed_window(loop1, iters, rtt)
         single_sps = batch_per_chip / dt1
         rec["single_chip_samples_per_sec"] = round(single_sps, 1)
         rec["scaling_efficiency"] = round(per_chip / single_sps, 3)
@@ -478,13 +479,10 @@ def bench_transformer(batch=32, seq=512, d_model=512, n_layers=6,
 def bench_longcontext():
     """Long-sequence decoder LM: seq 4096 is past the measured flash-attention
     crossover, so this config exercises the fused kernel (the naive path's
-    [B,H,T,T] logits would be ~1 GiB/layer here)."""
-    kw = dict(batch=4, seq=4096, iters=10,
-              metric="transformer_lm_4k_train_tokens_per_sec")
-    if _preflight():
-        # tiny shapes already applied inside bench_transformer
-        kw = dict(metric="transformer_lm_4k_train_tokens_per_sec")
-    return bench_transformer(**kw)
+    [B,H,T,T] logits would be ~1 GiB/layer here). Under preflight,
+    bench_transformer's own tiny-shape override applies."""
+    return bench_transformer(batch=4, seq=4096, iters=10,
+                             metric="transformer_lm_4k_train_tokens_per_sec")
 
 
 CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,

@@ -224,7 +224,9 @@ def _attention_bwd(causal, scale, block_q, block_k, interpret, res, g):
     # f32 accumulation via preferred_element_type; softmax math is f32
     qf, kf, vf, gf, of = q, k, v, g.astype(q.dtype), out
     bh, t, d = qf.shape
-    bk = block_k
+    # same clamp as _run_fwd: an unclamped 512 block would pad short
+    # sequences' key blocks with masked-out columns the einsums still chew
+    bk = min(block_k, -(-t // _LANE) * _LANE)
     t_pad = -(-t // bk) * bk
     kp = _pad_to(kf, t_pad, 1).reshape(bh, t_pad // bk, bk, d)
     vp = _pad_to(vf, t_pad, 1).reshape(bh, t_pad // bk, bk, d)
