@@ -582,6 +582,13 @@ class ComputationGraph:
             v = self._defs[name]
             if params[name]:
                 loss = loss + v.vertex.regularization_penalty(params[name])
+        # pop per-vertex auxiliary losses (MoE load balancing) — see
+        # multilayer.loss_fn for the contract
+        for name, s in list(new_state.items()):
+            if isinstance(s, dict) and "aux_loss" in s:
+                s = dict(s)
+                loss = loss + s.pop("aux_loss")
+                new_state[name] = s
         outs = {o: acts[o] for o in self.conf.outputs}
         return loss, (new_state, outs)
 

@@ -20,3 +20,4 @@ from deeplearning4j_tpu.nn.layers.centerloss import CenterLossOutputLayer  # noq
 from deeplearning4j_tpu.nn.layers.attention import (  # noqa: F401
     LayerNormalization, MultiHeadAttention, TransformerBlock,
 )
+from deeplearning4j_tpu.nn.layers.moe import MoETransformerBlock  # noqa: F401

@@ -141,6 +141,17 @@ class MultiLayerNetwork:
         for layer, p in zip(self.conf.layers, params):
             if p:
                 loss = loss + layer.regularization_penalty(p)
+        # input-dependent auxiliary losses (MoE load balancing): layers stash
+        # them in their step state under "aux_loss"; pop so the persistent
+        # state structure stays stable across steps
+        cleaned = []
+        for s in new_state:
+            if isinstance(s, dict) and "aux_loss" in s:
+                s = dict(s)
+                loss = loss + s.pop("aux_loss")
+            cleaned.append(s)
+        new_state = type(new_state)(cleaned) if not isinstance(
+            new_state, list) else cleaned
         return loss, (new_state, preds)
 
     # ------------------------------------------------------------------
@@ -189,7 +200,15 @@ class MultiLayerNetwork:
                 for layer, p in zip(conf.layers, params):
                     if p:
                         loss = loss + layer.regularization_penalty(p)
-                return loss, (new_state, new_carries)
+                # pop per-layer aux losses (MoE balancing) — same contract
+                # as loss_fn; keeps the carried state structure stable
+                cleaned = []
+                for s in new_state:
+                    if isinstance(s, dict) and "aux_loss" in s:
+                        s = dict(s)
+                        loss = loss + s.pop("aux_loss")
+                    cleaned.append(s)
+                return loss, (cleaned, new_carries)
 
             (loss, (new_state, new_carries)), grads = jax.value_and_grad(
                 chunk_loss, has_aux=True)(params)

@@ -39,7 +39,12 @@ def _layer_param_spec(layer, pname, arr):
     by the model-axis size stay replicated (XLA requires even shards).
     """
     spec = [None] * arr.ndim
-    if pname in ("W", "Wx", "Wh") and arr.ndim >= 2:
+    if pname.startswith("expert_"):
+        # MoE stacked expert weights [E, ...]: shard the EXPERT axis over
+        # 'model' — GSPMD partitions the per-expert einsums and inserts the
+        # dispatch/combine all-to-alls (expert parallelism)
+        spec[0] = "model"
+    elif pname in ("W", "Wx", "Wh") and arr.ndim >= 2:
         spec[-1] = "model"
     elif pname in ("b", "beta", "gamma") and arr.ndim == 1:
         spec[0] = "model"
@@ -66,18 +71,26 @@ def make_param_shardings(mesh: Mesh, net, params, tensor_parallel=False):
     tp_size = mesh.shape["model"]
     items = _layer_param_items(net, params)
     out = {} if isinstance(params, dict) else [None] * len(items)
+    repl = NamedSharding(mesh, P())
     for layer, key, p in items:
-        d = {}
-        for k, v in p.items():
-            if tensor_parallel and tp_size > 1 and layer is not None:
-                spec = _layer_param_spec(layer, k, v)
+        if tensor_parallel and tp_size > 1 and layer is not None:
+            def spec_for(path, v, _layer=layer):
+                # last path element names the parameter. Nested sub-dicts
+                # (MoE blocks' ln/mha params) only match the expert rule:
+                # the Megatron W/bias rules assume a flat dense-family
+                # layer and would wrongly shard e.g. LayerNorm gamma.
+                last = path[-1]
+                pname = getattr(last, "key", str(last))
+                if len(path) > 1 and not pname.startswith("expert_"):
+                    return NamedSharding(mesh, P())
+                spec = _layer_param_spec(_layer, pname, v)
                 # only shard when divisible
                 ok = all(s is None or v.shape[i] % tp_size == 0
                          for i, s in enumerate(spec))
-                d[k] = NamedSharding(mesh, spec if ok else P())
-            else:
-                d[k] = NamedSharding(mesh, P())
-        out[key] = d
+                return NamedSharding(mesh, spec if ok else P())
+            out[key] = jax.tree_util.tree_map_with_path(spec_for, p)
+        else:
+            out[key] = jax.tree_util.tree_map(lambda _: repl, p)
     return out
 
 
@@ -109,16 +122,8 @@ class ParallelTrainer:
         params, state = self.net.init(rng)
         self.param_shardings = make_param_shardings(self.mesh, self.net, params,
                                                     self.tensor_parallel)
-        if isinstance(params, dict):
-            self.params = {
-                name: {k: jax.device_put(v, self.param_shardings[name][k])
-                       for k, v in p.items()}
-                for name, p in params.items()}
-        else:
-            self.params = [
-                {k: jax.device_put(v, self.param_shardings[i][k])
-                 for k, v in p.items()}
-                for i, p in enumerate(params)]
+        self.params = jax.tree_util.tree_map(jax.device_put, params,
+                                             self.param_shardings)
         repl = NamedSharding(self.mesh, P())
         self.state = jax.device_put(state, repl)
         self.opt_state = jax.device_put(self.net.conf.updater.init(params), repl)

@@ -9,6 +9,7 @@ the Spark cluster layout of the TrainingMasters. TPU-native replacement: a
     data  — data parallelism (replica axis; per-step psum of grads rides ICI)
     model — tensor parallelism (weight shards; collectives inserted by XLA)
     seq   — sequence/context parallelism for long sequences
+    stage — pipeline parallelism (GPipe microbatch schedule; parallel/pipeline.py)
 
 Multi-host: pass all ``jax.devices()`` from a jax.distributed-initialized
 process set; the same named-axis code then spans hosts with DCN-aware
@@ -31,22 +32,24 @@ class MeshSpec:
     data: int = -1
     model: int = 1
     seq: int = 1
+    stage: int = 1
 
     def resolve(self, n_devices):
         d = self.data
         if d == -1:
-            d = n_devices // (self.model * self.seq)
-        assert d * self.model * self.seq == n_devices, \
-            f"mesh {d}x{self.model}x{self.seq} != {n_devices} devices"
-        return d, self.model, self.seq
+            d = n_devices // (self.model * self.seq * self.stage)
+        assert d * self.model * self.seq * self.stage == n_devices, \
+            (f"mesh {d}x{self.model}x{self.seq}x{self.stage} != "
+             f"{n_devices} devices")
+        return d, self.model, self.seq, self.stage
 
 
 def make_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     spec = spec or MeshSpec()
-    d, m, s = spec.resolve(len(devices))
-    arr = np.asarray(devices).reshape(d, m, s)
-    return Mesh(arr, axis_names=("data", "model", "seq"))
+    d, m, s, st = spec.resolve(len(devices))
+    arr = np.asarray(devices).reshape(d, m, s, st)
+    return Mesh(arr, axis_names=("data", "model", "seq", "stage"))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
