@@ -31,6 +31,7 @@ import numpy as np
 from deeplearning4j_tpu.nn import gradnorm as _gradnorm
 from deeplearning4j_tpu.nn import updaters as _updaters
 from deeplearning4j_tpu.nn.conf import inputs as _inputs
+from deeplearning4j_tpu.nn.layers import base as _base_layers
 from deeplearning4j_tpu.utils import dtypes as _dtypes
 from deeplearning4j_tpu.utils import serde
 
@@ -582,13 +583,7 @@ class ComputationGraph:
             v = self._defs[name]
             if params[name]:
                 loss = loss + v.vertex.regularization_penalty(params[name])
-        # pop per-vertex auxiliary losses (MoE load balancing) — see
-        # multilayer.loss_fn for the contract
-        for name, s in list(new_state.items()):
-            if isinstance(s, dict) and "aux_loss" in s:
-                s = dict(s)
-                loss = loss + s.pop("aux_loss")
-                new_state[name] = s
+        loss, new_state = _base_layers.pop_aux_losses(loss, new_state)
         outs = {o: acts[o] for o in self.conf.outputs}
         return loss, (new_state, outs)
 

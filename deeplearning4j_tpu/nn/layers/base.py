@@ -106,6 +106,27 @@ class ParamLayer(Layer):
         return out
 
 
+def pop_aux_losses(loss, states):
+    """(loss + popped aux terms, cleaned states).
+
+    Contract for input-dependent layer losses (MoE load balancing): a layer
+    stashes the term in its per-step state under ``"aux_loss"``; the
+    container's loss function pops it here so the PERSISTENT state structure
+    stays stable across steps (jit/scan/donation invariant). ``states`` is a
+    list of per-layer dicts (MultiLayerNetwork) or a dict keyed by vertex
+    name (ComputationGraph).
+    """
+    items = (list(states.items()) if isinstance(states, dict)
+             else list(enumerate(states)))
+    out = dict(states) if isinstance(states, dict) else list(states)
+    for k, s in items:
+        if isinstance(s, dict) and "aux_loss" in s:
+            s = dict(s)
+            loss = loss + s.pop("aux_loss")
+            out[k] = s
+    return loss, out
+
+
 def dropout_mask(rng, x, rate):
     """Inverted dropout: scale retained units by 1/(1-rate)."""
     keep = 1.0 - rate

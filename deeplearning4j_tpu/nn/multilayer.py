@@ -32,6 +32,7 @@ import numpy as np
 from deeplearning4j_tpu.nn import gradnorm as _gradnorm
 from deeplearning4j_tpu.nn.conf import inputs as _inputs
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import base as _base
 from deeplearning4j_tpu.utils import dtypes as _dtypes
 
 
@@ -141,17 +142,7 @@ class MultiLayerNetwork:
         for layer, p in zip(self.conf.layers, params):
             if p:
                 loss = loss + layer.regularization_penalty(p)
-        # input-dependent auxiliary losses (MoE load balancing): layers stash
-        # them in their step state under "aux_loss"; pop so the persistent
-        # state structure stays stable across steps
-        cleaned = []
-        for s in new_state:
-            if isinstance(s, dict) and "aux_loss" in s:
-                s = dict(s)
-                loss = loss + s.pop("aux_loss")
-            cleaned.append(s)
-        new_state = type(new_state)(cleaned) if not isinstance(
-            new_state, list) else cleaned
+        loss, new_state = _base.pop_aux_losses(loss, new_state)
         return loss, (new_state, preds)
 
     # ------------------------------------------------------------------
@@ -200,15 +191,8 @@ class MultiLayerNetwork:
                 for layer, p in zip(conf.layers, params):
                     if p:
                         loss = loss + layer.regularization_penalty(p)
-                # pop per-layer aux losses (MoE balancing) — same contract
-                # as loss_fn; keeps the carried state structure stable
-                cleaned = []
-                for s in new_state:
-                    if isinstance(s, dict) and "aux_loss" in s:
-                        s = dict(s)
-                        loss = loss + s.pop("aux_loss")
-                    cleaned.append(s)
-                return loss, (cleaned, new_carries)
+                loss, new_state = _base.pop_aux_losses(loss, new_state)
+                return loss, (new_state, new_carries)
 
             (loss, (new_state, new_carries)), grads = jax.value_and_grad(
                 chunk_loss, has_aux=True)(params)

@@ -150,17 +150,21 @@ class PipelineParallelLM:
         return self
 
     def _opt_shardings(self, opt_state):
-        """Match each optimizer-state leaf to its param's sharding when the
-        shapes line up (Adam moments), else replicate."""
-        flat_p, _ = jax.tree_util.tree_flatten(self.params)
-        flat_s, _ = jax.tree_util.tree_flatten(self.param_shardings)
-        by_shape = {}
-        for p, s in zip(flat_p, flat_s):
-            by_shape.setdefault(p.shape, s)
+        """Optimizer-state subtrees that mirror the param tree (Adam m/v,
+        momentum buffers) take the param shardings wholesale; anything else
+        replicates. Structure matching, not shape matching — two params
+        sharing a shape must not steal each other's sharding."""
+        p_struct = jax.tree_util.tree_structure(self.params)
         repl = NamedSharding(self.mesh, P())
-        return jax.tree_util.tree_map(
-            lambda leaf: by_shape.get(getattr(leaf, "shape", None), repl),
-            opt_state)
+
+        def per_entry(sub):
+            if jax.tree_util.tree_structure(sub) == p_struct:
+                return self.param_shardings
+            return jax.tree_util.tree_map(lambda _: repl, sub)
+
+        if isinstance(opt_state, dict):
+            return {k: per_entry(v) for k, v in opt_state.items()}
+        return per_entry(opt_state)
 
     # -- training --------------------------------------------------------
     def _loss_fn(self, params, ids, labels):

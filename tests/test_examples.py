@@ -20,6 +20,7 @@ TUTORIALS = [
     "examples/tutorials/t07_center_loss_embeddings.py",
     "examples/tutorials/t08_rnn_sequence_classification.py",
     "examples/tutorials/t09_transformer_language_model.py",
+    "examples/tutorials/t10_scaling_parallelism.py",
 ]
 EXAMPLES = [
     "examples/lenet_mnist.py",
