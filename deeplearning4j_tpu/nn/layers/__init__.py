@@ -14,7 +14,11 @@ from deeplearning4j_tpu.nn.layers.rnn import (  # noqa: F401
     LSTM, GravesLSTM, GravesBidirectionalLSTM, SimpleRnn, RnnOutputLayer,
     RnnLossLayer, LastTimeStep, Bidirectional,
 )
-from deeplearning4j_tpu.nn.layers.vae import VariationalAutoencoder  # noqa: F401
+from deeplearning4j_tpu.nn.layers.vae import (  # noqa: F401
+    VariationalAutoencoder, GaussianReconstruction, BernoulliReconstruction,
+    ExponentialReconstruction, CompositeReconstruction,
+    LossWrapperReconstruction,
+)
 from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer  # noqa: F401
 from deeplearning4j_tpu.nn.layers.centerloss import CenterLossOutputLayer  # noqa: F401
 from deeplearning4j_tpu.nn.layers.attention import (  # noqa: F401

@@ -1,14 +1,21 @@
-"""Variational autoencoder layer.
+"""Variational autoencoder layer + reconstruction-distribution family.
 
-Reference analog: nn/conf/layers/variational/ (7 config files incl.
-VariationalAutoencoder.java, GaussianReconstructionDistribution,
-BernoulliReconstructionDistribution) + nn/layers/variational/
-VariationalAutoencoder.java (1163 LoC) in /root/reference/deeplearning4j-nn.
+Reference analog: nn/conf/layers/variational/ (ReconstructionDistribution
+SPI + Gaussian/Bernoulli/Exponential/Composite/LossFunctionWrapper impls)
++ nn/layers/variational/VariationalAutoencoder.java (1163 LoC) in
+/root/reference/deeplearning4j-nn.
 
 Encoder MLP -> (mean, logvar) of q(z|x); reparameterized sample; decoder MLP
 -> reconstruction-distribution parameters. Supervised forward (the layer used
 inside a net) outputs the posterior mean, matching the reference's activate().
 ``pretrain_loss`` = -ELBO = -E[log p(x|z)] + KL(q(z|x) || N(0,I)).
+
+The reconstruction distribution is pluggable, mirroring the reference SPI
+(``distributionInputSize`` -> ``param_size``, ``negLogProbability`` ->
+``log_prob``, ``generateAtMean``/``generateRandom`` -> ``mean``/``sample``);
+gradients come from AD instead of the reference's hand-written
+``gradient()`` methods. ``reconstruction="gaussian"|"bernoulli"`` strings
+keep the original shorthand.
 """
 
 from __future__ import annotations
@@ -20,10 +27,202 @@ import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn import activations as _act
 from deeplearning4j_tpu.nn import initializers as _init
+from deeplearning4j_tpu.nn import losses as _losses
 from deeplearning4j_tpu.nn.conf import inputs as _inputs
 from deeplearning4j_tpu.nn.layers.base import ParamLayer
 from deeplearning4j_tpu.nn.layers.core import matmul
 from deeplearning4j_tpu.utils.serde import register_config
+
+
+# ---------------------------------------------------------------------------
+# Reconstruction distributions (reference: ReconstructionDistribution SPI)
+# ---------------------------------------------------------------------------
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class GaussianReconstruction:
+    """p(x|z) = N(mean, diag(var)); decoder emits [mean | logvar]
+    (reference: GaussianReconstructionDistribution, activation applied to
+    the MEAN half only, matching the Java impl)."""
+
+    activation: str = "identity"
+
+    def param_size(self, n):
+        return 2 * n
+
+    def _split(self, pre):
+        n = pre.shape[-1] // 2
+        return _act.get(self.activation)(pre[..., :n]), pre[..., n:]
+
+    def log_prob(self, pre, x):
+        mean, logvar = self._split(pre)
+        return -0.5 * jnp.sum(logvar + (x - mean) ** 2 / jnp.exp(logvar)
+                              + jnp.log(2 * jnp.pi), axis=-1)
+
+    def mean(self, pre):
+        return self._split(pre)[0]
+
+    def sample(self, pre, rng):
+        mean, logvar = self._split(pre)
+        return mean + jnp.exp(0.5 * logvar) * jax.random.normal(
+            rng, mean.shape, mean.dtype)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class BernoulliReconstruction:
+    """p(x|z) = prod Bernoulli(p); decoder emits logits through
+    ``activation`` (sigmoid by default, like the reference)."""
+
+    activation: str = "sigmoid"
+
+    def param_size(self, n):
+        return n
+
+    def _p(self, pre):
+        return jnp.clip(_act.get(self.activation)(pre), 1e-7, 1.0 - 1e-7)
+
+    def log_prob(self, pre, x):
+        p = self._p(pre)
+        return jnp.sum(x * jnp.log(p) + (1.0 - x) * jnp.log(1.0 - p),
+                       axis=-1)
+
+    def mean(self, pre):
+        return self._p(pre)
+
+    def sample(self, pre, rng):
+        p = self._p(pre)
+        return jax.random.bernoulli(rng, p).astype(p.dtype)
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class ExponentialReconstruction:
+    """p(x|z) = lambda * exp(-lambda x), lambda = exp(activation(pre)) —
+    log p = gamma - lambda*x (reference:
+    ExponentialReconstructionDistribution.negLogProbability)."""
+
+    activation: str = "identity"
+
+    def param_size(self, n):
+        return n
+
+    def log_prob(self, pre, x):
+        gamma = _act.get(self.activation)(pre)
+        return jnp.sum(gamma - jnp.exp(gamma) * x, axis=-1)
+
+    def mean(self, pre):
+        gamma = _act.get(self.activation)(pre)
+        return jnp.exp(-gamma)  # E[x] = 1/lambda
+
+    def sample(self, pre, rng):
+        gamma = _act.get(self.activation)(pre)
+        u = jax.random.uniform(rng, gamma.shape, gamma.dtype,
+                               minval=1e-7, maxval=1.0 - 1e-7)
+        return -jnp.log1p(-u) * jnp.exp(-gamma)  # inverse CDF
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class LossWrapperReconstruction:
+    """Use a plain loss function as the "reconstruction distribution"
+    (reference: LossFunctionWrapper — an ILossFunction behind the SPI;
+    log_prob := -loss, so the ELBO becomes reconstruction-error + KL)."""
+
+    loss: str = "mse"
+    activation: str = "identity"
+
+    def param_size(self, n):
+        return n
+
+    def _out(self, pre):
+        return _act.get(self.activation)(pre)
+
+    def log_prob(self, pre, x):
+        out = self._out(pre)
+        fn = _losses.get(self.loss)
+        # the loss fns reduce over the batch (vmap recovers per-example
+        # values) and average over features — scale by n_features so the
+        # term SUMS over features like every other distribution (else the
+        # KL term dominates by a factor of n_features)
+        per = jax.vmap(lambda o, t: fn(o[None], t[None]))(out, x)
+        return -per * x.shape[-1]
+
+    def mean(self, pre):
+        return self._out(pre)
+
+    def sample(self, pre, rng):
+        return self._out(pre)  # deterministic: a loss has no sampler
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class CompositeReconstruction:
+    """Different distributions over different feature slices (reference:
+    CompositeReconstructionDistribution.Builder.addDistribution). ``parts``
+    is a tuple of (feature_count, distribution) pairs covering the input."""
+
+    parts: tuple = ()
+
+    def __post_init__(self):
+        # normalize (serde rebuilds nested pairs as lists): keep the frozen
+        # dataclass hashable and round-trip equality intact
+        object.__setattr__(self, "parts",
+                           tuple((int(sz), d) for sz, d in self.parts))
+
+    def param_size(self, n):
+        total = sum(sz for sz, _ in self.parts)
+        if total != n:
+            raise ValueError(
+                f"composite covers {total} features, input has {n}")
+        return sum(d.param_size(sz) for sz, d in self.parts)
+
+    def _slices(self):
+        x_off = p_off = 0
+        for sz, d in self.parts:
+            yield d, (x_off, x_off + sz), (p_off, p_off + d.param_size(sz))
+            x_off += sz
+            p_off += d.param_size(sz)
+
+    def log_prob(self, pre, x):
+        total = 0.0
+        for d, (x0, x1), (p0, p1) in self._slices():
+            total = total + d.log_prob(pre[..., p0:p1], x[..., x0:x1])
+        return total
+
+    def mean(self, pre):
+        return jnp.concatenate([d.mean(pre[..., p0:p1])
+                                for d, _, (p0, p1) in self._slices()],
+                               axis=-1)
+
+    def sample(self, pre, rng):
+        outs = []
+        for d, _, (p0, p1) in self._slices():
+            rng, sub = jax.random.split(rng)
+            outs.append(d.sample(pre[..., p0:p1], sub))
+        return jnp.concatenate(outs, axis=-1)
+
+
+_DIST_SHORTHAND = {
+    "gaussian": GaussianReconstruction,
+    "bernoulli": BernoulliReconstruction,
+    "exponential": ExponentialReconstruction,
+}
+
+
+def resolve_distribution(spec):
+    """str shorthand or a distribution instance -> distribution instance."""
+    if isinstance(spec, str):
+        try:
+            return _DIST_SHORTHAND[spec]()
+        except KeyError:
+            raise ValueError(f"unknown reconstruction {spec!r}; use one of "
+                             f"{sorted(_DIST_SHORTHAND)} or a distribution "
+                             "instance") from None
+    if isinstance(spec, (list, tuple)):  # serde round-trip of composites
+        return CompositeReconstruction(parts=tuple(
+            (int(sz), resolve_distribution(d)) for sz, d in spec))
+    return spec
 
 
 @register_config
@@ -32,11 +231,17 @@ class VariationalAutoencoder(ParamLayer):
     n_latent: int = 2
     encoder_layer_sizes: tuple = (64,)
     decoder_layer_sizes: tuple = (64,)
-    reconstruction: str = "gaussian"  # gaussian (learned diag var) | bernoulli
+    # "gaussian" | "bernoulli" | "exponential" | a distribution instance
+    # (incl. CompositeReconstruction / LossWrapperReconstruction)
+    reconstruction: object = "gaussian"
     num_samples: int = 1
     activation: object = dataclasses.field(default="relu", kw_only=True)
 
     input_family = _inputs.FeedForwardType
+
+    @property
+    def dist(self):
+        return resolve_distribution(self.reconstruction)
 
     def output_type(self, input_type):
         return _inputs.FeedForwardType(self.n_latent)
@@ -61,9 +266,8 @@ class VariationalAutoencoder(ParamLayer):
         for i in range(len(dsizes) - 1):
             key, sub = jax.random.split(key)
             dense(sub, f"dec{i}", dsizes[i], dsizes[i + 1])
-        out_dim = 2 * n_in if self.reconstruction == "gaussian" else n_in
         key, k_out = jax.random.split(key)
-        dense(k_out, "x_out", dsizes[-1], out_dim)
+        dense(k_out, "x_out", dsizes[-1], self.dist.param_size(n_in))
         return p
 
     # ---- internals ----
@@ -92,14 +296,22 @@ class VariationalAutoencoder(ParamLayer):
         mean, logvar = self.encode(params, x)
         z = mean if rng is None else \
             mean + jnp.exp(0.5 * logvar) * jax.random.normal(rng, mean.shape, mean.dtype)
-        out = self.decode(params, z)
-        if self.reconstruction == "bernoulli":
-            return jax.nn.sigmoid(out)
-        return out[..., :out.shape[-1] // 2]  # gaussian mean half
+        return self.dist.mean(self.decode(params, z))
+
+    def generate_at_mean(self, params, z):
+        """Decode latent points to the distribution mean (reference:
+        generateAtMeanGivenZ)."""
+        return self.dist.mean(self.decode(params, z))
+
+    def generate_random(self, params, z, rng):
+        """Decode latent points and SAMPLE the reconstruction distribution
+        (reference: generateRandomGivenZ)."""
+        return self.dist.sample(self.decode(params, z), rng)
 
     def pretrain_loss(self, params, x, rng):
         """-ELBO averaged over the batch (reference: computeGradientAndScore
         of the VAE layer in pretrain mode)."""
+        dist = self.dist
         mean, logvar = self.encode(params, x)
         kl = 0.5 * jnp.sum(jnp.exp(logvar) + mean**2 - 1.0 - logvar, axis=-1)
         rec = 0.0
@@ -110,37 +322,20 @@ class VariationalAutoencoder(ParamLayer):
             else:
                 eps = 0.0
             z = mean + jnp.exp(0.5 * logvar) * eps
-            out = self.decode(params, z)
-            if self.reconstruction == "gaussian":
-                n_in = out.shape[-1] // 2
-                x_mean, x_logvar = out[..., :n_in], out[..., n_in:]
-                ll = -0.5 * jnp.sum(
-                    x_logvar + (x - x_mean) ** 2 / jnp.exp(x_logvar)
-                    + jnp.log(2 * jnp.pi), axis=-1)
-            else:
-                p = jnp.clip(jax.nn.sigmoid(out), 1e-7, 1 - 1e-7)
-                ll = jnp.sum(x * jnp.log(p) + (1 - x) * jnp.log(1 - p), axis=-1)
-            rec = rec + ll
+            rec = rec + dist.log_prob(self.decode(params, z), x)
         rec = rec / self.num_samples
         return jnp.mean(kl - rec)
 
     def reconstruction_probability(self, params, x, rng, num_samples=8):
         """Monte-Carlo estimate of log p(x) used for anomaly scoring
         (reference: VariationalAutoencoder.reconstructionProbability)."""
+        dist = self.dist
         mean, logvar = self.encode(params, x)
         total = None
         for s in range(num_samples):
             rng, sub = jax.random.split(rng)
             eps = jax.random.normal(sub, mean.shape, mean.dtype)
             z = mean + jnp.exp(0.5 * logvar) * eps
-            out = self.decode(params, z)
-            if self.reconstruction == "gaussian":
-                n_in = out.shape[-1] // 2
-                x_mean, x_logvar = out[..., :n_in], out[..., n_in:]
-                ll = -0.5 * jnp.sum(x_logvar + (x - x_mean) ** 2 / jnp.exp(x_logvar)
-                                    + jnp.log(2 * jnp.pi), axis=-1)
-            else:
-                p = jnp.clip(jax.nn.sigmoid(out), 1e-7, 1 - 1e-7)
-                ll = jnp.sum(x * jnp.log(p) + (1 - x) * jnp.log(1 - p), axis=-1)
+            ll = dist.log_prob(self.decode(params, z), x)
             total = ll if total is None else jnp.logaddexp(total, ll)
         return total - jnp.log(float(num_samples))
