@@ -490,6 +490,7 @@ class ComputationGraph:
         self.iteration = 0
         self.epoch = 0
         self.listeners = []
+        self.score_value = None
         self._train_step = None
         self._rng = jax.random.PRNGKey(conf.seed)
 
@@ -644,6 +645,7 @@ class ComputationGraph:
                 self.params, self.state, self.opt_state, loss = self._train_step(
                     self.params, self.state, self.opt_state, bi, bl,
                     self.iteration, sub, bm)
+                self.score_value = loss  # device scalar; float() on demand
                 self.iteration += 1
                 for l in self.listeners:
                     l.iteration_done(self, self.iteration, float(loss))

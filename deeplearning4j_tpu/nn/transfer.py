@@ -167,3 +167,149 @@ class TransferLearningHelper:
                       for s in self.net.state[self.frozen_until + 1:]]
         tail.opt_state = tail_conf.updater.init(tail.params)
         return tail
+
+
+class TransferLearningGraph:
+    """Transfer learning for ComputationGraph (reference:
+    TransferLearning.GraphBuilder — the path zoo users take to fine-tune a
+    pretrained DAG model: freeze a feature-extractor prefix, replace the
+    head, optionally extend the graph).
+
+    Freezing is by vertex NAME; ``set_feature_extractor(v)`` freezes ``v``
+    and every vertex topologically before it, matching the reference's
+    "frozen up to and including" semantics.
+    """
+
+    def __init__(self, cg):
+        assert cg.params is not None, "source graph must be initialized/trained"
+        from deeplearning4j_tpu.nn.graph import ComputationGraph  # cycle-free
+        self._cg_cls = ComputationGraph
+        self._src = cg
+        self._fine_tune = None
+        self._frozen = set()
+        self._replaced = {}
+        self._added = []       # (name, layer, inputs)
+        self._outputs = None
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+        self._fine_tune = ftc
+        return self
+
+    def set_feature_extractor(self, vertex_name):
+        order = self._src._order
+        assert vertex_name in order, f"unknown vertex {vertex_name!r}"
+        upto = order.index(vertex_name)
+        self._frozen = {n for n in order[:upto + 1]
+                        if n not in self._src.conf.inputs}
+        return self
+
+    def replace_layer(self, name, new_layer):
+        """Swap a LayerVertex's layer (reference: nOutReplace / removeVertex
+        + addLayer); its params re-initialize in build()."""
+        self._replaced[name] = new_layer
+        return self
+
+    def add_layer(self, name, layer, *inputs):
+        self._added.append((name, layer, tuple(inputs)))
+        return self
+
+    def set_outputs(self, *names):
+        self._outputs = tuple(names)
+        return self
+
+    def build(self):
+        from deeplearning4j_tpu.nn.graph import LayerVertex, VertexDef
+        conf = self._src.conf
+        vertices = []
+        for v in conf.vertices:
+            if v.name in self._replaced:
+                vertices.append(VertexDef(
+                    v.name, LayerVertex(layer=self._replaced[v.name]),
+                    v.inputs))
+            else:
+                vertices.append(v)
+        for name, layer, inputs in self._added:
+            vertices.append(VertexDef(name, LayerVertex(layer=layer), inputs))
+        bad = (set(self._replaced) | {n for n, _, _ in self._added}) \
+            & self._frozen
+        if bad:
+            raise ValueError(
+                f"vertices {sorted(bad)} are both frozen and replaced/added —"
+                " a replaced layer inside the frozen prefix would train-freeze"
+                " at its random initialization")
+        if self._fine_tune is not None:
+            ft = self._fine_tune
+            overrides = {f: getattr(ft, f) for f in ("l1", "l2", "dropout")
+                         if getattr(ft, f) is not None}
+            if overrides:
+                from deeplearning4j_tpu.nn.graph import LayerVertex, VertexDef
+                vertices = [
+                    VertexDef(v.name, LayerVertex(layer=dataclasses.replace(
+                        v.vertex.layer,
+                        **{k: val for k, val in overrides.items()
+                           if hasattr(v.vertex.layer, k)})), v.inputs)
+                    if isinstance(v.vertex, LayerVertex) else v
+                    for v in vertices]
+        kwargs = {"vertices": tuple(vertices)}
+        if self._outputs is not None:
+            kwargs["outputs"] = self._outputs
+        new_conf = dataclasses.replace(conf, **kwargs)
+        if self._fine_tune is not None:
+            if ft.updater is not None:
+                new_conf = dataclasses.replace(new_conf, updater=ft.updater)
+            if ft.seed is not None:
+                new_conf = dataclasses.replace(new_conf, seed=ft.seed)
+        net = self._cg_cls(new_conf)
+        net.frozen_vertices = set(self._frozen)
+        net.init()
+        added = {n for n, _, _ in self._added}
+
+        def shapes_match(a, b):
+            try:
+                return jax.tree_util.tree_all(jax.tree_util.tree_map(
+                    lambda x, y: x.shape == y.shape, a, b))
+            except ValueError:  # differing tree structure
+                return False
+
+        for name in net.params:
+            if name in self._src.params and name not in self._replaced \
+                    and name not in added:
+                # skip on shape mismatch (a vertex downstream of a replaced
+                # layer whose width changed keeps its fresh init — copying
+                # the stale source weights would fail inside jit later)
+                if not shapes_match(net.params[name], self._src.params[name]):
+                    continue
+                net.params[name] = jax.tree_util.tree_map(
+                    jnp.copy, self._src.params[name])
+                net.state[name] = jax.tree_util.tree_map(
+                    jnp.copy, self._src.state[name])
+        net.opt_state = new_conf.updater.init(net.params)
+        _install_freeze_graph(net)
+        return net
+
+
+def _install_freeze_graph(net):
+    """Graph twin of _install_freeze: frozen vertices get their params
+    restored after each update (zero effective update, FrozenLayer.java
+    semantics)."""
+    frozen = set(getattr(net, "frozen_vertices", ()))
+    if not frozen:
+        return
+    orig_make = net.make_train_step
+
+    def make_train_step(donate=True, jit=True):
+        base = orig_make(donate=False, jit=False)
+
+        def step(params, state, opt_state, x, y, it, rng, mask=None):
+            new_params, new_state, new_opt, loss = base(
+                params, state, opt_state, x, y, it, rng, mask)
+            new_params = {name: (params[name] if name in frozen else p)
+                          for name, p in new_params.items()}
+            return new_params, new_state, new_opt, loss
+
+        if not jit:
+            return step
+        return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+
+    net.make_train_step = make_train_step
+    net._train_step = None

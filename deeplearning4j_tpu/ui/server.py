@@ -78,16 +78,19 @@ class UIServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _html(self, markup, code=200):
+                body = markup.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/html")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 url = urlparse(self.path)
                 q = parse_qs(url.query)
                 if url.path in ("/", "/train", "/train/overview.html"):
-                    body = _PAGE.encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/html")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._html(_PAGE)
                     return
                 if url.path == "/train/sessions":
                     out = sorted({s for st in server.storages for s in st.sessions()})
@@ -107,12 +110,7 @@ class UIServer:
                     return
                 if url.path == "/train/model.html":
                     session = q.get("session", ["default"])[0]
-                    body = _model_page(server, session).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/html")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._html(_model_page(server, session))
                     return
                 if url.path == "/train/model":
                     session = q.get("session", ["default"])[0]
@@ -120,6 +118,14 @@ class UIServer:
                     series, _ = _param_series(recs)
                     self._json({k: [list(p) for p in v]
                                 for k, v in series.items()})
+                    return
+                if url.path == "/train/system":
+                    session = q.get("session", ["default"])[0]
+                    self._json(_system_series(server, session))
+                    return
+                if url.path == "/train/system.html":
+                    session = q.get("session", ["default"])[0]
+                    self._html(_system_page(server, session))
                     return
                 self.send_error(404)
 
@@ -269,6 +275,57 @@ def _model_page(server, session):
         parts.append("<h3>Latest parameter stats</h3>")
         parts.append(ComponentTable(["parameter", "l2", "mean", "std"],
                                     rows).render_html())
+    parts.append("</body></html>")
+    return "".join(parts)
+
+
+def _system_series(server, session):
+    """Memory/timing series + hardware info for the system tab."""
+    recs = [r for r in server._records(session, "stats") if "iteration" in r]
+    inits = server._records(session, "init")
+    out = {"hardware": (inits[-1].get("hardware", {}) if inits else {}),
+           "host_rss_mb": [], "device_bytes_in_use": [], "iter_time_s": []}
+    for r in recs:
+        it = r["iteration"]
+        sysd = r.get("system", {})
+        if "host_rss_mb" in sysd:
+            out["host_rss_mb"].append([it, sysd["host_rss_mb"]])
+        if "device_bytes_in_use" in sysd:
+            out["device_bytes_in_use"].append([it, sysd["device_bytes_in_use"]])
+        if "iter_time_s" in r:
+            out["iter_time_s"].append([it, r["iter_time_s"]])
+    return out
+
+
+def _system_page(server, session):
+    """Server-rendered system tab (reference: TrainModule.java system tab —
+    memory utilization + hardware/software info)."""
+    import html as _html
+
+    from deeplearning4j_tpu.ui.components import (ChartLine, ComponentTable,
+                                                  ComponentText)
+
+    data = _system_series(server, session)
+    parts = ["<!DOCTYPE html><html><head>"
+             "<title>system — deeplearning4j_tpu</title></head>"
+             '<body style="font-family:sans-serif;margin:2em">',
+             f"<h2>System: session {_html.escape(session)}</h2>"]
+    hw = data["hardware"]
+    if hw:
+        parts.append(ComponentTable(
+            ["property", "value"],
+            [[k, str(v)] for k, v in sorted(hw.items())]).render_html())
+    plotted = False
+    for key, title in (("host_rss_mb", "host RSS (MB)"),
+                       ("device_bytes_in_use", "device HBM in use (bytes)"),
+                       ("iter_time_s", "iteration time (s)")):
+        pts = data[key]
+        if pts:
+            parts.append(ChartLine(title, [(key, [p[0] for p in pts],
+                                            [p[1] for p in pts])]).render_svg())
+            plotted = True
+    if not plotted and not hw:
+        parts.append(ComponentText("no system records yet").render_html())
     parts.append("</body></html>")
     return "".join(parts)
 

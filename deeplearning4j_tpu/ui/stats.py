@@ -88,8 +88,57 @@ class StatsListener(TrainingListener):
                 "num_params": model.num_params() if model.params is not None else 0,
                 "num_layers": len(getattr(model.conf, "layers", ())) or
                 len(getattr(model.conf, "vertices", ()))}
+        # hardware info (reference: system tab's JVM/hardware section)
+        try:
+            import platform
+
+            import jax
+            devs = jax.devices()
+            info["hardware"] = {
+                "platform": devs[0].platform, "n_devices": len(devs),
+                "device_kind": getattr(devs[0], "device_kind", "?"),
+                "host": platform.platform(),
+                "python": platform.python_version()}
+        except Exception:
+            pass
         self.storage.put_record(info)
         self._init_posted = True
+
+    @staticmethod
+    def _system_stats():
+        """Host RSS + per-device memory, the reference system tab's
+        memory-utilization series (JVM/off-heap -> host RSS; GPU -> device
+        HBM via PJRT memory_stats, absent on CPU backends)."""
+        out = {}
+        try:
+            # CURRENT rss from /proc (ru_maxrss is the peak, and macOS
+            # reports it in bytes) — fall back to the peak where /proc is
+            # unavailable
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        out["host_rss_mb"] = round(
+                            float(line.split()[1]) / 1024.0, 1)
+                        break
+        except OSError:
+            try:
+                import resource
+                import sys
+                rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                div = 1 << 20 if sys.platform == "darwin" else 1 << 10
+                out["host_rss_mb"] = round(rss / div, 1)
+            except Exception:
+                pass
+        try:
+            import jax
+            ms = jax.devices()[0].memory_stats()
+            if ms:
+                out["device_bytes_in_use"] = int(ms.get("bytes_in_use", 0))
+                if "bytes_limit" in ms:
+                    out["device_bytes_limit"] = int(ms["bytes_limit"])
+        except Exception:
+            pass
+        return out
 
     def iteration_done(self, model, iteration, score, etl_time=0.0):
         if not self._init_posted:
@@ -111,6 +160,7 @@ class StatsListener(TrainingListener):
         bins = self.histogram_bins if self.collect_histograms else 0
         if model.params is not None:
             rec["params"] = _array_stats(model.params, bins)
+        rec["system"] = self._system_stats()
         self.storage.put_record(rec)
 
     def on_epoch_end(self, model):

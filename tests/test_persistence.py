@@ -3,6 +3,7 @@
 in deeplearning4j-core)."""
 
 import os
+import jax
 import numpy as np
 import pytest
 
@@ -212,3 +213,103 @@ class TestCheckpointRegression:
     def test_lstm_adam_fixture(self):
         net = self._check("lstm_adam")
         assert net.iteration > 0  # training progress restored
+
+
+class TestTransferLearningGraph:
+    """reference: TransferLearning.GraphBuilder — fine-tune a trained CG."""
+
+    def _small_graph(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+        conf = (GraphBuilder(updater=U.Sgd(learning_rate=0.1), seed=7)
+                .add_inputs("in")
+                .set_input_types(I.FeedForwardType(6))
+                .add_layer("h1", L.DenseLayer(n_out=8, activation="tanh"), "in")
+                .add_layer("h2", L.DenseLayer(n_out=8, activation="tanh"), "h1")
+                .add_layer("out", L.OutputLayer(n_out=3, loss="mcxent"), "h2")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf)
+        net.init()
+        return net
+
+    def _data(self, n=16, classes=3):
+        rs = np.random.RandomState(0)
+        x = rs.rand(n, 6).astype(np.float32)
+        y = np.eye(classes, dtype=np.float32)[rs.randint(0, classes, n)]
+        return x, y
+
+    def test_freeze_and_replace_head(self):
+        from deeplearning4j_tpu.nn.transfer import (FineTuneConfiguration,
+                                                    TransferLearningGraph)
+        src = self._small_graph()
+        x, y = self._data()
+        src.fit(x, y, epochs=2)
+
+        # replace the head with a 4-class output, freeze through h2
+        new = (TransferLearningGraph(src)
+               .fine_tune_configuration(FineTuneConfiguration(
+                   updater=U.Sgd(learning_rate=0.05)))
+               .set_feature_extractor("h2")
+               .replace_layer("out", L.OutputLayer(n_out=4, loss="mcxent"))
+               .build())
+        x2, y2 = self._data(classes=4)
+        frozen_before = jax.device_get(new.params["h1"])
+        head_before = jax.device_get(new.params["out"])
+        new.fit(x2, y2, epochs=3)
+        frozen_after = jax.device_get(new.params["h1"])
+        head_after = jax.device_get(new.params["out"])
+        np.testing.assert_array_equal(frozen_before["W"], frozen_after["W"])
+        assert np.abs(head_before["W"] - head_after["W"]).max() > 0
+        # copied feature weights match the source exactly
+        np.testing.assert_array_equal(
+            np.asarray(src.params["h1"]["W"]), frozen_after["W"])
+
+    def test_frozen_replaced_conflict_raises(self):
+        from deeplearning4j_tpu.nn.transfer import TransferLearningGraph
+        src = self._small_graph()
+        with pytest.raises(ValueError, match="frozen and replaced"):
+            (TransferLearningGraph(src)
+             .set_feature_extractor("h2")
+             .replace_layer("h2", L.DenseLayer(n_out=8))
+             .build())
+
+    def test_fine_tune_regularization_applies(self):
+        from deeplearning4j_tpu.nn.transfer import (FineTuneConfiguration,
+                                                    TransferLearningGraph)
+        src = self._small_graph()
+        new = (TransferLearningGraph(src)
+               .fine_tune_configuration(FineTuneConfiguration(l2=1e-3))
+               .build())
+        from deeplearning4j_tpu.nn.graph import LayerVertex
+        for v in new.conf.vertices:
+            if isinstance(v.vertex, LayerVertex) and hasattr(v.vertex.layer, "l2"):
+                assert v.vertex.layer.l2 == 1e-3
+
+    def test_width_change_keeps_downstream_fresh_init(self):
+        """Replacing h1 with a wider layer must NOT clobber h2's re-init
+        with stale source weights of the old shape."""
+        from deeplearning4j_tpu.nn.transfer import TransferLearningGraph
+        src = self._small_graph()
+        new = (TransferLearningGraph(src)
+               .replace_layer("h1", L.DenseLayer(n_out=12, activation="tanh"))
+               .build())
+        assert new.params["h2"]["W"].shape == (12, 8)
+        x, y = self._data()
+        new.fit(x, y, epochs=1)
+        assert np.isfinite(float(new.score_value))
+
+    def test_extend_graph_with_new_head(self):
+        from deeplearning4j_tpu.nn.transfer import TransferLearningGraph
+        src = self._small_graph()
+        x, y = self._data()
+        src.fit(x, y, epochs=1)
+        new = (TransferLearningGraph(src)
+               .set_feature_extractor("h1")
+               .replace_layer("out", L.DenseLayer(n_out=8, activation="relu"))
+               .add_layer("out2", L.OutputLayer(n_out=2, loss="mcxent"), "out")
+               .set_outputs("out2")
+               .build())
+        x2, y2 = self._data(classes=2)
+        new.fit(x2, y2, epochs=2)
+        assert np.isfinite(float(new.score_value))
+        preds = new.output(x2)  # single-output graph returns the array
+        assert preds.shape == (16, 2)

@@ -256,3 +256,55 @@ class TestUIComponents:
         assert "&lt;x&gt;" in body
         # corrupt record skipped, finite one charted, NaN didn't blank axes
         assert "W" in body and "nan" not in body.split("</h2>")[1][:2000]
+
+
+class TestSystemTab:
+    def test_system_page_and_json(self):
+        import json as _json
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+        st = InMemoryStatsStorage()
+        st.put_record({"type": "init", "session": "s1",
+                       "hardware": {"platform": "cpu", "n_devices": 8,
+                                    "device_kind": "virtual"}})
+        for i in range(4):
+            st.put_record({"type": "stats", "session": "s1", "iteration": i,
+                           "score": 1.0, "iter_time_s": 0.01 * (i + 1),
+                           "system": {"host_rss_mb": 100.0 + i,
+                                      "device_bytes_in_use": 1000 * (i + 1)}})
+        srv = UIServer().attach(st).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            body = urllib.request.urlopen(
+                base + "/train/system.html?session=s1", timeout=10).read().decode()
+            data = _json.loads(urllib.request.urlopen(
+                base + "/train/system?session=s1", timeout=10).read().decode())
+        finally:
+            srv.stop()
+        assert "host RSS" in body and "<svg" in body and "n_devices" in body
+        assert data["hardware"]["platform"] == "cpu"
+        assert len(data["host_rss_mb"]) == 4
+        assert data["device_bytes_in_use"][-1] == [3, 4000]
+
+    def test_stats_listener_records_system(self):
+        from deeplearning4j_tpu.ui.stats import StatsListener
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+        from deeplearning4j_tpu.nn import layers as L, updaters as U
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        st = InMemoryStatsStorage()
+        net = MultiLayerNetwork(
+            NeuralNetConfig(seed=1, updater=U.Sgd(learning_rate=0.1)).list(
+                L.DenseLayer(n_out=4, activation="tanh"),
+                L.OutputLayer(n_out=2, loss="mcxent"),
+                input_type=I.FeedForwardType(3)))
+        net.listeners.append(StatsListener(st, session_id="sys"))
+        x = np.random.RandomState(0).rand(8, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np.random.RandomState(1).randint(0, 2, 8)]
+        net.fit(x, y, epochs=2)
+        stats = [r for r in st.get_records("sys") if r.get("type") == "stats"]
+        assert stats and "system" in stats[-1]
+        assert stats[-1]["system"].get("host_rss_mb", 0) > 0
+        inits = [r for r in st.get_records("sys") if r.get("type") == "init"]
+        assert inits and "hardware" in inits[0]
