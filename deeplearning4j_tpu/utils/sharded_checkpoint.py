@@ -1,0 +1,90 @@
+"""Sharded (multi-device / multi-host) checkpointing via orbax.
+
+Reference analog: ModelSerializer (util/ModelSerializer.java) covers the
+single-process zip format — `utils/serialization.py` here. That format
+gathers every array to one host, which cannot scale to sharded state
+(tensor/pipeline/expert-parallel training holds each shard on its own
+device, and on a pod no single host can even fit the model). This module is
+the distributed tier's checkpoint path: orbax writes each shard from the
+device that owns it and restores arrays WITH their shardings, so a resumed
+job continues with the same mesh layout (and multi-host jobs write/read
+collectively — orbax coordinates across processes).
+
+Save/restore round-trips the pytree leaves' shapes, dtypes, and
+NamedShardings; restore accepts either a template tree of concrete arrays
+(e.g. a freshly init'd trainer's params) or ShapeDtypeStruct+sharding.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def save_sharded(path, tree):
+    """Write a sharded checkpoint of ``tree`` (any pytree of jax.Arrays).
+
+    Each device contributes its own shards; nothing is gathered to one
+    host. ``path`` is a directory (created by orbax; must not exist)."""
+    path = os.path.abspath(str(path))
+    ckptr = _checkpointer()
+    ckptr.save(path, tree)
+    ckptr.wait_until_finished()
+    return path
+
+
+def restore_sharded(path, like):
+    """Restore a checkpoint written by :func:`save_sharded`.
+
+    ``like`` is a template pytree fixing structure, shapes, dtypes AND
+    shardings — pass the freshly initialized state (concrete arrays work;
+    so do ShapeDtypeStructs with ``.sharding`` set). The restored arrays
+    land directly on the devices their shards belong to."""
+    path = os.path.abspath(str(path))
+    template = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
+        if isinstance(a, jax.Array) else a, like)
+    return _checkpointer().restore(path, template)
+
+
+def _trainer_tree(trainer):
+    """Everything a resume needs: params, optimizer state, MUTABLE layer
+    state (BatchNorm running stats), the step RNG (so dropout keys continue
+    from step N+1, not replay from step 1), and the iteration counter."""
+    tree = {"params": trainer.params, "opt_state": trainer.opt_state,
+            "iteration": jax.numpy.asarray(trainer.iteration)}
+    state = getattr(trainer, "state", None)
+    if state is not None:
+        tree["state"] = state
+    rng = getattr(trainer, "_rng", None)
+    if rng is not None:
+        tree["rng"] = rng
+    return tree
+
+
+def save_trainer(path, trainer):
+    """Checkpoint a ParallelTrainer / PipelineParallelLM, preserving
+    shardings."""
+    return save_sharded(path, _trainer_tree(trainer))
+
+
+def restore_trainer(path, trainer):
+    """Restore into an initialized trainer (its current params/opt_state
+    provide the sharding template). Returns the trainer."""
+    if trainer.params is None:
+        trainer.init()
+    tree = restore_sharded(path, _trainer_tree(trainer))
+    trainer.params = tree["params"]
+    trainer.opt_state = tree["opt_state"]
+    trainer.iteration = int(tree["iteration"])
+    if "state" in tree:
+        trainer.state = tree["state"]
+    if "rng" in tree:
+        trainer._rng = tree["rng"]
+    return trainer

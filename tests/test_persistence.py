@@ -313,3 +313,132 @@ class TestTransferLearningGraph:
         assert np.isfinite(float(new.score_value))
         preds = new.output(x2)  # single-output graph returns the array
         assert preds.shape == (16, 2)
+
+
+class TestEarlyStoppingGraph:
+    """EarlyStoppingGraphTrainer parity: the trainer is container-generic."""
+
+    def test_early_stopping_on_computation_graph(self):
+        from deeplearning4j_tpu.nn.earlystopping import (
+            DataSetLossCalculator, EarlyStoppingConfiguration,
+            EarlyStoppingTrainer, InMemoryModelSaver, MaxEpochsTermination)
+        conf = (GraphBuilder(updater=U.Adam(learning_rate=1e-2), seed=5)
+                .add_inputs("in").set_input_types(I.FeedForwardType(4))
+                .add_layer("h", L.DenseLayer(n_out=8, activation="tanh"), "in")
+                .add_layer("out", L.OutputLayer(n_out=2, loss="mcxent"), "h")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf)
+        rs = np.random.RandomState(0)
+        x = rs.rand(24, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0.5).astype(int)]
+        saver = InMemoryModelSaver()
+        cfg = EarlyStoppingConfiguration(
+            epoch_terminations=[MaxEpochsTermination(8)],
+            score_calculator=DataSetLossCalculator(x, y), saver=saver)
+        result = EarlyStoppingTrainer(cfg, net, x, y).fit()
+        assert result.best_score is not None and np.isfinite(result.best_score)
+        assert result.total_epochs >= 1
+        best = result.best_model
+        assert best is not None
+        # saved best model is a functioning graph
+        assert np.isfinite(float(best.score(x, y)))
+
+
+@pytest.mark.slow
+class TestShardedCheckpoint:
+    """orbax sharded checkpointing for the distributed tier (the zip format
+    gathers to host; this path writes/restores shards in place)."""
+
+    def test_parallel_trainer_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.models import lenet
+        from deeplearning4j_tpu.parallel import (MeshSpec, ParallelTrainer,
+                                                 make_mesh)
+        from deeplearning4j_tpu.utils.sharded_checkpoint import (
+            restore_trainer, save_trainer)
+        mesh = make_mesh(MeshSpec(data=4, model=2))
+        rs = np.random.RandomState(0)
+        x = rs.rand(8, 8, 8, 1).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 8)]
+
+        net = MultiLayerNetwork(lenet(height=8, width=8, n_classes=4,
+                                      padding="same"))
+        tr = ParallelTrainer(net, mesh, tensor_parallel=True).init()
+        tr.step(x, y)
+        save_trainer(str(tmp_path / "ck"), tr)
+        loss_next = float(np.asarray(tr.step(x, y)))  # continue original
+
+        net2 = MultiLayerNetwork(lenet(height=8, width=8, n_classes=4,
+                                       padding="same"))
+        tr2 = ParallelTrainer(net2, mesh, tensor_parallel=True).init()
+        restore_trainer(str(tmp_path / "ck"), tr2)
+        assert tr2.iteration == 1
+        # restored arrays keep their TENSOR-PARALLEL shardings, not some
+        # replicated/gathered fallback
+        flat_p = jax.tree_util.tree_leaves(tr2.params)
+        flat_s = jax.tree_util.tree_leaves(
+            tr2.param_shardings,
+            is_leaf=lambda s: hasattr(s, "spec"))
+        assert any(s.spec != jax.sharding.PartitionSpec() for s in flat_s)
+        for leaf, want in zip(flat_p, flat_s):
+            assert leaf.sharding == want, (leaf.sharding, want)
+        # resumed training step equals the uninterrupted one bit-for-bit
+        loss_resumed = float(np.asarray(tr2.step(x, y)))
+        np.testing.assert_allclose(loss_resumed, loss_next, rtol=1e-6)
+
+    def test_stochastic_stateful_net_resumes_exactly(self, tmp_path):
+        """BatchNorm running stats AND the step RNG are checkpointed: a
+        dropout+BN net resumed mid-run matches the uninterrupted run."""
+        from deeplearning4j_tpu.parallel import (MeshSpec, ParallelTrainer,
+                                                 make_mesh)
+        from deeplearning4j_tpu.utils.sharded_checkpoint import (
+            restore_trainer, save_trainer)
+
+        def make():
+            conf = NeuralNetConfig(seed=9, updater=U.Adam(learning_rate=1e-2)).list(
+                L.DenseLayer(n_out=16, activation="relu"),
+                L.BatchNormalization(),
+                L.DropoutLayer(rate=0.3),
+                L.OutputLayer(n_out=3, loss="mcxent"),
+                input_type=I.FeedForwardType(6))
+            return MultiLayerNetwork(conf)
+
+        mesh = make_mesh(MeshSpec(data=8, model=1))
+        rs = np.random.RandomState(0)
+        x = rs.rand(16, 6).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 16)]
+        tr = ParallelTrainer(make(), mesh).init()
+        for _ in range(3):
+            tr.step(x, y)
+        save_trainer(str(tmp_path / "st"), tr)
+        next_losses = [float(np.asarray(tr.step(x, y))) for _ in range(3)]
+
+        tr2 = ParallelTrainer(make(), mesh).init()
+        restore_trainer(str(tmp_path / "st"), tr2)
+        resumed = [float(np.asarray(tr2.step(x, y))) for _ in range(3)]
+        np.testing.assert_allclose(resumed, next_losses, rtol=1e-6)
+
+    def test_pipeline_lm_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.parallel import (MeshSpec, PipelineParallelLM,
+                                                 make_mesh)
+        from deeplearning4j_tpu.utils.sharded_checkpoint import (
+            restore_trainer, save_trainer)
+        mesh = make_mesh(MeshSpec(data=2, model=1, seq=1, stage=4))
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 40, (8, 12))
+        labels = np.roll(ids, -1, 1)
+        lm = PipelineParallelLM(vocab_size=40, n_layers=4, d_model=16,
+                                n_heads=2, seq_len=12, mesh=mesh,
+                                n_microbatches=2).init()
+        lm.step(ids, labels)
+        save_trainer(str(tmp_path / "pp"), lm)
+        loss_next = float(np.asarray(lm.step(ids, labels)))
+
+        lm2 = PipelineParallelLM(vocab_size=40, n_layers=4, d_model=16,
+                                 n_heads=2, seq_len=12, mesh=mesh,
+                                 n_microbatches=2).init()
+        restore_trainer(str(tmp_path / "pp"), lm2)
+        # stacked block leaves restore P('stage')-sharded
+        spec = lm2.params["blocks"]["mlp_W1"].sharding.spec
+        assert spec[0] == "stage"
+        loss_resumed = float(np.asarray(lm2.step(ids, labels)))
+        np.testing.assert_allclose(loss_resumed, loss_next, rtol=1e-6)
