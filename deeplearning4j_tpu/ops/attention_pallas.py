@@ -214,10 +214,15 @@ def _attention_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _attention_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _bwd_core(causal, scale, block_k, res, g, g_lse=None):
     """Blockwise flash backward in jax: scan over KEY blocks recomputing
     P = exp(S - lse) one [BH, T, Bk] tile at a time. dq accumulates in the
-    carry; dk/dv stack per block. Peak memory O(BH*T*Bk), never O(T^2)."""
+    carry; dk/dv stack per block. Peak memory O(BH*T*Bk), never O(T^2).
+
+    ``g_lse`` (optional, [BH, T]): cotangent on the log-sum-exp output —
+    d(lse)/d(s) is the softmax row, so it adds ``p * g_lse`` to ds. Used by
+    the ring-attention block primitive whose combination weights depend on
+    lse."""
     q, k, v, out, lse = res
     f32 = jnp.float32
     # big einsums stay in the input dtype (bf16 under the mixed policy) with
@@ -251,7 +256,10 @@ def _attention_bwd(causal, scale, block_q, block_k, interpret, res, g):
         pc = p.astype(qf.dtype)
         dv_j = jnp.einsum("bqk,bqd->bkd", pc, gf, preferred_element_type=f32)
         dp = jnp.einsum("bqd,bkd->bqk", gf, v_j, preferred_element_type=f32)
-        ds = (p * (dp - delta)).astype(qf.dtype)
+        ds = p * (dp - delta)
+        if g_lse is not None:
+            ds = ds + p * g_lse[..., None].astype(f32)
+        ds = ds.astype(qf.dtype)
         dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, k_j,
                                      preferred_element_type=f32) * scale
         dk_j = jnp.einsum("bqk,bqd->bkd", ds, qf,
@@ -265,6 +273,53 @@ def _attention_bwd(causal, scale, block_q, block_k, interpret, res, g):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _attention_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    return _bwd_core(causal, scale, block_k, res, g)
+
+
+def _fold_heads(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _unfold_heads(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_block(q, k, v, causal, scale, interpret):
+    """(out [B,T,H,D], lse [B,H,T]) for ONE ring-attention block pair —
+    the fused-kernel replacement for a naive [B,H,Tq,Tk]-logits block in
+    parallel/sequence.py. The lse output lets the caller combine blocks by
+    log-sum-exp; its cotangent is handled exactly (see _bwd_core)."""
+    b, t, h, d = q.shape
+    out, lse = _run_fwd(_fold_heads(q), _fold_heads(k), _fold_heads(v),
+                        causal, scale, 512, 512, interpret)
+    return _unfold_heads(out, b, h), lse.reshape(b, h, t)
+
+
+def _flash_block_fwd(q, k, v, causal, scale, interpret):
+    b, t, h, d = q.shape
+    qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
+    out, lse = _run_fwd(qf, kf, vf, causal, scale, 512, 512, interpret)
+    return (_unfold_heads(out, b, h), lse.reshape(b, h, t)), \
+        (qf, kf, vf, out, lse, b, h)
+
+
+def _flash_block_bwd(causal, scale, interpret, res, grads):
+    qf, kf, vf, out, lse, b, h = res
+    g_out, g_lse = grads
+    dq, dk, dv = _bwd_core(causal, scale, 512, (qf, kf, vf, out, lse),
+                           _fold_heads(g_out),
+                           g_lse=g_lse.reshape(b * h, -1))
+    return (_unfold_heads(dq, b, h), _unfold_heads(dk, b, h),
+            _unfold_heads(dv, b, h))
+
+
+flash_attention_block.defvjp(_flash_block_fwd, _flash_block_bwd)
+
+
 _attention.defvjp(_attention_fwd, _attention_bwd)
 
 
@@ -276,7 +331,6 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=512,
     b, t, h, d = q.shape
     if scale is None:
         scale = 1.0 / float(d) ** 0.5
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    out = _attention(fold(q), fold(k), fold(v), causal, float(scale),
-                     block_q, block_k, interpret)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    out = _attention(_fold_heads(q), _fold_heads(k), _fold_heads(v), causal,
+                     float(scale), block_q, block_k, interpret)
+    return _unfold_heads(out, b, h)

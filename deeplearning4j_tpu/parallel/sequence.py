@@ -49,48 +49,96 @@ def _block_attn(q, k, v, *, scale, block_mask=None):
     return m_safe, num, den
 
 
-def ring_self_attention(q, k, v, *, axis_name="seq", causal=False, scale=None):
+def _naive_block(q, k, v, scale, block_mask):
+    """(out_b, lse_b) for one block pair via materialized logits."""
+    m_safe, num, den = _block_attn(q, k, v, scale=scale,
+                                   block_mask=block_mask)
+    den_safe = jnp.maximum(den, 1e-30)
+    out = (num.astype(jnp.float32)
+           / den_safe.transpose(0, 2, 1)[..., None])
+    lse = jnp.where(den > 0, m_safe + jnp.log(den_safe), -jnp.inf)
+    return out, lse
+
+
+def _use_flash_blocks(q):
+    from deeplearning4j_tpu.ops import attention_pallas as _ap
+    return (_ap.enabled()
+            and _ap.supported(q.shape, q.shape, None, q.dtype))
+
+
+def ring_self_attention(q, k, v, *, axis_name="seq", causal=False,
+                        scale=None, use_flash=None, interpret=False):
     """Exact self-attention with q/k/v sharded over ``axis_name`` on the time
     axis. Call inside shard_map/pjit. Shapes per device: [B, T_local, H, D].
+
+    Blocks combine by log-sum-exp: each block pair yields (out_b, lse_b) and
+    the total is sum_b out_b * exp(lse_b - logsumexp_b lse_b) — the flash
+    combination identity. Per-block compute dispatches to the fused Pallas
+    kernel (ops/attention_pallas.flash_attention_block) when eligible, so
+    long local sequences never materialize [B,H,Tq,Tk] logits on device;
+    the naive blockwise path is the fallback (and the CPU/test path).
     """
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     d = q.shape[-1]
-    scale = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    # the kernel needs a STATIC scale; a traced scale falls back to the
+    # naive blocks (same guard as dot_product_attention's dispatch seam)
+    static_scale = scale is None or isinstance(scale, (int, float))
+    scale_f = (float(scale) if isinstance(scale, (int, float))
+               else 1.0 / float(d) ** 0.5 if scale is None else scale)
     t_local = q.shape[1]
+    f32 = jnp.float32
+    if use_flash is None:
+        use_flash = static_scale and _use_flash_blocks(q)
+    elif use_flash and not static_scale:
+        raise ValueError("flash ring blocks need a static (python float) "
+                         "scale; got a traced value")
+
+    def block(k_blk, v_blk, causal_diag):
+        if use_flash:
+            from deeplearning4j_tpu.ops.attention_pallas import \
+                flash_attention_block
+            out, lse = flash_attention_block(q, k_blk, v_blk, causal_diag,
+                                             scale_f, interpret)
+            return out.astype(f32), lse
+        mask = None
+        if causal_diag:
+            pos = jnp.arange(t_local)
+            mask = (pos[:, None] >= pos[None, :])[None, None]
+        return _naive_block(q, k_blk, v_blk, scale_f, mask)
+
+    def combine(acc, lse_run, out_b, lse_b):
+        lse_new = jnp.logaddexp(lse_run, lse_b)
+        w_old = jnp.where(jnp.isfinite(lse_run),
+                          jnp.exp(lse_run - lse_new), 0.0)
+        w_new = jnp.where(jnp.isfinite(lse_b),
+                          jnp.exp(lse_b - lse_new), 0.0)
+        acc = (acc * w_old.transpose(0, 2, 1)[..., None]
+               + out_b * w_new.transpose(0, 2, 1)[..., None])
+        return acc, lse_new
 
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    def make_mask(src_idx):
-        """Causal block mask: query global pos >= key global pos."""
-        if not causal:
-            return None
-        q_pos = my_idx * t_local + jnp.arange(t_local)            # [Tq]
-        k_pos = src_idx * t_local + jnp.arange(t_local)           # [Tk]
-        return (q_pos[:, None] >= k_pos[None, :])[None, None]     # [1,1,Tq,Tk]
+    # diagonal block first (the only one needing an intra-block causal mask;
+    # the kernel's causal flag must be static, so it sits outside the loop)
+    acc, lse_run = block(k, v, causal)
+    k_blk = jax.lax.ppermute(k, axis_name, perm)
+    v_blk = jax.lax.ppermute(v, axis_name, perm)
 
     def body(i, carry):
-        k_blk, v_blk, acc, m, l = carry
+        k_blk, v_blk, acc, lse_run = carry
         src_idx = (my_idx - i) % n  # which shard this block originated from
-        m_blk, num, den = _block_attn(q, k_blk, v_blk, scale=scale,
-                                      block_mask=make_mask(src_idx))
-        m_new = jnp.maximum(m, m_blk)
-        alpha = jnp.exp(m - m_new)          # rescale old accumulators
-        beta = jnp.exp(m_blk - m_new)       # rescale new block
-        acc = acc * alpha.transpose(0, 2, 1)[..., None] + \
-            num * beta.transpose(0, 2, 1)[..., None]
-        l = l * alpha + den * beta
+        out_b, lse_b = block(k_blk, v_blk, False)
+        if causal:
+            # off-diagonal blocks are all-or-nothing: visible iff src < mine
+            lse_b = jnp.where(src_idx < my_idx, lse_b, -jnp.inf)
+        acc, lse_run = combine(acc, lse_run, out_b, lse_b)
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return k_blk, v_blk, acc, m_new, l
+        return k_blk, v_blk, acc, lse_run
 
-    b, t, h, dd = q.shape
-    acc0 = jnp.zeros((b, t, h, dd), jnp.float32)
-    m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((b, h, t), jnp.float32)
-    _, _, acc, m, l = jax.lax.fori_loop(0, n, body, (k, v, acc0, m0, l0))
-    l_safe = jnp.maximum(l, 1e-20)
-    return (acc / l_safe.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    _, _, acc, _ = jax.lax.fori_loop(1, n, body, (k_blk, v_blk, acc, lse_run))
+    return acc.astype(q.dtype)
 
 
 def ulysses_self_attention(q, k, v, *, axis_name="seq", causal=False, scale=None):
@@ -111,7 +159,8 @@ def ulysses_self_attention(q, k, v, *, axis_name="seq", causal=False, scale=None
                               tiled=True)
 
 
-def make_ring_attention_fn(mesh: Mesh, *, causal=False, seq_axis="seq"):
+def make_ring_attention_fn(mesh: Mesh, *, causal=False, seq_axis="seq",
+                           use_flash=None, interpret=False):
     """shard_map-wrapped ring attention: takes full [B,T,H,D] arrays,
     returns full attention output, computed sequence-parallel."""
     from jax import shard_map
@@ -122,6 +171,7 @@ def make_ring_attention_fn(mesh: Mesh, *, causal=False, seq_axis="seq"):
                        in_specs=(spec, spec, spec), out_specs=spec,
                        check_vma=False)
     def fn(q, k, v):
-        return ring_self_attention(q, k, v, axis_name=seq_axis, causal=causal)
+        return ring_self_attention(q, k, v, axis_name=seq_axis, causal=causal,
+                                   use_flash=use_flash, interpret=interpret)
 
     return fn
