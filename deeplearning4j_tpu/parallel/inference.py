@@ -24,20 +24,54 @@ from deeplearning4j_tpu.parallel import mesh as _mesh
 
 
 class ParallelInference:
-    def __init__(self, net, *, max_batch_size=32, mesh=None, timeout_s=0.005):
-        self.net = net
-        self.max_batch = max_batch_size
+    """``inference_mode``: "batched" coalesces queued requests into one
+    padded device batch (reference InferenceMode.BATCHED, the default);
+    "sequential" serves requests one at a time (InferenceMode.SEQUENTIAL).
+    With a ``mesh``, the padded batch shards over the ``data`` axis —
+    multi-chip serving from the same API."""
+
+    def __init__(self, net, *, max_batch_size=32, mesh=None, timeout_s=0.005,
+                 inference_mode="batched"):
+        assert inference_mode in ("batched", "sequential"), inference_mode
         self.mesh = mesh
         self.timeout_s = timeout_s
+        self.inference_mode = inference_mode
+        if mesh is not None:
+            # padded batch must split evenly over the data axis
+            nd = mesh.shape["data"]
+            self.max_batch = -(-max_batch_size // nd) * nd
+            self._place = lambda x: jax.device_put(x, _mesh.data_sharded(mesh))
+        else:
+            self.max_batch = max_batch_size
+            self._place = lambda x: x
+        self._serving = self._compile(net)
         self._queue: queue.Queue = queue.Queue()
-        self._fwd = jax.jit(lambda p, s, x: net.apply_fn(p, s, x, train=False)[0])
         self._thread = None
         self._stop = threading.Event()
+
+    def _compile(self, net):
+        """(net, fwd, fwd_one): the served model and its jitted forwards —
+        kept in ONE tuple so hot-swaps are atomic (a batch never mixes one
+        model's params with another's state or apply_fn)."""
+        def raw(p, s, x):
+            return net.apply_fn(p, s, x, train=False)[0]
+        if self.mesh is not None:
+            repl = _mesh.replicated(self.mesh)
+            data_sh = _mesh.data_sharded(self.mesh)
+            fwd = jax.jit(raw, in_shardings=(repl, repl, data_sh),
+                          out_shardings=data_sh)
+        else:
+            fwd = jax.jit(raw)
+        # sequential mode serves one example per call: a batch-1 jit, not a
+        # padded max_batch forward with max_batch-1 wasted rows
+        fwd_one = jax.jit(raw)
+        return (net, fwd, fwd_one)
 
     # ---- synchronous API ----
 
     def output(self, x):
         """Direct batched inference (pads to max_batch internally)."""
+        net, fwd, _ = self._serving  # one atomic snapshot per call
         x = np.asarray(x)
         n = x.shape[0]
         outs = []
@@ -46,9 +80,25 @@ class ParallelInference:
             pad = self.max_batch - chunk.shape[0]
             if pad:
                 chunk = np.concatenate([chunk, np.zeros((pad,) + chunk.shape[1:], chunk.dtype)])
-            y = self._fwd(self.net.params, self.net.state, jnp.asarray(chunk))
+            y = fwd(net.params, net.state, self._place(jnp.asarray(chunk)))
             outs.append(np.asarray(y)[:self.max_batch - pad])
         return np.concatenate(outs)
+
+    def _output_one(self, x):
+        net, _, fwd_one = self._serving
+        return np.asarray(fwd_one(net.params, net.state,
+                                  jnp.asarray(x)[None]))[0]
+
+    @property
+    def net(self):
+        return self._serving[0]
+
+    def update_model(self, net):
+        """Hot-swap the served model (reference:
+        ParallelInference.updateModel) — in-flight requests finish on the
+        old model, later batches use the new one (including its forward
+        function, so the swapped model may differ in architecture)."""
+        self._serving = self._compile(net)
 
     # ---- async request-batching API (BATCHED InferenceMode) ----
 
@@ -75,12 +125,18 @@ class ParallelInference:
                 batch.append(self._queue.get(timeout=0.1))
             except queue.Empty:
                 continue
-            # opportunistically drain up to max_batch requests
-            while len(batch) < self.max_batch:
+            # BATCHED mode opportunistically drains up to max_batch
+            # requests; SEQUENTIAL serves them one at a time
+            while (self.inference_mode == "batched"
+                   and len(batch) < self.max_batch):
                 try:
                     batch.append(self._queue.get(timeout=self.timeout_s))
                 except queue.Empty:
                     break
+            if self.inference_mode == "sequential":
+                for x, holder in batch:
+                    holder._set(self._output_one(x))
+                continue
             xs = np.stack([b[0] for b in batch])
             ys = self.output(xs)
             for (_, holder), y in zip(batch, ys):

@@ -162,3 +162,51 @@ class TestGraphParallelTrainer:
                 np.testing.assert_allclose(
                     np.asarray(g1.params[name][k]),
                     np.asarray(params[name][k]), rtol=1e-3, atol=1e-5)
+
+
+class TestParallelInferenceModes:
+    def _net(self):
+        from deeplearning4j_tpu.nn import layers as L, updaters as U
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork(
+            NeuralNetConfig(seed=4, updater=U.Sgd(learning_rate=0.1)).list(
+                L.DenseLayer(n_out=8, activation="tanh"),
+                L.OutputLayer(n_out=3, loss="mcxent"),
+                input_type=I.FeedForwardType(5)))
+        net.init()
+        return net
+
+    def test_mesh_sharded_serving_matches_single_device(self):
+        from deeplearning4j_tpu.parallel import MeshSpec, make_mesh
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+        net = self._net()
+        x = np.random.RandomState(0).rand(13, 5).astype(np.float32)
+        plain = ParallelInference(net, max_batch_size=8)
+        mesh = make_mesh(MeshSpec(data=8, model=1))
+        sharded = ParallelInference(net, max_batch_size=6, mesh=mesh)
+        assert sharded.max_batch % 8 == 0  # rounded up to the data axis
+        np.testing.assert_allclose(sharded.output(x), plain.output(x),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_sequential_mode_and_hot_swap(self):
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+        net = self._net()
+        pi = ParallelInference(net, max_batch_size=4,
+                               inference_mode="sequential").start()
+        try:
+            x = np.random.RandomState(1).rand(5).astype(np.float32)
+            r1 = pi.submit(x).get(timeout=10)
+            assert r1.shape == (3,)
+            # hot-swap to a differently-trained model changes results
+            net2 = self._net()
+            xs = np.random.RandomState(2).rand(16, 5).astype(np.float32)
+            ys = np.eye(3, dtype=np.float32)[
+                np.random.RandomState(3).randint(0, 3, 16)]
+            net2.fit(xs, ys, epochs=30)
+            pi.update_model(net2)
+            r2 = pi.submit(x).get(timeout=10)
+            assert np.abs(np.asarray(r1) - np.asarray(r2)).max() > 1e-6
+        finally:
+            pi.stop()
