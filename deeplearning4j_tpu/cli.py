@@ -54,6 +54,17 @@ def _build_parser():
     u = sub.add_parser("ui", help="standalone training dashboard server")
     u.add_argument("--port", type=int, default=9000)
 
+    e = sub.add_parser("eval", help="evaluate a checkpoint on a dataset")
+    esrc = e.add_mutually_exclusive_group(required=True)
+    esrc.add_argument("--model-path", help="checkpoint zip")
+    esrc.add_argument("--zoo", help="zoo model name (fresh init)")
+    e.add_argument("--data", required=True, help=".npy features")
+    e.add_argument("--labels", required=True,
+                   help=".npy labels (one-hot or class indices)")
+    e.add_argument("--batch-size", type=int, default=128)
+    e.add_argument("--regression", action="store_true",
+                   help="report regression metrics instead of classification")
+
     b = sub.add_parser("bench", help="run a BASELINE.md bench config")
     b.add_argument("config", nargs="?", default="all")
     return p
@@ -152,6 +163,41 @@ def _cmd_bench(args):
     return subprocess.call(cmd)
 
 
+def _cmd_eval(args):
+    """(reference role: Evaluation printed from MultiLayerNetwork.evaluate /
+    the examples' eval.stats() tail — here as a CLI verb)."""
+    net = _load_model(args)
+    x = np.load(args.data)
+    y = np.load(args.labels)
+    preds = []
+    for i in range(0, x.shape[0], args.batch_size):
+        out = net.output(x[i:i + args.batch_size])
+        if isinstance(out, dict):  # multi-output graph: first output head
+            out = next(iter(out.values()))
+        preds.append(np.asarray(out))
+    preds = np.concatenate(preds)
+    if args.regression:
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+        if y.ndim == 1:  # single-target vector -> column
+            y = y[:, None]
+        ev = RegressionEvaluation()
+        ev.eval(y, preds)
+        print(ev.stats())
+        return 0
+    from deeplearning4j_tpu.eval.classification import Evaluation
+    n_classes = preds.shape[-1]
+    if n_classes == 1:
+        # single sigmoid output: Evaluation handles 1-column labels natively
+        if y.ndim == 1:
+            y = y[:, None]
+    elif y.ndim == 1 or (y.ndim == 2 and y.shape[-1] == 1):
+        y = np.eye(n_classes, dtype=np.float32)[y.astype(int).ravel()]
+    ev = Evaluation()
+    ev.eval(y, preds)
+    print(ev.stats())
+    return 0
+
+
 def main(argv=None):
     args = _build_parser().parse_args(argv)
     if args.command == "train":
@@ -160,6 +206,8 @@ def main(argv=None):
         return _cmd_ui(args)
     if args.command == "bench":
         return _cmd_bench(args)
+    if args.command == "eval":
+        return _cmd_eval(args)
     return 1
 
 

@@ -133,26 +133,39 @@ class ParallelInference:
                     batch.append(self._queue.get(timeout=self.timeout_s))
                 except queue.Empty:
                     break
-            if self.inference_mode == "sequential":
-                for x, holder in batch:
-                    holder._set(self._output_one(x))
-                continue
-            xs = np.stack([b[0] for b in batch])
-            ys = self.output(xs)
-            for (_, holder), y in zip(batch, ys):
-                holder._set(y)
+            # a failing forward (bad input shape, mid-swap architecture
+            # mismatch) must fail THESE requests, not kill the serving loop
+            try:
+                if self.inference_mode == "sequential":
+                    for x, holder in batch:
+                        holder._set(self._output_one(x))
+                    continue
+                xs = np.stack([b[0] for b in batch])
+                ys = self.output(xs)
+                for (_, holder), y in zip(batch, ys):
+                    holder._set(y)
+            except Exception as e:  # noqa: BLE001 — propagate to waiters
+                for _, holder in batch:
+                    holder._set_error(e)
 
 
 class _Result:
     def __init__(self):
         self._event = threading.Event()
         self._value = None
+        self._error = None
 
     def _set(self, v):
         self._value = v
         self._event.set()
 
+    def _set_error(self, e):
+        self._error = e
+        self._event.set()
+
     def get(self, timeout=None):
         if not self._event.wait(timeout):
             raise TimeoutError("inference result not ready")
+        if self._error is not None:
+            raise self._error
         return self._value

@@ -56,3 +56,35 @@ def test_unknown_zoo_model_exits(tmp_path):
     np.save(xp, np.zeros((4, 2), np.float32))
     with pytest.raises(SystemExit):
         main(["train", "--zoo", "not-a-model", "--data", xp, "--labels", xp])
+
+
+class TestEvalCommand:
+    def test_eval_checkpoint(self, tmp_path, capsys):
+        # train a small model, save, eval from the CLI
+        from deeplearning4j_tpu.nn import layers as L, updaters as U
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.utils.serialization import save_model
+        rs = np.random.RandomState(0)
+        x = rs.rand(64, 6).astype(np.float32)
+        labels = (x[:, 0] > 0.5).astype(int)
+        y = np.eye(2, dtype=np.float32)[labels]
+        net = MultiLayerNetwork(
+            NeuralNetConfig(seed=1, updater=U.Adam(learning_rate=0.05)).list(
+                L.DenseLayer(n_out=16, activation="relu"),
+                L.OutputLayer(n_out=2, loss="mcxent"),
+                input_type=I.FeedForwardType(6)))
+        net.init()
+        net.fit(x, y, epochs=40)
+        ck = tmp_path / "m.zip"
+        save_model(net, str(ck))
+        np.save(tmp_path / "x.npy", x)
+        np.save(tmp_path / "y_int.npy", labels)  # class-index labels path
+        rc = main(["eval", "--model-path", str(ck),
+                   "--data", str(tmp_path / "x.npy"),
+                   "--labels", str(tmp_path / "y_int.npy")])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "ccuracy" in out
+        assert "F1" in out or "onfusion" in out
