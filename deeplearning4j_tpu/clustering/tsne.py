@@ -11,7 +11,6 @@ early exaggeration, and momentum match the standard t-SNE recipe.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

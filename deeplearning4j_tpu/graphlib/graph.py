@@ -7,7 +7,6 @@ used by DeepWalk.
 
 from __future__ import annotations
 
-import numpy as np
 
 
 class Graph:

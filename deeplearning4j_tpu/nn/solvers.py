@@ -27,7 +27,6 @@ multilayer.py / graph.py).
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -7,7 +7,6 @@ deeplearning4j-nlp.
 
 from __future__ import annotations
 
-import math
 
 import numpy as np
 

@@ -8,7 +8,6 @@ softmax (models/word2vec/Huffman.java, graph variant GraphHuffman.java) in
 
 from __future__ import annotations
 
-import collections
 import dataclasses as _dc
 import heapq
 
