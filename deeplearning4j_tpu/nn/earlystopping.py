@@ -100,8 +100,13 @@ class InMemoryModelSaver:
                 "state": jax.tree_util.tree_map(jnp.copy, net.state)}
 
     def restore_best(self, net):
+        import jax
+        import jax.numpy as jnp
         snap, _, _ = self.best
-        net.params, net.state = snap["params"], snap["state"]
+        # copy OUT too: handing the snapshot's own buffers to a donating
+        # trainer would delete them on its next train step
+        net.params = jax.tree_util.tree_map(jnp.copy, snap["params"])
+        net.state = jax.tree_util.tree_map(jnp.copy, snap["state"])
         return net
 
 

@@ -112,10 +112,12 @@ class ParallelTrainer:
         self.tensor_parallel = tensor_parallel
         self.donate = donate
         self._step_fn = None
+        self._score_fn = None
         self.params = None
         self.state = None
         self.opt_state = None
         self.iteration = 0
+        self.score_value = None
         self._rng = jax.random.PRNGKey(net.conf.seed)
 
     def init(self, rng=None):
@@ -161,17 +163,40 @@ class ParallelTrainer:
         self._rng, sub = jax.random.split(self._rng)
         self.params, self.state, self.opt_state, loss = self._step_fn(
             self.params, self.state, self.opt_state, x, y, self.iteration, sub, mask)
+        self.score_value = loss  # device scalar; float() on demand
         self.iteration += 1
         return loss
 
-    def fit(self, x, y, *, epochs=1, batch_size=None):
+    def fit(self, x, y, *, epochs=1, batch_size=None, mask=None):
         n = x.shape[0]
         bs = batch_size or n
         last = None
         for _ in range(epochs):
             for i in range(0, n - bs + 1, bs):
-                last = self.step(x[i:i + bs], y[i:i + bs])
+                m = None if mask is None else mask[i:i + bs]
+                last = self.step(x[i:i + bs], y[i:i + bs], mask=m)
         return last
+
+    def score(self, x, y, mask=None):
+        """Validation loss on the mesh — the DataSetLossCalculator contract,
+        so EarlyStoppingTrainer drives a ParallelTrainer directly (reference:
+        TestParallelEarlyStopping)."""
+        if self.params is None:
+            self.init()
+        if self._score_fn is None:
+            def base(p, s, x, y, m):
+                return self.net.loss_fn(p, s, x, y, train=False, mask=m)[0]
+            self._score_fn = jax.jit(base)
+        # early stopping scores the SAME validation arrays every epoch:
+        # cache the sharded device copies keyed on the host array identities
+        key = (id(x), id(y))
+        if getattr(self, "_score_cache_key", None) != key:
+            self._score_cache_key = key
+            self._score_cache = (
+                jax.device_put(jnp.asarray(x), _mesh.data_sharded(self.mesh)),
+                jax.device_put(jnp.asarray(y), _mesh.data_sharded(self.mesh)))
+        xd, yd = self._score_cache
+        return float(self._score_fn(self.params, self.state, xd, yd, mask))
 
     def sync_to_net(self):
         """Copy trained params back into the wrapped MultiLayerNetwork."""

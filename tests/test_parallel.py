@@ -210,3 +210,40 @@ class TestParallelInferenceModes:
             assert np.abs(np.asarray(r1) - np.asarray(r2)).max() > 1e-6
         finally:
             pi.stop()
+
+
+class TestParallelEarlyStopping:
+    """reference: TestParallelEarlyStopping — early stopping drives the
+    multi-worker trainer through the same generic trainer."""
+
+    def test_early_stopping_on_parallel_trainer(self, eight_devices):
+        from deeplearning4j_tpu.nn import layers as L, updaters as U
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+        from deeplearning4j_tpu.nn.earlystopping import (
+            DataSetLossCalculator, EarlyStoppingConfiguration,
+            EarlyStoppingTrainer, InMemoryModelSaver, MaxEpochsTermination)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.parallel import (MeshSpec, ParallelTrainer,
+                                                 make_mesh)
+        rs = np.random.RandomState(0)
+        x = rs.rand(32, 5).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0.5).astype(int)]
+        net = MultiLayerNetwork(
+            NeuralNetConfig(seed=2, updater=U.Adam(learning_rate=0.02)).list(
+                L.DenseLayer(n_out=8, activation="tanh"),
+                L.OutputLayer(n_out=2, loss="mcxent"),
+                input_type=I.FeedForwardType(5)))
+        tr = ParallelTrainer(net, make_mesh(MeshSpec(data=8, model=1),
+                                            devices=eight_devices)).init()
+        saver = InMemoryModelSaver()
+        cfg = EarlyStoppingConfiguration(
+            epoch_terminations=[MaxEpochsTermination(6)],
+            score_calculator=DataSetLossCalculator(x, y), saver=saver)
+        result = EarlyStoppingTrainer(cfg, tr, x, y, batch_size=8).fit()
+        assert result.total_epochs == 6
+        assert np.isfinite(result.best_score)
+        assert saver.best is not None  # snapshot of the SHARDED trainer
+        # best snapshot restores into the trainer and still scores
+        best = saver.restore_best(tr)
+        assert np.isfinite(best.score(x, y))
