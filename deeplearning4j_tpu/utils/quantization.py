@@ -1,0 +1,148 @@
+"""Weight-only int8 quantization for inference.
+
+Reference analog: none — DL4J 0.9 has no quantization; net-new for the TPU
+goals. Weight-only int8 halves the HBM footprint and read bandwidth of the
+weight matrices (the bound resource for serving large models); activations
+stay in the compute dtype, and the dequantize (int8 -> compute dtype *
+per-channel scale) happens INSIDE the jitted forward so XLA fuses it into
+the weight load feeding the MXU.
+
+Scheme: symmetric per-output-channel scales (absmax / 127) on matmul-family
+weight leaves; everything else (biases, norms, embeddings' positional rows)
+stays untouched. Quantize once, serve many:
+
+    qi = QuantizedInference(net)        # quantizes a trained net
+    y = qi.output(x)                    # jitted forward on int8 weights
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.utils import dtypes as _dtypes
+
+# matmul-family parameter names whose leaves quantize (per-layer dicts may
+# nest, e.g. MoE blocks' mha sub-dict)
+WEIGHT_KEYS = frozenset({"W", "Wx", "Wh", "Wqkv", "Wo",
+                         "expert_W1", "expert_W2",
+                         "mlp_W1", "mlp_W2", "router_W"})
+
+
+def _leaf_name(path):
+    last = path[-1]
+    return getattr(last, "key", str(last))
+
+
+def _is_weight(path, leaf, keys):
+    return (_leaf_name(path) in keys and hasattr(leaf, "ndim")
+            and leaf.ndim >= 2)
+
+
+def weight_keys_for(net):
+    """Quantizable weight names for a network: the module defaults plus
+    every layer's own declared WEIGHT_KEYS (one source of truth with
+    nn/constraints.py's use of the same attribute)."""
+    keys = set(WEIGHT_KEYS)
+    layers = getattr(net.conf, "layers", None)
+    if layers is None:  # ComputationGraph
+        layers = [getattr(getattr(v, "vertex", None), "layer", None)
+                  for v in net.conf.vertices]
+    for layer in layers:
+        keys.update(getattr(layer, "WEIGHT_KEYS", ()) or ())
+    return frozenset(keys)
+
+
+def quantize_params(params, keys=WEIGHT_KEYS):
+    """(qparams, scales): weight leaves -> int8 with per-output-channel
+    scales (last axis = output channels; stacked 3-D expert weights [E,I,O]
+    get PER-EXPERT per-channel scales — a shared scale would pin every
+    expert to the largest one's range); non-weight leaves pass through
+    with a None scale."""
+    def quant(path, leaf):
+        if not _is_weight(path, leaf, keys):
+            return leaf, None
+        w = jnp.asarray(leaf, jnp.float32)
+        name = _leaf_name(path)
+        if w.ndim == 3 and name.startswith("expert_"):
+            axes = (1,)                      # [E, I, O] -> scale [E, 1, O]
+        else:
+            axes = tuple(range(w.ndim - 1))  # reduce everything but O
+        absmax = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+        scale = jnp.maximum(absmax, 1e-12) / 127.0
+        q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+        return q, scale
+
+    pairs = jax.tree_util.tree_map_with_path(quant, params)
+    qparams = jax.tree_util.tree_map(lambda p: p[0], pairs,
+                                     is_leaf=lambda x: isinstance(x, tuple))
+    scales = jax.tree_util.tree_map(lambda p: p[1], pairs,
+                                    is_leaf=lambda x: isinstance(x, tuple))
+    return qparams, scales
+
+
+def dequantize_params(qparams, scales, dtype=None):
+    """Rebuild a compute-dtype param tree (runs inside jit: XLA fuses the
+    int8 load + scale into the consuming matmul)."""
+    dtype = dtype or _dtypes.get_policy().compute_dtype
+
+    def deq(q, s):
+        if s is None:
+            return q
+        return (q.astype(jnp.float32) * s).astype(dtype)
+
+    return jax.tree_util.tree_map(deq, qparams, scales,
+                                  is_leaf=lambda x: x is None)
+
+
+def weight_bytes(params, keys=WEIGHT_KEYS):
+    """Total bytes of the quantizable weight leaves (for the 2x claim)."""
+    total = 0
+
+    def add(path, leaf):
+        nonlocal total
+        if _is_weight(path, leaf, keys):
+            total += leaf.size * leaf.dtype.itemsize
+        return leaf
+
+    jax.tree_util.tree_map_with_path(add, params)
+    return total
+
+
+class QuantizedInference:
+    """Serve a trained MultiLayerNetwork/ComputationGraph from int8 weights.
+
+    The stored tree is int8 + scales; each jitted forward dequantizes into
+    the compute dtype on the fly. Predictions match the float net up to the
+    quantization error (pinned in tests)."""
+
+    def __init__(self, net, dtype=None):
+        assert net.params is not None, "quantize a trained/initialized net"
+        self.net = net
+        self.qparams, self.scales = quantize_params(net.params,
+                                                    weight_keys_for(net))
+
+        def fwd(qp, sc, state, x, mask):
+            p = dequantize_params(qp, sc, dtype)
+            out = net.apply_fn(p, state, x, train=False, mask=mask)
+            return out[0]
+
+        self._fwd = jax.jit(fwd)
+
+    def output(self, x, mask=None):
+        """Same contract as the wrapped net's output(): dict inputs and
+        single-output unwrapping for graphs, mask passthrough for padded
+        sequences."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        if isinstance(self.net, ComputationGraph):
+            if not isinstance(x, dict):
+                x = {self.net.conf.inputs[0]: jnp.asarray(x)}
+            else:
+                x = {k: jnp.asarray(v) for k, v in x.items()}
+            outs = self._fwd(self.qparams, self.scales, self.net.state, x,
+                             mask)
+            if len(self.net.conf.outputs) == 1:
+                return outs[self.net.conf.outputs[0]]
+            return outs
+        return self._fwd(self.qparams, self.scales, self.net.state,
+                         jnp.asarray(x), mask)

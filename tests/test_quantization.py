@@ -1,0 +1,104 @@
+"""Weight-only int8 quantized inference (utils/quantization.py)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import layers as L, updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.utils.quantization import (QuantizedInference,
+                                                   dequantize_params,
+                                                   quantize_params,
+                                                   weight_bytes)
+
+
+def _trained_net(seed=3):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(64, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 64)]
+    net = MultiLayerNetwork(
+        NeuralNetConfig(seed=seed, updater=U.Adam(learning_rate=0.01)).list(
+            L.DenseLayer(n_out=32, activation="relu"),
+            L.DenseLayer(n_out=32, activation="relu"),
+            L.OutputLayer(n_out=3, loss="mcxent"),
+            input_type=I.FeedForwardType(8)))
+    net.init()
+    net.fit(x, y, epochs=5)
+    return net, x
+
+
+class TestQuantization:
+    def test_roundtrip_error_bounded(self):
+        net, _ = _trained_net()
+        qp, sc = quantize_params(net.params)
+        deq = dequantize_params(qp, sc, jnp.float32)
+        w, wq = net.params[0]["W"], deq[0]["W"]
+        # per-channel absmax/127 quantization error bound
+        col_absmax = np.abs(np.asarray(w)).max(axis=0)
+        err = np.abs(np.asarray(w) - np.asarray(wq)).max(axis=0)
+        assert (err <= col_absmax / 127.0 + 1e-7).all()
+        # int8 storage: weight leaves are 4x smaller than f32
+        assert weight_bytes(qp) * 4 == weight_bytes(net.params)
+        # biases untouched
+        np.testing.assert_array_equal(np.asarray(deq[0]["b"]),
+                                      np.asarray(net.params[0]["b"]))
+
+    def test_predictions_close_and_argmax_stable(self):
+        net, x = _trained_net()
+        qi = QuantizedInference(net, dtype=jnp.float32)
+        y_f = np.asarray(net.output(x))
+        y_q = np.asarray(qi.output(x))
+        assert np.abs(y_f - y_q).max() < 0.02
+        # class decisions agree on a comfortable majority
+        agree = (y_f.argmax(-1) == y_q.argmax(-1)).mean()
+        assert agree >= 0.98, agree
+
+    def test_quantizes_transformer_weights(self):
+        from deeplearning4j_tpu.models import transformer_lm
+        net = MultiLayerNetwork(transformer_lm(50, n_layers=1, d_model=32,
+                                               n_heads=2, seq_len=8))
+        net.init()
+        qp, sc = quantize_params(net.params)
+        # attention + mlp weights quantized inside the block dict
+        blk = qp[1]
+        assert blk["mha"]["Wqkv"].dtype == jnp.int8
+        assert blk["mlp_W1"].dtype == jnp.int8
+        # layernorm/bias leaves untouched
+        assert blk["ln1"]["gamma"].dtype != jnp.int8
+        qi = QuantizedInference(net, dtype=jnp.float32)
+        ids = np.random.RandomState(0).randint(0, 50, (2, 8))
+        out = np.asarray(qi.output(ids[..., None].astype(np.float32)))
+        assert np.isfinite(out).all()
+
+
+class TestQuantizationGraphsAndExperts:
+    def test_computation_graph_contract(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+        conf = (GraphBuilder(updater=U.Sgd(learning_rate=0.1), seed=2)
+                .add_inputs("in").set_input_types(I.FeedForwardType(6))
+                .add_layer("h", L.DenseLayer(n_out=16, activation="relu"), "in")
+                .add_layer("out", L.OutputLayer(n_out=4, loss="mcxent"), "h")
+                .set_outputs("out").build())
+        g = ComputationGraph(conf)
+        g.init()
+        x = np.random.RandomState(0).rand(8, 6).astype(np.float32)
+        qi = QuantizedInference(g, dtype=jnp.float32)
+        y_q = np.asarray(qi.output(x))          # bare array, like g.output
+        y_f = np.asarray(g.output(x))
+        assert y_q.shape == y_f.shape == (8, 4)
+        assert np.abs(y_q - y_f).max() < 0.02
+
+    def test_per_expert_scales(self):
+        """An expert with 10x smaller weights must keep its own scale."""
+        params = [{"expert_W1": jnp.concatenate([
+            jnp.ones((1, 4, 8)), 0.1 * jnp.ones((1, 4, 8))])}]
+        qp, sc = quantize_params(params)
+        s = np.asarray(sc[0]["expert_W1"])
+        assert s.shape == (2, 1, 8)
+        assert np.allclose(s[1], s[0] * 0.1)
+        deq = dequantize_params(qp, sc, jnp.float32)
+        np.testing.assert_allclose(np.asarray(deq[0]["expert_W1"][1]), 0.1,
+                                   rtol=1e-2)
