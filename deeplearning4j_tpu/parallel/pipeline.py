@@ -38,7 +38,7 @@ from deeplearning4j_tpu.nn import updaters as U
 from deeplearning4j_tpu.nn.conf import inputs as I
 
 
-def gpipe_schedule(block, n_micro, n_stages):
+def gpipe_schedule(block, n_micro, n_stages, remat=False):
     """Per-device GPipe schedule body (call inside shard_map over 'stage').
 
     ``block``: the (static) layer object whose ``apply(params, {}, x)`` runs
@@ -46,12 +46,23 @@ def gpipe_schedule(block, n_micro, n_stages):
     the device's stacked slab [L/S, ...] and ``x_mb`` is [M, mb, T, D]
     microbatched activations (same on every stage; only stage 0 reads them).
     Output: [M, mb, T, D] finished activations (identical on every stage).
+
+    ``remat``: rematerialize each block's forward during the backward
+    schedule (jax.checkpoint) — GPipe's activation stash shrinks from every
+    intra-block intermediate to one activation per block per in-flight
+    microbatch, the standard HBM-for-FLOPs trade for deep pipelines.
     """
+
+    def one_block(bp, h):
+        y, _ = block.apply(bp, {}, h)
+        return y
+
+    if remat:
+        one_block = jax.checkpoint(one_block)
 
     def stage_fn(local_blocks, x):
         def body(h, bp):
-            y, _ = block.apply(bp, {}, h)
-            return y, None
+            return one_block(bp, h), None
         h, _ = lax.scan(body, x, local_blocks)
         return h
 
@@ -97,7 +108,7 @@ class PipelineParallelLM:
 
     def __init__(self, *, vocab_size, n_layers, d_model, n_heads, seq_len,
                  mesh: Mesh, n_microbatches=4, mlp_ratio=4, updater=None,
-                 seed=12345):
+                 seed=12345, remat=False):
         assert "stage" in mesh.axis_names, "mesh needs a 'stage' axis"
         self.vocab_size = vocab_size
         self.n_layers = n_layers
@@ -114,6 +125,7 @@ class PipelineParallelLM:
                                         mlp_ratio=mlp_ratio, causal=True)
         self.updater = updater or U.Adam(learning_rate=3e-4)
         self.seed = seed
+        self.remat = remat
         self.params = None
         self.opt_state = None
         self._step_fn = None
@@ -172,7 +184,8 @@ class PipelineParallelLM:
         b, t, d = emb.shape
         mb = b // self.n_micro
         x_mb = emb.reshape(self.n_micro, mb, t, d)
-        run = gpipe_schedule(self.block, self.n_micro, self.n_stages)
+        run = gpipe_schedule(self.block, self.n_micro, self.n_stages,
+                             remat=self.remat)
         piped = shard_map(
             run, mesh=self.mesh,
             in_specs=(P("stage"), P(None, "data")),

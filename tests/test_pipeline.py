@@ -19,11 +19,11 @@ pytestmark = pytest.mark.slow
 VOCAB, LAYERS, DMODEL, HEADS, T = 50, 4, 32, 2, 16
 
 
-def _model(mesh, n_micro, seed=7):
+def _model(mesh, n_micro, seed=7, remat=False):
     return PipelineParallelLM(
         vocab_size=VOCAB, n_layers=LAYERS, d_model=DMODEL, n_heads=HEADS,
         seq_len=T, mesh=mesh, n_microbatches=n_micro,
-        updater=U.Sgd(learning_rate=0.1), seed=seed).init()
+        updater=U.Sgd(learning_rate=0.1), seed=seed, remat=remat).init()
 
 
 def _data(batch, seed=0):
@@ -101,4 +101,18 @@ class TestPipelineExactness:
         for n_micro in (2, 4):
             m = _model(mesh, n_micro=n_micro, seed=11)
             losses.append(float(m.step(ids, labels)))
+        np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+
+
+class TestPipelineRemat:
+    def test_remat_matches_plain(self):
+        """jax.checkpoint inside the schedule changes memory, not math."""
+        mesh = make_mesh(MeshSpec(data=1, model=1, seq=1, stage=4),
+                         devices=jax.devices()[:4])
+        ids, labels = _data(8)
+        losses = []
+        for remat in (False, True):
+            m = _model(mesh, n_micro=4, remat=remat)
+            m.step(ids, labels)            # one update
+            losses.append(float(m.step(ids, labels)))  # post-update loss
         np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
