@@ -503,6 +503,15 @@ def main():
         # preflight shapes rather than dying with no artifact at all.
         _emit({"event": "backend_unreachable",
                "action": "falling back to CPU preflight shapes"})
+        # point the reader at the round's measured TPU numbers (clearly
+        # labeled as historical, NOT this run's records)
+        hist = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_TPU_MEASURED.json")
+        if os.path.exists(hist):
+            _emit({"event": "last_measured_tpu_results",
+                   "file": hist,
+                   "note": "TPU numbers measured earlier this round; this "
+                           "run is a CPU fallback"})
         os.environ["BENCH_PREFLIGHT"] = "1"
         _force_cpu()
     elif platform == "cpu":
