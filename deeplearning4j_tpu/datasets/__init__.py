@@ -1,6 +1,6 @@
 from deeplearning4j_tpu.datasets.iterator import (  # noqa: F401
     DataSet, ArrayDataSetIterator, AsyncDataSetIterator, BenchmarkDataSetIterator,
-    EarlyTerminationIterator, MultipleEpochsIterator,
+    EarlyTerminationIterator, MultipleEpochsIterator, ShardedDataSetIterator,
 )
 from deeplearning4j_tpu.datasets.fetchers import (  # noqa: F401
     Cifar10DataFetcher, EmnistDataFetcher, IrisDataFetcher, LfwDataFetcher,

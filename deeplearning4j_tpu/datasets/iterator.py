@@ -291,3 +291,47 @@ class InterleavedDataSetCallback(DataSetCallback):
         return DataSet(features=put(ds.features), labels=put(ds.labels),
                        features_mask=put(ds.features_mask),
                        labels_mask=put(ds.labels_mask))
+
+
+class ShardedDataSetIterator(DataSetIterator):
+    """Per-process shard of a source iterator for multi-host training.
+
+    Reference analog: the Spark tier's RDD partitioning — each executor
+    consumes its own partition of the dataset (ParameterAveragingTraining-
+    Master's splits). On a jax.distributed multi-host run, each process
+    wraps its iterator in one of these with its own
+    ``jax.process_index()``/``jax.process_count()``: batch k is consumed by
+    process k % count, everything else is skipped, so the processes stream
+    disjoint data with no coordinator.
+
+    Defaults read the live jax runtime so single-process runs degrade to a
+    pass-through (index 0 of 1).
+    """
+
+    def __init__(self, source, process_index=None, process_count=None):
+        self.source = source
+        self.process_index = (jax.process_index() if process_index is None
+                              else process_index)
+        self.process_count = (jax.process_count() if process_count is None
+                              else process_count)
+        assert 0 <= self.process_index < self.process_count
+
+    def reset(self):
+        self.source.reset()
+
+    def __next__(self):
+        # consume one FULL round of process_count batches and return ours:
+        # an incomplete final round raises StopIteration before anything is
+        # returned, so every process sees the SAME number of batches — an
+        # uneven split would leave some processes stepping into collectives
+        # their peers never join (multi-host deadlock)
+        mine = None
+        for i in range(self.process_count):
+            batch = next(self.source)  # StopIteration drops the round
+            if i == self.process_index:
+                mine = batch
+        return mine
+
+    @property
+    def batch_size(self):
+        return self.source.batch_size
