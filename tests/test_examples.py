@@ -21,6 +21,7 @@ TUTORIALS = [
     "examples/tutorials/t08_rnn_sequence_classification.py",
     "examples/tutorials/t09_transformer_language_model.py",
     "examples/tutorials/t10_scaling_parallelism.py",
+    "examples/tutorials/t11_production_lifecycle.py",
 ]
 EXAMPLES = [
     "examples/lenet_mnist.py",
