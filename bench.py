@@ -59,7 +59,7 @@ def _emit(rec):
     print(json.dumps(rec), flush=True)
 
 
-def _probe_backend(timeout_s=120, retries=3):
+def _probe_backend(timeout_s=120, retries=2):
     """Initialize jax's default backend in a subprocess so a wedged TPU
     tunnel can only time the probe out, never hang this process. Returns the
     platform string ('tpu'/'axon'/'cpu'/...) or None if unreachable.

@@ -55,7 +55,13 @@ def build():
 def main():
     mesh = make_mesh(MeshSpec(data=8, model=1))
     workdir = tempfile.mkdtemp()
+    try:
+        _run(mesh, workdir)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
 
+
+def _run(mesh, workdir):
     # 1) data-parallel training with ZeRO-1 sharded Adam state: each device
     #    holds 1/8 of the moments; GSPMD derives the reduce-scatter pattern
     trainer = ParallelTrainer(build(), mesh, shard_optimizer_state=True).init()
@@ -92,7 +98,6 @@ def main():
     finally:
         server.stop()
     print(f"4. served {len(preds)} async requests over the 8-device mesh")
-    shutil.rmtree(workdir, ignore_errors=True)
     print("tutorial 11 complete: train -> checkpoint -> resume -> quantize -> serve")
 
 
