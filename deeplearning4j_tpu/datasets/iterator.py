@@ -306,6 +306,13 @@ class ShardedDataSetIterator(DataSetIterator):
 
     Defaults read the live jax runtime so single-process runs degrade to a
     pass-through (index 0 of 1).
+
+    ETL cost: if the source exposes ``skip(n)`` (cheap positional seek),
+    peers' batches are skipped without decoding, so per-host ETL cost is
+    1/process_count of the stream. Otherwise every process decodes all
+    process_count batches per round and discards the peers' — put this
+    shard filter UPSTREAM of expensive decode steps, or give the source a
+    ``skip``.
     """
 
     def __init__(self, source, process_index=None, process_count=None):
@@ -325,12 +332,32 @@ class ShardedDataSetIterator(DataSetIterator):
         # returned, so every process sees the SAME number of batches — an
         # uneven split would leave some processes stepping into collectives
         # their peers never join (multi-host deadlock)
+        if callable(getattr(self.source, "skip", None)):
+            # seek fast path: decode only our batch. skip(n) either raises
+            # StopIteration when fewer than n batches remain, or returns
+            # the count actually skipped (clamp-style seek, e.g. a
+            # tf.data-like source) — an under-skip is converted to
+            # StopIteration here. Either way every process abandons a
+            # ragged final round in the SAME __next__ call (lower ranks in
+            # the trailing skip, higher ranks in the leading one), which
+            # preserves the equal-batch-count invariant above.
+            self._skip(self.process_index)
+            mine = next(self.source)
+            self._skip(self.process_count - self.process_index - 1)
+            return mine
         mine = None
         for i in range(self.process_count):
             batch = next(self.source)  # StopIteration drops the round
             if i == self.process_index:
                 mine = batch
         return mine
+
+    def _skip(self, n):
+        if n <= 0:
+            return
+        skipped = self.source.skip(n)
+        if skipped is not None and skipped < n:
+            raise StopIteration
 
     @property
     def batch_size(self):

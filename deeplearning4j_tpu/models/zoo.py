@@ -68,8 +68,24 @@ class ZooModel:
         url, md5 = self.pretrained[pretrained_type]
         relpath = os.path.join("zoo", f"{self.name}_{pretrained_type}.zip")
         path = _cache.ensure_file(relpath, url=url, md5=md5)
-        from deeplearning4j_tpu.utils.serialization import load_model
-        return load_model(path)
+        return restore_checkpoint(path)
+
+
+def restore_checkpoint(path, input_type=None):
+    """Restore either checkpoint format by sniffing the zip: the
+    reference's ModelSerializer layout (``configuration.json`` +
+    ``coefficients.bin`` — what every zoo ``pretrainedUrl`` serves,
+    ZooModel.java:40-52) goes through modelimport.dl4j; this framework's
+    own layout goes through utils.serialization."""
+    import zipfile
+    with zipfile.ZipFile(path) as zf:
+        names = set(zf.namelist())
+    if "configuration.json" in names and "coefficients.bin" in names:
+        from deeplearning4j_tpu.modelimport.dl4j import \
+            restore_multilayer_network
+        return restore_multilayer_network(path, input_type=input_type)
+    from deeplearning4j_tpu.utils.serialization import load_model
+    return load_model(path)
 
 
 _REGISTRY = {}

@@ -62,13 +62,15 @@ class LayerNormalization(ParamLayer):
 def dot_product_attention(q, k, v, *, mask=None, causal=False, scale=None):
     """q,k,v: [B, T, H, D]. Returns [B, T, H, D]. bf16 matmuls, f32 softmax.
 
-    On TPU, unmasked attention dispatches to the fused flash kernel
-    (ops/attention_pallas.py) — O(T*D) HBM traffic instead of the [B,H,T,T]
-    logits tensor; the dispatch seam mirrors the LSTM fused path."""
+    On TPU, attention (incl. [B, Tk] key-padding-masked batches) dispatches
+    to the fused flash kernel (ops/attention_pallas.py) — O(T*D) HBM
+    traffic instead of the [B,H,T,T] logits tensor; the dispatch seam
+    mirrors the LSTM fused path."""
     from deeplearning4j_tpu.ops import attention_pallas as _ap
     if (_ap.enabled() and _ap.supported(q.shape, k.shape, mask, q.dtype)
             and (scale is None or isinstance(scale, (int, float)))):
-        return _ap.flash_attention(q, k, v, causal=causal, scale=scale)
+        return _ap.flash_attention(q, k, v, mask=mask, causal=causal,
+                                   scale=scale)
     cd, ad = _dtypes.compute_dtypes_for(q.dtype)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, ad))
@@ -81,7 +83,17 @@ def dot_product_attention(q, k, v, *, mask=None, causal=False, scale=None):
     if mask is not None:
         # mask: [B, Tk] -> key-side masking
         logits = jnp.where(mask[:, None, None, :] > 0, logits, -jnp.inf)
-    weights = jax.nn.softmax(logits, axis=-1)
+    if causal or mask is not None:
+        # fully-masked query rows (e.g. left padding under causal): softmax
+        # over all -inf is NaN fwd AND bwd — substitute a finite row before
+        # the softmax and zero its output after, matching the fused
+        # kernel's contract so dispatch choice never changes NaN behavior
+        any_valid = (logits > -jnp.inf).any(axis=-1, keepdims=True)
+        logits = jnp.where(any_valid, logits, 0.0)
+        weights = jax.nn.softmax(logits, axis=-1)
+        weights = jnp.where(any_valid, weights, 0.0)
+    else:
+        weights = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(cd), v.astype(cd),
                      preferred_element_type=ad)
     return out

@@ -71,7 +71,7 @@ class MoETransformerBlock(Layer):
         assert input_type.size == self.n_out, \
             "MoETransformerBlock requires input size == n_out (residual)"
         ln1, mha, ln2 = self._parts()
-        k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+        k1, k1b, k2, k3, k4, k5 = jax.random.split(key, 6)
         d, e = self.n_out, self.n_experts
         hidden = d * self.mlp_ratio
         it = _inputs.RecurrentType(d, input_type.timesteps)
@@ -84,7 +84,7 @@ class MoETransformerBlock(Layer):
 
         return {
             "ln1": ln1.init(k1, it, dtype),
-            "mha": mha.init(k1, it, dtype),
+            "mha": mha.init(k1b, it, dtype),
             "ln2": ln2.init(k2, it, dtype),
             "router_W": _init.init_weight("xavier", k3, (d, e), d, e, dtype),
             "expert_W1": expert_stack(k4, (d, hidden), d, hidden),

@@ -73,12 +73,17 @@ _MIN_SEQ = 1024
 
 def supported(q_shape, k_shape, mask, dtype, *, min_seq=None):
     """Fast path applies: self-attention shapes only (q and k share the
-    sequence length — KV-cache decode goes to the naive path), no padding
-    mask, head_dim <= 128, float dtype, and sequences long enough that the
-    kernel beats XLA's fused naive path (see _MIN_SEQ crossover note;
-    override via DL4J_TPU_FUSED_ATTENTION_MIN_SEQ or min_seq=)."""
+    sequence length — KV-cache decode goes to the naive path), head_dim
+    <= 128, float dtype, and sequences long enough that the kernel beats
+    XLA's fused naive path (see _MIN_SEQ crossover note; override via
+    DL4J_TPU_FUSED_ATTENTION_MIN_SEQ or min_seq=). Padding masks are
+    supported when they are key-side [B, Tk] (the reference's masking
+    contract, MaskedReductionUtil.java) — arbitrary-rank score masks go to
+    the naive path."""
     if mask is not None:
-        return False
+        mshape = tuple(getattr(mask, "shape", ()))
+        if mshape != (q_shape[0], k_shape[1]):
+            return False
     if tuple(q_shape) != tuple(k_shape):
         return False
     if q_shape[-1] > _LANE:
@@ -94,8 +99,13 @@ def supported(q_shape, k_shape, mask, dtype, *, min_seq=None):
     return jnp.issubdtype(dtype, jnp.floating)
 
 
-def _attn_kernel(t_true, causal, scale, block_q, block_k,
-                 q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s):
+def _attn_kernel(t_true, causal, scale, block_q, block_k, has_mask,
+                 q_ref, k_ref, v_ref, *rest):
+    if has_mask:
+        mask_ref, o_ref, lse_ref, m_s, l_s, acc_s = rest
+    else:
+        mask_ref = None
+        o_ref, lse_ref, m_s, l_s, acc_s = rest
     iq = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -123,6 +133,8 @@ def _attn_kernel(t_true, causal, scale, block_q, block_k,
         col = j * block_k + jax.lax.broadcasted_iota(jnp.int32,
                                                      (1, block_k), 1)
         valid = col < t_true
+        if has_mask:
+            valid = valid & (mask_ref[0][0:1] > 0)       # key padding mask
         if causal:
             row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
                                                           (bq, 1), 0)
@@ -130,7 +142,11 @@ def _attn_kernel(t_true, causal, scale, block_q, block_k,
         s = jnp.where(valid, s, _NEG_INF)
         m_old = m_s[:]
         m_new = jnp.maximum(m_old, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
+        # explicit zeroing: on a fully-masked row m_new == s == _NEG_INF and
+        # exp(s - m_new) would be 1, silently averaging v — zero it so l
+        # stays 0 and the row emits 0 (the naive path emits NaN there; 0 is
+        # the contract the masked-output multiply downstream expects)
+        p = jnp.where(valid, jnp.exp(s - m_new[:, None]), 0.0)
         alpha = jnp.exp(m_old - m_new)
         m_s[:] = m_new
         l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=-1)
@@ -157,8 +173,14 @@ def _pad_to(x, size, axis):
     return jnp.pad(x, widths)
 
 
-def _run_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    """q,k,v: [BH, T, D] -> (out [BH, T, D], lse [BH, T])."""
+def _run_fwd(q, k, v, mask, h, causal, scale, block_q, block_k, interpret):
+    """q,k,v: [BH, T, D]; mask: None, or [B, T] f32 key-validity (1=valid)
+    with B = BH // h — the kernel indexes it per batch element (b // h) so
+    heads share one mask block. A zero-width [B, 0] mask means "no mask"
+    (the custom_vjp needs a real array operand; unmasked calls pay no mask
+    traffic in the kernel). Returns (out [BH, T, D], lse [BH, T])."""
+    if mask is not None and mask.shape[-1] == 0:
+        mask = None
     bh, t, d = q.shape
     # clamp blocks to the 128-rounded sequence: short sequences would
     # otherwise pad up to the full default block (wasted compute), and
@@ -174,7 +196,7 @@ def _run_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     vp = _pad_to(_pad_to(v, t_pad, 1), d_pad, 2)
     grid = (bh, t_pad // block_q, t_pad // block_k)
     kernel = functools.partial(_attn_kernel, t, causal, scale,
-                               block_q, block_k)
+                               block_q, block_k, mask is not None)
     scratch = [pltpu.VMEM((block_q,), jnp.float32),
                pltpu.VMEM((block_q,), jnp.float32),
                pltpu.VMEM((block_q, d_pad), jnp.float32)] if _HAS_PLTPU else [
@@ -188,7 +210,11 @@ def _run_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
-        ],
+        ] + ([
+            # mask rides in as [B, 8, t_pad] f32 — the 8-sublane broadcast
+            # satisfies the TPU (8, 128) tile rule like the lse output block
+            pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // h, 0, j)),
+        ] if mask is not None else []),
         out_specs=[
             pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
@@ -199,19 +225,24 @@ def _run_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         scratch_shapes=scratch,
         interpret=interpret,
-    )(qp, kp, vp)
+    )(qp, kp, vp, *(() if mask is None else (
+        jnp.broadcast_to(_pad_to(mask.astype(jnp.float32), t_pad, 1)
+                         [:, None, :], (bh // h, 8, t_pad)),)))
     return out[:, :t, :d], lse[:, 0, :t]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _attention(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, _ = _run_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _attention(q, k, v, mask, causal, scale, block_q, block_k, interpret, h):
+    out, _ = _run_fwd(q, k, v, mask, h, causal, scale, block_q, block_k,
+                      interpret)
     return out
 
 
-def _attention_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _run_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _attention_fwd(q, k, v, mask, causal, scale, block_q, block_k,
+                   interpret, h):
+    out, lse = _run_fwd(q, k, v, mask, h, causal, scale, block_q, block_k,
+                        interpret)
+    return out, (q, k, v, mask, out, lse)
 
 
 def _bwd_core(causal, scale, block_k, res, g, g_lse=None):
@@ -223,7 +254,9 @@ def _bwd_core(causal, scale, block_k, res, g, g_lse=None):
     d(lse)/d(s) is the softmax row, so it adds ``p * g_lse`` to ds. Used by
     the ring-attention block primitive whose combination weights depend on
     lse."""
-    q, k, v, out, lse = res
+    q, k, v, mask, out, lse = res
+    if mask is not None and mask.shape[-1] == 0:   # zero-width = unmasked
+        mask = None
     f32 = jnp.float32
     # big einsums stay in the input dtype (bf16 under the mixed policy) with
     # f32 accumulation via preferred_element_type; softmax math is f32
@@ -238,21 +271,35 @@ def _bwd_core(causal, scale, block_k, res, g, g_lse=None):
     # move the block axis to front for scan
     kp = jnp.moveaxis(kp, 1, 0)                      # [nk, BH, Bk, D]
     vp = jnp.moveaxis(vp, 1, 0)
+    if mask is not None:
+        # key padding mask, repeated per head ([B, T] -> [BH, T],
+        # batch-major to match _fold_heads' bh = b * h + head layout),
+        # blocked like k/v
+        maskh = jnp.repeat(mask.astype(f32), bh // mask.shape[0], axis=0)
+        mp = jnp.moveaxis(_pad_to(maskh, t_pad, 1)
+                          .reshape(bh, t_pad // bk, bk), 1, 0)  # [nk,BH,Bk]
     delta = jnp.sum(gf.astype(f32) * of.astype(f32), axis=-1,
                     keepdims=True)                    # [BH, T, 1]
     row = jnp.arange(t)[None, :, None]                # [1, T, 1]
 
     def body(carry, blk):
         dq_acc, j = carry
-        k_j, v_j = blk                                # [BH, Bk, D]
+        if mask is not None:
+            k_j, v_j, m_j = blk                       # [BH, Bk, D], [BH, Bk]
+        else:
+            k_j, v_j = blk
         col = j * bk + jnp.arange(bk)[None, None, :]  # [1, 1, Bk]
         s = jnp.einsum("bqd,bkd->bqk", qf, k_j,
                        preferred_element_type=f32) * scale
         valid = col < t
+        if mask is not None:
+            valid = valid & (m_j[:, None, :] > 0)
         if causal:
             valid = valid & (col <= row)
         s = jnp.where(valid, s, _NEG_INF)
-        p = jnp.exp(s - lse[..., None])               # [BH, T, Bk] f32
+        # zero (not exp) masked entries: on fully-masked rows lse is the
+        # _NEG_INF sentinel and exp(s - lse) would be ~1, corrupting grads
+        p = jnp.where(valid, jnp.exp(s - lse[..., None]), 0.0)  # [BH,T,Bk]
         pc = p.astype(qf.dtype)
         dv_j = jnp.einsum("bqk,bqd->bkd", pc, gf, preferred_element_type=f32)
         dp = jnp.einsum("bqd,bkd->bqk", gf, v_j, preferred_element_type=f32)
@@ -267,14 +314,16 @@ def _bwd_core(causal, scale, block_k, res, g, g_lse=None):
         return (dq_acc, j + 1), (dk_j, dv_j)
 
     (dq, _), (dk_blocks, dv_blocks) = jax.lax.scan(
-        body, (jnp.zeros(qf.shape, f32), 0), (kp, vp))
+        body, (jnp.zeros(qf.shape, f32), 0),
+        (kp, vp) if mask is None else (kp, vp, mp))
     dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(bh, t_pad, d)[:, :t]
     dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(bh, t_pad, d)[:, :t]
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _attention_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    return _bwd_core(causal, scale, block_k, res, g)
+def _attention_bwd(causal, scale, block_q, block_k, interpret, h, res, g):
+    dq, dk, dv = _bwd_core(causal, scale, block_k, res, g)
+    return dq, dk, dv, jnp.zeros_like(res[3])
 
 
 def _fold_heads(x):
@@ -295,14 +344,15 @@ def flash_attention_block(q, k, v, causal, scale, interpret):
     log-sum-exp; its cotangent is handled exactly (see _bwd_core)."""
     b, t, h, d = q.shape
     out, lse = _run_fwd(_fold_heads(q), _fold_heads(k), _fold_heads(v),
-                        causal, scale, 512, 512, interpret)
+                        None, h, causal, scale, 512, 512, interpret)
     return _unfold_heads(out, b, h), lse.reshape(b, h, t)
 
 
 def _flash_block_fwd(q, k, v, causal, scale, interpret):
     b, t, h, d = q.shape
     qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
-    out, lse = _run_fwd(qf, kf, vf, causal, scale, 512, 512, interpret)
+    out, lse = _run_fwd(qf, kf, vf, None, h, causal, scale, 512, 512,
+                        interpret)
     return (_unfold_heads(out, b, h), lse.reshape(b, h, t)), \
         (qf, kf, vf, out, lse, b, h)
 
@@ -310,7 +360,7 @@ def _flash_block_fwd(q, k, v, causal, scale, interpret):
 def _flash_block_bwd(causal, scale, interpret, res, grads):
     qf, kf, vf, out, lse, b, h = res
     g_out, g_lse = grads
-    dq, dk, dv = _bwd_core(causal, scale, 512, (qf, kf, vf, out, lse),
+    dq, dk, dv = _bwd_core(causal, scale, 512, (qf, kf, vf, None, out, lse),
                            _fold_heads(g_out),
                            g_lse=g_lse.reshape(b * h, -1))
     return (_unfold_heads(dq, b, h), _unfold_heads(dk, b, h),
@@ -323,14 +373,20 @@ flash_attention_block.defvjp(_flash_block_fwd, _flash_block_bwd)
 _attention.defvjp(_attention_fwd, _attention_bwd)
 
 
-def flash_attention(q, k, v, *, causal=False, scale=None, block_q=512,
-                    block_k=512, interpret=False):
+def flash_attention(q, k, v, *, mask=None, causal=False, scale=None,
+                    block_q=512, block_k=512, interpret=False):
     """Fused attention over [B, T, H, D] self-attention inputs (same
-    contract as nn/layers/attention.py dot_product_attention minus padding
-    masks and cross-length decode)."""
+    contract as nn/layers/attention.py dot_product_attention minus
+    cross-length decode). ``mask``: optional [B, Tk] key-side padding mask
+    (1 = valid). Fully-masked query rows emit 0 (the naive path emits NaN
+    there — 0 is what the downstream masked-output multiply expects)."""
     b, t, h, d = q.shape
     if scale is None:
         scale = 1.0 / float(d) ** 0.5
-    out = _attention(_fold_heads(q), _fold_heads(k), _fold_heads(v), causal,
-                     float(scale), block_q, block_k, interpret)
+    # custom_vjp needs an array operand in every slot: a zero-width [B, 0]
+    # mask is the "no mask" sentinel (kernel + backward skip all mask work)
+    maskf = (jnp.zeros((b, 0), jnp.float32) if mask is None
+             else mask.astype(jnp.float32))
+    out = _attention(_fold_heads(q), _fold_heads(k), _fold_heads(v), maskf,
+                     causal, float(scale), block_q, block_k, interpret, h)
     return _unfold_heads(out, b, h)

@@ -23,6 +23,8 @@ per-step all-reduce is the synchronous limit of averaging every step — but
 
 from __future__ import annotations
 
+import weakref
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -210,10 +212,20 @@ class ParallelTrainer:
                 return self.net.loss_fn(p, s, x, y, train=False, mask=m)[0]
             self._score_fn = jax.jit(base)
         # early stopping scores the SAME validation arrays every epoch:
-        # cache the sharded device copies keyed on the host array identities
-        key = (id(x), id(y))
-        if getattr(self, "_score_cache_key", None) != key:
-            self._score_cache_key = key
+        # cache the sharded device copies, keyed by weakrefs to the host
+        # arrays — live-referent identity subsumes id()/shape checks and
+        # cannot alias a recycled address (raw id()s can, after GC)
+        deref = lambda r: r() if isinstance(r, weakref.ref) else r
+        refs = getattr(self, "_score_cache_refs", None)
+        hit = (refs is not None
+               and deref(refs[0]) is x and deref(refs[1]) is y)
+        if not hit:
+            def mkref(a):
+                try:
+                    return weakref.ref(a)
+                except TypeError:
+                    return a  # non-weakref-able (e.g. list): strong ref
+            self._score_cache_refs = (mkref(x), mkref(y))
             self._score_cache = (
                 jax.device_put(jnp.asarray(x), _mesh.data_sharded(self.mesh)),
                 jax.device_put(jnp.asarray(y), _mesh.data_sharded(self.mesh)))

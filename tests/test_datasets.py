@@ -379,3 +379,60 @@ class TestShardedIterator:
         it = ShardedDataSetIterator(src)  # jax defaults: index 0 of 1
         assert len(list(it)) == 3
         assert it.batch_size == 2
+
+    class _SkipSource:
+        """skip()-capable source; style='raise' raises StopIteration on an
+        under-skip, style='clamp' seeks what it can and returns the count
+        (tf.data-like) — both must preserve the equal-batch-count
+        invariant through ShardedDataSetIterator._skip."""
+
+        def __init__(self, n_batches, style):
+            self.n, self.style = n_batches, style
+            self.pos = 0
+            self.decoded = 0  # ETL-cost proxy: batches actually decoded
+
+        def reset(self):
+            self.pos = 0
+
+        def skip(self, k):
+            avail = min(k, self.n - self.pos)
+            if self.style == "raise" and avail < k:
+                self.pos = self.n
+                raise StopIteration
+            self.pos += avail
+            return avail if self.style == "clamp" else None
+
+        def __next__(self):
+            if self.pos >= self.n:
+                raise StopIteration
+            self.pos += 1
+            self.decoded += 1
+            return self.pos - 1  # batch id
+
+        batch_size = 2
+
+    @pytest.mark.parametrize("style", ["raise", "clamp"])
+    @pytest.mark.parametrize("n_batches", [8, 10, 11])
+    def test_skip_fast_path(self, style, n_batches):
+        """With a seekable source each process decodes ONLY its own
+        batches, shards stay disjoint, and ragged tails (10, 11 batches
+        over 4 processes) are dropped by EVERY process — under both skip
+        contracts."""
+        from deeplearning4j_tpu.datasets import ShardedDataSetIterator
+        count = 4
+        rounds = n_batches // count
+        seen, decoded = [], []
+        for idx in range(count):
+            src = self._SkipSource(n_batches, style)
+            it = ShardedDataSetIterator(src, process_index=idx,
+                                        process_count=count)
+            seen.append(list(it))
+            decoded.append(src.decoded)
+        assert [len(s) for s in seen] == [rounds] * count   # equal counts
+        assert sorted(v for s in seen for v in s) == \
+            [r * count + i for r in range(rounds) for i in range(count)]
+        # ~1/count of the stream decoded per process (the abandoned ragged
+        # round may decode at most one extra batch before bailing)
+        assert all(rounds <= d <= rounds + 1 for d in decoded)
+        if n_batches % count == 0:
+            assert decoded == [rounds] * count

@@ -286,8 +286,11 @@ class TestFlashAttention:
         from deeplearning4j_tpu.ops.attention_pallas import supported
         assert supported((2, 16, 2, 64), (2, 16, 2, 64), None, np.float32,
                          min_seq=0)
+        # [B, Tk] key-padding masks take the fast path; other shapes don't
+        assert supported((2, 16, 2, 64), (2, 16, 2, 64),
+                         np.ones((2, 16)), np.float32, min_seq=0)
         assert not supported((2, 16, 2, 64), (2, 16, 2, 64),
-                             np.ones((2, 16)), np.float32, min_seq=0)
+                             np.ones((2, 16, 16)), np.float32, min_seq=0)
         assert not supported((2, 16, 2, 256), (2, 16, 2, 256), None,
                              np.float32, min_seq=0)
         # KV-cache decode (tq != tk) must fall back to the naive path
@@ -307,4 +310,86 @@ class TestFlashAttention:
         out = flash_attention(q, k, v, block_q=8, block_k=6, interpret=True)
         np.testing.assert_allclose(np.asarray(out),
                                    np.asarray(self._ref(q, k, v)),
+                                   rtol=2e-5, atol=2e-6)
+
+    def _ref_masked(self, q, k, v, mask, causal=False):
+        import jax.numpy as jnp
+        d = q.shape[-1]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+        if causal:
+            t = logits.shape[-1]
+            logits = jnp.where(jnp.tril(jnp.ones((t, t), bool)), logits,
+                               -jnp.inf)
+        logits = jnp.where(jnp.asarray(mask)[:, None, None, :] > 0, logits,
+                           -jnp.inf)
+        w = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+    def test_padding_mask_matches_reference(self):
+        from deeplearning4j_tpu.ops.attention_pallas import flash_attention
+        q, k, v = self._rand(b=2, t=24, h=2, d=8, seed=6)
+        mask = np.ones((2, 24), np.float32)
+        mask[0, 17:] = 0.0    # ragged valid length, not block-aligned
+        mask[1, ::3] = 0.0    # non-contiguous holes
+        out = flash_attention(q, k, v, mask=jnp.asarray(mask),
+                              block_q=8, block_k=8, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._ref_masked(q, k, v, mask)),
+            rtol=2e-5, atol=2e-6)
+
+    def test_padding_mask_causal_fully_masked_rows(self):
+        """Left-padded batch under causal attention: rows before the first
+        valid key see NO valid keys. The kernel emits 0 there (naive emits
+        NaN); valid rows must match the naive path exactly."""
+        from deeplearning4j_tpu.ops.attention_pallas import flash_attention
+        q, k, v = self._rand(b=2, t=16, h=2, d=8, seed=7)
+        mask = np.ones((2, 16), np.float32)
+        mask[0, :5] = 0.0     # left padding: causal rows 0-4 fully masked
+        out = flash_attention(q, k, v, mask=jnp.asarray(mask), causal=True,
+                              block_q=8, block_k=8, interpret=True)
+        ref = np.asarray(self._ref_masked(q, k, v, mask, causal=True))
+        np.testing.assert_allclose(np.asarray(out)[0, 5:], ref[0, 5:],
+                                   rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(np.asarray(out)[1], ref[1],
+                                   rtol=2e-5, atol=2e-6)
+        assert np.all(np.asarray(out)[0, :5] == 0.0)
+        assert np.isnan(ref[0, :5]).any()   # the behavior we're fixing
+
+    def test_padding_mask_gradients_match_reference(self):
+        from deeplearning4j_tpu.ops.attention_pallas import flash_attention
+        q, k, v = self._rand(b=2, t=16, h=1, d=8, seed=8)
+        mask = np.ones((2, 16), np.float32)
+        mask[0, 11:] = 0.0
+        mask[1, :2] = 0.0
+        mj = jnp.asarray(mask)
+
+        def loss_fused(q, k, v):
+            o = flash_attention(q, k, v, mask=mj, block_q=8, block_k=8,
+                                interpret=True)
+            return (o * o).sum()
+
+        def loss_ref(q, k, v):
+            o = self._ref_masked(q, k, v, mask)
+            return (o * o).sum()
+
+        gf = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=1e-5)
+
+    def test_mask_dispatch_through_layer_api(self):
+        """dot_product_attention with a mask and the fused path forced on
+        (interpret) must agree with the naive path on valid positions."""
+        from deeplearning4j_tpu.ops.attention_pallas import flash_attention
+        from deeplearning4j_tpu.nn.layers.attention import \
+            dot_product_attention
+        q, k, v = self._rand(b=2, t=24, h=2, d=8, seed=9)
+        mask = np.ones((2, 24), np.float32)
+        mask[0, 20:] = 0.0
+        fused = flash_attention(q, k, v, mask=jnp.asarray(mask),
+                                block_q=8, block_k=8, interpret=True)
+        naive = dot_product_attention(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), mask=jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(naive),
                                    rtol=2e-5, atol=2e-6)
