@@ -126,9 +126,11 @@ def read_nd4j(stream_or_bytes) -> np.ndarray:
 def write_nd4j(arr: np.ndarray, f, order="c") -> None:
     """Nd4j.write-compatible serialization (f32 unless the array is f64)."""
     arr = np.asarray(arr)
+    if arr.ndim == 0:  # nd4j has no rank-0: scalars are length-1 vectors
+        arr = arr.reshape(1)
     typ = "DOUBLE" if arr.dtype == np.float64 else "FLOAT"
     rank = arr.ndim
-    shape = arr.shape if rank else (1,)
+    shape = arr.shape
     # strides in elements for the chosen order
     strides = [0] * len(shape)
     acc = 1
@@ -738,7 +740,6 @@ def write_multilayer_network(net: MultiLayerNetwork, path,
     confs = []
     name, lr, extra = _updater_json(conf.updater)
     segments = []
-    layer_kinds = []
     for layer, in_type, p, s in zip(conf.layers, types, net.params,
                                     net.state):
         kind, body = _layer_json(layer, in_type)
@@ -746,7 +747,6 @@ def write_multilayer_network(net: MultiLayerNetwork, path,
         body["learningRate"] = lr
         body.update(extra)
         confs.append({"layer": {kind: body}})
-        layer_kinds.append((kind, body))
         segments.extend(_flat_layer_params(layer, kind, p, s))
     cfg = {"backprop": True, "pretrain": False, "confs": confs}
     if conf.backprop_type == "tbptt":

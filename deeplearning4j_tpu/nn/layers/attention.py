@@ -83,11 +83,12 @@ def dot_product_attention(q, k, v, *, mask=None, causal=False, scale=None):
     if mask is not None:
         # mask: [B, Tk] -> key-side masking
         logits = jnp.where(mask[:, None, None, :] > 0, logits, -jnp.inf)
-    if causal or mask is not None:
+    if mask is not None:
         # fully-masked query rows (e.g. left padding under causal): softmax
         # over all -inf is NaN fwd AND bwd — substitute a finite row before
         # the softmax and zero its output after, matching the fused
-        # kernel's contract so dispatch choice never changes NaN behavior
+        # kernel's contract so dispatch choice never changes NaN behavior.
+        # (Pure-causal rows always see >= 1 valid key; no guard needed.)
         any_valid = (logits > -jnp.inf).any(axis=-1, keepdims=True)
         logits = jnp.where(any_valid, logits, 0.0)
         weights = jax.nn.softmax(logits, axis=-1)

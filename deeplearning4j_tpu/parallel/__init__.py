@@ -2,3 +2,4 @@ from deeplearning4j_tpu.parallel.mesh import make_mesh, MeshSpec  # noqa: F401
 from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer  # noqa: F401
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
 from deeplearning4j_tpu.parallel.pipeline import PipelineParallelLM  # noqa: F401
+from deeplearning4j_tpu.parallel.composed import ComposedParallelLM  # noqa: F401

@@ -53,9 +53,15 @@ def gpipe_schedule(block, n_micro, n_stages, remat=False):
     microbatch, the standard HBM-for-FLOPs trade for deep pipelines.
     """
 
-    def one_block(bp, h):
-        y, _ = block.apply(bp, {}, h)
-        return y
+    if callable(block) and not hasattr(block, "apply"):
+        # generalized entry: a plain ``bp, h -> y`` function (the composed
+        # dp x tp x pp facade passes a tensor-parallel block forward here)
+        def one_block(bp, h):
+            return block(bp, h)
+    else:
+        def one_block(bp, h):
+            y, _ = block.apply(bp, {}, h)
+            return y
 
     if remat:
         one_block = jax.checkpoint(one_block)

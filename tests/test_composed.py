@@ -1,0 +1,89 @@
+"""Composed dp x tp x pp facade tests (VERDICT r2 #4): one MeshSpec trains
+a transformer_lm-architecture model with data + tensor + pipeline
+parallelism at once, semantics-pinned against the sequential single-device
+computation (reference facade role: ParallelWrapper.java:58)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel import ComposedParallelLM, MeshSpec, make_mesh
+
+pytestmark = pytest.mark.slow  # 8-device mesh + jit of the full schedule
+
+
+def _data(rs, batch, seq, vocab):
+    ids = rs.randint(0, vocab, (batch, seq))
+    return jnp.asarray(ids), jnp.asarray(np.roll(ids, -1, axis=1))
+
+
+def _make(mesh, **kw):
+    cfg = dict(vocab_size=50, n_layers=4, d_model=32, n_heads=4,
+               seq_len=12, mesh=mesh, n_microbatches=2)
+    cfg.update(kw)
+    return ComposedParallelLM(**cfg).init()
+
+
+class TestComposedParallelLM:
+    def test_dp2_tp2_pp2_loss_matches_sequential(self, eight_devices):
+        """The headline composition: dp=2 x tp=2 x pp=2 on 8 devices, loss
+        exactly the sequential computation."""
+        mesh = make_mesh(MeshSpec(data=2, model=2, seq=1, stage=2),
+                         devices=eight_devices)
+        lm = _make(mesh)
+        rs = np.random.RandomState(0)
+        ids, labels = _data(rs, 8, 12, 50)
+        ref = float(lm.loss_reference(ids, labels))
+        loss = float(lm.step(ids, labels))
+        assert np.isfinite(loss)
+        np.testing.assert_allclose(loss, ref, rtol=2e-4)
+
+    def test_training_reduces_loss(self, eight_devices):
+        mesh = make_mesh(MeshSpec(data=2, model=2, seq=1, stage=2),
+                         devices=eight_devices)
+        lm = _make(mesh)
+        rs = np.random.RandomState(1)
+        ids, labels = _data(rs, 8, 12, 50)
+        losses = [float(lm.step(ids, labels)) for _ in range(12)]
+        assert losses[-1] < losses[0] * 0.9, losses
+
+    @pytest.mark.parametrize("spec", [
+        MeshSpec(data=8, model=1, seq=1, stage=1),   # pure dp
+        MeshSpec(data=1, model=4, seq=1, stage=2),   # tp x pp, no dp
+        MeshSpec(data=4, model=1, seq=1, stage=2),   # dp x pp
+        MeshSpec(data=1, model=2, seq=1, stage=4),   # deep pipeline + tp
+    ])
+    def test_other_compositions_match_sequential(self, eight_devices, spec):
+        mesh = make_mesh(spec, devices=eight_devices)
+        lm = _make(mesh)
+        rs = np.random.RandomState(2)
+        # batch 16: per-microbatch 8 divides every data-axis size used here
+        ids, labels = _data(rs, 16, 12, 50)
+        ref = float(lm.loss_reference(ids, labels))
+        loss = float(lm.step(ids, labels))
+        np.testing.assert_allclose(loss, ref, rtol=2e-4)
+
+    def test_tp_shards_are_actually_sharded(self, eight_devices):
+        """Weight memory really splits: each Wqkv shard holds H/tp heads
+        and each W1 shard hid/tp columns (not just replicated views)."""
+        mesh = make_mesh(MeshSpec(data=2, model=2, seq=1, stage=2),
+                         devices=eight_devices)
+        lm = _make(mesh)
+        wqkv = lm.params["blocks"]["Wqkv"]
+        shard_shapes = {tuple(s.data.shape) for s in wqkv.addressable_shards}
+        # global [4, 32, 3, 4, 8] -> per-device [2, 32, 3, 2, 8]
+        assert shard_shapes == {(2, 32, 3, 2, 8)}, shard_shapes
+        w1 = lm.params["blocks"]["W1"]
+        assert {tuple(s.data.shape) for s in w1.addressable_shards} == \
+            {(2, 32, 64)}  # hid 128 / tp 2
+
+    def test_remat_matches(self, eight_devices):
+        mesh = make_mesh(MeshSpec(data=2, model=2, seq=1, stage=2),
+                         devices=eight_devices)
+        lm = _make(mesh, remat=True)
+        rs = np.random.RandomState(3)
+        ids, labels = _data(rs, 8, 12, 50)
+        ref = float(lm.loss_reference(ids, labels))
+        np.testing.assert_allclose(float(lm.step(ids, labels)), ref,
+                                   rtol=2e-4)
