@@ -46,8 +46,14 @@ def _bottleneck(g, name, inp, filters, stride=(1, 1), project=False):
 
 
 def resnet50(height=224, width=224, channels=3, n_classes=1000, updater=None,
-             seed=12345):
-    g = GraphBuilder(updater=updater or U.Adam(learning_rate=1e-3), seed=seed)
+             seed=12345, checkpoint_scope=None):
+    """``checkpoint_scope="prefix"`` remats each bottleneck block during
+    backward (nn/graph.py scope-level checkpointing): only block-boundary
+    activations are stashed, the block interior recomputes. On v5e the
+    model is HBM-bandwidth-bound at 27% MXU (PROFILE.md) — trading idle
+    FLOPs for the activation-stash traffic is the MFU lever."""
+    g = GraphBuilder(updater=updater or U.Adam(learning_rate=1e-3), seed=seed,
+                     checkpoint_scope=checkpoint_scope)
     g.add_inputs("input")
     g.set_input_types(I.ConvolutionalType(height, width, channels))
 

@@ -365,6 +365,14 @@ class GraphConfiguration:
     seed: int = 12345
     # remat each vertex's forward during backprop: HBM for FLOPs
     gradient_checkpointing: bool = False
+    # coarser remat: group vertices sharing a name prefix (up to the first
+    # '_') into ONE jax.checkpoint region on the training path, so only
+    # block BOUNDARY activations are stashed for backward and everything
+    # inside a block (conv outputs, BN pre-activations) is recomputed.
+    # For an HBM-bound model (PROFILE.md: ResNet50 at v5e bandwidth peak)
+    # this trades idle-MXU FLOPs for the activation-stash traffic that
+    # bounds the step. "prefix" is the only mode; None disables.
+    checkpoint_scope: str | None = None
 
     def to_json(self, indent=2):
         return serde.to_json(self, indent=indent)
@@ -419,7 +427,7 @@ class GraphBuilder:
 
     def __init__(self, updater=None, seed=12345, gradient_normalization="none",
                  gradient_normalization_threshold=1.0,
-                 gradient_checkpointing=False):
+                 gradient_checkpointing=False, checkpoint_scope=None):
         self._inputs = []
         self._input_types = []
         self._vertices = []
@@ -429,6 +437,7 @@ class GraphBuilder:
         self._gn = gradient_normalization
         self._gnt = gradient_normalization_threshold
         self._remat = gradient_checkpointing
+        self._ckpt_scope = checkpoint_scope
 
     def add_inputs(self, *names):
         self._inputs.extend(names)
@@ -468,7 +477,8 @@ class GraphBuilder:
             updater=self._updater, seed=self._seed,
             gradient_normalization=self._gn,
             gradient_normalization_threshold=self._gnt,
-            gradient_checkpointing=self._remat)
+            gradient_checkpointing=self._remat,
+            checkpoint_scope=self._ckpt_scope)
         conf.topological_order()  # validate
         return conf
 
@@ -484,6 +494,8 @@ class ComputationGraph:
         self._defs = {v.name: v for v in conf.vertices}
         self._order = conf.topological_order()
         self._types = conf.vertex_types()
+        self._segments = (self._build_segments()
+                          if conf.checkpoint_scope == "prefix" else None)
         self.params = None
         self.state = None
         self.opt_state = None
@@ -508,6 +520,83 @@ class ComputationGraph:
         self.opt_state = self.conf.updater.init(params)
         return params, state
 
+    def _build_segments(self):
+        """Partition the topo order into checkpoint segments for the
+        ``checkpoint_scope="prefix"`` mode: a maximal contiguous run of >= 2
+        vertices sharing the name prefix before the first '_' becomes one
+        ("group", names, external_inputs, boundary_outputs) region; loss /
+        network-output vertices always stay singles. Only activations at
+        group boundaries are stashed for backward — the bottleneck-block
+        granularity ResNet-style graphs need (per-vertex jax.checkpoint
+        stores every vertex input and saves nothing)."""
+        dependents = {}
+        for v in self.conf.vertices:
+            for inp in v.inputs:
+                dependents.setdefault(inp, set()).add(v.name)
+
+        def scope_of(name):
+            if name in self.conf.outputs:
+                return None
+            v = self._defs[name]
+            layer = v.vertex.layer if isinstance(v.vertex, LayerVertex) \
+                else None
+            if layer is not None and hasattr(layer, "loss_from_features"):
+                return None
+            return name.split("_", 1)[0] if "_" in name else None
+
+        segments = []
+        i = 0
+        order = self._order
+        while i < len(order):
+            sc = scope_of(order[i])
+            j = i + 1
+            while sc is not None and j < len(order) \
+                    and scope_of(order[j]) == sc:
+                j += 1
+            if sc is None or j - i < 2:
+                segments.append(("single", order[i]))
+                i += 1
+                continue
+            names = order[i:j]
+            produced = set(names)
+            ext = []
+            for n in names:
+                for inp in self._defs[n].inputs:
+                    if inp not in produced and inp not in ext:
+                        ext.append(inp)
+            after = set(order[j:])
+            bnd = [n for n in names
+                   if n in self.conf.outputs
+                   or dependents.get(n, set()) & after]
+            segments.append(("group", tuple(names), tuple(ext), tuple(bnd)))
+            i = j
+        return segments
+
+    def _run_group(self, seg, params, state, acts, new_state, subs, mask,
+                   train):
+        """Execute one checkpoint group: recompute-in-backward region over
+        its member vertices. Only boundary outputs land in ``acts``."""
+        _, names, ext, bnd = seg
+
+        def run(gp, gs, ext_vals, subs_, m):
+            local = dict(zip(ext, ext_vals))
+            ns = {}
+            for k, n in enumerate(names):
+                v = self._defs[n]
+                xs = [local[i] for i in v.inputs]
+                local[n], ns[n] = v.vertex.apply(gp[n], gs[n], xs,
+                                                 train=train, rng=subs_[k],
+                                                 mask=m)
+            return [local[n] for n in bnd], ns
+
+        run = jax.checkpoint(run)
+        outs, ns = run({n: params[n] for n in names},
+                       {n: state[n] for n in names},
+                       [acts[i] for i in ext], subs, mask)
+        for n, val in zip(bnd, outs):
+            acts[n] = val
+        new_state.update(ns)
+
     def _forward_pass(self, params, state, inputs, *, train=False, rng=None,
                       mask=None, labels=None, label_masks=None):
         """THE single topological traversal all forward entry points share.
@@ -519,7 +608,25 @@ class ComputationGraph:
         acts = dict(inputs)
         new_state = dict(state)
         loss = 0.0 if labels is not None else None
-        for name in self._order:
+        # scope-level remat applies on the loss/training path only —
+        # feed_forward()'s contract (an activation for EVERY vertex) needs
+        # the ungrouped traversal, and there is no backward there anyway
+        use_groups = self._segments is not None and labels is not None
+        walk = (self._segments if use_groups
+                else [("single", n) for n in self._order])
+        for seg in walk:
+            if seg[0] == "group":
+                subs = []
+                for _ in seg[1]:
+                    if rng is not None:
+                        rng, sub = jax.random.split(rng)
+                        subs.append(sub)
+                    else:
+                        subs.append(None)
+                self._run_group(seg, params, state, acts, new_state,
+                                tuple(subs), mask, train)
+                continue
+            name = seg[1]
             v = self._defs[name]
             xs = [acts[i] for i in v.inputs]
             if rng is not None:

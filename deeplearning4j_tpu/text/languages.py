@@ -204,7 +204,10 @@ class ChineseTokenizerFactory(_CjkTokenizerFactoryBase):
 
 class JapaneseTokenizerFactory(_CjkTokenizerFactoryBase):
     """Reference: deeplearning4j-nlp-japanese JapaneseTokenizerFactory
-    (kuromoji). Heuristic morphology in place of the full analyzer:
+    (kuromoji). Default mode="lattice" runs the Viterbi lattice
+    morphological analyzer (text/ja_lattice.py — dictionary + unknown-word
+    invocation + connection-cost Viterbi, the kuromoji design
+    self-contained). mode="maxmatch" keeps the round-2 heuristic:
 
     * a short hiragana tail (<=2 chars) directly after a kanji run attaches
       to the kanji token (okurigana: 食べ, 思い);
@@ -217,7 +220,31 @@ class JapaneseTokenizerFactory(_CjkTokenizerFactoryBase):
 
     OKURIGANA_MAX = 2
 
+    def __init__(self, lexicon=None, preprocessor=None, max_word_len=8,
+                 mode="lattice", use_default_lexicon=True):
+        super().__init__(lexicon=lexicon, preprocessor=preprocessor,
+                         max_word_len=max_word_len,
+                         use_default_lexicon=use_default_lexicon)
+        # lexicon-free segmentation (use_default_lexicon=False) is
+        # inherently the heuristic path — a lattice without its bundled
+        # dictionary cannot run, so that request selects maxmatch mode
+        # (where max_word_len / self.lexicon keep their round-2 contract)
+        self.mode = mode if use_default_lexicon else "maxmatch"
+        # user-supplied words feed the lattice as mid-cost noun entries
+        self._user_lexicon = set(lexicon) if lexicon else None
+
     def create(self, text: str) -> Tokenizer:
+        if self.mode == "lattice":
+            from deeplearning4j_tpu.text import ja_lattice
+            tokens = ja_lattice.tokenize(
+                text, user_entries=self._user_lexicon)
+            if self.preprocessor is not None:
+                tokens = [self.preprocessor.pre_process(t) for t in tokens]
+                tokens = [t for t in tokens if t]
+            return Tokenizer(tokens)
+        return self._create_maxmatch(text)
+
+    def _create_maxmatch(self, text: str) -> Tokenizer:
         runs = self._runs(text)
         tokens = []
         i = 0

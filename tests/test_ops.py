@@ -165,9 +165,9 @@ class TestFusedLstmKernel:
             (8, 16, 32), 128, **{**ok, "mask": np.ones((8, 16))})
         assert not lstm_pallas.supported(
             (8, 16, 32), 128, **{**ok, "activation": "relu"})
-        # measured upper bound: H>512 loses to XLA's scan (and the resident
-        # Wh block VMEM-OOMs at H=2048)
-        assert not lstm_pallas.supported((8, 16, 32), 1024, **ok)
+        # H>512 now dispatches to the tiled-Wh kernel (TestTiledLstmKernel);
+        # resident-kernel boundary stays at 512
+        assert lstm_pallas.supported((8, 16, 32), 1024, **ok)
         assert lstm_pallas.supported((8, 16, 32), 512, **ok)
 
     def test_padded_dispatch_matches_unpadded_exactly(self):
@@ -203,6 +203,62 @@ class TestFusedLstmKernel:
         layer = L.LSTM(n_out=128)
         x = jnp.zeros((8, 4, 16))
         assert not layer._fused_eligible(x, None)
+
+
+class TestTiledLstmKernel:
+    """Large-H variant (H > _RESIDENT_MAX_H streams Wh column tiles —
+    VERDICT r2 #5, reference: CudnnLSTMHelper had no hidden-size cap).
+    Interpret mode on CPU; small T/B keep it tractable."""
+
+    def test_forward_matches_scan_h1024(self):
+        xz, wh, h0, c0 = _inputs(T=2, B=8, H=1024, seed=11)
+        hs_f, (hT_f, cT_f) = lstm_pallas.lstm_fused_sequence(
+            xz, wh, h0, c0, True)
+        hs_r, (hT_r, cT_r) = _ref_scan(xz, wh, h0, c0)
+        np.testing.assert_allclose(np.asarray(hs_f), np.asarray(hs_r),
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(cT_f), np.asarray(cT_r),
+                                   atol=1e-4)
+
+    def test_tiled_kernel_actually_selected(self):
+        # the dispatch boundary: resident path at 512, tiled above
+        assert lstm_pallas._RESIDENT_MAX_H == 512
+        assert lstm_pallas.supported((8, 4, 64), 1024, peephole=False,
+                                     mask=None, gate_activation="sigmoid",
+                                     activation="tanh")
+        assert lstm_pallas.supported((8, 4, 64), 2048, peephole=False,
+                                     mask=None, gate_activation="sigmoid",
+                                     activation="tanh")
+        # peephole stays scan-path above the resident bound
+        assert not lstm_pallas.supported((8, 4, 64), 1024, peephole=True,
+                                         mask=None,
+                                         gate_activation="sigmoid",
+                                         activation="tanh")
+        # VMEM gate: very large B x H combinations refuse
+        assert not lstm_pallas.supported((512, 4, 64), 2048, peephole=False,
+                                         mask=None,
+                                         gate_activation="sigmoid",
+                                         activation="tanh")
+
+    def test_gradients_match_scan_h640(self):
+        # H=640 > 512 exercises the tiled path with a non-tile-multiple 4H
+        # (2560 -> tile 1024 doesn't divide): pad_hidden keeps H at 640
+        # (128-multiple) and the runner clamps the tile to a divisor
+        xz, wh, h0, c0 = _inputs(T=2, B=8, H=640, seed=12)
+
+        def loss_fused(*a):
+            hs, (hT, cT) = lstm_pallas.lstm_fused_sequence(*a, True)
+            return (hs * hs).sum() + (hT * cT).sum()
+
+        def loss_ref(*a):
+            hs, (hT, cT) = _ref_scan(*a)
+            return (hs * hs).sum() + (hT * cT).sum()
+
+        gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(xz, wh, h0, c0)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(xz, wh, h0, c0)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-4)
 
 
 class TestFlashAttention:

@@ -200,12 +200,71 @@ class TestLanguagePacks:
 
     def test_japanese_okurigana_attachment(self):
         from deeplearning4j_tpu.text.languages import JapaneseTokenizerFactory
-        # 食べます: kanji 食 + hiragana べます(3) -> no attach; 食べ + る...
-        toks = JapaneseTokenizerFactory(use_default_lexicon=False).create(
+        # the heuristic mode's signature behavior (the lattice mode instead
+        # produces the morphological 食べ/た split, tested below)
+        toks = JapaneseTokenizerFactory(use_default_lexicon=False,
+                                        mode="maxmatch").create(
             "肉を食べた").get_tokens()
         # 食 + short tail べた (2 chars) attaches as okurigana
         assert "食べた" in toks
         assert "を" in toks           # particle preserved
+
+    def test_japanese_lattice_goldens(self):
+        """Curated golden segmentations for the Viterbi lattice analyzer
+        (VERDICT r2 #9; reference role: kuromoji). Goldens follow
+        kuromoji-style morphology: particles split off, verb stems split
+        from inflections, te-forms kept as conjugated units, katakana
+        loanword runs whole."""
+        from deeplearning4j_tpu.text.ja_lattice import tokenize
+        goldens = {
+            "私は学生です": ["私", "は", "学生", "です"],
+            "東京に行きました": ["東京", "に", "行き", "ました"],
+            "猫が魚を食べた": ["猫", "が", "魚", "を", "食べ", "た"],
+            "彼女は本を読んでいます":
+                ["彼女", "は", "本", "を", "読んで", "います"],
+            "今日はとても暑いですね":
+                ["今日", "は", "とても", "暑い", "です", "ね"],
+            "データを使って新しいモデルを作りました":
+                ["データ", "を", "使って", "新しい", "モデル", "を",
+                 "作り", "ました"],
+            "日本で働いています": ["日本", "で", "働いて", "います"],
+            "問題がありました": ["問題", "が", "ありました"],
+            "ありがとうございます": ["ありがとうございます"],
+            "先生と学生が学校で話しています":
+                ["先生", "と", "学生", "が", "学校", "で", "話して",
+                 "います"],
+        }
+        wrong = {t: tokenize(t) for t, want in goldens.items()
+                 if tokenize(t) != want}
+        # segmentation accuracy over the golden suite: require exact match
+        assert not wrong, wrong
+
+    def test_japanese_lattice_unknown_words(self):
+        from deeplearning4j_tpu.text.ja_lattice import tokenize
+        # katakana loanword run not in the dictionary stays whole
+        assert "ラーメン" in tokenize("ラーメンを食べた")
+        # latin + digits stay whole
+        toks = tokenize("GPT4は強い")
+        assert "GPT" in toks and "4" in toks or "GPT4" in toks
+        # empty + whitespace robustness
+        assert tokenize("") == []
+        assert tokenize("   ") == []
+
+    def test_japanese_lattice_user_entries(self):
+        from deeplearning4j_tpu.text.ja_lattice import tokenize
+        base = tokenize("深層学習は難しい")
+        assert "深層学習" not in base      # not in the bundled dictionary
+        toks = tokenize("深層学習は難しい", user_entries=["深層学習"])
+        assert toks[:2] == ["深層学習", "は"]
+
+    def test_japanese_factory_lattice_default(self):
+        from deeplearning4j_tpu.text.languages import JapaneseTokenizerFactory
+        f = JapaneseTokenizerFactory()
+        assert f.create("私は学生です").get_tokens() == \
+            ["私", "は", "学生", "です"]
+        # user lexicon flows into the lattice
+        f2 = JapaneseTokenizerFactory(lexicon=["深層学習"])
+        assert "深層学習" in f2.create("深層学習の本").get_tokens()
 
     def test_korean_josa_stripping(self):
         from deeplearning4j_tpu.text.languages import KoreanTokenizerFactory
