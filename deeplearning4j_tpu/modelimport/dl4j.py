@@ -274,7 +274,8 @@ def _layer_from_json(kind: str, body: dict):
         return L.RnnLossLayer(loss=_loss(body),
                               activation=_activation(body, "identity"))
     if k == "embedding":
-        return L.EmbeddingLayer(n_out=n_out, **_common(body))
+        return L.EmbeddingLayer(n_in=int(_ci(body, "nIn", default=0) or 0),
+                                n_out=n_out, **_common(body))
     if k == "autoencoder":
         return L.AutoEncoder(n_out=n_out, **_common(body))
     if k in ("convolution", "convolution2d"):
@@ -297,7 +298,8 @@ def _layer_from_json(kind: str, body: dict):
             decay=float(_ci(body, "decay", default=0.9) or 0.9),
             eps=float(_ci(body, "eps", default=1e-5) or 1e-5),
             use_gamma_beta=not bool(_ci(body, "lockGammaBeta",
-                                        default=False)))
+                                        default=False)),
+            activation=_activation(body, "identity"))
     if k == "localresponsenormalization":
         return L.LocalResponseNormalization(
             n=int(_ci(body, "n", default=5) or 5),
@@ -525,6 +527,258 @@ def params_from_flat(conf, layers_json, flat):
 
 
 # ---------------------------------------------------------------------------
+# ComputationGraph configs (the format every zoo pretrainedUrl zip uses —
+# ResNet50.java etc. are graphs)
+# ---------------------------------------------------------------------------
+
+
+def _vertex_from_json(kind: str, body: dict):
+    """One GraphVertex JSON (wrapper-object per GraphVertex.java:39-56) ->
+    (my vertex object | layer, layer_json_or_None)."""
+    from deeplearning4j_tpu.nn import graph as G
+    k = kind.lower()
+    if k == "layervertex":
+        layer_conf = _ci(body, "layerConf") or {}
+        layer = layer_conf.get("layer")
+        if not isinstance(layer, dict) or len(layer) != 1:
+            raise Dl4jImportError(f"malformed LayerVertex body: {body!r}")
+        (lk, lb), = layer.items()
+        pre = _ci(body, "preProcessor")
+        if pre is not None:
+            pcls = str(pre.get("@class", "") or next(iter(pre), "")
+                       if isinstance(pre, dict) else pre).lower()
+            if "cnntofeedforward" not in pcls:
+                # rank adaption is implicit here for the common cases; an
+                # unknown preprocessor means silently-wrong numerics, so
+                # refuse loudly instead
+                raise Dl4jImportError(
+                    f"LayerVertex preprocessor {pre!r} unsupported")
+        return _layer_from_json(lk, lb), (lk, lb, pre)
+    if k == "mergevertex":
+        return G.MergeVertex(), None
+    if k == "elementwisevertex":
+        op = str(_ci(body, "op", default="Add")).lower()
+        return G.ElementWiseVertex(op={"add": "add", "subtract": "subtract",
+                                       "product": "product",
+                                       "average": "average",
+                                       "max": "max"}.get(op, "add")), None
+    if k == "subsetvertex":
+        return G.SubsetVertex(from_idx=int(_ci(body, "from", default=0)),
+                              to_idx=int(_ci(body, "to", default=0))), None
+    if k == "stackvertex":
+        return G.StackVertex(), None
+    if k == "unstackvertex":
+        return G.UnstackVertex(index=int(_ci(body, "from", default=0)),
+                               stack_size=int(_ci(body, "stackSize",
+                                                  default=1))), None
+    if k == "scalevertex":
+        return G.ScaleVertex(factor=float(_ci(body, "scaleFactor",
+                                              default=1.0))), None
+    if k == "shiftvertex":
+        return G.ShiftVertex(amount=float(_ci(body, "shiftFactor",
+                                              default=0.0))), None
+    if k == "l2normalizevertex":
+        return G.L2NormalizeVertex(), None
+    if k == "l2vertex":
+        return G.L2Vertex(), None
+    if k == "poolhelpervertex":
+        return G.PoolHelperVertex(), None
+    if k == "lasttimestepvertex":
+        return G.LastTimeStepVertex(), None
+    if k == "duplicatetotimeseriesvertex":
+        # T resolves from the named reference input at build time; the
+        # importer leaves the default and relies on shape inference usage
+        return G.DuplicateToTimeSeriesVertex(), None
+    if k == "preprocessorvertex":
+        # map the common preprocessor classes onto the explicit-conversion
+        # vertex; anything else defers to this framework's implicit rank
+        # adaption (nn/conf/inputs.py) via a cnn_to_ff-style no-op
+        pre = _ci(body, "preProcessor") or {}
+        pcls = ""
+        if isinstance(pre, dict):
+            pcls = str(pre.get("@class", "") or next(iter(pre), ""))
+        pl = pcls.lower()
+        if "cnntofeedforward" in pl:
+            return G.PreprocessorVertex(kind="cnn_to_ff"), None
+        if "feedforwardtocnn" in pl:
+            return G.PreprocessorVertex(
+                kind="ff_to_cnn",
+                height=int(_ci(pre, "inputHeight", default=0) or 0),
+                width=int(_ci(pre, "inputWidth", default=0) or 0),
+                channels=int(_ci(pre, "numChannels", default=0) or 0)), None
+        if "rnntofeedforward" in pl:
+            return G.PreprocessorVertex(kind="rnn_to_ff"), None
+        if "cnntornn" in pl:
+            return G.PreprocessorVertex(kind="cnn_to_rnn"), None
+        raise Dl4jImportError(
+            f"unsupported PreprocessorVertex preprocessor {pcls!r}")
+    raise Dl4jImportError(f"unsupported DL4J graph vertex type {kind!r}")
+
+
+def _reference_topo_order(inputs, vertex_names, vertex_inputs):
+    """Kahn FIFO exactly as ComputationGraph.topologicalSortOrder:1194 —
+    indices assigned inputs-first then JSON map order, seeds and edge
+    releases processed in ascending index order — because the FLAT PARAM
+    VECTOR is laid out in this order (ComputationGraph.java:455-463)."""
+    names = list(inputs) + list(vertex_names)
+    idx_of = {n: i for i, n in enumerate(names)}
+    in_edges = {i: set() for i in range(len(names))}
+    out_edges = {i: set() for i in range(len(names))}
+    for v, ins in vertex_inputs.items():
+        for s in ins:
+            in_edges[idx_of[v]].add(idx_of[s])
+            out_edges[idx_of[s]].add(idx_of[v])
+    queue = [i for i in range(len(names)) if not in_edges[i]]
+    out = []
+    while queue:
+        nxt = queue.pop(0)
+        out.append(nxt)
+        for v in sorted(out_edges[nxt]):
+            in_edges[v].discard(nxt)
+            if not in_edges[v]:
+                queue.append(v)
+    if len(out) != len(names):
+        raise Dl4jImportError("cycle in graph config")
+    return [names[i] for i in out if names[i] not in set(inputs)]
+
+
+def read_graph_config(config_json, input_type=None):
+    """ComputationGraphConfiguration JSON -> (GraphConfiguration,
+    {vertex_name: (kind, layer_body) or None}, param_order)."""
+    from deeplearning4j_tpu.nn.graph import GraphBuilder
+    cfg = (json.loads(config_json) if isinstance(config_json, str)
+           else config_json)
+    vertices = cfg.get("vertices")
+    if vertices is None:
+        raise Dl4jImportError("not a ComputationGraphConfiguration "
+                              "(no 'vertices')")
+    net_inputs = cfg.get("networkInputs", [])
+    net_outputs = cfg.get("networkOutputs", [])
+    vertex_inputs = cfg.get("vertexInputs", {})
+
+    layer_bodies = {}
+    built = {}
+    first_layer_body = None
+    for name, wrapped in vertices.items():
+        if not isinstance(wrapped, dict) or len(wrapped) != 1:
+            raise Dl4jImportError(f"malformed vertex entry {name!r}")
+        (kind, body), = wrapped.items()
+        obj, lb = _vertex_from_json(kind, body)
+        built[name] = obj
+        layer_bodies[name] = lb
+        if lb is not None and first_layer_body is None:
+            first_layer_body = lb
+
+    if input_type is None:
+        if first_layer_body is None:
+            raise Dl4jImportError("graph has no layers; pass input_type=")
+        input_type = _infer_input_type([first_layer_body],
+                                       cfg.get("inputPreProcessors"), None)
+
+    g = GraphBuilder()
+    g.add_inputs(*net_inputs)
+    types = input_type if isinstance(input_type, (list, tuple)) \
+        else [input_type] * len(net_inputs)
+    g.set_input_types(*types)
+    from deeplearning4j_tpu.nn.layers.base import Layer as _Layer
+    for name, obj in built.items():
+        ins = vertex_inputs.get(name, [])
+        if isinstance(obj, _Layer):
+            g.add_layer(name, obj, *ins)
+        else:
+            g.add_vertex(name, obj, *ins)
+    g.set_outputs(*net_outputs)
+    if first_layer_body is not None:
+        # network-wide updater from the first layer conf (same convention
+        # as the MLN reader)
+        g._updater = _updater_from_conf(first_layer_body[1])
+    conf = g.build()
+    order = _reference_topo_order(net_inputs, list(vertices), vertex_inputs)
+    return conf, layer_bodies, order
+
+
+def _install_params(target_p, target_s, imported_p, imported_s, label):
+    """Shape-checked install of one layer's imported params/state into the
+    initialized pytrees (shared by the MLN and CG restore paths)."""
+    for key, arr in imported_p.items():
+        if key not in target_p:
+            # the DL4J format always stores a bias; a has_bias=False layer
+            # here has no slot — an all-zero import is exactly equivalent,
+            # anything else would silently change the model
+            if np.all(arr == 0):
+                continue
+            raise Dl4jImportError(
+                f"{label}: zip stores non-zero {key!r} but the model layer "
+                f"has no such parameter (params: {sorted(target_p)})")
+        want = tuple(np.shape(target_p[key]))
+        if tuple(arr.shape) != want:
+            raise Dl4jImportError(
+                f"{label} param {key!r}: zip has {arr.shape}, model needs "
+                f"{want}")
+        target_p[key] = jnp.asarray(arr)
+    for key, arr in imported_s.items():
+        target_s[key] = jnp.asarray(arr)
+
+
+def _cnn_flatten_permutation(h, w, c):
+    """Row permutation taking DL4J's CnnToFeedForwardPreProcessor flatten
+    (NCHW activations, channel-major: index = c*H*W + h*W + w) to this
+    framework's NHWC flatten (index = h*W*C + w*C + c). Same transform as
+    the Keras importer's channels_first handling."""
+    return np.arange(c * h * w).reshape(c, h, w).transpose(1, 2, 0) \
+        .reshape(-1)
+
+
+def restore_computation_graph(path, input_type=None):
+    """restoreComputationGraph (ModelSerializer.java) for this framework:
+    flat params slice in the REFERENCE's topological order (emulated in
+    _reference_topo_order) since that is the layout the zips store."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    with zipfile.ZipFile(path) as zf:
+        names = set(zf.namelist())
+        cfg = json.loads(zf.read("configuration.json").decode("utf-8"))
+        conf, layer_bodies, order = read_graph_config(cfg, input_type)
+        if "coefficients.bin" not in names:
+            raise Dl4jImportError("zip has no coefficients.bin")
+        flat = read_nd4j(zf.read("coefficients.bin")).reshape(-1) \
+            .astype(np.float32)
+        net = ComputationGraph(conf)
+        net.init()
+        pos = 0
+        new_p = dict(net.params)
+        new_s = dict(net.state)
+        for vname in order:
+            lb = layer_bodies.get(vname)
+            if lb is None:
+                continue
+            kind, body, pre = lb
+            # input type for BN feature count: my CG's inferred vertex
+            # input types
+            vdef = net._defs[vname]
+            in_t = net._types[vdef.inputs[0]] if vdef.inputs else None
+            layer = vdef.vertex.layer
+            p, s, pos = _split_layer_params(layer, kind, body, in_t, flat,
+                                            pos)
+            if pre is not None and "W" in p and p["W"].ndim == 2:
+                # CnnToFeedForward LayerVertex preprocessor: the dense
+                # weight rows are stored in DL4J's channel-major CHW
+                # flatten; re-order to this framework's HWC flatten
+                from deeplearning4j_tpu.nn.conf import inputs as _I
+                if isinstance(in_t, _I.ConvolutionalType):
+                    perm = _cnn_flatten_permutation(
+                        in_t.height, in_t.width, in_t.channels)
+                    if p["W"].shape[0] == perm.size:
+                        p["W"] = np.ascontiguousarray(p["W"][perm])
+            _install_params(new_p[vname], new_s[vname], p, s,
+                            f"vertex {vname!r}")
+        if pos != flat.size:
+            raise Dl4jImportError(
+                f"flat params length {flat.size} != consumed {pos}")
+        net.params, net.state = new_p, new_s
+        return net
+
+
+# ---------------------------------------------------------------------------
 # zip restore / write
 # ---------------------------------------------------------------------------
 
@@ -542,9 +796,11 @@ def restore_multilayer_network(path, input_type=None,
         cfg_raw = zf.read("configuration.json").decode("utf-8")
         cfg = json.loads(cfg_raw)
         if "confs" not in cfg:
-            raise Dl4jImportError(
-                "ComputationGraph zips are not supported yet "
-                "(no 'confs' key — this looks like a graph config)")
+            if "vertices" in cfg:
+                raise Dl4jImportError(
+                    "this is a ComputationGraph zip — use "
+                    "restore_computation_graph")
+            raise Dl4jImportError("unrecognized configuration.json")
         conf, layers_json = read_multilayer_config(cfg, input_type)
         if "coefficients.bin" not in names:
             raise Dl4jImportError("zip has no coefficients.bin")
@@ -556,15 +812,7 @@ def restore_multilayer_network(path, input_type=None,
         new_p = list(net.params)
         new_s = list(net.state)
         for i, (p, s) in enumerate(zip(params, states)):
-            for key, arr in p.items():
-                want = tuple(np.shape(new_p[i][key]))
-                if tuple(arr.shape) != want:
-                    raise Dl4jImportError(
-                        f"layer {i} param {key!r}: zip has {arr.shape}, "
-                        f"model needs {want}")
-                new_p[i][key] = jnp.asarray(arr)
-            for key, arr in s.items():
-                new_s[i][key] = jnp.asarray(arr)
+            _install_params(new_p[i], new_s[i], p, s, f"layer {i}")
         net.params, net.state = new_p, new_s
         if load_updater and "updaterState.bin" in names:
             net.dl4j_updater_state = read_nd4j(zf.read("updaterState.bin"))
@@ -697,15 +945,23 @@ def _flat_layer_params(layer, kind, params, state):
     k = kind.lower()
     out = []
     get = lambda key: np.asarray(params[key], np.float32)
+
+    def bias(n):
+        # the DL4J format always stores a bias; a has_bias=False layer
+        # exports zeros (reads back as an explicit zero bias — identical
+        # outputs)
+        return (get("b") if "b" in params else np.zeros((n,), np.float32))
+
     if k in ("dense", "output", "rnnoutput", "embedding", "autoencoder"):
-        out.append(np.ravel(get("W"), order="F"))
-        out.append(np.ravel(get("b"), order="C"))
+        W = get("W")
+        out.append(np.ravel(W, order="F"))
+        out.append(np.ravel(bias(W.shape[1]), order="C"))
         if k == "autoencoder":
             out.append(np.ravel(get("vb"), order="C"))
     elif k == "convolution":
-        out.append(np.ravel(get("b"), order="C"))
-        w = get("W").transpose(3, 2, 0, 1)  # HWIO -> OIHW
-        out.append(np.ravel(w, order="C"))
+        w = get("W")
+        out.append(np.ravel(bias(w.shape[3]), order="C"))
+        out.append(np.ravel(w.transpose(3, 2, 0, 1), order="C"))  # ->OIHW
     elif k == "batchnormalization":
         if "gamma" in params:
             out.append(get("gamma"))
@@ -727,6 +983,82 @@ def _flat_layer_params(layer, kind, params, state):
         out.append(np.ravel(wh, order="F"))
         out.append(np.ravel(get("b")[inv], order="C"))
     return out
+
+
+def _vertex_json(vertex):
+    """My vertex object -> (kind, DL4J-field body)."""
+    from deeplearning4j_tpu.nn import graph as G
+    if isinstance(vertex, G.MergeVertex):
+        return "MergeVertex", {}
+    if isinstance(vertex, G.ElementWiseVertex):
+        return "ElementWiseVertex", {"op": vertex.op.capitalize()}
+    if isinstance(vertex, G.SubsetVertex):
+        return "SubsetVertex", {"from": vertex.from_idx,
+                                "to": vertex.to_idx}
+    if isinstance(vertex, G.StackVertex):
+        return "StackVertex", {}
+    if isinstance(vertex, G.UnstackVertex):
+        return "UnstackVertex", {"from": vertex.index,
+                                 "stackSize": vertex.stack_size}
+    if isinstance(vertex, G.ScaleVertex):
+        return "ScaleVertex", {"scaleFactor": vertex.factor}
+    if isinstance(vertex, G.ShiftVertex):
+        return "ShiftVertex", {"shiftFactor": vertex.amount}
+    if isinstance(vertex, G.L2NormalizeVertex):
+        return "L2NormalizeVertex", {}
+    if isinstance(vertex, G.L2Vertex):
+        return "L2Vertex", {}
+    if isinstance(vertex, G.PoolHelperVertex):
+        return "PoolHelperVertex", {}
+    if isinstance(vertex, G.LastTimeStepVertex):
+        return "LastTimeStepVertex", {}
+    if isinstance(vertex, G.DuplicateToTimeSeriesVertex):
+        return "DuplicateToTimeSeriesVertex", {}
+    raise Dl4jImportError(
+        f"cannot export vertex {type(vertex).__name__}")
+
+
+def write_computation_graph(net, path) -> None:
+    """ModelSerializer.writeModel for a ComputationGraph: vertices map +
+    vertexInputs + flat params in the reference's topological order."""
+    from deeplearning4j_tpu.nn.graph import LayerVertex
+    conf = net.conf
+    name_upd, lr, extra = _updater_json(conf.updater)
+    vertices = {}
+    vertex_inputs = {}
+    for v in conf.vertices:
+        vertex_inputs[v.name] = list(v.inputs)
+        if isinstance(v.vertex, LayerVertex):
+            in_t = net._types[v.inputs[0]] if v.inputs else None
+            kind, body = _layer_json(v.vertex.layer, in_t)
+            body["updater"] = name_upd
+            body["learningRate"] = lr
+            body.update(extra)
+            vertices[v.name] = {"LayerVertex": {"layerConf": {
+                "layer": {kind: body}}}}
+        else:
+            vk, vb = _vertex_json(v.vertex)
+            vertices[v.name] = {vk: vb}
+    cfg = {"networkInputs": list(conf.inputs),
+           "networkOutputs": list(conf.outputs),
+           "vertices": vertices, "vertexInputs": vertex_inputs}
+    order = _reference_topo_order(conf.inputs, list(vertices),
+                                  vertex_inputs)
+    segments = []
+    for vname in order:
+        v = net._defs[vname]
+        if isinstance(v.vertex, LayerVertex):
+            in_t = net._types[v.inputs[0]] if v.inputs else None
+            kind, body = _layer_json(v.vertex.layer, in_t)
+            segments.extend(_flat_layer_params(
+                v.vertex.layer, kind, net.params[vname], net.state[vname]))
+    flat = (np.concatenate(segments) if segments
+            else np.zeros((0,), np.float32))
+    buf = io.BytesIO()
+    write_nd4j(flat.reshape(1, -1), buf)
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("configuration.json", json.dumps(cfg, indent=2))
+        zf.writestr("coefficients.bin", buf.getvalue())
 
 
 def write_multilayer_network(net: MultiLayerNetwork, path,

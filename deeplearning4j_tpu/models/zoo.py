@@ -68,7 +68,19 @@ class ZooModel:
         url, md5 = self.pretrained[pretrained_type]
         relpath = os.path.join("zoo", f"{self.name}_{pretrained_type}.zip")
         path = _cache.ensure_file(relpath, url=url, md5=md5)
-        return restore_checkpoint(path)
+        # DL4J graph configs carry no input shape (setInputTypes is not
+        # serialized in the 0.9 format) — the registry's own builder knows
+        # it, so CNN zips restore without the caller supplying dims
+        return restore_checkpoint(path, input_type=self._default_input_type())
+
+    def _default_input_type(self):
+        try:
+            conf = self.builder()
+            if self.graph:
+                return conf.input_types[0] if conf.input_types else None
+            return conf.input_type
+        except Exception:
+            return None
 
 
 def restore_checkpoint(path, input_type=None):
@@ -77,13 +89,18 @@ def restore_checkpoint(path, input_type=None):
     ``coefficients.bin`` — what every zoo ``pretrainedUrl`` serves,
     ZooModel.java:40-52) goes through modelimport.dl4j; this framework's
     own layout goes through utils.serialization."""
+    import json
     import zipfile
     with zipfile.ZipFile(path) as zf:
         names = set(zf.namelist())
-    if "configuration.json" in names and "coefficients.bin" in names:
-        from deeplearning4j_tpu.modelimport.dl4j import \
-            restore_multilayer_network
-        return restore_multilayer_network(path, input_type=input_type)
+        cfg = (json.loads(zf.read("configuration.json").decode("utf-8"))
+               if "configuration.json" in names else None)
+    if cfg is not None and "coefficients.bin" in names:
+        from deeplearning4j_tpu.modelimport import dl4j
+        if "vertices" in cfg:  # graph zips — what the zoo URLs serve
+            return dl4j.restore_computation_graph(path,
+                                                  input_type=input_type)
+        return dl4j.restore_multilayer_network(path, input_type=input_type)
     from deeplearning4j_tpu.utils.serialization import load_model
     return load_model(path)
 

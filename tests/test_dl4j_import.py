@@ -325,6 +325,18 @@ class TestDl4jSemanticsPin:
                                    np.asarray(net2.output(jnp.asarray(x))),
                                    rtol=1e-6)
 
+    def test_mln_reader_rejects_graph_zip(self, tmp_path):
+        """MLN reader refuses graph zips with a pointer to the CG reader."""
+        import json
+        import zipfile
+        cfg = {"networkInputs": ["in"], "networkOutputs": ["out"],
+               "vertices": {}, "vertexInputs": {}}
+        p = tmp_path / "graph.zip"
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("configuration.json", json.dumps(cfg))
+        with pytest.raises(dl4j.Dl4jImportError, match="ComputationGraph"):
+            dl4j.restore_multilayer_network(p)
+
     def test_length_mismatch_raises(self, tmp_path):
         import json
         import zipfile
@@ -339,3 +351,190 @@ class TestDl4jSemanticsPin:
             zf.writestr("coefficients.bin", buf.getvalue())
         with pytest.raises(dl4j.Dl4jImportError):
             dl4j.restore_multilayer_network(p)
+
+
+class TestComputationGraphZips:
+    """DL4J ComputationGraph zip import/export — the format every zoo
+    pretrainedUrl serves (ResNet50.java etc. are graphs). Param layout
+    follows the reference's topological order
+    (ComputationGraph.java:455-463), emulated in _reference_topo_order."""
+
+    def _residual_graph(self):
+        from deeplearning4j_tpu.nn.graph import (ComputationGraph,
+                                                 ElementWiseVertex,
+                                                 GraphBuilder)
+        g = (GraphBuilder(updater=U.Adam(1e-3), seed=9)
+             .add_inputs("in")
+             .set_input_types(I.convolutional(8, 8, 3))
+             .add_layer("c1", L.ConvolutionLayer(n_out=4, kernel=(3, 3),
+                                                 padding="same",
+                                                 activation="relu"), "in")
+             .add_layer("bn1", L.BatchNormalization(), "c1")
+             .add_layer("c2", L.ConvolutionLayer(n_out=4, kernel=(3, 3),
+                                                 padding="same"), "bn1")
+             .add_vertex("add", ElementWiseVertex(op="add"), "c2", "bn1")
+             .add_layer("relu", L.ActivationLayer(activation="relu"), "add")
+             .add_layer("pool", L.GlobalPoolingLayer(mode="avg"), "relu")
+             .add_layer("out", L.OutputLayer(n_out=3, activation="softmax",
+                                             loss="mcxent"), "pool"))
+        g.set_outputs("out")
+        net = ComputationGraph(g.build())
+        net.init()
+        return net
+
+    def test_round_trip_residual_graph(self, tmp_path):
+        net = self._residual_graph()
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 8, 8, 3).astype(np.float32)
+        # non-trivial BN state
+        y = np.zeros((2, 3), np.float32)
+        y[:, 0] = 1
+        net.fit(x, y)
+        p = tmp_path / "cg.zip"
+        dl4j.write_computation_graph(net, p)
+        net2 = dl4j.restore_computation_graph(
+            p, input_type=I.convolutional(8, 8, 3))
+        o1 = np.asarray(net.output(jnp.asarray(x)))
+        o2 = np.asarray(net2.output(jnp.asarray(x)))
+        np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-6)
+
+    def test_zoo_restore_checkpoint_routes_graph_zip(self, tmp_path):
+        from deeplearning4j_tpu.models.zoo import restore_checkpoint
+        net = self._residual_graph()
+        p = tmp_path / "cgzoo.zip"
+        dl4j.write_computation_graph(net, p)
+        net2 = restore_checkpoint(p, input_type=I.convolutional(8, 8, 3))
+        rs = np.random.RandomState(1)
+        x = rs.rand(2, 8, 8, 3).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(net.output(jnp.asarray(x))),
+                                   np.asarray(net2.output(jnp.asarray(x))),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_reference_topo_order_param_layout(self):
+        """Hand-built diamond graph: the reference topo (inputs first,
+        JSON-map order seeds, FIFO, ascending release) fixes the param
+        slicing order — a/b branches in map order, not name order."""
+        order = dl4j._reference_topo_order(
+            ["in"], ["zz_first", "aa_second", "merge"],
+            {"zz_first": ["in"], "aa_second": ["in"],
+             "merge": ["zz_first", "aa_second"]})
+        assert order == ["zz_first", "aa_second", "merge"]
+
+    def test_mini_resnet_zip_round_trip(self, tmp_path):
+        """The real target shape: a bottleneck ResNet stage (conv-BN x3 +
+        projection shortcut + add) exports and restores bit-exact."""
+        from deeplearning4j_tpu.models.resnet import resnet50
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        net = ComputationGraph(resnet50(height=16, width=16, n_classes=4,
+                                        updater=U.Adam(1e-3), seed=3))
+        net.init()
+        p = tmp_path / "resnet16.zip"
+        dl4j.write_computation_graph(net, p)
+        net2 = dl4j.restore_computation_graph(
+            p, input_type=I.convolutional(16, 16, 3))
+        rs = np.random.RandomState(2)
+        x = rs.rand(2, 16, 16, 3).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(net.output(jnp.asarray(x))),
+                                   np.asarray(net2.output(jnp.asarray(x))),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestReviewFixes:
+    def test_biasless_embedding_round_trips(self, tmp_path):
+        """EmbeddingLayer (has_bias=False): the DL4J format always stores a
+        bias — export writes zeros, restore drops the zero bias into the
+        void instead of KeyError-ing."""
+        conf = MultiLayerConfiguration(
+            layers=(L.EmbeddingLayer(n_in=10, n_out=6),
+                    L.OutputLayer(n_out=3, activation="softmax")),
+            input_type=I.feed_forward(10), updater=U.Sgd(0.1))
+        net = MultiLayerNetwork(conf)
+        net.init()
+        assert "b" not in net.params[0]
+        p = tmp_path / "emb.zip"
+        dl4j.write_multilayer_network(net, p)
+        net2 = dl4j.restore_multilayer_network(p)
+        ids = np.asarray([[1.0], [7.0]], np.float32)
+        np.testing.assert_allclose(np.asarray(net.output(jnp.asarray(ids))),
+                                   np.asarray(net2.output(jnp.asarray(ids))),
+                                   rtol=1e-6)
+
+    def test_nonzero_bias_into_biasless_layer_raises(self, tmp_path):
+        import json
+        import zipfile
+        W = np.zeros((4, 2), np.float32)
+        b = np.asarray([1.0, 2.0], np.float32)  # NON-zero
+        flat = np.concatenate([np.ravel(W, order="F"), b])
+        cfg = {"backprop": True, "confs": [
+            {"layer": {"embedding": {"nin": 4, "nout": 2, "updater": "SGD",
+                                     "learningRate": 0.1}}},
+        ]}
+        p = tmp_path / "embbad.zip"
+        buf = io.BytesIO()
+        dl4j.write_nd4j(flat.reshape(1, -1), buf)
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("configuration.json", json.dumps(cfg))
+            zf.writestr("coefficients.bin", buf.getvalue())
+        with pytest.raises(dl4j.Dl4jImportError, match="non-zero"):
+            dl4j.restore_multilayer_network(p)
+
+    def test_zoo_default_input_type_plumbs_to_cnn_graph_restore(self):
+        """init_pretrained's input-type gap (graph configs store no input
+        shape): the registry builder supplies it."""
+        from deeplearning4j_tpu.models.zoo import get_model
+        m = get_model("resnet50")
+        it = m._default_input_type()
+        assert isinstance(it, I.ConvolutionalType)
+        assert (it.height, it.width, it.channels) == (224, 224, 3)
+
+    def test_layervertex_unknown_preprocessor_refuses(self):
+        body = {"layerConf": {"layer": {"dense": {"nin": 4, "nout": 2}}},
+                "preProcessor": {"@class":
+                                 "org.deeplearning4j.nn.conf.preprocessor."
+                                 "RnnToCnnPreProcessor"}}
+        with pytest.raises(dl4j.Dl4jImportError, match="preprocessor"):
+            dl4j._vertex_from_json("LayerVertex", body)
+
+    def test_layervertex_cnn_to_ff_preprocessor_permutes_dense_rows(
+            self, tmp_path):
+        """A dense LayerVertex behind CnnToFeedForwardPreProcessor: DL4J
+        flattens CHW-major, this framework HWC-major — the import permutes
+        W rows so outputs match a numpy simulation of the DL4J forward."""
+        import json
+        import zipfile
+        h, w, c, n_out = 2, 2, 3, 2
+        rs = np.random.RandomState(8)
+        Wd = rs.randn(h * w * c, n_out).astype(np.float32)  # DL4J rows: CHW
+        b = rs.randn(n_out).astype(np.float32)
+        flat = np.concatenate([np.ravel(Wd, order="F"), b])
+        cfg = {"networkInputs": ["in"], "networkOutputs": ["out"],
+               "vertexInputs": {"out": ["in"]},
+               "vertices": {"out": {"LayerVertex": {
+                   "layerConf": {"layer": {"output": {
+                       "activationFn": {"@class":
+                                        "org.nd4j.linalg.activations.impl."
+                                        "ActivationIdentity"},
+                       "lossFn": {"@class": "org.nd4j.linalg.lossfunctions."
+                                            "impl.LossMSE"},
+                       "nin": h * w * c, "nout": n_out, "updater": "SGD",
+                       "learningRate": 0.1}}},
+                   "preProcessor": {"@class":
+                                    "org.deeplearning4j.nn.conf."
+                                    "preprocessor."
+                                    "CnnToFeedForwardPreProcessor",
+                                    "inputHeight": h, "inputWidth": w,
+                                    "numChannels": c}}}}}
+        p = tmp_path / "cnnff.zip"
+        buf = io.BytesIO()
+        dl4j.write_nd4j(flat.reshape(1, -1), buf)
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("configuration.json", json.dumps(cfg))
+            zf.writestr("coefficients.bin", buf.getvalue())
+        net = dl4j.restore_computation_graph(
+            p, input_type=I.convolutional(h, w, c))
+        x = rs.rand(2, h, w, c).astype(np.float32)   # NHWC
+        got = np.asarray(net.output(jnp.asarray(x)))
+        # DL4J forward: flatten NCHW channel-major then x @ W + b
+        x_chw = x.transpose(0, 3, 1, 2).reshape(2, -1)
+        want = x_chw @ Wd + b
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
