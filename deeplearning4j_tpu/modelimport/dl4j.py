@@ -586,8 +586,9 @@ def _vertex_from_json(kind: str, body: dict):
     if k == "lasttimestepvertex":
         return G.LastTimeStepVertex(), None
     if k == "duplicatetotimeseriesvertex":
-        # T resolves from the named reference input at build time; the
-        # importer leaves the default and relies on shape inference usage
+        # this framework's vertex carries a static T; read_graph_config
+        # resolves it from the DL4J inputName's RecurrentType (and refuses
+        # when it can't — a silent T=1 broadcast would corrupt numerics)
         return G.DuplicateToTimeSeriesVertex(), None
     if k == "preprocessorvertex":
         # map the common preprocessor classes onto the explicit-conversion
@@ -672,14 +673,34 @@ def read_graph_config(config_json, input_type=None):
     if input_type is None:
         if first_layer_body is None:
             raise Dl4jImportError("graph has no layers; pass input_type=")
-        input_type = _infer_input_type([first_layer_body],
+        input_type = _infer_input_type([first_layer_body[:2]],
                                        cfg.get("inputPreProcessors"), None)
 
     g = GraphBuilder()
     g.add_inputs(*net_inputs)
-    types = input_type if isinstance(input_type, (list, tuple)) \
+    types = list(input_type) if isinstance(input_type, (list, tuple)) \
         else [input_type] * len(net_inputs)
     g.set_input_types(*types)
+
+    # resolve DuplicateToTimeSeriesVertex timesteps from its DL4J
+    # inputName (rnn/DuplicateToTimeSeriesVertex.java stores the name of a
+    # [B,T,*] input whose T it copies; this framework's vertex is static-T)
+    from deeplearning4j_tpu.nn.graph import \
+        DuplicateToTimeSeriesVertex as _Dup
+    type_of_input = dict(zip(net_inputs, types))
+    for name, wrapped in vertices.items():
+        if not isinstance(built.get(name), _Dup):
+            continue
+        (_, body), = wrapped.items()
+        ref = _ci(body, "inputName")
+        ref_t = type_of_input.get(ref)
+        t = getattr(ref_t, "timesteps", None)
+        if t is None:
+            raise Dl4jImportError(
+                f"DuplicateToTimeSeriesVertex {name!r} references input "
+                f"{ref!r} whose timestep count is unknown — pass an "
+                "input_type with explicit timesteps")
+        built[name] = _Dup(timesteps=int(t))
     from deeplearning4j_tpu.nn.layers.base import Layer as _Layer
     for name, obj in built.items():
         ins = vertex_inputs.get(name, [])
@@ -729,10 +750,12 @@ def _cnn_flatten_permutation(h, w, c):
         .reshape(-1)
 
 
-def restore_computation_graph(path, input_type=None):
+def restore_computation_graph(path, input_type=None, load_updater=False):
     """restoreComputationGraph (ModelSerializer.java) for this framework:
     flat params slice in the REFERENCE's topological order (emulated in
-    _reference_topo_order) since that is the layout the zips store."""
+    _reference_topo_order) since that is the layout the zips store. As in
+    the MLN reader, ``load_updater`` keeps the raw updaterState.bin vector
+    on ``net.dl4j_updater_state``."""
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     with zipfile.ZipFile(path) as zf:
         names = set(zf.namelist())
@@ -775,6 +798,8 @@ def restore_computation_graph(path, input_type=None):
             raise Dl4jImportError(
                 f"flat params length {flat.size} != consumed {pos}")
         net.params, net.state = new_p, new_s
+        if load_updater and "updaterState.bin" in names:
+            net.dl4j_updater_state = read_nd4j(zf.read("updaterState.bin"))
         return net
 
 
@@ -1014,11 +1039,26 @@ def _vertex_json(vertex):
         return "LastTimeStepVertex", {}
     if isinstance(vertex, G.DuplicateToTimeSeriesVertex):
         return "DuplicateToTimeSeriesVertex", {}
+    if isinstance(vertex, G.PreprocessorVertex):
+        cls = {"cnn_to_ff": "CnnToFeedForwardPreProcessor",
+               "ff_to_cnn": "FeedForwardToCnnPreProcessor",
+               "rnn_to_ff": "RnnToFeedForwardPreProcessor",
+               "cnn_to_rnn": "CnnToRnnPreProcessor"}.get(vertex.kind)
+        if cls is None:
+            raise Dl4jImportError(
+                f"PreprocessorVertex kind {vertex.kind!r} has no DL4J "
+                "export mapping")
+        body = {"@class":
+                f"org.deeplearning4j.nn.conf.preprocessor.{cls}"}
+        if vertex.kind == "ff_to_cnn":
+            body.update(inputHeight=vertex.height, inputWidth=vertex.width,
+                        numChannels=vertex.channels)
+        return "PreprocessorVertex", {"preProcessor": body}
     raise Dl4jImportError(
         f"cannot export vertex {type(vertex).__name__}")
 
 
-def write_computation_graph(net, path) -> None:
+def write_computation_graph(net, path, save_updater=False) -> None:
     """ModelSerializer.writeModel for a ComputationGraph: vertices map +
     vertexInputs + flat params in the reference's topological order."""
     from deeplearning4j_tpu.nn.graph import LayerVertex
@@ -1059,6 +1099,15 @@ def write_computation_graph(net, path) -> None:
     with zipfile.ZipFile(path, "w") as zf:
         zf.writestr("configuration.json", json.dumps(cfg, indent=2))
         zf.writestr("coefficients.bin", buf.getvalue())
+        if save_updater and getattr(net, "opt_state", None) is not None:
+            leaves = [np.ravel(np.asarray(a, np.float32)) for a in
+                      jax.tree_util.tree_leaves(net.opt_state)]
+            if leaves:
+                flat_u = np.concatenate(leaves)
+                if flat_u.size:
+                    ub = io.BytesIO()
+                    write_nd4j(flat_u.reshape(1, -1), ub)
+                    zf.writestr("updaterState.bin", ub.getvalue())
 
 
 def write_multilayer_network(net: MultiLayerNetwork, path,

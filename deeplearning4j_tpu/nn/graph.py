@@ -578,15 +578,17 @@ class ComputationGraph:
         its member vertices. Only boundary outputs land in ``acts``."""
         _, names, ext, bnd = seg
 
+        frozen = getattr(self, "frozen_vertices", set())
+
         def run(gp, gs, ext_vals, subs_, m):
             local = dict(zip(ext, ext_vals))
             ns = {}
             for k, n in enumerate(names):
                 v = self._defs[n]
                 xs = [local[i] for i in v.inputs]
-                local[n], ns[n] = v.vertex.apply(gp[n], gs[n], xs,
-                                                 train=train, rng=subs_[k],
-                                                 mask=m)
+                local[n], ns[n] = v.vertex.apply(
+                    gp[n], gs[n], xs, train=train and n not in frozen,
+                    rng=subs_[k], mask=m)
             return [local[n] for n in bnd], ns
 
         run = jax.checkpoint(run)
@@ -614,6 +616,7 @@ class ComputationGraph:
         use_groups = self._segments is not None and labels is not None
         walk = (self._segments if use_groups
                 else [("single", n) for n in self._order])
+        frozen = getattr(self, "frozen_vertices", set())
         for seg in walk:
             if seg[0] == "group":
                 subs = []
@@ -644,12 +647,18 @@ class ComputationGraph:
                 lm = (label_masks or {}).get(name)
                 l_i, preds, st = layer.loss_from_features(
                     params[name], state[name], x, labels[name], lm,
-                    train=train)
+                    train=train and name not in frozen)
                 loss = loss + l_i
                 acts[name], new_state[name] = preds, st
             else:
-                def run(p, s, x_list, r, m, _v=v.vertex):
-                    return _v.apply(p, s, x_list, train=train, rng=r, mask=m)
+                # FrozenLayer.java:23: frozen vertices forward in TEST mode
+                # regardless of the network's mode (running-stat BN, no
+                # stat updates, no dropout)
+                l_train = train and name not in frozen
+
+                def run(p, s, x_list, r, m, _v=v.vertex, _train=l_train):
+                    return _v.apply(p, s, x_list, train=_train, rng=r,
+                                    mask=m)
 
                 if self.conf.gradient_checkpointing:
                     run = jax.checkpoint(run)  # remat: HBM for FLOPs

@@ -82,13 +82,19 @@ class MultiLayerNetwork:
         new_state = list(state)
         cur_type = self.conf.input_type
         n = len(self.conf.layers) if layer_limit is None else layer_limit
+        frozen = set(getattr(self, "frozen_layers", ()))
         for i in range(n):
             layer = self.conf.layers[i]
+            # FrozenLayer.java:23 contract: a frozen layer "behaves as the
+            # layer within it would during TEST regardless of the
+            # training/test mode" — frozen BN normalizes with its running
+            # statistics and does NOT update them; frozen dropout is off
+            l_train = train and i not in frozen
             fam = layer.input_family
             if fam is not None and not isinstance(cur_type, fam):
                 x = _inputs.adapt(x, cur_type, fam)
                 cur_type = _inputs.adapted_type(cur_type, fam)
-            if train and layer.dropout > 0.0 and rng is not None:
+            if l_train and layer.dropout > 0.0 and rng is not None:
                 rng, sub = jax.random.split(rng)
                 from deeplearning4j_tpu.nn.layers.base import dropout_mask
                 x = dropout_mask(sub, x, layer.dropout)
@@ -101,12 +107,14 @@ class MultiLayerNetwork:
                 sub = None
             layer_params = params[i]
             wn = getattr(layer, "weight_noise", None)
-            if train and wn is not None and sub is not None and layer_params:
+            if l_train and wn is not None and sub is not None \
+                    and layer_params:
                 sub, noise_rng = jax.random.split(sub)
                 layer_params = wn.perturb(noise_rng, layer, layer_params)
 
-            def run(p, s, xx, r, _layer=layer, _kwargs=kwargs):
-                return _layer.apply(p, s, xx, train=train, rng=r, **_kwargs)
+            def run(p, s, xx, r, _layer=layer, _kwargs=kwargs,
+                    _train=l_train):
+                return _layer.apply(p, s, xx, train=_train, rng=r, **_kwargs)
 
             if self.conf.gradient_checkpointing:
                 # remat: drop this layer's activations after the forward and

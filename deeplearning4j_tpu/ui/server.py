@@ -280,20 +280,36 @@ def _model_page(server, session):
 
 
 def _system_series(server, session):
-    """Memory/timing series + hardware info for the system tab."""
+    """Memory/timing series + hardware info for the system tab. On
+    multi-host runs (workers POST via the remote router with a "process"
+    tag) the per-process series are additionally split out under
+    ``processes`` — the reference TrainModule's machine-selector role; the
+    flat series keep process 0 so single-host dashboards are unchanged."""
     recs = [r for r in server._records(session, "stats") if "iteration" in r]
     inits = server._records(session, "init")
     out = {"hardware": (inits[-1].get("hardware", {}) if inits else {}),
            "host_rss_mb": [], "device_bytes_in_use": [], "iter_time_s": []}
+    per_proc = {}
     for r in recs:
         it = r["iteration"]
         sysd = r.get("system", {})
-        if "host_rss_mb" in sysd:
-            out["host_rss_mb"].append([it, sysd["host_rss_mb"]])
-        if "device_bytes_in_use" in sysd:
-            out["device_bytes_in_use"].append([it, sysd["device_bytes_in_use"]])
-        if "iter_time_s" in r:
-            out["iter_time_s"].append([it, r["iter_time_s"]])
+        proc = int(r.get("process", 0))
+        dst = out if proc == 0 else None
+        pp = per_proc.setdefault(proc, {"host_rss_mb": [],
+                                        "device_bytes_in_use": [],
+                                        "iter_time_s": []})
+        for tgt in (dst, pp):
+            if tgt is None:
+                continue
+            if "host_rss_mb" in sysd:
+                tgt["host_rss_mb"].append([it, sysd["host_rss_mb"]])
+            if "device_bytes_in_use" in sysd:
+                tgt["device_bytes_in_use"].append(
+                    [it, sysd["device_bytes_in_use"]])
+            if "iter_time_s" in r:
+                tgt["iter_time_s"].append([it, r["iter_time_s"]])
+    if len(per_proc) > 1:
+        out["processes"] = {str(k): v for k, v in sorted(per_proc.items())}
     return out
 
 

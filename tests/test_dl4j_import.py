@@ -538,3 +538,92 @@ class TestReviewFixes:
         x_chw = x.transpose(0, 3, 1, 2).reshape(2, -1)
         want = x_chw @ Wd + b
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestGraphReviewFixes:
+    def test_graph_infer_input_type_without_explicit(self, tmp_path):
+        """Feed-forward graph zip restores with NO input_type argument
+        (inference from the first LayerVertex's nIn)."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+        g = (GraphBuilder(updater=U.Sgd(0.1), seed=2)
+             .add_inputs("in").set_input_types(I.feed_forward(5))
+             .add_layer("d", L.DenseLayer(n_out=4, activation="tanh"), "in")
+             .add_layer("out", L.OutputLayer(n_out=2,
+                                             activation="softmax"), "d")
+             .set_outputs("out"))
+        net = ComputationGraph(g.build())
+        net.init()
+        p = tmp_path / "ffg.zip"
+        dl4j.write_computation_graph(net, p)
+        net2 = dl4j.restore_computation_graph(p)   # no input_type
+        x = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(net.output(jnp.asarray(x))),
+                                   np.asarray(net2.output(jnp.asarray(x))),
+                                   rtol=1e-5)
+
+    def test_dup_tts_resolves_timesteps_from_input(self):
+        cfg = {"networkInputs": ["seq", "ctx"],
+               "networkOutputs": ["out"],
+               "vertexInputs": {"dup": ["ctx"], "merge": ["seq", "dup"],
+                                "out": ["merge"]},
+               "vertices": {
+                   "dup": {"DuplicateToTimeSeriesVertex":
+                           {"inputName": "seq"}},
+                   "merge": {"MergeVertex": {}},
+                   "out": {"LayerVertex": {"layerConf": {"layer": {
+                       "rnnoutput": {"nin": 7, "nout": 2,
+                                     "updater": "SGD",
+                                     "learningRate": 0.1}}}}}}}
+        conf, _, _ = dl4j.read_graph_config(
+            cfg, input_type=[I.recurrent(4, 9), I.feed_forward(3)])
+        dup = [v for v in conf.vertices if v.name == "dup"][0]
+        assert dup.vertex.timesteps == 9
+
+    def test_dup_tts_unknown_timesteps_refuses(self):
+        cfg = {"networkInputs": ["ctx"], "networkOutputs": ["out"],
+               "vertexInputs": {"dup": ["ctx"], "out": ["dup"]},
+               "vertices": {
+                   "dup": {"DuplicateToTimeSeriesVertex":
+                           {"inputName": "missing"}},
+                   "out": {"LayerVertex": {"layerConf": {"layer": {
+                       "rnnoutput": {"nin": 3, "nout": 2, "updater": "SGD",
+                                     "learningRate": 0.1}}}}}}}
+        with pytest.raises(dl4j.Dl4jImportError, match="timestep"):
+            dl4j.read_graph_config(cfg, input_type=[I.feed_forward(3)])
+
+    def test_cg_updater_state_round_trips(self, tmp_path):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+        g = (GraphBuilder(updater=U.Adam(1e-3), seed=6)
+             .add_inputs("in").set_input_types(I.feed_forward(4))
+             .add_layer("out", L.OutputLayer(n_out=2,
+                                             activation="softmax"), "in")
+             .set_outputs("out"))
+        net = ComputationGraph(g.build())
+        net.init()
+        rs = np.random.RandomState(3)
+        net.fit(rs.randn(8, 4).astype(np.float32),
+                np.eye(2, dtype=np.float32)[rs.randint(0, 2, 8)])
+        p = tmp_path / "cgupd.zip"
+        dl4j.write_computation_graph(net, p, save_updater=True)
+        net2 = dl4j.restore_computation_graph(p, load_updater=True)
+        assert getattr(net2, "dl4j_updater_state", None) is not None
+        assert net2.dl4j_updater_state.size > 0
+
+    def test_preprocessor_vertex_export_import(self, tmp_path):
+        from deeplearning4j_tpu.nn.graph import (ComputationGraph,
+                                                 GraphBuilder,
+                                                 PreprocessorVertex)
+        g = (GraphBuilder(updater=U.Sgd(0.1), seed=7)
+             .add_inputs("in").set_input_types(I.convolutional(4, 4, 2))
+             .add_vertex("flat", PreprocessorVertex(kind="cnn_to_ff"), "in")
+             .add_layer("out", L.OutputLayer(n_out=2,
+                                             activation="softmax"), "flat")
+             .set_outputs("out"))
+        net = ComputationGraph(g.build())
+        net.init()
+        p = tmp_path / "prep.zip"
+        dl4j.write_computation_graph(net, p)
+        net2 = dl4j.restore_computation_graph(
+            p, input_type=I.convolutional(4, 4, 2))
+        assert any(isinstance(v.vertex, PreprocessorVertex)
+                   for v in net2.conf.vertices)

@@ -22,6 +22,7 @@ TUTORIALS = [
     "examples/tutorials/t09_transformer_language_model.py",
     "examples/tutorials/t10_scaling_parallelism.py",
     "examples/tutorials/t11_production_lifecycle.py",
+    "examples/tutorials/t12_migrating_from_dl4j.py",
 ]
 EXAMPLES = [
     "examples/lenet_mnist.py",

@@ -442,3 +442,78 @@ class TestShardedCheckpoint:
         assert spec[0] == "stage"
         loss_resumed = float(np.asarray(lm2.step(ids, labels)))
         np.testing.assert_allclose(loss_resumed, loss_next, rtol=1e-6)
+
+
+class TestFrozenTestModeContract:
+    """FrozenLayer.java:23: frozen layers forward in TEST mode regardless
+    of the network's training mode — frozen BN uses running stats and does
+    NOT update them; frozen dropout never drops."""
+
+    def _tuned(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn import layers as L, updaters as U
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.transfer import TransferLearning
+        conf = MultiLayerConfiguration(
+            layers=(L.DenseLayer(n_out=8, activation="relu"),
+                    L.BatchNormalization(),
+                    L.OutputLayer(n_out=2, activation="softmax")),
+            input_type=I.feed_forward(4), updater=U.Sgd(0.05))
+        src = MultiLayerNetwork(conf)
+        src.init()
+        rs = np.random.RandomState(0)
+        x = rs.randn(16, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 16)]
+        src.fit(jnp.asarray(x), jnp.asarray(y), epochs=2)
+        tuned = (TransferLearning(src).set_feature_extractor(1).build())
+        return tuned, x, y
+
+    def test_frozen_bn_stats_do_not_update(self):
+        import jax.numpy as jnp
+        tuned, x, y = self._tuned()
+        mean_before = np.asarray(tuned.state[1]["mean"]).copy()
+        var_before = np.asarray(tuned.state[1]["var"]).copy()
+        tuned.fit(jnp.asarray(x), jnp.asarray(y), epochs=3)
+        np.testing.assert_array_equal(np.asarray(tuned.state[1]["mean"]),
+                                      mean_before)
+        np.testing.assert_array_equal(np.asarray(tuned.state[1]["var"]),
+                                      var_before)
+
+    def test_frozen_forward_is_test_mode(self):
+        """Train-mode and eval-mode losses agree on the frozen prefix: with
+        every BN frozen, the only train/eval difference would be batch-vs-
+        running statistics — which the frozen contract removes."""
+        import jax.numpy as jnp
+        tuned, x, y = self._tuned()
+        xj, yj = jnp.asarray(x), jnp.asarray(y)
+        lt, _ = tuned.loss_fn(tuned.params, tuned.state, xj, yj, train=True)
+        le, _ = tuned.loss_fn(tuned.params, tuned.state, xj, yj,
+                              train=False)
+        np.testing.assert_allclose(float(lt), float(le), rtol=1e-6)
+
+    def test_graph_frozen_bn_stats_do_not_update(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn import layers as L, updaters as U
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+        from deeplearning4j_tpu.nn.transfer import TransferLearningGraph
+        g = (GraphBuilder(updater=U.Sgd(0.05), seed=4)
+             .add_inputs("in").set_input_types(I.feed_forward(4))
+             .add_layer("d", L.DenseLayer(n_out=8, activation="relu"), "in")
+             .add_layer("bn", L.BatchNormalization(), "d")
+             .add_layer("out", L.OutputLayer(n_out=2,
+                                             activation="softmax"), "bn")
+             .set_outputs("out"))
+        src = ComputationGraph(g.build())
+        src.init()
+        rs = np.random.RandomState(1)
+        x = rs.randn(16, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 16)]
+        src.fit(x, y)
+        tuned = TransferLearningGraph(src).set_feature_extractor("bn").build()
+        mean_before = np.asarray(tuned.state["bn"]["mean"]).copy()
+        tuned.fit(x, y)
+        np.testing.assert_array_equal(np.asarray(tuned.state["bn"]["mean"]),
+                                      mean_before)
