@@ -609,6 +609,10 @@ def _vertex_from_json(kind: str, body: dict):
                 channels=int(_ci(pre, "numChannels", default=0) or 0)), None
         if "rnntofeedforward" in pl:
             return G.PreprocessorVertex(kind="rnn_to_ff"), None
+        if "feedforwardtornn" in pl:
+            return G.PreprocessorVertex(
+                kind="ff_to_rnn",
+                timesteps=int(_ci(pre, "timesteps", default=1) or 1)), None
         if "cnntornn" in pl:
             return G.PreprocessorVertex(kind="cnn_to_rnn"), None
         raise Dl4jImportError(
@@ -1043,6 +1047,7 @@ def _vertex_json(vertex):
         cls = {"cnn_to_ff": "CnnToFeedForwardPreProcessor",
                "ff_to_cnn": "FeedForwardToCnnPreProcessor",
                "rnn_to_ff": "RnnToFeedForwardPreProcessor",
+               "ff_to_rnn": "FeedForwardToRnnPreProcessor",
                "cnn_to_rnn": "CnnToRnnPreProcessor"}.get(vertex.kind)
         if cls is None:
             raise Dl4jImportError(
@@ -1053,6 +1058,8 @@ def _vertex_json(vertex):
         if vertex.kind == "ff_to_cnn":
             body.update(inputHeight=vertex.height, inputWidth=vertex.width,
                         numChannels=vertex.channels)
+        elif vertex.kind == "ff_to_rnn":
+            body["timesteps"] = vertex.timesteps
         return "PreprocessorVertex", {"preProcessor": body}
     raise Dl4jImportError(
         f"cannot export vertex {type(vertex).__name__}")

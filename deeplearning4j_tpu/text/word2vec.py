@@ -93,16 +93,20 @@ def _scatter_mean_update(table, idx, grads, lr, axis=None):
     return table - lr * num / jnp.maximum(cnt, 1.0)[:, None]
 
 
-def _sgns_math(syn0, syn1neg, centers, contexts, negatives, lr, axis=None):
-    """One batched skip-gram negative-sampling update.
+def _sgns_core(gather0, gather1, scatter0, scatter1, centers, contexts,
+               negatives):
+    """Shared SGNS forward/gradient/loss math, parametrized over table
+    access: ``gather0/gather1`` read rows of syn0/syn1, ``scatter0/
+    scatter1`` apply the mean-scatter update. Both the replicated-table
+    path (_sgns_math) and the vocab-sharded path
+    (_sgns_math_table_sharded) are thin wrappers, so their pinned
+    exactness cannot drift apart.
 
-    centers [B], contexts [B], negatives [B,K]; returns (syn0, syn1neg, loss).
     Closed-form gradients of  -log σ(v·u+) - Σ log σ(-v·u-)  applied via
-    scatter updates (the XLA-native replacement for AggregateSkipGram).
-    """
-    v = jnp.take(syn0, centers, axis=0)            # [B,D]
-    u_pos = jnp.take(syn1neg, contexts, axis=0)    # [B,D]
-    u_neg = jnp.take(syn1neg, negatives, axis=0)   # [B,K,D]
+    scatter updates (the XLA-native replacement for AggregateSkipGram)."""
+    v = gather0(centers)                           # [B,D]
+    u_pos = gather1(contexts)                      # [B,D]
+    u_neg = gather1(negatives)                     # [B,K,D]
 
     s_pos = jax.nn.sigmoid(jnp.einsum("bd,bd->b", v, u_pos))          # [B]
     s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", v, u_neg))        # [B,K]
@@ -114,14 +118,29 @@ def _sgns_math(syn0, syn1neg, centers, contexts, negatives, lr, axis=None):
     grad_u_pos = g_pos * v
     grad_u_neg = g_neg * v[:, None, :]
 
-    syn0 = _scatter_mean_update(syn0, centers, grad_v, lr, axis)
+    syn0 = scatter0(centers, grad_v)
     u_idx = jnp.concatenate([contexts, negatives.reshape(-1)])
     u_grads = jnp.concatenate([grad_u_pos,
                                grad_u_neg.reshape(-1, grad_u_neg.shape[-1])])
-    syn1neg = _scatter_mean_update(syn1neg, u_idx, u_grads, lr, axis)
+    syn1neg = scatter1(u_idx, u_grads)
 
     loss = -jnp.mean(jnp.log(jnp.clip(s_pos, 1e-9, 1.0))
-                     + jnp.sum(jnp.log(jnp.clip(1.0 - s_neg, 1e-9, 1.0)), axis=1))
+                     + jnp.sum(jnp.log(jnp.clip(1.0 - s_neg, 1e-9, 1.0)),
+                               axis=1))
+    return syn0, syn1neg, loss
+
+
+def _sgns_math(syn0, syn1neg, centers, contexts, negatives, lr, axis=None):
+    """One batched skip-gram negative-sampling update (replicated tables).
+
+    centers [B], contexts [B], negatives [B,K]; returns (syn0, syn1neg,
+    loss)."""
+    syn0, syn1neg, loss = _sgns_core(
+        lambda idx: jnp.take(syn0, idx, axis=0),
+        lambda idx: jnp.take(syn1neg, idx, axis=0),
+        lambda idx, g: _scatter_mean_update(syn0, idx, g, lr, axis),
+        lambda idx, g: _scatter_mean_update(syn1neg, idx, g, lr, axis),
+        centers, contexts, negatives)
     if axis is not None:
         loss = jax.lax.pmean(loss, axis)
     return syn0, syn1neg, loss
@@ -251,6 +270,73 @@ def _dist_fns(math_fn, mesh):
     return make(step, False), make(epoch, True)
 
 
+def _sgns_math_table_sharded(rows, axis, syn0_l, syn1_l, centers, contexts,
+                             negatives, lr):
+    """SGNS step with VOCAB-SHARDED tables: each device owns ``rows``
+    consecutive table rows; the index batch is REPLICATED. Row gathers are
+    mask-and-psum collectives; scatters apply locally (each device updates
+    only its own rows — no table traffic at all).
+
+    This is the >HBM tier of InMemoryLookupTable.java's role: the
+    replicated-table _dist_fns path trades compute for exactness when the
+    tables fit (syn0+syn1 at V=100k/D=300 is 240 MB — single chip); this
+    path shards memory V/n per chip for vocabularies that don't, at the
+    cost of replicated dense math + O(B*K*D) psum gathers per step."""
+    shard = jax.lax.axis_index(axis)
+    lo = shard * rows
+
+    def gather(table_l, idx):
+        local = idx - lo
+        ok = ((local >= 0) & (local < rows))
+        vals = jnp.take(table_l, jnp.clip(local, 0, rows - 1), axis=0)
+        vals = vals * ok[..., None].astype(vals.dtype)
+        return jax.lax.psum(vals, axis)
+
+    def scatter_mean_local(table_l, idx, grads):
+        local = idx - lo
+        ok = ((local >= 0) & (local < rows)).astype(grads.dtype)
+        safe = jnp.clip(local, 0, rows - 1)
+        grads = grads * ok[..., None]
+        num = jnp.zeros_like(table_l).at[safe].add(grads)
+        cnt = jnp.zeros(rows, grads.dtype).at[safe].add(ok)
+        return table_l - lr * num / jnp.maximum(cnt, 1.0)[:, None]
+
+    return _sgns_core(
+        lambda idx: gather(syn0_l, idx),
+        lambda idx: gather(syn1_l, idx),
+        lambda idx, g: scatter_mean_local(syn0_l, idx, g),
+        lambda idx, g: scatter_mean_local(syn1_l, idx, g),
+        centers, contexts, negatives)
+
+
+def _dist_fns_table_sharded(mesh, rows):
+    """(step, epoch) with tables sharded P('data') by rows and batches
+    replicated. Complements _dist_fns (replicated tables, sharded batch)."""
+    from jax.sharding import PartitionSpec as P
+
+    math = functools.partial(_sgns_math_table_sharded, rows, "data")
+
+    def step(syn0, syn1, *rest):
+        batch, lr = rest[:-1], rest[-1]
+        return math(syn0, syn1, *batch, lr)
+
+    epoch = _epoch_body(math)
+
+    def make(fn):
+        def sharded(syn0, syn1, *rest):
+            batch, lr = rest[:-1], rest[-1]
+            f = jax.shard_map(
+                fn, mesh=mesh,
+                in_specs=(P("data"), P("data")) + tuple(
+                    P() for _ in batch) + (P(),),
+                out_specs=(P("data"), P("data"), P()),
+                check_vma=False)
+            return f(syn0, syn1, *batch, lr)
+        return jax.jit(sharded, donate_argnums=(0, 1))
+
+    return make(step), make(epoch)
+
+
 class SequenceVectors:
     """Generic embedding trainer over element sequences (reference:
     SequenceVectors.java — Word2Vec, DeepWalk walks, ParagraphVectors all run
@@ -259,9 +345,22 @@ class SequenceVectors:
     def __init__(self, *, vector_size=100, window=5, min_count=5, negative=5,
                  learning_rate=0.025, min_learning_rate=1e-4, epochs=1,
                  batch_size=2048, subsample=1e-3, use_hierarchic_softmax=False,
-                 algorithm="skipgram", seed=123, mesh=None):
+                 algorithm="skipgram", seed=123, mesh=None,
+                 shard_tables=False):
         self.mesh = mesh  # jax Mesh with a "data" axis -> distributed fit
-        if mesh is not None and batch_size % mesh.shape["data"]:
+        # shard_tables: syn0/syn1 rows shard V/n per device (batches
+        # replicate) — for vocabularies whose tables exceed one chip's HBM;
+        # SGNS only (see _sgns_math_table_sharded)
+        if shard_tables and mesh is None:
+            raise ValueError("shard_tables=True requires mesh= (the tables "
+                             "shard over the mesh 'data' axis)")
+        self.shard_tables = bool(shard_tables)
+        if self.shard_tables and (use_hierarchic_softmax
+                                  or algorithm != "skipgram"):
+            raise ValueError("shard_tables supports skipgram-negative-"
+                             "sampling only")
+        if mesh is not None and not shard_tables \
+                and batch_size % mesh.shape["data"]:
             raise ValueError(
                 f"batch_size {batch_size} must divide by the mesh data "
                 f"axis size {mesh.shape['data']}")
@@ -294,9 +393,24 @@ class SequenceVectors:
             self.vocab = ctor.build(sequences)
         v, d = len(self.vocab), self.vector_size
         rs = np.random.RandomState(self.seed)
-        self.syn0 = jnp.asarray((rs.rand(v, d).astype(np.float32) - 0.5) / d)
+        syn0_host = (rs.rand(v, d).astype(np.float32) - 0.5) / d
         rows = v if not self.use_hs else max(v - 1, 1)
-        self.syn1 = jnp.asarray(np.zeros((rows, d), np.float32))
+        if self.shard_tables:
+            # pad rows to the shard count and place row-sharded: V/n rows
+            # of each table live on each device
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            nd = self.mesh.shape["data"]
+            vp = -(-v // nd) * nd
+            self._rows_per_shard = vp // nd
+            pad = vp - v
+            sh = NamedSharding(self.mesh, P("data", None))
+            self.syn0 = jax.device_put(
+                jnp.asarray(np.pad(syn0_host, ((0, pad), (0, 0)))), sh)
+            self.syn1 = jax.device_put(
+                jnp.zeros((vp, d), jnp.float32), sh)
+        else:
+            self.syn0 = jnp.asarray(syn0_host)
+            self.syn1 = jnp.asarray(np.zeros((rows, d), np.float32))
         counts = self.vocab.counts().astype(np.float64)
         probs = counts ** 0.75
         self._neg_table = (probs / probs.sum()).astype(np.float64)
@@ -505,7 +619,12 @@ class SequenceVectors:
         scatter stats — see _dist_fns); ragged tails truncate to a multiple
         of the axis size (at most n_devices-1 pairs dropped per epoch,
         recorded in ``examples_dropped``)."""
-        if self.mesh is not None:
+        if self.mesh is not None and self.shard_tables:
+            if "table_sharded" not in self._dist_cache:
+                self._dist_cache["table_sharded"] = _dist_fns_table_sharded(
+                    self.mesh, self._rows_per_shard)
+            step_fn, epoch_fn = self._dist_cache["table_sharded"]
+        elif self.mesh is not None:
             if math_fn not in self._dist_cache:
                 self._dist_cache[math_fn] = _dist_fns(math_fn, self.mesh)
             step_fn, epoch_fn = self._dist_cache[math_fn]

@@ -293,21 +293,21 @@ def _system_series(server, session):
     for r in recs:
         it = r["iteration"]
         sysd = r.get("system", {})
-        proc = int(r.get("process", 0))
-        dst = out if proc == 0 else None
-        pp = per_proc.setdefault(proc, {"host_rss_mb": [],
-                                        "device_bytes_in_use": [],
-                                        "iter_time_s": []})
-        for tgt in (dst, pp):
-            if tgt is None:
-                continue
-            if "host_rss_mb" in sysd:
-                tgt["host_rss_mb"].append([it, sysd["host_rss_mb"]])
-            if "device_bytes_in_use" in sysd:
-                tgt["device_bytes_in_use"].append(
-                    [it, sysd["device_bytes_in_use"]])
-            if "iter_time_s" in r:
-                tgt["iter_time_s"].append([it, r["iter_time_s"]])
+        pp = per_proc.setdefault(int(r.get("process", 0)),
+                                 {"host_rss_mb": [],
+                                  "device_bytes_in_use": [],
+                                  "iter_time_s": []})
+        if "host_rss_mb" in sysd:
+            pp["host_rss_mb"].append([it, sysd["host_rss_mb"]])
+        if "device_bytes_in_use" in sysd:
+            pp["device_bytes_in_use"].append(
+                [it, sysd["device_bytes_in_use"]])
+        if "iter_time_s" in r:
+            pp["iter_time_s"].append([it, r["iter_time_s"]])
+    if per_proc:
+        # flat series = lowest process present (NOT hardcoded 0: a run
+        # whose only listener lives on a non-zero worker still renders)
+        out.update(per_proc[min(per_proc)])
     if len(per_proc) > 1:
         out["processes"] = {str(k): v for k, v in sorted(per_proc.items())}
     return out

@@ -40,74 +40,83 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.transfer import TransferLearning
 from deeplearning4j_tpu.models.zoo import restore_checkpoint
 
+import shutil
+
 workdir = tempfile.mkdtemp(prefix="dl4j_migration_")
 zip_path = os.path.join(workdir, "legacy_model.zip")
 
-# ---------------------------------------------------------------------------
-# 1. "your old DL4J model": a small conv net trained on 8x8 patches,
-#    saved in the ModelSerializer zip layout
-# ---------------------------------------------------------------------------
-legacy_conf = MultiLayerConfiguration(
-    layers=(L.ConvolutionLayer(n_out=8, kernel=(3, 3), padding="same",
-                               activation="relu"),
-            L.BatchNormalization(),
-            L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)),
-            L.DenseLayer(n_out=16, activation="relu"),
-            L.OutputLayer(n_out=4, activation="softmax", loss="mcxent")),
-    input_type=I.convolutional(8, 8, 1), updater=U.Adam(1e-3))
-legacy = MultiLayerNetwork(legacy_conf)
-legacy.init()
-rs = np.random.RandomState(0)
-x = rs.rand(64, 8, 8, 1).astype(np.float32)
-y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 64)]
-legacy.fit(jnp.asarray(x), jnp.asarray(y), epochs=3, batch_size=32)
-dl4j.write_multilayer_network(legacy, zip_path)
-print(f"1. 'legacy' DL4J zip written: {os.path.getsize(zip_path)} bytes "
-      f"(configuration.json + coefficients.bin)")
+def _run():
 
-# ---------------------------------------------------------------------------
-# 2. migrate: restore the zip. restore_checkpoint sniffs MLN-vs-graph
-#    layouts, so zoo pretrainedUrl downloads go through the same call.
-# ---------------------------------------------------------------------------
-net = restore_checkpoint(zip_path, input_type=I.convolutional(8, 8, 1))
-o_legacy = np.asarray(legacy.output(jnp.asarray(x[:4])))
-o_migrated = np.asarray(net.output(jnp.asarray(x[:4])))
-assert np.allclose(o_legacy, o_migrated, rtol=1e-5), "migration changed outputs"
-print("2. restored: outputs match the original bit-for-bit "
-      f"(max diff {np.abs(o_legacy - o_migrated).max():.2e})")
+    # ---------------------------------------------------------------------------
+    # 1. "your old DL4J model": a small conv net trained on 8x8 patches,
+    #    saved in the ModelSerializer zip layout
+    # ---------------------------------------------------------------------------
+    legacy_conf = MultiLayerConfiguration(
+        layers=(L.ConvolutionLayer(n_out=8, kernel=(3, 3), padding="same",
+                                   activation="relu"),
+                L.BatchNormalization(),
+                L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)),
+                L.DenseLayer(n_out=16, activation="relu"),
+                L.OutputLayer(n_out=4, activation="softmax", loss="mcxent")),
+        input_type=I.convolutional(8, 8, 1), updater=U.Adam(1e-3))
+    legacy = MultiLayerNetwork(legacy_conf)
+    legacy.init()
+    rs = np.random.RandomState(0)
+    x = rs.rand(64, 8, 8, 1).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 64)]
+    legacy.fit(jnp.asarray(x), jnp.asarray(y), epochs=3, batch_size=32)
+    dl4j.write_multilayer_network(legacy, zip_path)
+    print(f"1. 'legacy' DL4J zip written: {os.path.getsize(zip_path)} bytes "
+          f"(configuration.json + coefficients.bin)")
 
-# ---------------------------------------------------------------------------
-# 3. fine-tune for a NEW 2-class task: freeze the conv trunk, replace the
-#    head (the reference's TransferLearning builder flow)
-# ---------------------------------------------------------------------------
-tuned = (TransferLearning(net)
-         .set_feature_extractor(3)           # freeze up through the dense
-         .remove_output_layer()
-         .add_layer(L.OutputLayer(n_out=2, activation="softmax",
-                                  loss="mcxent"))
-         .build())
-y2 = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 64)]
-# frozen layers forward in TEST mode during training (the FrozenLayer.java
-# contract): the frozen BN uses its running statistics and never updates
-# them, so the head optimizes exactly the features score() evaluates
-xj, y2j = jnp.asarray(x), jnp.asarray(y2)
-before = tuned.score(xj, y2j)
-tuned.fit(xj, y2j, epochs=40, batch_size=32)
-after = tuned.score(xj, y2j)
-print(f"3. fine-tuned frozen-trunk model: loss {before:.4f} -> {after:.4f}")
-assert after < before
+    # ---------------------------------------------------------------------------
+    # 2. migrate: restore the zip. restore_checkpoint sniffs MLN-vs-graph
+    #    layouts, so zoo pretrainedUrl downloads go through the same call.
+    # ---------------------------------------------------------------------------
+    net = restore_checkpoint(zip_path, input_type=I.convolutional(8, 8, 1))
+    o_legacy = np.asarray(legacy.output(jnp.asarray(x[:4])))
+    o_migrated = np.asarray(net.output(jnp.asarray(x[:4])))
+    assert np.allclose(o_legacy, o_migrated, rtol=1e-5), "migration changed outputs"
+    print("2. restored: outputs match the original bit-for-bit "
+          f"(max diff {np.abs(o_legacy - o_migrated).max():.2e})")
 
-# ---------------------------------------------------------------------------
-# 4. export the result BACK to the DL4J format (for JVM-side tooling)
-# ---------------------------------------------------------------------------
-out_path = os.path.join(workdir, "finetuned.zip")
-dl4j.write_multilayer_network(tuned, out_path)
-back = dl4j.restore_multilayer_network(
-    out_path, input_type=I.convolutional(8, 8, 1))
-assert np.allclose(np.asarray(tuned.output(jnp.asarray(x[:4]))),
-                   np.asarray(back.output(jnp.asarray(x[:4]))), rtol=1e-5)
-print(f"4. exported fine-tuned model to {out_path} and verified round-trip")
+    # ---------------------------------------------------------------------------
+    # 3. fine-tune for a NEW 2-class task: freeze the conv trunk, replace the
+    #    head (the reference's TransferLearning builder flow)
+    # ---------------------------------------------------------------------------
+    tuned = (TransferLearning(net)
+             .set_feature_extractor(3)           # freeze up through the dense
+             .remove_output_layer()
+             .add_layer(L.OutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+             .build())
+    y2 = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 64)]
+    # frozen layers forward in TEST mode during training (the FrozenLayer.java
+    # contract): the frozen BN uses its running statistics and never updates
+    # them, so the head optimizes exactly the features score() evaluates
+    xj, y2j = jnp.asarray(x), jnp.asarray(y2)
+    before = tuned.score(xj, y2j)
+    tuned.fit(xj, y2j, epochs=40, batch_size=32)
+    after = tuned.score(xj, y2j)
+    print(f"3. fine-tuned frozen-trunk model: loss {before:.4f} -> {after:.4f}")
+    assert after < before
 
-import shutil
-shutil.rmtree(workdir, ignore_errors=True)
+    # ---------------------------------------------------------------------------
+    # 4. export the result BACK to the DL4J format (for JVM-side tooling)
+    # ---------------------------------------------------------------------------
+    out_path = os.path.join(workdir, "finetuned.zip")
+    dl4j.write_multilayer_network(tuned, out_path)
+    back = dl4j.restore_multilayer_network(
+        out_path, input_type=I.convolutional(8, 8, 1))
+    assert np.allclose(np.asarray(tuned.output(jnp.asarray(x[:4]))),
+                       np.asarray(back.output(jnp.asarray(x[:4]))), rtol=1e-5)
+    print(f"4. exported fine-tuned model to {out_path} and verified round-trip")
+
+
+try:
+    _run()
+finally:
+    # clean up on failure paths too (same guard as t11)
+    shutil.rmtree(workdir, ignore_errors=True)
 print("migration tutorial complete")
+
