@@ -680,7 +680,13 @@ def read_graph_config(config_json, input_type=None):
         input_type = _infer_input_type([first_layer_body[:2]],
                                        cfg.get("inputPreProcessors"), None)
 
-    g = GraphBuilder()
+    tbptt = None
+    if str(cfg.get("backpropType", "Standard")).lower() == "truncatedbptt":
+        tbptt = int(cfg.get("tbpttFwdLength", 20))
+    g = GraphBuilder(backprop_type="tbptt" if tbptt else "standard",
+                     tbptt_fwd_length=tbptt or 20,
+                     tbptt_back_length=int(cfg.get("tbpttBackLength",
+                                                   tbptt or 20)))
     g.add_inputs(*net_inputs)
     types = list(input_type) if isinstance(input_type, (list, tuple)) \
         else [input_type] * len(net_inputs)
@@ -1089,6 +1095,12 @@ def write_computation_graph(net, path, save_updater=False) -> None:
     cfg = {"networkInputs": list(conf.inputs),
            "networkOutputs": list(conf.outputs),
            "vertices": vertices, "vertexInputs": vertex_inputs}
+    if getattr(conf, "backprop_type", "standard") == "tbptt":
+        cfg["backpropType"] = "TruncatedBPTT"
+        cfg["tbpttFwdLength"] = conf.tbptt_fwd_length
+        cfg["tbpttBackLength"] = conf.tbptt_back_length
+    else:
+        cfg["backpropType"] = "Standard"
     order = _reference_topo_order(conf.inputs, list(vertices),
                                   vertex_inputs)
     segments = []

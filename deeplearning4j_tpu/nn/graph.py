@@ -96,6 +96,17 @@ class LayerVertex(GraphVertex):
             kwargs["mask"] = mask
         return self.layer.apply(params, state, x, train=train, rng=rng, **kwargs)
 
+    # recurrent-carry plumbing (TBPTT / rnnTimeStep): delegate to the
+    # wrapped layer when it is recurrent
+    def has_carry(self):
+        return hasattr(self.layer, "apply_with_carry")
+
+    def zero_carry(self, batch, dtype=jnp.float32):
+        return self.layer.zero_carry(batch, dtype)
+
+    def apply_with_carry(self, params, carry, xs, *, mask=None):
+        return self.layer.apply_with_carry(params, carry, xs[0], mask=mask)
+
     def regularization_penalty(self, params):
         return self.layer.regularization_penalty(params) if params else 0.0
 
@@ -365,6 +376,11 @@ class GraphConfiguration:
     seed: int = 12345
     # remat each vertex's forward during backprop: HBM for FLOPs
     gradient_checkpointing: bool = False
+    # truncated BPTT (reference: ComputationGraph.doTruncatedBPTT:2595 +
+    # the fit branches at :937/:1038/:1162)
+    backprop_type: str = "standard"  # standard | tbptt
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
     # coarser remat: group vertices sharing a name prefix (up to the first
     # '_') into ONE jax.checkpoint region on the training path, so only
     # block BOUNDARY activations are stashed for backward and everything
@@ -427,7 +443,9 @@ class GraphBuilder:
 
     def __init__(self, updater=None, seed=12345, gradient_normalization="none",
                  gradient_normalization_threshold=1.0,
-                 gradient_checkpointing=False, checkpoint_scope=None):
+                 gradient_checkpointing=False, checkpoint_scope=None,
+                 backprop_type="standard", tbptt_fwd_length=20,
+                 tbptt_back_length=20):
         self._inputs = []
         self._input_types = []
         self._vertices = []
@@ -438,6 +456,9 @@ class GraphBuilder:
         self._gnt = gradient_normalization_threshold
         self._remat = gradient_checkpointing
         self._ckpt_scope = checkpoint_scope
+        self._backprop_type = backprop_type
+        self._tbptt_fwd = tbptt_fwd_length
+        self._tbptt_back = tbptt_back_length
 
     def add_inputs(self, *names):
         self._inputs.extend(names)
@@ -478,7 +499,10 @@ class GraphBuilder:
             gradient_normalization=self._gn,
             gradient_normalization_threshold=self._gnt,
             gradient_checkpointing=self._remat,
-            checkpoint_scope=self._ckpt_scope)
+            checkpoint_scope=self._ckpt_scope,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back)
         conf.topological_order()  # validate
         return conf
 
@@ -600,20 +624,29 @@ class ComputationGraph:
         new_state.update(ns)
 
     def _forward_pass(self, params, state, inputs, *, train=False, rng=None,
-                      mask=None, labels=None, label_masks=None):
+                      mask=None, labels=None, label_masks=None,
+                      carries=None):
         """THE single topological traversal all forward entry points share.
-        Returns (acts, new_state, loss); ``loss`` is None unless ``labels``
-        is given, in which case output-vertex losses accumulate (feature-loss
-        heads like CenterLossOutputLayer receive their input activations)."""
+        Returns (acts, new_state, loss[, new_carries]); ``loss`` is None
+        unless ``labels`` is given, in which case output-vertex losses
+        accumulate (feature-loss heads like CenterLossOutputLayer receive
+        their input activations). ``carries``: optional {vertex: carry}
+        dict threading recurrent hidden state (TBPTT / rnnTimeStep —
+        reference: doTruncatedBPTT:2595, rnnTimeStep on ComputationGraph);
+        when given, recurrent LayerVertices run apply_with_carry and the
+        updated carries are returned as a fourth element."""
         if not isinstance(inputs, dict):
             inputs = {self.conf.inputs[0]: jnp.asarray(inputs)}
         acts = dict(inputs)
         new_state = dict(state)
+        new_carries = dict(carries) if carries is not None else None
         loss = 0.0 if labels is not None else None
         # scope-level remat applies on the loss/training path only —
         # feed_forward()'s contract (an activation for EVERY vertex) needs
-        # the ungrouped traversal, and there is no backward there anyway
-        use_groups = self._segments is not None and labels is not None
+        # the ungrouped traversal, and there is no backward there anyway;
+        # carry-threaded passes also walk ungrouped
+        use_groups = (self._segments is not None and labels is not None
+                      and carries is None)
         walk = (self._segments if use_groups
                 else [("single", n) for n in self._order])
         frozen = getattr(self, "frozen_vertices", set())
@@ -650,6 +683,11 @@ class ComputationGraph:
                     train=train and name not in frozen)
                 loss = loss + l_i
                 acts[name], new_state[name] = preds, st
+            elif (new_carries is not None and isinstance(v.vertex,
+                                                         LayerVertex)
+                  and v.vertex.has_carry()):
+                acts[name], new_carries[name] = v.vertex.apply_with_carry(
+                    params[name], new_carries.get(name), xs, mask=mask)
             else:
                 # FrozenLayer.java:23: frozen vertices forward in TEST mode
                 # regardless of the network's mode (running-stat BN, no
@@ -671,6 +709,8 @@ class ComputationGraph:
                     lm = (label_masks or {}).get(name)
                     loss = loss + l_layer.compute_loss(acts[name],
                                                        labels[name], lm)
+        if carries is not None:
+            return acts, new_state, loss, new_carries
         return acts, new_state, loss
 
     def apply_fn(self, params, state, inputs, *, train=False, rng=None, mask=None):
@@ -688,21 +728,123 @@ class ComputationGraph:
         return acts
 
     def loss_fn(self, params, state, inputs, labels, *, train=True, rng=None,
-                mask=None, label_masks=None):
+                mask=None, label_masks=None, carries=None):
         """Sum of output-layer losses + regularization (reference:
-        computeGradientAndScore:1302)."""
+        computeGradientAndScore:1302). With ``carries`` (TBPTT chunks) the
+        aux gains the updated carries: (new_state, outs, new_carries)."""
         if not isinstance(labels, dict):
             labels = {self.conf.outputs[0]: labels}
-        acts, new_state, loss = self._forward_pass(
+        fwd = self._forward_pass(
             params, state, inputs, train=train, rng=rng, mask=mask,
-            labels=labels, label_masks=label_masks)
+            labels=labels, label_masks=label_masks, carries=carries)
+        acts, new_state, loss = fwd[:3]
         for name in self._order:
             v = self._defs[name]
             if params[name]:
                 loss = loss + v.vertex.regularization_penalty(params[name])
         loss, new_state = _base_layers.pop_aux_losses(loss, new_state)
         outs = {o: acts[o] for o in self.conf.outputs}
+        if carries is not None:
+            return loss, (new_state, outs, fwd[3])
         return loss, (new_state, outs)
+
+    # ------------------------------------------------------------------
+    # truncated BPTT + streaming inference (reference:
+    # ComputationGraph.doTruncatedBPTT:2595, rnnTimeStep) — carries thread
+    # through recurrent LayerVertices with stop_gradient at chunk edges
+    # ------------------------------------------------------------------
+
+    def _zero_carries(self, batch, dtype):
+        return {v.name: v.vertex.zero_carry(batch, dtype)
+                for v in self.conf.vertices
+                if isinstance(v.vertex, LayerVertex) and v.vertex.has_carry()}
+
+    def make_tbptt_step(self, jit=True):
+        conf = self.conf
+
+        def tbptt_step(params, state, opt_state, carries, inputs, labels,
+                       step, rng, mask=None):
+            carries = jax.tree_util.tree_map(jax.lax.stop_gradient, carries)
+            (loss, (new_state, _, new_carries)), grads = jax.value_and_grad(
+                self.loss_fn, has_aux=True)(
+                    params, state, inputs, labels, train=True, rng=rng,
+                    mask=mask, carries=carries)
+            if conf.gradient_normalization not in (None, "none"):
+                grads = {k: _gradnorm.normalize_layer_grads(
+                    conf.gradient_normalization, g,
+                    conf.gradient_normalization_threshold)
+                    if g else g for k, g in grads.items()}
+            new_params, new_opt = self.apply_update(params, opt_state,
+                                                    grads, step)
+            return new_params, new_state, new_opt, new_carries, loss
+
+        return jax.jit(tbptt_step) if jit else tbptt_step
+
+    @staticmethod
+    def _chunk_time(tree, t0, t1):
+        """Slice [B, T, ...] arrays along time; static [B, F] entries (and
+        2D labels of a LastTimeStep-style head) pass through whole — the
+        MLN path's y.ndim == 3 guard, per-entry."""
+        return {k: (jnp.asarray(v)[:, t0:t1]
+                    if np.ndim(v) == 3 else jnp.asarray(v))
+                for k, v in tree.items()}
+
+    def _fit_tbptt(self, inputs, labels, mask):
+        if getattr(self, "_tbptt_step", None) is None:
+            self._tbptt_step = self.make_tbptt_step()
+        first = next(iter(inputs.values()))
+        T = first.shape[1]
+        L = self.conf.tbptt_fwd_length
+        carries = self._zero_carries(first.shape[0], jnp.asarray(first).dtype)
+        total = 0.0
+        n_chunks = 0
+        for t0 in range(0, T, L):
+            ci = self._chunk_time(inputs, t0, t0 + L)
+            cl = self._chunk_time(labels, t0, t0 + L)
+            cm = jnp.asarray(mask[:, t0:t0 + L]) if mask is not None else None
+            self._rng, sub = jax.random.split(self._rng)
+            (self.params, self.state, self.opt_state, carries, loss) = \
+                self._tbptt_step(self.params, self.state, self.opt_state,
+                                 carries, ci, cl, self.iteration, sub, cm)
+            total = total + loss  # device accumulate: no per-chunk sync
+            n_chunks += 1
+            self.iteration += 1
+            self.score_value = loss
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration, float(loss))
+        self.score_value = float(total) / max(n_chunks, 1)
+        return self.score_value
+
+    def rnn_clear_previous_state(self):
+        """(reference: ComputationGraph.rnnClearPreviousState)"""
+        self._rnn_stream_state = None
+
+    def rnn_time_step(self, inputs):
+        """One timestep [B, F] (or a short [B,T,F] chunk) of streaming
+        inference, carrying recurrent state between calls (reference:
+        ComputationGraph.rnnTimeStep)."""
+        if not isinstance(inputs, dict):
+            inputs = {self.conf.inputs[0]: jnp.asarray(inputs)}
+        inputs = {k: jnp.asarray(v) for k, v in inputs.items()}
+        first = next(iter(inputs.values()))
+        squeeze = first.ndim == 2
+        if squeeze:
+            inputs = {k: v[:, None, :] for k, v in inputs.items()}
+            first = next(iter(inputs.values()))
+        carries = getattr(self, "_rnn_stream_state", None)
+        if carries is None:
+            carries = self._zero_carries(first.shape[0], first.dtype)
+        acts, _, _, carries = self._forward_pass(
+            self.params, self.state, inputs, train=False, carries=carries)
+        self._rnn_stream_state = carries
+        # squeeze only time-major [B,T,F] outputs; a LastTimeStep-style
+        # head already emits [B,C] and must pass through untouched
+        outs = {o: (acts[o][:, 0] if squeeze and acts[o].ndim == 3
+                    else acts[o])
+                for o in self.conf.outputs}
+        if len(outs) == 1:
+            return next(iter(outs.values()))
+        return outs
 
     def compute_gradients(self, params, state, inputs, labels, *, rng=None,
                           mask=None):
@@ -742,12 +884,30 @@ class ComputationGraph:
     def fit(self, inputs, labels, *, epochs=1, batch_size=None, mask=None):
         if self.params is None:
             self.init()
-        if self._train_step is None:
-            self._train_step = self.make_train_step()
         if not isinstance(inputs, dict):
             inputs = {self.conf.inputs[0]: np.asarray(inputs)}
         if not isinstance(labels, dict):
             labels = {self.conf.outputs[0]: np.asarray(labels)}
+        if (self.conf.backprop_type == "tbptt"
+                and next(iter(inputs.values())).ndim == 3
+                and next(iter(inputs.values())).shape[1]
+                > self.conf.tbptt_fwd_length):
+            n = next(iter(inputs.values())).shape[0]
+            bs = batch_size or n
+            for _ in range(epochs):
+                for l in self.listeners:
+                    l.on_epoch_start(self)
+                for i in range(0, n, bs):   # TBPTT per minibatch, as MLN
+                    bi = {k: v[i:i + bs] for k, v in inputs.items()}
+                    bl = {k: v[i:i + bs] for k, v in labels.items()}
+                    bm = mask[i:i + bs] if mask is not None else None
+                    self._fit_tbptt(bi, bl, bm)
+                for l in self.listeners:
+                    l.on_epoch_end(self)
+                self.epoch += 1
+            return self
+        if self._train_step is None:
+            self._train_step = self.make_train_step()
         n = next(iter(inputs.values())).shape[0]
         bs = batch_size or n
         for _ in range(epochs):
