@@ -72,8 +72,11 @@ def _build_parser():
 
 def _load_model(args):
     if args.model_path:
-        from deeplearning4j_tpu.utils.serialization import load_model
-        return load_model(args.model_path)
+        # sniffs the zip layout: this framework's format OR a reference
+        # ModelSerializer zip (MLN or ComputationGraph) both load — the
+        # CLI is the migration path's front door
+        from deeplearning4j_tpu.models.zoo import restore_checkpoint
+        return restore_checkpoint(args.model_path)
     from deeplearning4j_tpu.models import zoo
     try:
         builder = zoo.get_model(args.zoo).builder

@@ -1149,6 +1149,19 @@ def write_multilayer_network(net: MultiLayerNetwork, path,
         confs.append({"layer": {kind: body}})
         segments.extend(_flat_layer_params(layer, kind, p, s))
     cfg = {"backprop": True, "pretrain": False, "confs": confs}
+    # CNN input dims ride in an inputPreProcessors entry, as DL4J's
+    # setInputType does — _infer_input_type reads them back, so CNN zips
+    # restore without the caller passing input_type. Only when layer 0 is
+    # conv-family: a feedForwardToCnn entry in front of a dense layer
+    # would tell DL4J to reshape flat input to 4D in the wrong place.
+    first_fam = getattr(conf.layers[0], "input_family", None) \
+        if conf.layers else None
+    if isinstance(conf.input_type, I.ConvolutionalType) \
+            and first_fam is I.ConvolutionalType:
+        it = conf.input_type
+        cfg["inputPreProcessors"] = {"0": {"feedForwardToCnn": {
+            "inputHeight": int(it.height), "inputWidth": int(it.width),
+            "numChannels": int(it.channels)}}}
     if conf.backprop_type == "tbptt":
         cfg["backpropType"] = "TruncatedBPTT"
         cfg["tbpttFwdLength"] = conf.tbptt_fwd_length

@@ -755,6 +755,18 @@ class ComputationGraph:
     # ------------------------------------------------------------------
 
     def _zero_carries(self, batch, dtype):
+        from deeplearning4j_tpu.nn.layers.rnn import (
+            Bidirectional, GravesBidirectionalLSTM)
+        for v in self.conf.vertices:
+            layer = getattr(v.vertex, "layer", None)
+            if isinstance(layer, (Bidirectional, GravesBidirectionalLSTM)):
+                # the backward direction needs the FULL future sequence —
+                # the reference's rnnTimeStep throws for bidirectional
+                # layers too; silent per-chunk state resets would produce
+                # wrong numerics without an error
+                raise ValueError(
+                    f"vertex {v.name!r}: bidirectional layers do not "
+                    "support TBPTT / rnn_time_step streaming")
         return {v.name: v.vertex.zero_carry(batch, dtype)
                 for v in self.conf.vertices
                 if isinstance(v.vertex, LayerVertex) and v.vertex.has_carry()}
@@ -789,10 +801,19 @@ class ComputationGraph:
                     if np.ndim(v) == 3 else jnp.asarray(v))
                 for k, v in tree.items()}
 
+    @staticmethod
+    def _time_major(inputs):
+        """The [B, T, ...] entry driving chunking (a multi-input graph may
+        list a static [B, F] input first — scan, don't take the first)."""
+        for v in inputs.values():
+            if np.ndim(v) == 3:
+                return v
+        return None
+
     def _fit_tbptt(self, inputs, labels, mask):
         if getattr(self, "_tbptt_step", None) is None:
             self._tbptt_step = self.make_tbptt_step()
-        first = next(iter(inputs.values()))
+        first = self._time_major(inputs)
         T = first.shape[1]
         L = self.conf.tbptt_fwd_length
         carries = self._zero_carries(first.shape[0], jnp.asarray(first).dtype)
@@ -823,6 +844,8 @@ class ComputationGraph:
         """One timestep [B, F] (or a short [B,T,F] chunk) of streaming
         inference, carrying recurrent state between calls (reference:
         ComputationGraph.rnnTimeStep)."""
+        if self.params is None:
+            self.init()
         if not isinstance(inputs, dict):
             inputs = {self.conf.inputs[0]: jnp.asarray(inputs)}
         inputs = {k: jnp.asarray(v) for k, v in inputs.items()}
@@ -888,25 +911,10 @@ class ComputationGraph:
             inputs = {self.conf.inputs[0]: np.asarray(inputs)}
         if not isinstance(labels, dict):
             labels = {self.conf.outputs[0]: np.asarray(labels)}
-        if (self.conf.backprop_type == "tbptt"
-                and next(iter(inputs.values())).ndim == 3
-                and next(iter(inputs.values())).shape[1]
-                > self.conf.tbptt_fwd_length):
-            n = next(iter(inputs.values())).shape[0]
-            bs = batch_size or n
-            for _ in range(epochs):
-                for l in self.listeners:
-                    l.on_epoch_start(self)
-                for i in range(0, n, bs):   # TBPTT per minibatch, as MLN
-                    bi = {k: v[i:i + bs] for k, v in inputs.items()}
-                    bl = {k: v[i:i + bs] for k, v in labels.items()}
-                    bm = mask[i:i + bs] if mask is not None else None
-                    self._fit_tbptt(bi, bl, bm)
-                for l in self.listeners:
-                    l.on_epoch_end(self)
-                self.epoch += 1
-            return self
-        if self._train_step is None:
+        tm = self._time_major(inputs)
+        use_tbptt = (self.conf.backprop_type == "tbptt" and tm is not None
+                     and tm.shape[1] > self.conf.tbptt_fwd_length)
+        if not use_tbptt and self._train_step is None:
             self._train_step = self.make_train_step()
         n = next(iter(inputs.values())).shape[0]
         bs = batch_size or n
@@ -914,11 +922,18 @@ class ComputationGraph:
             for l in self.listeners:
                 l.on_epoch_start(self)
             for i in range(0, n, bs):
-                bi = {k: jnp.asarray(v[i:i + bs]) for k, v in inputs.items()}
-                bl = {k: jnp.asarray(v[i:i + bs]) for k, v in labels.items()}
-                bm = jnp.asarray(mask[i:i + bs]) if mask is not None else None
+                bi = {k: v[i:i + bs] for k, v in inputs.items()}
+                bl = {k: v[i:i + bs] for k, v in labels.items()}
+                bm = mask[i:i + bs] if mask is not None else None
+                if use_tbptt:   # TBPTT per minibatch, as MLN
+                    self._fit_tbptt(bi, bl, bm)
+                    continue
+                bi = {k: jnp.asarray(v) for k, v in bi.items()}
+                bl = {k: jnp.asarray(v) for k, v in bl.items()}
+                bm = jnp.asarray(bm) if bm is not None else None
                 self._rng, sub = jax.random.split(self._rng)
-                self.params, self.state, self.opt_state, loss = self._train_step(
+                (self.params, self.state, self.opt_state,
+                 loss) = self._train_step(
                     self.params, self.state, self.opt_state, bi, bl,
                     self.iteration, sub, bm)
                 self.score_value = loss  # device scalar; float() on demand
