@@ -1,4 +1,5 @@
-"""One-config composed parallelism: data x tensor x pipeline on one mesh.
+"""One-config composed parallelism: data x tensor x pipeline x sequence
+on one mesh.
 
 Reference analog: ParallelWrapper.java:58 — the reference's single facade
 over its (data-parallel-only) training modes. The TPU-native scale tiers
@@ -28,12 +29,17 @@ mesh):
 * Embedding + head run outside the pipelined region, replicated — same
   rationale as PipelineParallelLM.
 
-Sequence parallelism composes separately (parallel/sequence.py ring x
-flash); it is not fused into this facade — long-context + pipeline in one
-program is future work, documented rather than implied.
+* ``seq`` axis (sp > 1): the activations' TIME axis shards too, and each
+  block's attention runs as ring attention over the axis
+  (parallel/sequence.py — exact log-sum-exp block combination, fused
+  flash block kernel on TPU), so long sequences split across devices
+  INSIDE the pipeline: dp x tp x pp x sp in one program from one
+  MeshSpec.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -54,14 +60,20 @@ def _ln(x, g, b, eps=1e-5):
     return (x - mean) * lax.rsqrt(var + eps) * g + b
 
 
-def _causal_attention(q, k, v):
+def _causal_attention(q, k, v, seq_axis=None):
     """[B,T,h,dh] attention over the LOCAL heads (exact under head
-    sharding: heads never mix until the Wo row-parallel psum)."""
+    sharding: heads never mix until the Wo row-parallel psum). With
+    ``seq_axis`` the time axis is ALSO sharded and attention runs as ring
+    attention over that mesh axis (parallel/sequence.py — exact, blocks
+    combine by log-sum-exp), composing sp with the tp head sharding."""
+    if seq_axis is not None:
+        from deeplearning4j_tpu.parallel.sequence import ring_self_attention
+        return ring_self_attention(q, k, v, axis_name=seq_axis, causal=True)
     from deeplearning4j_tpu.nn.layers.attention import dot_product_attention
     return dot_product_attention(q, k, v, causal=True)
 
 
-def tp_block_forward(bp, h, *, activation="gelu"):
+def tp_block_forward(bp, h, *, activation="gelu", seq_axis=None):
     """One tensor-parallel transformer block on the model-axis shard.
 
     ``bp`` leaves are the LOCAL shard (inside shard_map):
@@ -77,7 +89,7 @@ def tp_block_forward(bp, h, *, activation="gelu"):
     hn = _ln(x, bp["ln1_g"], bp["ln1_b"])
     qkv = jnp.einsum("btd,dghe->btghe", hn, bp["Wqkv"]) + bp["bqkv"]
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]   # [B,T,hl,dh]
-    attn = _causal_attention(q, k, v)
+    attn = _causal_attention(q, k, v, seq_axis)
     y = jnp.einsum("bthe,hed->btd", attn, bp["Wo"])
     y = lax.psum(y, "model") + bp["bo"]
     x = x + y
@@ -91,19 +103,19 @@ def tp_block_forward(bp, h, *, activation="gelu"):
 
 
 class ComposedParallelLM:
-    """Decoder-only LM trained with dp x tp x pp from one MeshSpec.
+    """Decoder-only LM trained with dp x tp x pp x sp from one MeshSpec.
 
     Same architecture as ``models.transformer_lm`` / PipelineParallelLM:
     EmbeddingSequenceLayer + n_layers pre-norm blocks + vocab head.
     Requirements: n_layers % stage == 0, n_heads % model == 0,
     (mlp_ratio * d_model) % model == 0, batch % (n_microbatches * data)
-    == 0.
+    == 0, seq_len % seq == 0.
     """
 
     def __init__(self, *, vocab_size, n_layers, d_model, n_heads, seq_len,
                  mesh: Mesh, n_microbatches=2, mlp_ratio=4, updater=None,
                  seed=12345, remat=False):
-        for ax in ("data", "model", "stage"):
+        for ax in ("data", "model", "seq", "stage"):
             assert ax in mesh.axis_names, f"mesh needs a {ax!r} axis"
         self.vocab_size = vocab_size
         self.n_layers = n_layers
@@ -115,9 +127,12 @@ class ComposedParallelLM:
         self.n_micro = n_microbatches
         self.n_stages = mesh.shape["stage"]
         self.tp = mesh.shape["model"]
+        self.sp = mesh.shape["seq"]
         assert n_layers % self.n_stages == 0
         assert n_heads % self.tp == 0
         assert (mlp_ratio * d_model) % self.tp == 0
+        assert seq_len % self.sp == 0, \
+            f"seq_len {seq_len} must divide by the seq axis ({self.sp})"
         self.embed = L.EmbeddingSequenceLayer(n_in=vocab_size, n_out=d_model,
                                               add_positional=True)
         self.updater = updater or U.Adam(learning_rate=3e-4)
@@ -216,13 +231,20 @@ class ComposedParallelLM:
         b, t, d = emb.shape
         mb = b // self.n_micro
         x_mb = emb.reshape(self.n_micro, mb, t, d)
-        run = gpipe_schedule(tp_block_forward, self.n_micro, self.n_stages,
+        # sp > 1: the TIME axis of the microbatched activations also
+        # shards over 'seq'; attention inside each block runs ring-
+        # parallel (exact), so dp x tp x pp x sp compose in one program
+        block = (functools.partial(tp_block_forward, seq_axis="seq")
+                 if self.sp > 1 else tp_block_forward)
+        act_spec = (P(None, "data", "seq") if self.sp > 1
+                    else P(None, "data"))
+        run = gpipe_schedule(block, self.n_micro, self.n_stages,
                              remat=self.remat)
         block_specs = {k: s for k, s in self._block_specs().items()}
         piped = shard_map(
             run, mesh=self.mesh,
-            in_specs=(block_specs, P(None, "data")),
-            out_specs=P(None, "data"),
+            in_specs=(block_specs, act_spec),
+            out_specs=act_spec,
             check_vma=False,
         )(params["blocks"], x_mb)
         h = piped.reshape(b, t, d)
