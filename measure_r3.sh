@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-3 measurement matrix (PROFILE.md "staged to measure" table), one
+# command for a live-tunnel window. Runs configs SEQUENTIALLY (the tunnel
+# is single-client: stop any pytest/python first). Every live record
+# auto-persists into BENCH_TPU_MEASURED.json as it completes, so a wedge
+# mid-matrix loses nothing.
+#
+#   bash measure_r3.sh 2>&1 | tee /tmp/measure_r3.log
+set -u
+cd "$(dirname "$0")"
+
+run() { echo "=== $* ==="; env "$@" python bench.py "${CFG}"; }
+
+# 1. the north star: ResNet50 MFU, remat A/B, then batch scaling
+CFG=resnet50 run BENCH_REMAT=0
+CFG=resnet50 run BENCH_REMAT=1
+CFG=resnet50 run BENCH_REMAT=1 BENCH_BATCH=128
+CFG=resnet50 run BENCH_REMAT=1 BENCH_BATCH=256
+# 2. tiled-Wh LSTM past the old H=512 cap, with scan-path A/B
+CFG=lstm run BENCH_LSTM_HIDDEN=1024
+CFG=lstm run BENCH_LSTM_HIDDEN=1024 DL4J_TPU_FUSED_LSTM=0
+CFG=lstm run BENCH_LSTM_HIDDEN=2048
+CFG=lstm run BENCH_LSTM_HIDDEN=2048 DL4J_TPU_FUSED_LSTM=0
+# 3. word2vec at production scale (V=100k, D=300, 10M words)
+CFG=word2vec run BENCH_W2V_SCALE=production
+# 4. refresh the standard sweep records
+for c in lenet lstm word2vec parallel transformer longcontext; do
+  CFG=$c run _=;
+done
+echo "=== matrix complete; records merged into BENCH_TPU_MEASURED.json ==="
