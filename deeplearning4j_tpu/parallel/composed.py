@@ -114,7 +114,7 @@ class ComposedParallelLM:
 
     def __init__(self, *, vocab_size, n_layers, d_model, n_heads, seq_len,
                  mesh: Mesh, n_microbatches=2, mlp_ratio=4, updater=None,
-                 seed=12345, remat=False):
+                 seed=12345, remat=False, shard_optimizer_state=False):
         for ax in ("data", "model", "seq", "stage"):
             assert ax in mesh.axis_names, f"mesh needs a {ax!r} axis"
         self.vocab_size = vocab_size
@@ -138,6 +138,12 @@ class ComposedParallelLM:
         self.updater = updater or U.Adam(learning_rate=3e-4)
         self.seed = seed
         self.remat = remat
+        # ZeRO-1 (same design note as ParallelTrainer.shard_optimizer_
+        # state): optimizer-state leaves additionally shard over 'data',
+        # so Adam moments cost HBM/dp per replica; GSPMD reduce-scatters
+        # grads into the sharded update and all-gathers params out.
+        # Per-leaf guard: only dimensions divisible by dp shard.
+        self.shard_optimizer_state = shard_optimizer_state
         self.params = None
         self.opt_state = None
         self._step_fn = None
@@ -212,13 +218,39 @@ class ComposedParallelLM:
             jax.device_put, opt, self._opt_shardings(opt))
         return self
 
+    def _zero1_sharding(self, sharding, leaf):
+        """Extend a param sharding's FIRST axis with 'data' for the
+        optimizer-state copy of that leaf — only when the per-device size
+        along that axis divides by dp (leaves that don't divide stay at
+        the param sharding; correctness is unaffected either way)."""
+        dp = self.mesh.shape["data"]
+        if dp == 1 or jnp.ndim(leaf) == 0:
+            return sharding
+        spec = list(sharding.spec) if sharding.spec else []
+        spec += [None] * (jnp.ndim(leaf) - len(spec))
+        first = spec[0]
+        axes = (first if isinstance(first, tuple)
+                else () if first is None else (first,))
+        if "data" in axes:
+            return sharding
+        shard_n = np.prod([self.mesh.shape[a] for a in axes], dtype=int)
+        if (leaf.shape[0] // shard_n) % dp != 0:
+            return sharding
+        spec[0] = tuple(axes) + ("data",)
+        return NamedSharding(self.mesh, P(*spec))
+
     def _opt_shardings(self, opt_state):
         p_struct = jax.tree_util.tree_structure(self.params)
         repl = NamedSharding(self.mesh, P())
+        if self.shard_optimizer_state:
+            p_shards = jax.tree_util.tree_map(
+                self._zero1_sharding, self.param_shardings, self.params)
+        else:
+            p_shards = self.param_shardings
 
         def per_entry(sub):
             if jax.tree_util.tree_structure(sub) == p_struct:
-                return self.param_shardings
+                return p_shards
             return jax.tree_util.tree_map(lambda _: repl, sub)
 
         if isinstance(opt_state, dict):

@@ -9,7 +9,7 @@
 set -u
 cd "$(dirname "$0")"
 
-run() { echo "=== $* ==="; env "$@" python bench.py "${CFG}"; }
+run() { echo "=== ${CFG} $* ==="; env "$@" python bench.py "${CFG}"; }
 
 # 1. the north star: ResNet50 MFU, remat A/B, then batch scaling
 CFG=resnet50 run BENCH_REMAT=0
