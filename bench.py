@@ -277,8 +277,11 @@ def bench_resnet50(batch=64, hw=224, warmup=2, iters=30):
     # BENCH_REMAT=1: block-level activation rematerialization (A/B knob for
     # the HBM-traffic-vs-FLOPs trade; see models/resnet.py docstring)
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    # BENCH_FUSED_CONV=1: FusedConvBNVertex graph — the Pallas conv kernel
+    # folds the BN stats reduction into the conv epilogue (ops/conv_pallas)
+    fused = os.environ.get("BENCH_FUSED_CONV", "0") == "1"
     net = ComputationGraph(resnet50(
-        height=hw, width=hw, n_classes=1000,
+        height=hw, width=hw, n_classes=1000, fused=fused,
         checkpoint_scope="prefix" if remat else None))
     net.init()
     raw = net.make_train_step(donate=True, jit=False)
@@ -296,20 +299,21 @@ def bench_resnet50(batch=64, hw=224, warmup=2, iters=30):
     # analytic estimate: train step ~ 3x fwd FLOPs
     analytic = 3.0 * resnet50_flops_per_example(hw, hw) * batch
     # MFU counts USEFUL model FLOPs: under remat XLA's cost analysis also
-    # counts the recompute, which must not inflate MFU
-    flops = analytic if remat else (info.get("xla_flops_per_step")
-                                    or analytic)
+    # counts the recompute (inflating MFU), and under fused-conv the Pallas
+    # custom-calls are invisible to it (deflating MFU) — both use analytic
+    flops = analytic if (remat or fused) else (info.get("xla_flops_per_step")
+                                               or analytic)
     mfu = flops / dt / PEAK_FLOPS
     return {"metric": "resnet50_train_samples_per_sec",
             "value": round(sps, 2), "unit": "samples/sec/chip",
             "vs_baseline": round(sps / BASELINES["resnet50"], 2),
             "step_time_ms": round(1e3 * dt, 2), "batch": batch, "hw": hw,
-            "remat": remat,
+            "remat": remat, "fused_conv": fused,
             "mfu": round(mfu, 4),
             "analytic_flops_per_step": analytic,
-            "flops_source": ("xla_cost_analysis"
-                             if info.get("xla_flops_per_step") else
-                             "analytic_3x_fwd"), **info}
+            "flops_source": ("analytic_3x_fwd"
+                             if flops is analytic
+                             else "xla_cost_analysis"), **info}
 
 
 def bench_lstm(batch=64, seq=128, hidden=512, vocab=96, warmup=2, iters=30):

@@ -1,0 +1,141 @@
+"""Fused conv+BN(+residual+activation) graph vertex.
+
+Reference analog: the cuDNN helper swap-in at ConvolutionLayer.java:74-84 —
+the reference keeps the layer graph unchanged and substitutes a fused fast
+path per layer. Here the fusion spans what in the unfused graph is a
+ConvolutionLayer -> BatchNormalization (-> ElementWiseVertex(add) ->
+ActivationLayer) chain, collapsed into ONE vertex so the Pallas phase-1
+kernel (ops/conv_pallas.py) can fuse the BN statistics reduction into the
+conv epilogue. ``models/resnet.py`` builds with these vertices under
+``fused=True`` (the BENCH_FUSED_CONV A/B).
+
+The vertex is self-sufficient on any backend: when the kernel seam is
+closed (CPU, unsupported geometry, eval mode) it runs the same math as the
+unfused chain via XLA — so checkpoints and eval paths never depend on
+Pallas.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn import initializers as _init
+from deeplearning4j_tpu.nn.conf import inputs as _inputs
+from deeplearning4j_tpu.nn.graph import GraphVertex
+from deeplearning4j_tpu.nn.layers.conv import (
+    DIMNUMS_2D, _conv_out_size, _explicit_padding, _pair, conv)
+from deeplearning4j_tpu.ops import conv_pallas
+from deeplearning4j_tpu.utils import dtypes as _dtypes
+from deeplearning4j_tpu.utils.serde import register_config
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
+class FusedConvBNVertex(GraphVertex):
+    """conv (no bias) + batch-norm + optional residual add + activation.
+
+    Inputs: (x,) or (x, residual) when ``residual=True``; the residual is
+    added AFTER the affine, before the activation — exactly the ResNet
+    bottleneck tail (conv_c -> BN -> add -> relu).
+    """
+
+    n_out: int = 0
+    kernel: tuple = (1, 1)
+    stride: tuple = (1, 1)
+    padding: str = "same"
+    activation: str = "relu"
+    residual: bool = False
+    eps: float = 1e-5
+    decay: float = 0.9
+    weight_init: object = "relu"
+
+    def output_type(self, input_types):
+        it = input_types[0]
+        assert isinstance(it, _inputs.ConvolutionalType)
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        h = _conv_out_size(it.height, kh, sh, self.padding, 0)
+        w = _conv_out_size(it.width, kw, sw, self.padding, 0)
+        return _inputs.ConvolutionalType(h, w, self.n_out)
+
+    def init(self, key, input_types, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel)
+        cin = input_types[0].channels
+        return {
+            "W": _init.init_weight(self.weight_init, key,
+                                   (kh, kw, cin, self.n_out),
+                                   cin * kh * kw, self.n_out * kh * kw,
+                                   dtype),
+            "gamma": jnp.ones((self.n_out,), dtype),
+            "beta": jnp.zeros((self.n_out,), dtype),
+        }
+
+    def init_state(self, input_types, dtype=jnp.float32):
+        return {"mean": jnp.zeros((self.n_out,), dtype),
+                "var": jnp.ones((self.n_out,), dtype)}
+
+    def _kernel_applies(self, train):
+        if not train:
+            return False, False
+        # test seam: force the Pallas path in interpret mode on CPU
+        if os.environ.get("DL4J_TPU_FUSED_CONV_INTERPRET", "0") == "1":
+            interp = True
+        elif conv_pallas.enabled():
+            interp = False
+        else:
+            return False, False
+        ok = conv_pallas.supported(_pair(self.kernel), _pair(self.stride),
+                                   self.padding, (1, 1), self.activation)
+        return ok, interp
+
+    def apply(self, params, state, xs, *, train=False, rng=None, mask=None):
+        x = xs[0]
+        r = xs[1] if self.residual else None
+        use_kernel, interpret = self._kernel_applies(train)
+        if use_kernel:
+            y, mean, var = conv_pallas.fused_conv_bn_act(
+                x, params["W"], params["gamma"], params["beta"], r,
+                _pair(self.stride), self.eps, self.activation, interpret)
+            new_state = {
+                "mean": self.decay * state["mean"]
+                        + (1 - self.decay) * mean.astype(state["mean"].dtype),
+                "var": self.decay * state["var"]
+                       + (1 - self.decay) * var.astype(state["var"].dtype),
+            }
+            return y, new_state
+        # XLA fallback: same math as the unfused conv->BN->add->act chain
+        z = conv(x, params["W"], window_strides=_pair(self.stride),
+                 padding=_explicit_padding(self.padding, (0, 0)),
+                 dimension_numbers=DIMNUMS_2D)
+        _, ad = _dtypes.compute_dtypes_for(z.dtype)
+        zf = z.astype(ad)
+        axes = (0, 1, 2)
+        if train:
+            mean = jnp.mean(zf, axis=axes)
+            var = jnp.var(zf, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"]
+                        + (1 - self.decay) * mean.astype(state["mean"].dtype),
+                "var": self.decay * state["var"]
+                       + (1 - self.decay) * var.astype(state["var"].dtype),
+            }
+        else:
+            mean, var = state["mean"].astype(ad), state["var"].astype(ad)
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        ypre = (zf - mean) * inv * params["gamma"].astype(ad) \
+            + params["beta"].astype(ad)
+        if r is not None:
+            ypre = ypre + r.astype(ad)
+        if self.activation == "relu":
+            ypre = jnp.maximum(ypre, 0.0)
+        return ypre.astype(z.dtype), new_state
+
+    WEIGHT_KEYS = ("W", "gamma")
+
+    def regularization_penalty(self, params):
+        return 0.0

@@ -1,0 +1,368 @@
+"""Fused conv + batch-norm (+ residual add) + activation (Pallas, TPU).
+
+Reference analog: CudnnConvolutionHelper
+(/root/reference/deeplearning4j-cuda/src/main/java/org/deeplearning4j/nn/
+layers/convolution/CudnnConvolutionHelper.java:230-239,389-392) — the
+reference's "own the conv lowering" fast path, where algo selection and
+HALF-math conv descriptors replace the generic im2col route. On TPU the
+generic route is XLA's conv custom-call, which is already MXU-tiled; what
+it canNOT do is fuse the batch-norm *statistics reduction* into the conv
+epilogue — the conv output z is written to HBM, read again for mean/var,
+and read a third time for the normalize. PROFILE.md's round-2 analysis
+shows ResNet50 pinned at the v5e HBM peak (0.27 MFU), so each avoided
+pass over z is direct step-time.
+
+Kernel design (TPU-first):
+* Phase 1 (Pallas): the conv as a tiled MXU matmul whose epilogue
+  accumulates per-channel sum and sum-of-squares in f32 VMEM scratch while
+  the f32 accumulator tile is still resident — z is written ONCE and never
+  re-read for statistics. Two kernel variants share the epilogue:
+    - 1x1 convs (2 of 3 convs in every ResNet bottleneck + all projection
+      shortcuts): [N, Cin] x [Cin, Cout] tiled matmul, N = B*Ho*Wo
+      (stride-2 is a pre-slice).
+    - stride-1 SAME 3x3 convs: implicit GEMM over batch-row blocks — for
+      one output row h across a batch tile, the 9 taps are 9 static
+      slice+matmul accumulations against a VMEM-resident [3,3,Cin,Cout]
+      weight block; input rows stream with a 1-row halo from the
+      zero-padded input. No im2col materialization.
+* Phase 2 is pure elementwise (normalize, affine, residual add,
+  activation) and is left to XLA, which fuses it into one pass.
+* Backward is a jax composition under ``jax.custom_vjp``: train-mode BN
+  backward to dz fused by XLA, then dx/dW as MXU matmuls (1x1) or XLA conv
+  grads (3x3). Batch mean/var are returned for the running-average state
+  update (not differentiated, matching the unfused layer's state path).
+
+Dispatch seam (``enabled()`` / ``supported()``) mirrors the reference's
+helper checks at ConvolutionLayer.java:74-84, like ops/lstm_pallas.py and
+ops/attention_pallas.py. ``interpret=True`` runs the kernels on CPU for
+exactness tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # TPU memory-space hints exist only on TPU builds
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+
+def _pad_to(n, m):
+    return -(-n // m) * m
+
+
+# ---------------------------------------------------------------------------
+# Phase-1 kernels: conv matmul with fused per-channel stats epilogue
+# ---------------------------------------------------------------------------
+
+# tile geometry: rows (sublane dim) and Cout lanes; bk tiles the Cin
+# reduction of the 1x1 matmul. VMEM at the defaults: f32 acc 256x512 =
+# 512 KiB + double-buffered bf16 x/w blocks well under the ~16 MiB budget.
+_BN = 256
+_BK = 256
+_BJ = 512
+
+
+def _mm_stats_kernel(nk, x_ref, w_ref, z_ref, s_ref, acc_s, st_s):
+    """grid (j, i, k): j over Cout tiles, i over row tiles, k over Cin
+    tiles (innermost). Stats for Cout tile j accumulate across all i in
+    VMEM and are written once at the last row tile."""
+    i = pl.program_id(1)
+    k = pl.program_id(2)
+    ni = pl.num_programs(1)
+
+    @pl.when(k == 0)
+    def _():
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    acc_s[:] += jnp.dot(x_ref[:], w_ref[:],
+                        preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _():
+        z = acc_s[:]
+        z_ref[:] = z.astype(z_ref.dtype)
+
+        @pl.when(i == 0)
+        def _():
+            st_s[:] = jnp.zeros_like(st_s)
+
+        st_s[0:1] += jnp.sum(z, axis=0, keepdims=True)
+        st_s[1:2] += jnp.sum(z * z, axis=0, keepdims=True)
+
+        @pl.when(i == ni - 1)
+        def _():
+            s_ref[:] = st_s[:]
+
+
+def _matmul_stats(x2d, w2d, interpret):
+    """x2d [N, Cin] @ w2d [Cin, Cout] -> (z [N, Cout] in x.dtype,
+    stats [2, Cout] f32 = per-channel [sum, sum_of_squares]).
+
+    Pads every axis to tile multiples with zeros; zero rows contribute 0
+    to both stats sums, so the caller divides by the REAL row count.
+    """
+    n, cin = x2d.shape
+    cout = w2d.shape[1]
+    dt = x2d.dtype
+    bn = min(_BN, _pad_to(n, 8))
+    bk = min(_BK, _pad_to(cin, 128))
+    bj = min(_BJ, _pad_to(cout, 128))
+    np_, kp, jp = _pad_to(n, bn), _pad_to(cin, bk), _pad_to(cout, bj)
+    xp = jnp.pad(x2d, ((0, np_ - n), (0, kp - cin)))
+    wp = jnp.pad(w2d, ((0, kp - cin), (0, jp - cout)))
+    nk = kp // bk
+    z, stats = pl.pallas_call(
+        functools.partial(_mm_stats_kernel, nk),
+        grid=(jp // bj, np_ // bn, nk),
+        in_specs=[
+            pl.BlockSpec((bn, bk), lambda j, i, k: (i, k)),
+            pl.BlockSpec((bk, bj), lambda j, i, k: (k, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, bj), lambda j, i, k: (i, j)),
+            pl.BlockSpec((2, bj), lambda j, i, k: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((np_, jp), dt),
+            jax.ShapeDtypeStruct((2, jp), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bn, bj), jnp.float32),
+                        pltpu.VMEM((2, bj), jnp.float32)],
+        interpret=interpret,
+    )(xp, wp)
+    return z[:n, :cout], stats[:, :cout]
+
+
+def _conv3x3_stats_kernel(x0_ref, x1_ref, x2_ref, w_ref, z_ref, s_ref,
+                          st_s):
+    """grid (j, b, h): one output row h for a batch tile, Cout tile j.
+    The three x refs are the same padded input at row offsets h, h+1, h+2
+    (the 3x3 halo); taps unroll as 9 static-slice matmuls."""
+    b = pl.program_id(1)
+    h = pl.program_id(2)
+    nb = pl.num_programs(1)
+    nh = pl.num_programs(2)
+
+    bt, _, wp_, cinp = x0_ref.shape
+    wout = z_ref.shape[2]
+    acc = jnp.zeros((bt * wout, w_ref.shape[3]), jnp.float32)
+    for dh, row_ref in enumerate((x0_ref, x1_ref, x2_ref)):
+        rows = row_ref[:, 0]  # [bt, Wp, Cin]
+        for dw in range(3):
+            xs = rows[:, dw:dw + wout, :].reshape(bt * wout, cinp)
+            acc += jnp.dot(xs, w_ref[dh, dw],
+                           preferred_element_type=jnp.float32)
+    z_ref[:] = acc.reshape(bt, 1, wout, -1).astype(z_ref.dtype)
+
+    @pl.when((b == 0) & (h == 0))
+    def _():
+        st_s[:] = jnp.zeros_like(st_s)
+
+    st_s[0:1] += jnp.sum(acc, axis=0, keepdims=True)
+    st_s[1:2] += jnp.sum(acc * acc, axis=0, keepdims=True)
+
+    @pl.when((b == nb - 1) & (h == nh - 1))
+    def _():
+        s_ref[:] = st_s[:]
+
+
+def _conv3x3_stats(x, w, interpret):
+    """Stride-1 SAME 3x3 conv with fused stats. x [B,H,W,Cin] NHWC,
+    w [3,3,Cin,Cout] HWIO -> (z [B,H,W,Cout], stats [2, Cout] f32)."""
+    bsz, h, wd, cin = x.shape
+    cout = w.shape[3]
+    dt = x.dtype
+    cinp = _pad_to(cin, 128)
+    bj = min(_BJ, _pad_to(cout, 128))
+    jp = _pad_to(cout, bj)
+    # batch tile: keep the row-block GEMM M-dim (bt*W) near the 256-row
+    # sweet spot without exceeding it wildly on large images
+    bt = max(1, min(bsz, _pad_to(256 // max(wd, 1), 1)))
+    while bsz % bt:
+        bt -= 1
+    bp = bsz  # batch stays unpadded (bt divides it)
+    # zero-pad: 1-px spatial halo + channel/cout lane padding
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, cinp - cin)))
+    wp = jnp.pad(w, ((0, 0), (0, 0), (0, cinp - cin), (0, jp - cout)))
+    wp_ = wd + 2
+    row_spec = [
+        pl.BlockSpec((bt, 1, wp_, cinp),
+                     (lambda dh: lambda j, b, h: (b, h + dh, 0, 0))(dh))
+        for dh in range(3)
+    ]
+    z, stats = pl.pallas_call(
+        _conv3x3_stats_kernel,
+        grid=(jp // bj, bp // bt, h),
+        in_specs=row_spec + [
+            pl.BlockSpec((3, 3, cinp, bj), lambda j, b, h: (0, 0, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bt, 1, wd, bj), lambda j, b, h: (b, h, 0, j)),
+            pl.BlockSpec((2, bj), lambda j, b, h: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, h, wd, jp), dt),
+            jax.ShapeDtypeStruct((2, jp), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((2, bj), jnp.float32)],
+        interpret=interpret,
+    )(xp, xp, xp, wp)
+    return z[:, :, :, :cout], stats[:, :cout]
+
+
+# ---------------------------------------------------------------------------
+# Fused forward/backward (custom VJP)
+# ---------------------------------------------------------------------------
+
+
+def _act(name, z):
+    if name == "relu":
+        return jnp.maximum(z, 0.0)
+    if name == "identity":
+        return z
+    raise ValueError(f"fused conv-bn supports relu|identity, got {name!r}")
+
+
+def _conv_z(x, w, stride, interpret):
+    """Dispatch the phase-1 kernel by conv geometry. Returns (z [B,Ho,Wo,
+    Cout] in x.dtype, stats [2, Cout] f32)."""
+    kh, kw = w.shape[0], w.shape[1]
+    if (kh, kw) == (1, 1):
+        if stride != (1, 1):
+            x = x[:, ::stride[0], ::stride[1], :]
+        b, ho, wo, cin = x.shape
+        z2d, stats = _matmul_stats(x.reshape(b * ho * wo, cin),
+                                   w.reshape(cin, -1), interpret)
+        return z2d.reshape(b, ho, wo, -1), stats
+    assert (kh, kw) == (3, 3) and stride == (1, 1)
+    return _conv3x3_stats(x, w, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def fused_conv_bn_act(x, w, gamma, beta, residual,
+                      stride=(1, 1), eps=1e-5, act="relu", interpret=False):
+    """Train-mode fused conv + BN + (residual add) + activation.
+
+    x [B,H,W,Cin] NHWC, w HWIO ([1,1,Cin,Cout] or [3,3,Cin,Cout] SAME),
+    gamma/beta [Cout], residual [B,Ho,Wo,Cout] or None. Returns
+    (y, mean, var) — mean/var are the f32 batch statistics for the
+    caller's running-average update (never differentiated, matching the
+    unfused BatchNormalization state path).
+    """
+    y, mean, var, _ = _fwd_impl(x, w, gamma, beta, residual,
+                                stride, eps, act, interpret)
+    return y, mean, var
+
+
+def _fwd_impl(x, w, gamma, beta, residual, stride, eps, act, interpret):
+    z, stats = _conv_z(x, w, stride, interpret)
+    n_rows = z.shape[0] * z.shape[1] * z.shape[2]
+    mean = stats[0] / n_rows
+    var = jnp.maximum(stats[1] / n_rows - mean * mean, 0.0)
+    invstd = lax.rsqrt(var + eps)
+    scale = (gamma.astype(jnp.float32) * invstd)
+    shift = beta.astype(jnp.float32) - mean * scale
+    ypre = z.astype(jnp.float32) * scale + shift
+    if residual is not None:
+        ypre = ypre + residual.astype(jnp.float32)
+    y = _act(act, ypre).astype(z.dtype)
+    return y, mean, var, (z, mean, invstd)
+
+
+def _fused_fwd(x, w, gamma, beta, residual, stride, eps, act, interpret):
+    y, mean, var, (z, _, invstd) = _fwd_impl(
+        x, w, gamma, beta, residual, stride, eps, act, interpret)
+    has_res = residual is not None
+    return (y, mean, var), (x, w, gamma, beta, z, mean, invstd, y, has_res)
+
+
+def _fused_bwd(stride, eps, act, interpret, res, cots):
+    x, w, gamma, beta, z, mean, invstd, y, has_res = res
+    dy, _, _ = cots  # mean/var feed only the (stop-grad) running stats
+    f32 = jnp.float32
+    dy = dy.astype(f32)
+    if act == "relu":
+        dy = dy * (y > 0).astype(f32)
+    # dy is now the cotangent of (bn_out + residual)
+    dres = dy.astype(z.dtype) if has_res else None
+    zf = z.astype(f32)
+    xhat = (zf - mean) * invstd
+    axes = (0, 1, 2)
+    n = z.shape[0] * z.shape[1] * z.shape[2]
+    dgamma = jnp.sum(dy * xhat, axis=axes)
+    dbeta = jnp.sum(dy, axis=axes)
+    dxhat = dy * gamma.astype(f32)
+    # train-mode BN backward (batch stats participate in the graph)
+    dz = invstd * (dxhat - dbeta * gamma.astype(f32) / n
+                   - xhat * (dgamma * gamma.astype(f32) / n))
+    dz = dz.astype(z.dtype)
+    kh, kw = w.shape[0], w.shape[1]
+    if (kh, kw) == (1, 1):
+        xs = x[:, ::stride[0], ::stride[1], :] if stride != (1, 1) else x
+        b, ho, wo, cin = xs.shape
+        x2d = xs.reshape(b * ho * wo, cin)
+        dz2d = dz.reshape(b * ho * wo, -1)
+        dw2d = jnp.matmul(x2d.T, dz2d, preferred_element_type=f32)
+        dw = dw2d.astype(w.dtype).reshape(w.shape)
+        dx2d = jnp.matmul(dz2d, w.reshape(cin, -1).T,
+                          preferred_element_type=f32).astype(x.dtype)
+        dxs = dx2d.reshape(xs.shape)
+        if stride != (1, 1):
+            dx = jnp.zeros(x.shape, x.dtype)
+            dx = dx.at[:, ::stride[0], ::stride[1], :].set(dxs)
+        else:
+            dx = dxs
+    else:
+        dimn = ("NHWC", "HWIO", "NHWC")
+        dx = lax.conv_general_dilated(
+            dz, jnp.flip(w, (0, 1)).swapaxes(2, 3),
+            window_strides=(1, 1), padding="SAME",
+            dimension_numbers=dimn).astype(x.dtype)
+        dw = lax.conv_general_dilated(
+            x.transpose(3, 1, 2, 0), dz.transpose(1, 2, 0, 3),
+            window_strides=(1, 1), padding=[(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ).transpose(1, 2, 0, 3).astype(w.dtype)
+    return (dx, dw, dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype),
+            dres)
+
+
+fused_conv_bn_act.defvjp(_fused_fwd, _fused_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch seam
+# ---------------------------------------------------------------------------
+
+
+def enabled():
+    """Env flag + TPU backend, like the lstm/attention seams."""
+    from deeplearning4j_tpu.ops.attention_pallas import backend_is_tpu
+    if os.environ.get("DL4J_TPU_FUSED_CONV", "1") == "0":
+        return False
+    return backend_is_tpu()
+
+
+def supported(kernel, stride, padding, dilation, act):
+    """Geometries the phase-1 kernels cover: 1x1 (any stride via
+    pre-slice) and stride-1 SAME 3x3, no dilation, relu/identity. The
+    stem 7x7 and the three stride-2 3x3 convs in ResNet50 stay on XLA's
+    conv — they are <6% of the conv FLOPs."""
+    if act not in ("relu", "identity"):
+        return False
+    if tuple(dilation) != (1, 1):
+        return False
+    k = tuple(kernel)
+    if k == (1, 1):
+        return True
+    return k == (3, 3) and tuple(stride) == (1, 1) and padding == "same"

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Round-4 measurement matrix: supersedes measure_r3.sh (still valid) with
+# the fused-conv A/B stacked on remat, the peephole/masked LSTM kernels,
+# and the 1F1B pipeline A/B. One command for a live-tunnel window; the
+# tunnel is single-client — stop any pytest/python first. Every live
+# record auto-persists into BENCH_TPU_MEASURED.json as it completes.
+#
+#   bash measure_r4.sh 2>&1 | tee /tmp/measure_r4.log
+set -u
+cd "$(dirname "$0")"
+
+run() { echo "=== ${CFG} $* ==="; env "$@" python bench.py "${CFG}"; }
+
+# 1. the north star: ResNet50 MFU — baseline / remat / remat+fused A/B/C
+CFG=resnet50 run BENCH_REMAT=0
+CFG=resnet50 run BENCH_REMAT=1
+CFG=resnet50 run BENCH_REMAT=1 BENCH_FUSED_CONV=1
+CFG=resnet50 run BENCH_REMAT=0 BENCH_FUSED_CONV=1
+CFG=resnet50 run BENCH_REMAT=1 BENCH_BATCH=128
+CFG=resnet50 run BENCH_REMAT=1 BENCH_FUSED_CONV=1 BENCH_BATCH=128
+CFG=resnet50 run BENCH_REMAT=1 BENCH_BATCH=256
+CFG=resnet50 run BENCH_REMAT=1 BENCH_FUSED_CONV=1 BENCH_BATCH=256
+# 2. tiled-Wh LSTM past the old H=512 cap, with scan-path A/B
+CFG=lstm run BENCH_LSTM_HIDDEN=1024
+CFG=lstm run BENCH_LSTM_HIDDEN=1024 DL4J_TPU_FUSED_LSTM=0
+CFG=lstm run BENCH_LSTM_HIDDEN=2048
+CFG=lstm run BENCH_LSTM_HIDDEN=2048 DL4J_TPU_FUSED_LSTM=0
+# 3. word2vec at production scale (V=100k, D=300, 10M words)
+CFG=word2vec run BENCH_W2V_SCALE=production
+# 4. refresh the standard sweep records
+for c in lenet lstm word2vec parallel transformer longcontext; do
+  CFG=$c run _=;
+done
+echo "=== matrix complete; records merged into BENCH_TPU_MEASURED.json ==="
