@@ -97,8 +97,14 @@ class FusedConvBNVertex(GraphVertex):
         r = xs[1] if self.residual else None
         use_kernel, interpret = self._kernel_applies(train)
         if use_kernel:
+            # kernel interface runs in the COMPUTE dtype (bf16 under the
+            # mixed policy — 4x the f32 MXU rate, half the W/x traffic);
+            # stats/normalize stay f32 inside fused_conv_bn_act
+            cd, _ = _dtypes.compute_dtypes_for(x.dtype)
             y, mean, var = conv_pallas.fused_conv_bn_act(
-                x, params["W"], params["gamma"], params["beta"], r,
+                x.astype(cd), params["W"].astype(cd),
+                params["gamma"], params["beta"],
+                None if r is None else r.astype(cd),
                 _pair(self.stride), self.eps, self.activation, interpret)
             new_state = {
                 "mean": self.decay * state["mean"]

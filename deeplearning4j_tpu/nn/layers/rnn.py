@@ -129,18 +129,20 @@ class LSTM(ParamLayer):
         else:
             h0, c0 = initial_state
 
-        if mask_tm is None and self._fused_eligible(x, mask):
+        if self._fused_eligible(x, mask):
             from deeplearning4j_tpu.ops.lstm_pallas import fused_sequence_padded
             # the kernel interface runs in the COMPUTE dtype (bf16 under the
             # mixed policy): halves the xz/dxz HBM traffic — the f32 dxz
             # stack alone was 38% of the train step in the round-2 profile —
             # and puts the recurrent matmul on the bf16 MXU path. Cell state
-            # stays f32 inside the kernel.
+            # stays f32 inside the kernel. Masked batches ride the kernel
+            # too (time-major [T, B] mask; state freezes at padded steps).
             cd, _ = _dtypes.compute_dtypes_for(x.dtype)
             wp = params.get("Wp")
             hs, (hT, cT) = fused_sequence_padded(
                 xz.astype(cd), params["Wh"].astype(cd), h0.astype(cd),
-                c0.astype(cd), wp=None if wp is None else wp.astype(cd))
+                c0.astype(cd), wp=None if wp is None else wp.astype(cd),
+                mask=mask_tm)
         elif mask_tm is None:
             def body(carry, xz_t):
                 return self._step(params, carry, xz_t, None)

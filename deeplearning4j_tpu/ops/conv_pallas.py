@@ -111,6 +111,8 @@ def _matmul_stats(x2d, w2d, interpret):
     Pads every axis to tile multiples with zeros; zero rows contribute 0
     to both stats sums, so the caller divides by the REAL row count.
     """
+    if not _HAS_PLTPU:
+        raise NotImplementedError("Pallas TPU support unavailable")
     n, cin = x2d.shape
     cout = w2d.shape[1]
     dt = x2d.dtype
@@ -179,6 +181,8 @@ def _conv3x3_stats_kernel(x0_ref, x1_ref, x2_ref, w_ref, z_ref, s_ref,
 def _conv3x3_stats(x, w, interpret):
     """Stride-1 SAME 3x3 conv with fused stats. x [B,H,W,Cin] NHWC,
     w [3,3,Cin,Cout] HWIO -> (z [B,H,W,Cout], stats [2, Cout] f32)."""
+    if not _HAS_PLTPU:
+        raise NotImplementedError("Pallas TPU support unavailable")
     bsz, h, wd, cin = x.shape
     cout = w.shape[3]
     dt = x.dtype

@@ -161,8 +161,12 @@ class TestFusedLstmKernel:
         assert not lstm_pallas.supported((4, 16, 32), 128, **ok)  # B<8
         assert lstm_pallas.supported(
             (8, 16, 32), 128, **{**ok, "peephole": True})  # peephole kernel
-        assert not lstm_pallas.supported(
+        # [B, T] sequence masks ride the kernel (VERDICT r3 #4); other
+        # mask ranks fall back
+        assert lstm_pallas.supported(
             (8, 16, 32), 128, **{**ok, "mask": np.ones((8, 16))})
+        assert not lstm_pallas.supported(
+            (8, 16, 32), 128, **{**ok, "mask": np.ones((8, 16, 1))})
         assert not lstm_pallas.supported(
             (8, 16, 32), 128, **{**ok, "activation": "relu"})
         # H>512 now dispatches to the tiled-Wh kernel (TestTiledLstmKernel);
@@ -229,11 +233,12 @@ class TestTiledLstmKernel:
         assert lstm_pallas.supported((8, 4, 64), 2048, peephole=False,
                                      mask=None, gate_activation="sigmoid",
                                      activation="tanh")
-        # peephole stays scan-path above the resident bound
-        assert not lstm_pallas.supported((8, 4, 64), 1024, peephole=True,
-                                         mask=None,
-                                         gate_activation="sigmoid",
-                                         activation="tanh")
+        # peephole rides the tiled kernel above the resident bound too
+        # (VERDICT r3 #4 — CudnnLSTMHelper had no size split)
+        assert lstm_pallas.supported((8, 4, 64), 1024, peephole=True,
+                                     mask=None,
+                                     gate_activation="sigmoid",
+                                     activation="tanh")
         # VMEM gate: very large B x H combinations refuse
         assert not lstm_pallas.supported((512, 4, 64), 2048, peephole=False,
                                          mask=None,
@@ -449,3 +454,211 @@ class TestFlashAttention:
                                       jnp.asarray(v), mask=jnp.asarray(mask))
         np.testing.assert_allclose(np.asarray(fused), np.asarray(naive),
                                    rtol=2e-5, atol=2e-6)
+
+
+def _ref_scan_any(xz, wh, h0, c0, wp=None, mask=None):
+    """Scan reference covering peephole x mask (mask time-major [T, B],
+    1=valid: state freezes at padded steps — nn/layers/rnn.py _step)."""
+    def step(carry, inp):
+        xz_t, m_t = inp
+        h_prev, c_prev = carry
+        z = xz_t + h_prev @ wh
+        zi, zf, zg, zo = jnp.split(z, 4, -1)
+        if wp is not None:
+            zi = zi + wp[0] * c_prev
+            zf = zf + wp[1] * c_prev
+        c = jax.nn.sigmoid(zf) * c_prev + jax.nn.sigmoid(zi) * jnp.tanh(zg)
+        if wp is not None:
+            zo = zo + wp[2] * c
+        h = jax.nn.sigmoid(zo) * jnp.tanh(c)
+        if m_t is not None:
+            m = m_t[:, None]
+            h = m * h + (1 - m) * h_prev
+            c = m * c + (1 - m) * c_prev
+        return (h, c), h
+    ms = jnp.ones(xz.shape[:2], xz.dtype) if mask is None else mask
+    (hT, cT), hs = jax.lax.scan(
+        lambda ca, inp: step(ca, (inp[0], inp[1])), (h0, c0), (xz, ms))
+    return hs, (hT, cT)
+
+
+class TestMaskedAndTiledPeepholeLstm:
+    """VERDICT r3 #4: masked sequences on every fused path, peephole on
+    the tiled large-H path. Numerics pinned vs the scan reference in
+    interpret mode."""
+
+    def _mask(self, T, B, seed):
+        rs = np.random.RandomState(seed)
+        lens = rs.randint(1, T + 1, B)
+        m = (np.arange(T)[:, None] < lens[None, :]).astype(np.float32)
+        return jnp.asarray(m)  # time-major [T, B]
+
+    def test_masked_forward_matches_scan(self):
+        xz, wh, h0, c0 = _inputs(T=5, B=8, H=128, seed=21)
+        mask = self._mask(5, 8, 21)
+        hs_f, (hT_f, cT_f) = lstm_pallas.fused_sequence_padded(
+            xz, wh, h0, c0, mask=mask, interpret=True)
+        hs_r, (hT_r, cT_r) = _ref_scan_any(xz, wh, h0, c0, mask=mask)
+        np.testing.assert_allclose(np.asarray(hs_f), np.asarray(hs_r),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(hT_f), np.asarray(hT_r),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cT_f), np.asarray(cT_r),
+                                   atol=1e-5)
+
+    def test_masked_peephole_forward_matches_scan(self):
+        xz, wh, h0, c0 = _inputs(T=4, B=8, H=128, seed=22)
+        rs = np.random.RandomState(122)
+        wp = jnp.asarray(rs.randn(3, 128).astype(np.float32) * 0.1)
+        mask = self._mask(4, 8, 22)
+        hs_f, (hT_f, cT_f) = lstm_pallas.fused_sequence_padded(
+            xz, wh, h0, c0, wp=wp, mask=mask, interpret=True)
+        hs_r, (hT_r, cT_r) = _ref_scan_any(xz, wh, h0, c0, wp=wp, mask=mask)
+        np.testing.assert_allclose(np.asarray(hs_f), np.asarray(hs_r),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cT_f), np.asarray(cT_r),
+                                   atol=1e-5)
+
+    def test_masked_gradients_match_scan(self):
+        xz, wh, h0, c0 = _inputs(T=4, B=8, H=100, seed=23)  # lane-padded H
+        mask = self._mask(4, 8, 23)
+
+        def make_loss(fn):
+            def loss(xz, wh, h0, c0):
+                hs, (hT, cT) = fn(xz, wh, h0, c0)
+                return (jnp.sum((hs * mask[..., None]) ** 2)
+                        + jnp.sum(jnp.tanh(hT)) + jnp.sum(cT ** 2))
+            return loss
+
+        gp = jax.grad(make_loss(
+            lambda *a: lstm_pallas.fused_sequence_padded(
+                *a, mask=mask, interpret=True)),
+            argnums=(0, 1, 2, 3))(xz, wh, h0, c0)
+        gr = jax.grad(make_loss(
+            lambda *a: _ref_scan_any(*a, mask=mask)),
+            argnums=(0, 1, 2, 3))(xz, wh, h0, c0)
+        for p, r, name in zip(gp, gr, ("dxz", "dwh", "dh0", "dc0")):
+            np.testing.assert_allclose(np.asarray(p), np.asarray(r),
+                                       atol=2e-5, err_msg=name)
+
+    @pytest.mark.slow
+    def test_tiled_peephole_forward_matches_scan_h640(self):
+        xz, wh, h0, c0 = _inputs(T=2, B=8, H=640, seed=24)
+        rs = np.random.RandomState(124)
+        wp = jnp.asarray(rs.randn(3, 640).astype(np.float32) * 0.1)
+        hs_f, (hT_f, cT_f) = lstm_pallas.lstm_fused_sequence_peephole(
+            xz, wh, wp, h0, c0, True)
+        hs_r, (hT_r, cT_r) = _ref_scan_any(xz, wh, h0, c0, wp=wp)
+        np.testing.assert_allclose(np.asarray(hs_f), np.asarray(hs_r),
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(cT_f), np.asarray(cT_r),
+                                   atol=1e-4)
+
+    @pytest.mark.slow
+    def test_tiled_peephole_gradients_match_scan_h640(self):
+        xz, wh, h0, c0 = _inputs(T=2, B=8, H=640, seed=25)
+        rs = np.random.RandomState(125)
+        wp = jnp.asarray(rs.randn(3, 640).astype(np.float32) * 0.1)
+
+        def make_loss(fn):
+            def loss(xz, wh, wp, h0, c0):
+                hs, (hT, cT) = fn(xz, wh, wp, h0, c0)
+                return jnp.sum(hs ** 2) + jnp.sum(cT ** 2)
+            return loss
+
+        gp = jax.grad(make_loss(
+            lambda *a: lstm_pallas.lstm_fused_sequence_peephole(*a, True)),
+            argnums=(0, 1, 2, 3, 4))(xz, wh, wp, h0, c0)
+        gr = jax.grad(make_loss(
+            lambda xz, wh, wp, h0, c0: _ref_scan_any(xz, wh, h0, c0, wp=wp)),
+            argnums=(0, 1, 2, 3, 4))(xz, wh, wp, h0, c0)
+        for p, r, name in zip(gp, gr, ("dxz", "dwh", "dwp", "dh0", "dc0")):
+            np.testing.assert_allclose(np.asarray(p), np.asarray(r),
+                                       atol=5e-4, err_msg=name)
+
+    @pytest.mark.slow
+    def test_tiled_masked_forward_matches_scan_h640(self):
+        xz, wh, h0, c0 = _inputs(T=3, B=8, H=640, seed=26)
+        mask = self._mask(3, 8, 26)
+        hs_f, (hT_f, cT_f) = lstm_pallas.fused_sequence_padded(
+            xz, wh, h0, c0, mask=mask, interpret=True)
+        hs_r, (hT_r, cT_r) = _ref_scan_any(xz, wh, h0, c0, mask=mask)
+        np.testing.assert_allclose(np.asarray(hs_f), np.asarray(hs_r),
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(cT_f), np.asarray(cT_r),
+                                   atol=1e-4)
+
+    def test_layer_masked_batch_uses_kernel_path(self, monkeypatch):
+        """The LSTM layer's masked-batch output is identical between the
+        scan path and the fused path (via the supported() contract —
+        dispatch itself is TPU-gated, so pin the layer's scan result to
+        the kernel called directly)."""
+        from deeplearning4j_tpu.nn import layers as L
+        layer = L.LSTM(n_out=128)
+        it = __import__("deeplearning4j_tpu.nn.conf.inputs",
+                        fromlist=["RecurrentType"]).RecurrentType(16, 4)
+        p = layer.init(jax.random.PRNGKey(0), it)
+        rs = np.random.RandomState(27)
+        x = jnp.asarray(rs.randn(8, 4, 16).astype(np.float32))
+        mask_bm = jnp.asarray(
+            (np.arange(4)[None, :] < rs.randint(1, 5, 8)[:, None])
+            .astype(np.float32))
+        y_scan, _ = layer.apply(p, {}, x, mask=mask_bm)
+        b, t, _ = x.shape
+        xz = (x.reshape(b * t, -1) @ p["Wx"] + p["b"]).reshape(
+            b, t, 4 * 128).transpose(1, 0, 2)
+        h0 = jnp.zeros((b, 128)); c0 = jnp.zeros((b, 128))
+        hs, _ = lstm_pallas.fused_sequence_padded(
+            xz, p["Wh"], h0, c0, mask=mask_bm.transpose(1, 0),
+            interpret=True)
+        y_kern = hs.transpose(1, 0, 2) * mask_bm[..., None]
+        np.testing.assert_allclose(np.asarray(y_scan), np.asarray(y_kern),
+                                   atol=1e-5)
+
+    @pytest.mark.slow
+    def test_masked_peephole_gradients_match_scan(self):
+        xz, wh, h0, c0 = _inputs(T=4, B=8, H=128, seed=28)
+        rs = np.random.RandomState(128)
+        wp = jnp.asarray(rs.randn(3, 128).astype(np.float32) * 0.1)
+        mask = self._mask(4, 8, 28)
+
+        def make_loss(fn):
+            def loss(xz, wh, wp, h0, c0):
+                hs, (hT, cT) = fn(xz, wh, wp, h0, c0)
+                return (jnp.sum((hs * mask[..., None]) ** 2)
+                        + jnp.sum(cT ** 2))
+            return loss
+
+        gp = jax.grad(make_loss(
+            lambda xz, wh, wp, h0, c0: lstm_pallas.fused_sequence_padded(
+                xz, wh, h0, c0, wp=wp, mask=mask, interpret=True)),
+            argnums=(0, 1, 2, 3, 4))(xz, wh, wp, h0, c0)
+        gr = jax.grad(make_loss(
+            lambda xz, wh, wp, h0, c0: _ref_scan_any(
+                xz, wh, h0, c0, wp=wp, mask=mask)),
+            argnums=(0, 1, 2, 3, 4))(xz, wh, wp, h0, c0)
+        for p, r, name in zip(gp, gr, ("dxz", "dwh", "dwp", "dh0", "dc0")):
+            np.testing.assert_allclose(np.asarray(p), np.asarray(r),
+                                       atol=5e-5, err_msg=name)
+
+    @pytest.mark.slow
+    def test_tiled_masked_gradients_match_scan_h640(self):
+        xz, wh, h0, c0 = _inputs(T=2, B=8, H=640, seed=29)
+        mask = self._mask(2, 8, 29)
+
+        def make_loss(fn):
+            def loss(xz, wh, h0, c0):
+                hs, (hT, cT) = fn(xz, wh, h0, c0)
+                return jnp.sum(hs ** 2) + jnp.sum(cT ** 2)
+            return loss
+
+        gp = jax.grad(make_loss(
+            lambda *a: lstm_pallas.fused_sequence_padded(
+                *a, mask=mask, interpret=True)),
+            argnums=(0, 1, 2, 3))(xz, wh, h0, c0)
+        gr = jax.grad(make_loss(
+            lambda *a: _ref_scan_any(*a, mask=mask)),
+            argnums=(0, 1, 2, 3))(xz, wh, h0, c0)
+        for p, r, name in zip(gp, gr, ("dxz", "dwh", "dh0", "dc0")):
+            np.testing.assert_allclose(np.asarray(p), np.asarray(r),
+                                       atol=5e-4, err_msg=name)
