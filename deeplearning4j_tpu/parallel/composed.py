@@ -51,7 +51,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn import updaters as U
 from deeplearning4j_tpu.nn.conf import inputs as I
-from deeplearning4j_tpu.parallel.pipeline import gpipe_schedule
+from deeplearning4j_tpu.parallel.pipeline import (
+    gpipe_schedule, lm_1f1b_loss_and_grads, one_f_one_b_schedule)
 
 
 def _ln(x, g, b, eps=1e-5):
@@ -73,7 +74,55 @@ def _causal_attention(q, k, v, seq_axis=None):
     return dot_product_attention(q, k, v, causal=True)
 
 
-def tp_block_forward(bp, h, *, activation="gelu", seq_axis=None):
+# Megatron-style f/g conjugate boundary pair for differentiating the tp
+# block with an explicit ``jax.vjp`` INSIDE a shard_map body (the 1F1B
+# schedule). Whole-shard_map AD (the GPipe path) tracks replication and
+# inserts these transposes itself; inside-body AD with check_vma=False
+# does NOT — plain psum transposes to another psum (double-counting by the
+# axis size, verified experimentally) and the missing entry psum leaves
+# per-shard cotangents partial. The pair restores the correct transposes:
+#
+#   g = psum_id_bwd:  row-parallel EXIT — forward reduces the partial
+#       outputs, backward passes the (replicated) cotangent through.
+#   f = id_psum_bwd:  column-parallel ENTRY — forward identity on the
+#       replicated activation, backward sums the per-shard partial
+#       cotangents (each shard only saw its own heads/columns).
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_id_bwd(y, axis):
+    return lax.psum(y, axis)
+
+
+def _g_fwd(y, axis):
+    return lax.psum(y, axis), None
+
+
+def _g_bwd(axis, _, dz):
+    return (dz,)
+
+
+psum_id_bwd.defvjp(_g_fwd, _g_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def id_psum_bwd(y, axis):
+    return y
+
+
+def _f_fwd(y, axis):
+    return y, None
+
+
+def _f_bwd(axis, _, dz):
+    return (lax.psum(dz, axis),)
+
+
+id_psum_bwd.defvjp(_f_fwd, _f_bwd)
+
+
+def tp_block_forward(bp, h, *, activation="gelu", seq_axis=None,
+                     inside_vjp=False):
     """One tensor-parallel transformer block on the model-axis shard.
 
     ``bp`` leaves are the LOCAL shard (inside shard_map):
@@ -84,19 +133,31 @@ def tp_block_forward(bp, h, *, activation="gelu", seq_axis=None):
       W2   [hid/tp, d], b2 [d]          row-parallel + replicated bias
     """
     from deeplearning4j_tpu.nn import activations as _act
+    if inside_vjp:
+        def f(y):
+            return id_psum_bwd(y, "model")
+
+        def g(y):
+            return psum_id_bwd(y, "model")
+    else:
+        def f(y):
+            return y
+
+        def g(y):
+            return lax.psum(y, "model")
     b, t, d = h.shape
     x = h
-    hn = _ln(x, bp["ln1_g"], bp["ln1_b"])
+    hn = f(_ln(x, bp["ln1_g"], bp["ln1_b"]))
     qkv = jnp.einsum("btd,dghe->btghe", hn, bp["Wqkv"]) + bp["bqkv"]
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]   # [B,T,hl,dh]
     attn = _causal_attention(q, k, v, seq_axis)
     y = jnp.einsum("bthe,hed->btd", attn, bp["Wo"])
-    y = lax.psum(y, "model") + bp["bo"]
+    y = g(y) + bp["bo"]
     x = x + y
-    hn = _ln(x, bp["ln2_g"], bp["ln2_b"])
+    hn = f(_ln(x, bp["ln2_g"], bp["ln2_b"]))
     m = _act.get(activation)(jnp.einsum("btd,df->btf", hn, bp["W1"])
                              + bp["b1"])
-    m = lax.psum(jnp.einsum("btf,fd->btd", m, bp["W2"]), "model") + bp["b2"]
+    m = g(jnp.einsum("btf,fd->btd", m, bp["W2"])) + bp["b2"]
     # scan-carry dtype stability: the attention path may promote (f64 under
     # x64 test mode); the residual stream stays in the input dtype
     return (x + m).astype(h.dtype)
@@ -114,7 +175,9 @@ class ComposedParallelLM:
 
     def __init__(self, *, vocab_size, n_layers, d_model, n_heads, seq_len,
                  mesh: Mesh, n_microbatches=2, mlp_ratio=4, updater=None,
-                 seed=12345, remat=False, shard_optimizer_state=False):
+                 seed=12345, remat=False, shard_optimizer_state=False,
+                 schedule="gpipe"):
+        assert schedule in ("gpipe", "1f1b"), schedule
         for ax in ("data", "model", "seq", "stage"):
             assert ax in mesh.axis_names, f"mesh needs a {ax!r} axis"
         self.vocab_size = vocab_size
@@ -144,6 +207,7 @@ class ComposedParallelLM:
         # grads into the sharded update and all-gathers params out.
         # Per-leaf guard: only dimensions divisible by dp shard.
         self.shard_optimizer_state = shard_optimizer_state
+        self.schedule = schedule
         self.params = None
         self.opt_state = None
         self._step_fn = None
@@ -286,7 +350,40 @@ class ComposedParallelLM:
                                    axis=-1)
         return jnp.mean(nll)
 
+    def _build_step_1f1b(self):
+        """1F1B for the composed facade: the explicit-VJP schedule replaces
+        AD-through-GPipe; tp/sp collectives inside the block and their
+        transposes are untouched (extra_axes lists only the activation-
+        sharding axes — 'model' reductions remain the block's own)."""
+        upd = self.updater
+        extra = ("data", "seq") if self.sp > 1 else ("data",)
+        block = functools.partial(
+            tp_block_forward, inside_vjp=True,
+            seq_axis="seq" if self.sp > 1 else None)
+        act_spec = (P(None, "data", "seq") if self.sp > 1
+                    else P(None, "data"))
+
+        def step(params, opt_state, ids, labels, it):
+            loss, grads = lm_1f1b_loss_and_grads(
+                self.embed, block, self.mesh, self.n_micro, self.n_stages,
+                self._block_specs(), act_spec, extra, params, ids, labels)
+            updates, opt_state = upd.update(grads, opt_state, params, it)
+            params = jax.tree_util.tree_map(jnp.add, params, updates)
+            return params, opt_state, loss
+
+        data_sh = NamedSharding(self.mesh, P("data"))
+        opt_sh = self._opt_shardings(self.opt_state)
+        return jax.jit(
+            step,
+            in_shardings=(self.param_shardings, opt_sh, data_sh, data_sh,
+                          None),
+            out_shardings=(self.param_shardings, opt_sh,
+                           NamedSharding(self.mesh, P())),
+            donate_argnums=(0, 1))
+
     def _build_step(self):
+        if self.schedule == "1f1b":
+            return self._build_step_1f1b()
         upd = self.updater
 
         def step(params, opt_state, ids, labels, it):

@@ -38,24 +38,12 @@ from deeplearning4j_tpu.nn import updaters as U
 from deeplearning4j_tpu.nn.conf import inputs as I
 
 
-def gpipe_schedule(block, n_micro, n_stages, remat=False):
-    """Per-device GPipe schedule body (call inside shard_map over 'stage').
-
-    ``block``: the (static) layer object whose ``apply(params, {}, x)`` runs
-    one block. Returns ``run(local_blocks, x_mb)`` where ``local_blocks`` is
-    the device's stacked slab [L/S, ...] and ``x_mb`` is [M, mb, T, D]
-    microbatched activations (same on every stage; only stage 0 reads them).
-    Output: [M, mb, T, D] finished activations (identical on every stage).
-
-    ``remat``: rematerialize each block's forward during the backward
-    schedule (jax.checkpoint) — GPipe's activation stash shrinks from every
-    intra-block intermediate to one activation per block per in-flight
-    microbatch, the standard HBM-for-FLOPs trade for deep pipelines.
-    """
-
+def _stage_fn_of(block, remat=False):
+    """Shared stage body: scan a device's stacked block slab over an
+    activation. ``block`` is a layer object (``apply(params, {}, x)``) or a
+    plain ``bp, h -> y`` function (the composed facade passes its
+    tensor-parallel block forward here)."""
     if callable(block) and not hasattr(block, "apply"):
-        # generalized entry: a plain ``bp, h -> y`` function (the composed
-        # dp x tp x pp facade passes a tensor-parallel block forward here)
         def one_block(bp, h):
             return block(bp, h)
     else:
@@ -71,6 +59,37 @@ def gpipe_schedule(block, n_micro, n_stages, remat=False):
             return one_block(bp, h), None
         h, _ = lax.scan(body, x, local_blocks)
         return h
+    return stage_fn
+
+
+def lm_head_loss(scale):
+    """Per-microbatch LM loss closure shared by every 1F1B caller:
+    sum of token NLLs times ``scale`` (pick scale = 1/(B*T) so summing
+    over microbatches and data shards reproduces the full-batch mean)."""
+    def head_loss(hp, h, lab):
+        logits = h @ hp["W"] + hp["b"]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        nll = -jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32),
+                                   axis=-1)
+        return jnp.sum(nll) * scale
+    return head_loss
+
+
+def gpipe_schedule(block, n_micro, n_stages, remat=False):
+    """Per-device GPipe schedule body (call inside shard_map over 'stage').
+
+    ``block``: the (static) layer object whose ``apply(params, {}, x)`` runs
+    one block. Returns ``run(local_blocks, x_mb)`` where ``local_blocks`` is
+    the device's stacked slab [L/S, ...] and ``x_mb`` is [M, mb, T, D]
+    microbatched activations (same on every stage; only stage 0 reads them).
+    Output: [M, mb, T, D] finished activations (identical on every stage).
+
+    ``remat``: rematerialize each block's forward during the backward
+    schedule (jax.checkpoint) — GPipe's activation stash shrinks from every
+    intra-block intermediate to one activation per block per in-flight
+    microbatch, the standard HBM-for-FLOPs trade for deep pipelines.
+    """
+    stage_fn = _stage_fn_of(block, remat)
 
     def run(local_blocks, x_mb):
         s = lax.axis_index("stage")
@@ -101,6 +120,152 @@ def gpipe_schedule(block, n_micro, n_stages, remat=False):
     return run
 
 
+def one_f_one_b_schedule(block, n_micro, n_stages, head_loss,
+                         extra_axes=()):
+    """1F1B schedule (Megatron-style non-interleaved): each combined tick
+    runs ONE microbatch forward and ONE microbatch backward per stage, with
+    explicit VJPs instead of whole-schedule AD.
+
+    Why: GPipe's backward is derived by differentiating the forward scan,
+    so every in-flight microbatch's activations stay stashed until the
+    backward sweep — the stash grows with M. Here backward for microbatch
+    m starts as soon as its forward clears the last stage; only the stage
+    INPUT per in-flight microbatch is saved (2S-1 slots, independent of M)
+    and the stage forward recomputes inside its VJP — the standard
+    1F1B-with-recompute memory profile that lets M grow (and the relative
+    bubble (S-1)/M shrink) without the activation stash growing.
+
+    Tick arithmetic: fwd(m, s) at tick m + s; bwd(m, s) at tick
+    m + 2(S-1) - s. The last stage runs F and B of the same microbatch in
+    one tick; cotangents hop backward over the reverse ppermute ring.
+
+    ``head_loss(head_p, h_mb, lab_mb)`` must return the SCALED scalar loss
+    contribution of one microbatch's final activations (so that summing
+    over microbatches — and over ``data_axis`` shards — gives the
+    full-batch loss); its VJP seeds the backward wave on stage S-1 and
+    yields the head grads.
+
+    Returns ``run(local_blocks, head_p, x_mb, lab_mb) ->
+    (loss, dblocks_local, dhead, dx_mb)`` for use inside shard_map over
+    'stage'. ``extra_axes``: mesh axes that shard the activation dims
+    (e.g. ('data',) or ('data', 'seq')) — block/head grads and the loss
+    psum over them inside; tensor-parallel axes must NOT be listed (their
+    reductions are the transposes of the block's own collectives).
+    """
+
+    stage_fn = _stage_fn_of(block)
+
+    def run(local_blocks, head_p, x_mb, lab_mb):
+        s = lax.axis_index("stage")
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+        n_slots = 2 * n_stages - 1  # max residual lifetime in ticks
+
+        zero_act = jnp.zeros_like(x_mb[0])
+        zero_blocks = jax.tree_util.tree_map(jnp.zeros_like, local_blocks)
+        zero_head = jax.tree_util.tree_map(jnp.zeros_like, head_p)
+
+        def tick(carry, t):
+            a_buf, g_buf, resid, gblocks, ghead, dx_acc, loss_acc = carry
+            # ---- forward half ----
+            m_f = t - s
+            f_active = (m_f >= 0) & (m_f < n_micro)
+            fresh = lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(m_f, 0, n_micro - 1), axis=0, keepdims=False)
+            x_in = jnp.where(s == 0, fresh, a_buf)
+            y_f = stage_fn(local_blocks, x_in)
+            slot_f = jnp.mod(jnp.clip(m_f, 0, n_micro - 1), n_slots)
+            saved = jnp.where(f_active, x_in,
+                              lax.dynamic_index_in_dim(resid, slot_f, axis=0,
+                                                       keepdims=False))
+            resid = lax.dynamic_update_index_in_dim(resid, saved, slot_f,
+                                                    axis=0)
+            a_next = lax.ppermute(jnp.where(f_active, y_f, zero_act),
+                                  "stage", fwd_perm)
+            # ---- backward half ----
+            m_b = t - 2 * (n_stages - 1) + s
+            b_active = (m_b >= 0) & (m_b < n_micro)
+            m_bc = jnp.clip(m_b, 0, n_micro - 1)
+            slot_b = jnp.mod(m_bc, n_slots)
+            x_saved = lax.dynamic_index_in_dim(resid, slot_b, axis=0,
+                                               keepdims=False)
+            lab = lax.dynamic_index_in_dim(lab_mb, m_bc, axis=0,
+                                           keepdims=False)
+            y_b, vjp = jax.vjp(stage_fn, local_blocks, x_saved)
+            loss_mb, head_vjp = jax.vjp(
+                lambda hp, h: head_loss(hp, h, lab), head_p, y_b)
+            dhead_mb, dy_head = head_vjp(jnp.ones_like(loss_mb))
+            dy = jnp.where(s == n_stages - 1, dy_head, g_buf)
+            db_mb, dx_mb = vjp(dy)
+            bact = b_active.astype(jnp.float32)
+            gblocks = jax.tree_util.tree_map(
+                lambda g, d: g + bact * d, gblocks, db_mb)
+            last = (b_active & (s == n_stages - 1)).astype(jnp.float32)
+            ghead = jax.tree_util.tree_map(
+                lambda g, d: g + last * d, ghead, dhead_mb)
+            loss_acc = loss_acc + last * loss_mb
+            dx_keep = jnp.where(b_active & (s == 0), dx_mb,
+                                lax.dynamic_index_in_dim(dx_acc, m_bc,
+                                                         axis=0,
+                                                         keepdims=False))
+            dx_acc = lax.dynamic_update_index_in_dim(dx_acc, dx_keep, m_bc,
+                                                     axis=0)
+            g_next = lax.ppermute(jnp.where(b_active, dx_mb, zero_act),
+                                  "stage", bwd_perm)
+            return (a_next, g_next, resid, gblocks, ghead, dx_acc,
+                    loss_acc), None
+
+        resid0 = jnp.zeros((n_slots,) + x_mb.shape[1:], x_mb.dtype)
+        dx0 = jnp.zeros_like(x_mb)
+        carry0 = (zero_act, zero_act, resid0, zero_blocks, zero_head, dx0,
+                  jnp.zeros((), jnp.float32))
+        ticks = jnp.arange(n_micro + 2 * (n_stages - 1))
+        (_, _, _, gblocks, ghead, dx_acc, loss_acc), _ = lax.scan(
+            tick, carry0, ticks)
+        # loss/head grads live on stage S-1, dx on stage 0: psums broadcast;
+        # extra_axes shard the activation dims, so replicated-param grads
+        # and the loss also sum over them
+        stage_extra = ("stage",) + tuple(extra_axes)
+        loss = lax.psum(loss_acc, stage_extra)
+        ghead = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, stage_extra), ghead)
+        if extra_axes:
+            gblocks = jax.tree_util.tree_map(
+                lambda g: lax.psum(g, tuple(extra_axes)), gblocks)
+        dx_mb = lax.psum(dx_acc, "stage")
+        return loss, gblocks, ghead, dx_mb
+
+    return run
+
+
+def lm_1f1b_loss_and_grads(embed, block, mesh, n_micro, n_stages,
+                           block_specs, act_spec, extra_axes,
+                           params, ids, labels):
+    """Loss + full grad dict for the embed/blocks/head LM family via the
+    1F1B schedule — shared by PipelineParallelLM and ComposedParallelLM
+    (they differ only in block forward, block specs, and activation
+    sharding). The embedding runs outside the pipelined region with an
+    explicit vjp; dx from the schedule closes its backward."""
+    def embed_fwd(ep):
+        emb, _ = embed.apply(ep, {}, ids)
+        return emb
+    emb, vjp_e = jax.vjp(embed_fwd, params["embed"])
+    b, t, d = emb.shape
+    mb = b // n_micro
+    x_mb = emb.reshape(n_micro, mb, t, d)
+    lab_mb = labels.reshape(n_micro, mb, t)
+    run = one_f_one_b_schedule(block, n_micro, n_stages,
+                               lm_head_loss(1.0 / (b * t)), extra_axes)
+    loss, gblocks, ghead, dx_mb = shard_map(
+        run, mesh=mesh,
+        in_specs=(block_specs, P(), act_spec, act_spec),
+        out_specs=(P(), block_specs, P(), act_spec),
+        check_vma=False,
+    )(params["blocks"], params["head"], x_mb, lab_mb)
+    (dembed,) = vjp_e(dx_mb.reshape(b, t, d))
+    return loss, {"embed": dembed, "blocks": gblocks, "head": ghead}
+
+
 class PipelineParallelLM:
     """Decoder-only transformer LM trained with pipeline parallelism.
 
@@ -114,8 +279,9 @@ class PipelineParallelLM:
 
     def __init__(self, *, vocab_size, n_layers, d_model, n_heads, seq_len,
                  mesh: Mesh, n_microbatches=4, mlp_ratio=4, updater=None,
-                 seed=12345, remat=False):
+                 seed=12345, remat=False, schedule="gpipe"):
         assert "stage" in mesh.axis_names, "mesh needs a 'stage' axis"
+        assert schedule in ("gpipe", "1f1b"), schedule
         self.vocab_size = vocab_size
         self.n_layers = n_layers
         self.d_model = d_model
@@ -132,6 +298,7 @@ class PipelineParallelLM:
         self.updater = updater or U.Adam(learning_rate=3e-4)
         self.seed = seed
         self.remat = remat
+        self.schedule = schedule
         self.params = None
         self.opt_state = None
         self._step_fn = None
@@ -205,7 +372,37 @@ class PipelineParallelLM:
                                    axis=-1)
         return jnp.mean(nll)
 
+    def _build_step_1f1b(self):
+        """1F1B step: grads assembled from the explicit-VJP schedule
+        (one_f_one_b_schedule) instead of differentiating the GPipe scan —
+        loss and grads are the same math, the order (and the activation
+        stash) changes."""
+        upd = self.updater
+        assert "data" in self.mesh.axis_names, \
+            "PipelineParallelLM meshes carry a 'data' axis (size 1 is fine)"
+
+        def step(params, opt_state, ids, labels, it):
+            loss, grads = lm_1f1b_loss_and_grads(
+                self.embed, self.block, self.mesh, self.n_micro,
+                self.n_stages, P("stage"), P(None, "data"), ("data",),
+                params, ids, labels)
+            updates, opt_state = upd.update(grads, opt_state, params, it)
+            params = jax.tree_util.tree_map(jnp.add, params, updates)
+            return params, opt_state, loss
+
+        data_sh = NamedSharding(self.mesh, P("data"))
+        opt_sh = self._opt_shardings(self.opt_state)
+        return jax.jit(
+            step,
+            in_shardings=(self.param_shardings, opt_sh, data_sh, data_sh,
+                          None),
+            out_shardings=(self.param_shardings, opt_sh,
+                           NamedSharding(self.mesh, P())),
+            donate_argnums=(0, 1))
+
     def _build_step(self):
+        if self.schedule == "1f1b":
+            return self._build_step_1f1b()
         upd = self.updater
 
         def step(params, opt_state, ids, labels, it):

