@@ -137,6 +137,22 @@ def split_sentences(text):
     return out
 
 
+def max_match(run, lexicon, max_word_len):
+    """Greedy forward maximum matching against the lexicon; unmatched
+    characters become single-char tokens (the classical CJK baseline)."""
+    out, i, n = [], 0, len(run)
+    while i < n:
+        for ln in range(min(max_word_len, n - i), 1, -1):
+            if run[i:i + ln] in lexicon:
+                out.append(run[i:i + ln])
+                i += ln
+                break
+        else:
+            out.append(run[i])
+            i += 1
+    return out
+
+
 class _CjkTokenizerFactoryBase:
     """Shared CJK factory: lexicon maximum-matching + script-run rules."""
 
@@ -164,19 +180,15 @@ class _CjkTokenizerFactoryBase:
         return list(run)
 
     def _max_match(self, run):
-        """Greedy forward maximum matching against the lexicon; unmatched
-        characters become single-char tokens (the classical CJK baseline)."""
-        out, i, n = [], 0, len(run)
-        while i < n:
-            for ln in range(min(self.max_word_len, n - i), 1, -1):
-                if run[i:i + ln] in self.lexicon:
-                    out.append(run[i:i + ln])
-                    i += ln
-                    break
-            else:
-                out.append(run[i])
-                i += 1
-        return out
+        return max_match(run, self.lexicon, self.max_word_len)
+
+    def _lattice_create(self, text, tokens):
+        """Shared lattice-mode tail: drop-filter + preprocessor + wrap."""
+        tokens = [t for t in tokens if _char_class(t[0]) not in self.drop]
+        if self.preprocessor is not None:
+            tokens = [self.preprocessor.pre_process(t) for t in tokens]
+            tokens = [t for t in tokens if t]
+        return Tokenizer(tokens)
 
     def _runs(self, text):
         return _script_runs(unicodedata.normalize("NFKC", text))
@@ -195,11 +207,39 @@ class _CjkTokenizerFactoryBase:
 
 class ChineseTokenizerFactory(_CjkTokenizerFactoryBase):
     """Reference: deeplearning4j-nlp-chinese ChineseTokenizerFactory (ansj).
-    Han runs max-match the embedded+user lexicon; unmatched characters
-    tokenize per character."""
+
+    Default mode="lattice" runs the Viterbi lattice segmenter
+    (text/zh_lattice.py — dictionary + rule candidates incl. the ansj
+    person-name invocation + connection-cost Viterbi, the ansj design
+    self-contained). mode="maxmatch" keeps the greedy lexicon
+    maximum-matching baseline (per-character fallback without a lexicon).
+    """
 
     per_char_scripts = ("han",)
     default_lexicon = _ZH_LEXICON
+
+    def __init__(self, lexicon=None, preprocessor=None, max_word_len=8,
+                 mode="lattice", use_default_lexicon=True):
+        super().__init__(lexicon=lexicon, preprocessor=preprocessor,
+                         max_word_len=max_word_len,
+                         use_default_lexicon=use_default_lexicon)
+        if mode not in ("lattice", "maxmatch"):
+            raise ValueError(f"unknown mode {mode!r}")
+        # same contract as the Japanese factory: without its bundled
+        # dictionary a lattice cannot run, so that request means maxmatch
+        self.mode = mode if use_default_lexicon else "maxmatch"
+        from deeplearning4j_tpu.text import zh_lattice
+        # merge the user lexicon into the lattice dictionary ONCE (create()
+        # runs per document in SequenceVectors loops)
+        self._merged = zh_lattice.merge_entries(set(lexicon)
+                                                if lexicon else None)
+
+    def create(self, text: str) -> Tokenizer:
+        if self.mode == "lattice":
+            from deeplearning4j_tpu.text import zh_lattice
+            return self._lattice_create(
+                text, zh_lattice.tokenize(text, merged=self._merged))
+        return super().create(text)
 
 
 class JapaneseTokenizerFactory(_CjkTokenizerFactoryBase):
@@ -225,6 +265,8 @@ class JapaneseTokenizerFactory(_CjkTokenizerFactoryBase):
         super().__init__(lexicon=lexicon, preprocessor=preprocessor,
                          max_word_len=max_word_len,
                          use_default_lexicon=use_default_lexicon)
+        if mode not in ("lattice", "maxmatch"):
+            raise ValueError(f"unknown mode {mode!r}")
         # lexicon-free segmentation (use_default_lexicon=False) is
         # inherently the heuristic path — a lattice without its bundled
         # dictionary cannot run, so that request selects maxmatch mode
@@ -236,12 +278,9 @@ class JapaneseTokenizerFactory(_CjkTokenizerFactoryBase):
     def create(self, text: str) -> Tokenizer:
         if self.mode == "lattice":
             from deeplearning4j_tpu.text import ja_lattice
-            tokens = ja_lattice.tokenize(
-                text, user_entries=self._user_lexicon)
-            if self.preprocessor is not None:
-                tokens = [self.preprocessor.pre_process(t) for t in tokens]
-                tokens = [t for t in tokens if t]
-            return Tokenizer(tokens)
+            return self._lattice_create(
+                text, ja_lattice.tokenize(text,
+                                          user_entries=self._user_lexicon))
         return self._create_maxmatch(text)
 
     def _create_maxmatch(self, text: str) -> Tokenizer:
@@ -330,24 +369,7 @@ class KoreanTokenizerFactory(_CjkTokenizerFactoryBase):
     def _segment_run(self, run, cls):
         if cls != "hangul":
             return [run]
-        if run in self.lexicon or not self.strip_josa:
-            return [run]  # known word, or raw-eojeol mode
-        # accept a lexicon split only when EVERY piece is a known word or a
-        # particle — a compound of knowns (한국사람) splits, but an unknown
-        # word that merely starts with a known word (한국어) stays whole
-        # (twitter-korean-text keeps unknown eojeol intact)
-        pieces = self._max_match(run) if self.lexicon else [run]
-        if not all(p in self.lexicon or p in _KO_JOSA for p in pieces):
-            pieces = [run]
-        # josa can only close the eojeol: strip from the FINAL piece
-        last = pieces[-1]
-        if last not in self.lexicon:
-            for josa in _KO_JOSA:
-                if last == josa and len(pieces) > 1:
-                    # a whole trailing piece that IS a particle
-                    return pieces if self.emit_josa else pieces[:-1]
-                if len(last) > len(josa) and last.endswith(josa):
-                    stem = last[:-len(josa)]
-                    tail = [stem, josa] if self.emit_josa else [stem]
-                    return pieces[:-1] + tail
-        return pieces
+        from deeplearning4j_tpu.text import ko_stemmer
+        return ko_stemmer.analyze_eojeol(
+            run, self.lexicon, _KO_JOSA, max_word_len=self.max_word_len,
+            strip=self.strip_josa, emit_suffixes=self.emit_josa)

@@ -1,0 +1,251 @@
+"""Lattice-based Chinese word segmenter (Viterbi).
+
+Reference analog: deeplearning4j-nlp-chinese — the ansj_seg segmenter
+(~75 files: core n-gram dictionary lookup over a double-array trie,
+person-name recognition, numeral/quantifier rules, and a shortest-path
+search over the word lattice). This module implements the same design
+self-contained, the ``text/ja_lattice.py`` precedent applied to Mandarin:
+
+1. **Dictionary lookup**: every substring (bounded length) from each
+   position is matched against an embedded dictionary of words, each
+   carrying a word cost (≈ -log frequency, coarsened) and a part-of-speech
+   connection class.
+2. **Rule candidates**: numeral runs (arabic or Chinese numerals) followed
+   by measure words, latin/digit runs as whole tokens, and ansj's
+   signature person-name rule — a common surname followed by one or two
+   non-dictionary han characters spawns a name candidate.
+3. **Viterbi**: dynamic programming over (position, class) minimizing
+   word+connection cost; the connection matrix is a compact class-pair
+   table (numeral→measure cheap, adjective→noun cheap, particle after
+   verb/noun cheap — the bigram-frequency core dictionary's role at class
+   granularity).
+
+The bundled dictionary is a starter lexicon of high-frequency Mandarin
+words (golden-tested in tests/test_text.py); production use merges a
+domain dictionary via ``user_entries``.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+
+# connection classes
+NOUN, VERB, ADJ, ADV, PRON, NUM, MEAS, PART, CONJ, PREP, NAME, UNK = \
+    range(12)
+
+
+def _build_dictionary():
+    d: dict[str, list[tuple[int, int]]] = {}
+
+    def add(words, cls, cost):
+        for w in words.split():
+            d.setdefault(w, []).append((cost, cls))
+
+    # --- pronouns / demonstratives ---
+    add("我 你 您 他 她 它 我们 你们 他们 她们 它们 自己 大家 咱们 "
+        "这 那 这个 那个 这些 那些 这里 那里 哪里 哪个 谁 什么 怎么 "
+        "为什么 多少 几 这样 那样 怎样", PRON, 2000)
+    # --- high-frequency nouns ---
+    add("人 事 物 年 月 日 天 时 时候 时间 地方 国家 首都 政府 人民 "
+        "世界 中国 北京 "
+        "上海 天安门 问题 工作 学习 学校 老师 学生 朋友 孩子 先生 "
+        "小姐 女士 东西 事情 生活 社会 经济 政治 文化 历史 科学 技术 "
+        "机器 数据 模型 训练 智能 计算 网络 电脑 手机 电话 汽车 火车 "
+        "飞机 城市 农村 公司 单位 家 家庭 父母 爸爸 妈妈 哥哥 弟弟 "
+        "姐姐 妹妹 儿子 女儿 水 火 山 河 海 天 地 路 门 窗 书 报 笔 "
+        "纸 桌子 椅子 房子 钱 饭 菜 肉 鱼 鸡 蛋 水果 苹果 米饭 面条 "
+        "茶 咖啡 牛奶 啤酒 春天 夏天 秋天 冬天 今天 明天 昨天 现在 "
+        "以前 以后 将来 过去 早上 上午 中午 下午 晚上 夜里 星期 礼拜 "
+        "名字 意思 办法 方法 原因 结果 目的 条件 情况 关系 影响 作用 "
+        "能力 水平 程度 方面 方向 部分 全部 内容 形式 声音 颜色 味道 "
+        "感觉 心情 身体 健康 医院 医生 病人 药 伤 痛 语言 汉语 英语 "
+        "中文 英文 文章 句子 词 字 话", NOUN, 2800)
+    # --- verbs ---
+    add("是 有 在 来 去 到 说 看 听 想 要 会 能 可以 应该 必须 需要 "
+        "知道 认识 了解 明白 懂 觉得 认为 希望 喜欢 爱 恨 怕 做 干 "
+        "作 用 拿 放 给 送 带 买 卖 吃 喝 睡 睡觉 起床 走 跑 飞 游 "
+        "坐 站 躺 住 开 关 打 打开 关上 写 读 念 学 教 问 回答 告诉 "
+        "帮助 找 丢 得到 失去 开始 结束 继续 停止 变 变成 成为 发生 "
+        "出现 消失 进 出 上 下 回 回来 回去 过 过来 过去 起 起来 "
+        "工作 休息 玩 笑 哭 生气 高兴 担心 放心 小心 注意 记得 忘记 "
+        "等 等待 见 见面 遇到 碰到 参加 离开 经过 通过 完成 实现 "
+        "研究 发现 发明 创造 生产 建设 发展 提高 改变 解决 决定 选择 "
+        "准备 打算 计划 试 尝试 练习 复习 预习 考试 毕业 上班 下班 "
+        "上课 下课 开车 坐车 骑车 走路 旅行 旅游 唱歌 跳舞 画画 "
+        "游泳 跑步 锻炼 运动 比赛 赢 输", VERB, 2600)
+    # --- adjectives ---
+    add("大 小 多 少 高 低 长 短 宽 窄 厚 薄 快 慢 早 晚 新 旧 好 "
+        "坏 对 错 真 假 美 丑 胖 瘦 冷 热 暖和 凉快 干净 脏 安静 吵 "
+        "忙 闲 累 饿 渴 饱 困 漂亮 好看 难看 好吃 难吃 好听 难听 "
+        "容易 简单 复杂 困难 重要 主要 必要 可能 一样 不同 相同 特别 "
+        "普通 一般 有名 著名 年轻 年老 聪明 笨 认真 马虎 努力 勤奋 "
+        "懒 快乐 幸福 痛苦 难过 伤心 奇怪 正常 方便 舒服 危险 安全 "
+        "便宜 贵 远 近 深 浅 强 弱 轻 重 满 空 够 整齐 乱", ADJ, 2700)
+    # --- adverbs ---
+    add("不 没 没有 很 太 真 最 更 还 也 都 只 就 才 又 再 常 常常 "
+        "经常 总是 一直 已经 曾经 刚 刚才 马上 立刻 正在 一起 一共 "
+        "大概 也许 可能 当然 一定 必然 几乎 差不多 非常 十分 特别 "
+        "比较 稍微 有点 有点儿 越来越 忽然 突然 终于 到底 究竟 原来 "
+        "其实 确实 的确 互相 亲自 故意 尤其 甚至", ADV, 2400)
+    # --- numerals + measure words ---
+    add("一 二 三 四 五 六 七 八 九 十 百 千 万 亿 零 两 半 第一 "
+        "第二 第三 许多 很多 好多 一些 有些 一点 一点儿", NUM, 2200)
+    add("个 只 条 张 把 件 本 台 辆 架 艘 头 匹 棵 朵 座 间 套 双 "
+        "对 副 群 批 次 遍 趟 回 下 年 月 日 天 小时 分钟 秒 块 元 "
+        "角 分 斤 公斤 米 公里 岁 位 名 口 家 种 样 层 页 句 段 篇 "
+        "部 场 首 幅 支 枝 枚 粒 颗 滴 杯 瓶 碗 盘 锅 包 盒 箱 "
+        "袋", MEAS, 2000)
+    # --- particles / aspect markers ---
+    add("的 地 得 了 着 过 吗 呢 吧 啊 呀 嘛 哦 啦 们 所 之 者", PART, 800)
+    # --- conjunctions ---
+    add("和 与 跟 同 或 或者 还是 而 而且 并且 不但 不仅 但是 可是 "
+        "不过 然而 因为 所以 因此 于是 如果 要是 假如 虽然 尽管 无论 "
+        "不管 只要 只有 除非 然后 接着 首先 其次 最后 另外 此外 "
+        "比如 例如 总之", CONJ, 1800)
+    # --- prepositions ---
+    add("在 从 向 往 朝 对 对于 关于 至于 按 按照 根据 通过 经过 "
+        "为 为了 被 把 让 叫 比 跟 给 替 除了 自从 直到 离", PREP, 1900)
+    # --- greetings / set phrases ---
+    add("你好 您好 谢谢 再见 请问 对不起 没关系 不客气 欢迎 恭喜", NOUN, 1500)
+    return d
+
+
+_DICT = _build_dictionary()
+_MAX_WORD = max(len(w) for w in _DICT)
+
+_SURNAMES = set("王李张刘陈杨赵黄周吴徐孙胡朱高林何郭马罗梁宋郑谢韩唐")
+
+# connection-cost matrix at class granularity (ansj's core bigram
+# dictionary role). Base 1000; pairs tuned for the golden suite.
+_CONN_DEFAULT = 1000
+_CONN = {
+    (NUM, MEAS): -600, (MEAS, NOUN): 100, (ADJ, NOUN): 200,
+    (PRON, VERB): 100, (NOUN, VERB): 200, (VERB, NOUN): 200,
+    (VERB, PART): -200, (NOUN, PART): 0, (ADJ, PART): 0,
+    (PART, NOUN): 200, (ADV, VERB): 0, (ADV, ADJ): 0,
+    (PREP, NOUN): 100, (PREP, PRON): 100, (CONJ, NOUN): 300,
+    (CONJ, VERB): 300, (CONJ, PRON): 300, (VERB, PRON): 200,
+    (PRON, NOUN): 400, (NOUN, NOUN): 900, (VERB, VERB): 1200,
+    (NUM, NOUN): 500, (NAME, VERB): 200, (NAME, PART): 100,
+    (VERB, NAME): 300, (UNK, UNK): 1800, (UNK, PART): 200,
+    (PRON, MEAS): -100,
+}
+_BOS_COST = {PART: 2000, MEAS: 1200, CONJ: 400}
+
+
+def _conn(a, b):
+    return _CONN.get((a, b), _CONN_DEFAULT)
+
+
+def _is_han(ch):
+    o = ord(ch)
+    return 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF
+
+
+def _run_class(ch):
+    if ch.isdigit():
+        return "num"
+    if ch.isalpha() and not _is_han(ch):
+        return "latin"
+    if ch.isspace():
+        return "space"
+    if _is_han(ch):
+        return "han"
+    return "sym"
+
+
+def _rule_candidates(text, i, dic):
+    """Non-dictionary candidates: digit/latin runs, person names, and
+    single-char unknown fallback. Returns [(surface, cost, cls)]."""
+    cls = _run_class(text[i])
+    j = i
+    while j < len(text) and _run_class(text[j]) == cls:
+        j += 1
+    run = j - i
+    out = []
+    if cls in ("num", "latin"):
+        out.append((text[i:i + run], 2500, NUM if cls == "num" else NOUN))
+        return out
+    if cls == "space":
+        out.append((text[i:i + run], 0, UNK))
+        return out
+    if cls == "sym":
+        out.append((text[i:i + run], 2500, UNK))
+        return out
+    # han: unknown single/double char pieces
+    out.append((text[i], 5200, UNK))
+    if run >= 2:
+        out.append((text[i:i + 2], 8200, UNK))
+    # ansj person-name invocation: surname + 1-2 following han chars that
+    # do not open a dictionary word
+    if text[i] in _SURNAMES:
+        for ln in (2, 3):
+            if i + ln <= len(text) and all(_is_han(c)
+                                           for c in text[i:i + ln]):
+                if text[i + 1:i + ln] not in dic:
+                    out.append((text[i:i + ln], 4500 + 400 * ln, NAME))
+    return out
+
+
+def merge_entries(user_entries):
+    """Merge a user lexicon over the bundled dictionary ONCE; pass the
+    result to ``tokenize(merged=...)`` in per-document loops.
+    ``user_entries``: {surface: (cost, cls)} or iterable of surfaces
+    (added as low-cost nouns). Returns an opaque (dict, max_word_len)."""
+    if not user_entries:
+        return (_DICT, _MAX_WORD)
+    dic = dict(_DICT)
+    max_w = _MAX_WORD
+    if isinstance(user_entries, dict):
+        extra = user_entries.items()
+    else:
+        extra = ((w, (1800, NOUN)) for w in user_entries)
+    for w, v in extra:
+        dic.setdefault(w, [])
+        dic[w] = dic[w] + [v if isinstance(v, tuple) else (1800, NOUN)]
+        max_w = max(max_w, len(w))
+    return (dic, max_w)
+
+
+def tokenize(text, user_entries=None, merged=None):
+    """Viterbi lattice segmentation. Returns the token list (whitespace
+    dropped). ``user_entries``: one-off lexicon merge (see
+    ``merge_entries`` for the cached form callers in loops should use)."""
+    dic, max_w = merged if merged is not None else merge_entries(user_entries)
+
+    text = unicodedata.normalize("NFKC", text)
+    n = len(text)
+    if n == 0:
+        return []
+    best = [dict() for _ in range(n + 1)]
+    best[0] = {UNK: (0.0, -1, -1, "")}  # BOS
+
+    for i in range(n):
+        if not best[i]:
+            continue
+        cands = []
+        upper = min(n, i + max_w)
+        for j in range(i + 1, upper + 1):
+            for cost, cls in dic.get(text[i:j], ()):
+                cands.append((text[i:j], cost, cls))
+        cands.extend(_rule_candidates(text, i, dic))
+        for surface, wcost, cls in cands:
+            j = i + len(surface)
+            for pcls, (pcost, *_r) in best[i].items():
+                conn = _BOS_COST.get(cls, 0) if i == 0 else _conn(pcls, cls)
+                total = pcost + wcost + conn
+                cur = best[j].get(cls)
+                if cur is None or total < cur[0]:
+                    best[j][cls] = (total, i, pcls, surface)
+
+    if not best[n]:
+        return [text]
+    cls = min(best[n], key=lambda c: best[n][c][0])
+    pos = n
+    toks = []
+    while pos > 0:
+        _, prev, pcls, surface = best[pos][cls]
+        toks.append(surface)
+        pos, cls = prev, pcls
+    toks.reverse()
+    return [t for t in toks if t.strip()]
