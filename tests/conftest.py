@@ -82,6 +82,12 @@ _HEAVY_TESTS = {
     "test_bidirectional_lstm", "test_centers_update_and_training",
     "test_replace_output_layer", "test_gradients_match_non_remat",
     "test_feed_forward_still_returns_all_activations",
+    # round-4 additions (fast tier crossed 300s): the heaviest DL4J-zip
+    # graph round trip (small MLN/CG zips stay fast), the masked-LSTM
+    # interpret-mode gradient run (its forward pin stays fast), and the
+    # heaviest MoE fit (cheaper MoE structure/aux tests stay fast)
+    "test_mini_resnet_zip_round_trip", "test_masked_gradients_match_scan",
+    "test_training_reduces_loss_and_uses_aux",
 }
 
 
