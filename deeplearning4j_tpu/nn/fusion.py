@@ -78,7 +78,7 @@ class FusedConvBNVertex(GraphVertex):
         return {"mean": jnp.zeros((self.n_out,), dtype),
                 "var": jnp.ones((self.n_out,), dtype)}
 
-    def _kernel_applies(self, train):
+    def _kernel_applies(self, train, x_shape):
         if not train:
             return False, False
         # test seam: force the Pallas path in interpret mode on CPU
@@ -89,13 +89,14 @@ class FusedConvBNVertex(GraphVertex):
         else:
             return False, False
         ok = conv_pallas.supported(_pair(self.kernel), _pair(self.stride),
-                                   self.padding, (1, 1), self.activation)
+                                   self.padding, (1, 1), self.activation,
+                                   x_shape=x_shape)
         return ok, interp
 
     def apply(self, params, state, xs, *, train=False, rng=None, mask=None):
         x = xs[0]
         r = xs[1] if self.residual else None
-        use_kernel, interpret = self._kernel_applies(train)
+        use_kernel, interpret = self._kernel_applies(train, x.shape)
         if use_kernel:
             # kernel interface runs in the COMPUTE dtype (bf16 under the
             # mixed policy — 4x the f32 MXU rate, half the W/x traffic);

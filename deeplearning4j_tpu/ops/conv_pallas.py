@@ -145,11 +145,12 @@ def _matmul_stats(x2d, w2d, interpret):
     return z[:n, :cout], stats[:, :cout]
 
 
-def _conv3x3_stats_kernel(x0_ref, x1_ref, x2_ref, w_ref, z_ref, s_ref,
-                          st_s):
+def _conv3x3_stats_kernel(stride, x0_ref, x1_ref, x2_ref, w_ref, z_ref,
+                          s_ref, st_s):
     """grid (j, b, h): one output row h for a batch tile, Cout tile j.
-    The three x refs are the same padded input at row offsets h, h+1, h+2
-    (the 3x3 halo); taps unroll as 9 static-slice matmuls."""
+    The three x refs are the same padded input at row offsets
+    stride*h+{0,1,2} (the 3x3 halo); taps unroll as 9 static-slice
+    matmuls, each tap column-subsampling its row by the stride."""
     b = pl.program_id(1)
     h = pl.program_id(2)
     nb = pl.num_programs(1)
@@ -161,7 +162,8 @@ def _conv3x3_stats_kernel(x0_ref, x1_ref, x2_ref, w_ref, z_ref, s_ref,
     for dh, row_ref in enumerate((x0_ref, x1_ref, x2_ref)):
         rows = row_ref[:, 0]  # [bt, Wp, Cin]
         for dw in range(3):
-            xs = rows[:, dw:dw + wout, :].reshape(bt * wout, cinp)
+            xs = rows[:, dw:dw + stride * (wout - 1) + 1:stride, :]
+            xs = xs.reshape(bt * wout, cinp)
             acc += jnp.dot(xs, w_ref[dh, dw],
                            preferred_element_type=jnp.float32)
     z_ref[:] = acc.reshape(bt, 1, wout, -1).astype(z_ref.dtype)
@@ -178,9 +180,16 @@ def _conv3x3_stats_kernel(x0_ref, x1_ref, x2_ref, w_ref, z_ref, s_ref,
         s_ref[:] = st_s[:]
 
 
-def _conv3x3_stats(x, w, interpret):
-    """Stride-1 SAME 3x3 conv with fused stats. x [B,H,W,Cin] NHWC,
-    w [3,3,Cin,Cout] HWIO -> (z [B,H,W,Cout], stats [2, Cout] f32)."""
+def _conv3x3_stats(x, w, interpret, stride=1):
+    """SAME 3x3 conv with fused stats, stride 1 or 2. x [B,H,W,Cin] NHWC,
+    w [3,3,Cin,Cout] HWIO -> (z [B,Ho,Wo,Cout], stats [2, Cout] f32).
+
+    Stride 2 (torchvision-style ResNet v1.5 b-convs; this repo's
+    reference-parity ResNet50 strides its 1x1 convs instead, which the
+    matmul kernel already covers): XLA's SAME padding for k=3, s=2 on
+    even dims is (lo 0, hi 1); output row h reads padded input rows
+    2h..2h+2 (the row index maps do the arithmetic) and every tap
+    subsamples its row with a static stride-2 column slice."""
     if not _HAS_PLTPU:
         raise NotImplementedError("Pallas TPU support unavailable")
     bsz, h, wd, cin = x.shape
@@ -189,33 +198,47 @@ def _conv3x3_stats(x, w, interpret):
     cinp = _pad_to(cin, 128)
     bj = min(_BJ, _pad_to(cout, 128))
     jp = _pad_to(cout, bj)
-    # batch tile: keep the row-block GEMM M-dim (bt*W) near the 256-row
+    ho = -(-h // stride)
+    wo = -(-wd // stride)
+    # batch tile: keep the row-block GEMM M-dim (bt*Wo) near the 256-row
     # sweet spot without exceeding it wildly on large images
-    bt = max(1, min(bsz, _pad_to(256 // max(wd, 1), 1)))
+    bt = max(1, min(bsz, _pad_to(256 // max(wo, 1), 1)))
     while bsz % bt:
         bt -= 1
     bp = bsz  # batch stays unpadded (bt divides it)
-    # zero-pad: 1-px spatial halo + channel/cout lane padding
-    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, cinp - cin)))
+    # zero-pad: spatial halo + channel/cout lane padding. SAME paddings:
+    # s=1 -> (1, 1); s=2 on EVEN dims -> (lo 0, hi 1). Odd dims under s=2
+    # split SAME padding (1, 1) — supported() refuses them, so direct
+    # callers get a clear error rather than a wrong answer.
+    if stride == 1:
+        pads = pads_w = (1, 1)
+    else:
+        if h % 2 or wd % 2:
+            raise NotImplementedError(
+                "stride-2 3x3 kernel needs even spatial dims "
+                f"(got {h}x{wd}); check supported(..., x_shape=) first")
+        pads = pads_w = (0, 1)
+    xp = jnp.pad(x, ((0, 0), pads, pads_w, (0, cinp - cin)))
     wp = jnp.pad(w, ((0, 0), (0, 0), (0, cinp - cin), (0, jp - cout)))
-    wp_ = wd + 2
+    wp_ = xp.shape[2]
     row_spec = [
         pl.BlockSpec((bt, 1, wp_, cinp),
-                     (lambda dh: lambda j, b, h: (b, h + dh, 0, 0))(dh))
+                     (lambda dh: lambda j, b, hh: (b, stride * hh + dh,
+                                                   0, 0))(dh))
         for dh in range(3)
     ]
     z, stats = pl.pallas_call(
-        _conv3x3_stats_kernel,
-        grid=(jp // bj, bp // bt, h),
+        functools.partial(_conv3x3_stats_kernel, stride),
+        grid=(jp // bj, bp // bt, ho),
         in_specs=row_spec + [
-            pl.BlockSpec((3, 3, cinp, bj), lambda j, b, h: (0, 0, 0, j)),
+            pl.BlockSpec((3, 3, cinp, bj), lambda j, b, hh: (0, 0, 0, j)),
         ],
         out_specs=[
-            pl.BlockSpec((bt, 1, wd, bj), lambda j, b, h: (b, h, 0, j)),
-            pl.BlockSpec((2, bj), lambda j, b, h: (0, j)),
+            pl.BlockSpec((bt, 1, wo, bj), lambda j, b, hh: (b, hh, 0, j)),
+            pl.BlockSpec((2, bj), lambda j, b, hh: (0, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bp, h, wd, jp), dt),
+            jax.ShapeDtypeStruct((bp, ho, wo, jp), dt),
             jax.ShapeDtypeStruct((2, jp), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((2, bj), jnp.float32)],
@@ -248,8 +271,8 @@ def _conv_z(x, w, stride, interpret):
         z2d, stats = _matmul_stats(x.reshape(b * ho * wo, cin),
                                    w.reshape(cin, -1), interpret)
         return z2d.reshape(b, ho, wo, -1), stats
-    assert (kh, kw) == (3, 3) and stride == (1, 1)
-    return _conv3x3_stats(x, w, interpret)
+    assert (kh, kw) == (3, 3) and stride in ((1, 1), (2, 2))
+    return _conv3x3_stats(x, w, interpret, stride=stride[0])
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
@@ -327,16 +350,25 @@ def _fused_bwd(stride, eps, act, interpret, res, cots):
         else:
             dx = dxs
     else:
+        # conv is linear in each operand: linear_transpose gives the exact
+        # dx/dw convolutions for any stride/padding without re-running the
+        # forward (the Pallas kernel already produced z)
         dimn = ("NHWC", "HWIO", "NHWC")
-        dx = lax.conv_general_dilated(
-            dz, jnp.flip(w, (0, 1)).swapaxes(2, 3),
-            window_strides=(1, 1), padding="SAME",
-            dimension_numbers=dimn).astype(x.dtype)
-        dw = lax.conv_general_dilated(
-            x.transpose(3, 1, 2, 0), dz.transpose(1, 2, 0, 3),
-            window_strides=(1, 1), padding=[(1, 1), (1, 1)],
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        ).transpose(1, 2, 0, 3).astype(w.dtype)
+
+        def conv_x(x_):
+            return lax.conv_general_dilated(
+                x_, w, window_strides=stride, padding="SAME",
+                dimension_numbers=dimn)
+
+        def conv_w(w_):
+            return lax.conv_general_dilated(
+                x, w_, window_strides=stride, padding="SAME",
+                dimension_numbers=dimn)
+
+        (dx,) = jax.linear_transpose(conv_x, x)(dz)
+        (dw,) = jax.linear_transpose(conv_w, w)(dz)
+        dx = dx.astype(x.dtype)
+        dw = dw.astype(w.dtype)
     return (dx, dw, dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype),
             dres)
 
@@ -357,11 +389,13 @@ def enabled():
     return backend_is_tpu()
 
 
-def supported(kernel, stride, padding, dilation, act):
+def supported(kernel, stride, padding, dilation, act, x_shape=None):
     """Geometries the phase-1 kernels cover: 1x1 (any stride via
-    pre-slice) and stride-1 SAME 3x3, no dilation, relu/identity. The
-    stem 7x7 and the three stride-2 3x3 convs in ResNet50 stay on XLA's
-    conv — they are <6% of the conv FLOPs."""
+    pre-slice) and SAME 3x3 at stride 1, or stride 2 on even spatial dims
+    (pass ``x_shape`` [B,H,W,C] to check the parity — without it, stride-2
+    3x3 is conservatively refused). No dilation; relu/identity only. In
+    the reference-parity ResNet50 only the 7x7 stem stays on XLA's conv
+    (<2% of conv FLOPs); its strided convs are 1x1."""
     if act not in ("relu", "identity"):
         return False
     if tuple(dilation) != (1, 1):
@@ -369,4 +403,11 @@ def supported(kernel, stride, padding, dilation, act):
     k = tuple(kernel)
     if k == (1, 1):
         return True
-    return k == (3, 3) and tuple(stride) == (1, 1) and padding == "same"
+    if k != (3, 3) or padding != "same":
+        return False
+    if tuple(stride) == (1, 1):
+        return True
+    if tuple(stride) != (2, 2):
+        return False
+    return (x_shape is not None
+            and x_shape[1] % 2 == 0 and x_shape[2] % 2 == 0)

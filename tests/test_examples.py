@@ -23,6 +23,7 @@ TUTORIALS = [
     "examples/tutorials/t10_scaling_parallelism.py",
     "examples/tutorials/t11_production_lifecycle.py",
     "examples/tutorials/t12_migrating_from_dl4j.py",
+    "examples/tutorials/t13_pipeline_any_network_and_cjk.py",
 ]
 EXAMPLES = [
     "examples/lenet_mnist.py",

@@ -49,6 +49,7 @@ def _mk(kern, stride, cin, cout, hw, batch=4, residual=True, seed=0):
     ((1, 1), (1, 1), 64, 256),   # bottleneck a/c conv
     ((1, 1), (2, 2), 256, 512),  # projection shortcut, strided
     ((3, 3), (1, 1), 64, 64),    # bottleneck b conv (implicit GEMM)
+    ((3, 3), (2, 2), 32, 64),    # torchvision-style strided 3x3
     ((1, 1), (1, 1), 48, 96),    # non-128-multiple channels (lane padding)
 ])
 def test_forward_matches_unfused(kern, stride, cin, cout):
@@ -70,7 +71,8 @@ def test_identity_act_no_residual():
 
 
 @pytest.mark.parametrize("kern,stride", [((1, 1), (1, 1)), ((1, 1), (2, 2)),
-                                         ((3, 3), (1, 1))])
+                                         ((3, 3), (1, 1)),
+                                         ((3, 3), (2, 2))])
 def test_gradients_match_unfused(kern, stride):
     x, w, gamma, beta, r = _mk(kern, stride, 32, 64, hw=4, batch=2)
 
@@ -108,6 +110,11 @@ def test_bf16_policy_path():
 def test_supported_matrix():
     assert cp.supported((1, 1), (2, 2), "same", (1, 1), "relu")
     assert cp.supported((3, 3), (1, 1), "same", (1, 1), "identity")
+    # stride-2 3x3 needs the shape (even spatial dims) to say yes
+    assert cp.supported((3, 3), (2, 2), "same", (1, 1), "relu",
+                        x_shape=(4, 8, 8, 32))
+    assert not cp.supported((3, 3), (2, 2), "same", (1, 1), "relu",
+                            x_shape=(4, 7, 7, 32))
     assert not cp.supported((3, 3), (2, 2), "same", (1, 1), "relu")
     assert not cp.supported((7, 7), (2, 2), "same", (1, 1), "relu")
     assert not cp.supported((3, 3), (1, 1), "same", (2, 2), "relu")
