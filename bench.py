@@ -343,15 +343,24 @@ def bench_lstm(batch=64, seq=128, hidden=512, vocab=96, warmup=2, iters=30):
     x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
     y = jnp.asarray(np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, axis=1)])
     rng = jax.random.PRNGKey(0)
+    # BENCH_LSTM_MASKED=1: a variable-length batch (25-100% of T) — the
+    # masked fused-kernel path (state freezing), A/B against the scan path
+    # via DL4J_TPU_FUSED_LSTM=0 (VERDICT r3 #4 coverage on hardware)
+    masked = os.environ.get("BENCH_LSTM_MASKED", "0") == "1"
+    mask = None
+    if masked:
+        lens = rs.randint(seq // 4, seq + 1, batch)
+        mask = jnp.asarray((np.arange(seq)[None, :] < lens[:, None])
+                           .astype(np.float32))
 
     dt, info = _train_bench(raw, net.params, net.state, net.opt_state,
-                            (x, y, 0, rng, None), warmup, iters)
+                            (x, y, 0, rng, mask), warmup, iters)
     tps = batch * seq / dt
     return {"metric": "graveslstm_charnn_train_tokens_per_sec",
             "value": round(tps, 1), "unit": "tokens/sec/chip",
             "vs_baseline": round(tps / BASELINES["lstm"], 2),
             "step_time_ms": round(1e3 * dt, 2), "batch": batch, "seq": seq,
-            "hidden": hidden,
+            "hidden": hidden, "masked": masked,
             "fused_kernel": lstm_pallas.enabled(), **info}
 
 

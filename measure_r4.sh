@@ -25,6 +25,9 @@ CFG=lstm run BENCH_LSTM_HIDDEN=1024
 CFG=lstm run BENCH_LSTM_HIDDEN=1024 DL4J_TPU_FUSED_LSTM=0
 CFG=lstm run BENCH_LSTM_HIDDEN=2048
 CFG=lstm run BENCH_LSTM_HIDDEN=2048 DL4J_TPU_FUSED_LSTM=0
+# 2b. masked-batch LSTM (state-freezing kernel path) A/B vs scan
+CFG=lstm run BENCH_LSTM_MASKED=1
+CFG=lstm run BENCH_LSTM_MASKED=1 DL4J_TPU_FUSED_LSTM=0
 # 3. word2vec at production scale (V=100k, D=300, 10M words)
 CFG=word2vec run BENCH_W2V_SCALE=production
 # 4. refresh the standard sweep records

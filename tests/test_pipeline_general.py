@@ -217,3 +217,32 @@ class TestOneFOneB:
         _, g_in = jax.jit(inside)(w)
         np.testing.assert_allclose(np.asarray(g_in), np.asarray(g_ref),
                                    atol=1e-5)
+
+
+class TestPipelineCheckpointInterop:
+    @pytest.mark.slow
+    def test_pipeline_trained_params_export_to_zip(self, tmp_path):
+        """A pipeline-trained network exports through the STANDARD
+        checkpoint path: unpack() -> MultiLayerNetwork -> save_model ->
+        load_model, predictions identical (reference contract:
+        ModelSerializer round-trips any trained Model)."""
+        from deeplearning4j_tpu.utils.serialization import (load_model,
+                                                            save_model)
+        conf = _conv_conf()
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2,), ("stage",))
+        pn = PipelinedNetwork(conf, mesh, n_microbatches=2)
+        pn.init()
+        rs = np.random.RandomState(7)
+        x, y = _data(rs)
+        for _ in range(3):
+            pn.step(x, y)
+        net = MultiLayerNetwork(conf)
+        net.init()
+        net.params = pn.unpack()
+        p = str(tmp_path / "pipelined.zip")
+        save_model(net, p)
+        net2 = load_model(p)
+        out1 = net.output(x)
+        out2 = net2.output(x)
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                                   atol=1e-6)
