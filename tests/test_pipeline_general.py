@@ -220,7 +220,6 @@ class TestOneFOneB:
 
 
 class TestPipelineCheckpointInterop:
-    @pytest.mark.slow
     def test_pipeline_trained_params_export_to_zip(self, tmp_path):
         """A pipeline-trained network exports through the STANDARD
         checkpoint path: unpack() -> MultiLayerNetwork -> save_model ->
@@ -239,6 +238,12 @@ class TestPipelineCheckpointInterop:
         net = MultiLayerNetwork(conf)
         net.init()
         net.params = pn.unpack()
+        # the unpacked params must BE the trained params: the sequential
+        # loss on them equals the pipeline's own loss
+        l_seq, _ = net.loss_fn(net.params, net.state, jnp.asarray(x),
+                               jnp.asarray(y), train=True, rng=None)
+        l_pipe = pn.loss(x, y)
+        assert abs(float(l_seq) - float(l_pipe)) < 2e-5
         p = str(tmp_path / "pipelined.zip")
         save_model(net, p)
         net2 = load_model(p)
