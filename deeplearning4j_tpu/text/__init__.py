@@ -2,6 +2,15 @@ from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory, NGramT
 from deeplearning4j_tpu.text.languages import (  # noqa: F401
     ChineseTokenizerFactory, JapaneseTokenizerFactory, KoreanTokenizerFactory,
 )
+from deeplearning4j_tpu.text.corpus import (  # noqa: F401
+    AggregatingSentenceIterator, AsyncLabelAwareIterator,
+    BasicLabelAwareIterator, BasicLineIterator, CollectionSentenceIterator,
+    FileLabelAwareIterator, FileSentenceIterator,
+    FilenamesLabelAwareIterator, LabelAwareIterator, LabelledDocument,
+    LabelsSource, LineSentenceIterator, MultipleEpochsSentenceIterator,
+    PrefetchingSentenceIterator, SentenceIterator,
+    SimpleLabelAwareIterator, StreamLineIterator,
+    SynchronizedSentenceIterator)
 from deeplearning4j_tpu.text.vocab import VocabCache, VocabConstructor, huffman_encode  # noqa: F401
 from deeplearning4j_tpu.text.word2vec import SequenceVectors, Word2Vec  # noqa: F401
 from deeplearning4j_tpu.text.paragraph_vectors import ParagraphVectors  # noqa: F401

@@ -36,11 +36,26 @@ def _infer_step(vec, syn1neg, targets, negatives, lr):
 
 
 class ParagraphVectors(SequenceVectors):
-    def __init__(self, *, dm=False, **kwargs):
+    def __init__(self, *, dm=False, tokenizer_factory=None, **kwargs):
         super().__init__(**kwargs)
         self.dm = dm
+        from deeplearning4j_tpu.text.tokenization import \
+            default_tokenizer_factory
+        self.tokenizer_factory = tokenizer_factory or \
+            default_tokenizer_factory()
         self.doc_vectors = None
         self.doc_labels = []
+
+    def fit_label_aware(self, iterator):
+        """Train from any corpus LabelAwareIterator (reference:
+        ParagraphVectors.Builder.iterate(LabelAwareIterator) — see
+        text/corpus.py: Basic/Simple/File/Filenames/AsyncLabelAwareIterator
+        + LabelsSource). Documents tokenize through the constructor's
+        ``tokenizer_factory`` (same contract as Word2Vec)."""
+        tf = self.tokenizer_factory
+        docs = [(doc.label, tf.create(doc.content).get_tokens())
+                for doc in iterator]
+        return self.fit_documents(docs)
 
     def fit_documents(self, documents):
         """documents: list of (label, token list)."""

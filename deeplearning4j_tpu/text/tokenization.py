@@ -76,3 +76,10 @@ class NGramTokenizerFactory:
             for i in range(len(words) - n + 1):
                 grams.append(" ".join(words[i:i + n]))
         return Tokenizer(grams)
+
+
+def default_tokenizer_factory():
+    """The default factory every SequenceVectors front door shares
+    (reference: Word2Vec.Builder's DefaultTokenizerFactory +
+    CommonPreprocessor default)."""
+    return DefaultTokenizerFactory(CommonPreprocessor())

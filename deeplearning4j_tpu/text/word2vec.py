@@ -692,11 +692,18 @@ class Word2Vec(SequenceVectors):
 
     def __init__(self, *, tokenizer_factory=None, **kwargs):
         super().__init__(**kwargs)
-        from deeplearning4j_tpu.text.tokenization import (CommonPreprocessor,
-                                                          DefaultTokenizerFactory)
+        from deeplearning4j_tpu.text.tokenization import \
+            default_tokenizer_factory
         self.tokenizer_factory = tokenizer_factory or \
-            DefaultTokenizerFactory(CommonPreprocessor())
+            default_tokenizer_factory()
 
     def fit_sentences(self, sentences):
         seqs = [self.tokenizer_factory.create(s).get_tokens() for s in sentences]
         return self.fit(seqs)
+
+    def fit_iterator(self, sentence_iterator):
+        """Train from any corpus SentenceIterator (reference:
+        Word2Vec.Builder.iterate(SentenceIterator) — the front door of
+        text/corpus.py). The iterator is fully consumed once; multi-epoch
+        replay happens device-side over the materialized sequences."""
+        return self.fit_sentences(list(sentence_iterator))

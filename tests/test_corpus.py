@@ -1,0 +1,176 @@
+"""Corpus ingestion SPI (text/corpus.py) — reference:
+deeplearning4j-nlp text/sentenceiterator + text/documentiterator."""
+
+import io
+
+import pytest
+
+from deeplearning4j_tpu.text.corpus import (
+    AggregatingSentenceIterator, AsyncLabelAwareIterator,
+    BasicLabelAwareIterator, CollectionSentenceIterator,
+    FileLabelAwareIterator, FileSentenceIterator,
+    FilenamesLabelAwareIterator, LabelledDocument, LabelsSource,
+    LineSentenceIterator, MultipleEpochsSentenceIterator,
+    PrefetchingSentenceIterator, SimpleLabelAwareIterator,
+    StreamLineIterator, SynchronizedSentenceIterator)
+
+
+class TestSentenceIterators:
+    def test_collection_iterator_and_reset(self):
+        it = CollectionSentenceIterator(["a b", "c d"])
+        assert it.has_next()
+        assert it.next_sentence() == "a b"
+        assert it.next_sentence() == "c d"
+        assert not it.has_next()
+        it.reset()
+        assert list(it) == ["a b", "c d"]
+
+    def test_pre_processor_applies(self):
+        it = CollectionSentenceIterator(["  Hello  "],
+                                        pre_processor=str.strip)
+        assert it.next_sentence() == "Hello"
+
+    def test_line_iterator(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text("one\ntwo\nthree\n", encoding="utf-8")
+        it = LineSentenceIterator(str(p))
+        assert list(it) == ["one", "two", "three"]
+        it.reset()
+        assert it.next_sentence() == "one"
+        it.finish()
+
+    def test_stream_line_iterator(self):
+        it = StreamLineIterator(io.StringIO("x\ny\n"))
+        assert list(it) == ["x", "y"]
+        it.reset()
+        assert it.next_sentence() == "x"
+
+    def test_file_sentence_iterator_walks_dir(self, tmp_path):
+        (tmp_path / "a.txt").write_text("s1\ns2\n")
+        sub = tmp_path / "sub"
+        sub.mkdir()
+        (sub / "b.txt").write_text("s3\n")
+        it = FileSentenceIterator(str(tmp_path))
+        assert sorted(it) == ["s1", "s2", "s3"]
+
+    def test_aggregating_iterator(self):
+        it = AggregatingSentenceIterator([
+            CollectionSentenceIterator(["a"]),
+            CollectionSentenceIterator(["b", "c"]),
+        ])
+        assert list(it) == ["a", "b", "c"]
+        it.reset()
+        assert list(it) == ["a", "b", "c"]
+
+    def test_multiple_epochs_replays(self):
+        under = CollectionSentenceIterator(["a", "b"])
+        it = MultipleEpochsSentenceIterator(under, n_epochs=3)
+        assert list(it) == ["a", "b"] * 3
+
+    def test_prefetching_iterator_matches_plain(self):
+        data = [f"s{i}" for i in range(300)]
+        it = PrefetchingSentenceIterator(
+            CollectionSentenceIterator(data), buffer_size=16)
+        assert list(it) == data
+        it.reset()  # second pass after reset
+        assert list(it) == data
+        it.finish()
+
+    def test_synchronized_iterator_threadsafe_drain(self):
+        """Multi-consumer drain through the atomic next_or_none primitive
+        — no external locking, no sentence lost or duplicated."""
+        import threading
+        data = [str(i) for i in range(500)]
+        it = SynchronizedSentenceIterator(CollectionSentenceIterator(data))
+        got = []
+        append = got.append  # list.append is atomic under the GIL
+
+        def worker():
+            while True:
+                s = it.next_or_none()
+                if s is None:
+                    return
+                append(s)
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert sorted(got, key=int) == data
+
+
+class TestLabelAware:
+    def test_labels_source_template_and_formatter(self):
+        ls = LabelsSource("SENT_")
+        assert [ls.next_label() for _ in range(3)] == \
+            ["SENT_0", "SENT_1", "SENT_2"]
+        assert ls.get_labels() == ["SENT_0", "SENT_1", "SENT_2"]
+        ls2 = LabelsSource("DOC_%d_F")
+        assert ls2.next_label() == "DOC_0_F"
+        ls3 = LabelsSource(["x", "y"])
+        assert ls3.next_label() == "x" and ls3.next_label() == "y"
+        assert ls3.index_of("y") == 1 and ls3.size() == 2
+
+    def test_basic_label_aware_wraps_sentences(self):
+        it = BasicLabelAwareIterator(
+            CollectionSentenceIterator(["hello world", "foo bar"]))
+        docs = list(it)
+        assert [d.content for d in docs] == ["hello world", "foo bar"]
+        assert [d.label for d in docs] == ["SENT_0", "SENT_1"]
+        it.reset()
+        assert next(iter(it)).label == "SENT_0"  # labels reset too
+
+    def test_simple_label_aware(self):
+        docs = [LabelledDocument("a", ["pos"]),
+                LabelledDocument("b", ["neg"])]
+        it = SimpleLabelAwareIterator(docs)
+        assert [d.label for d in it] == ["pos", "neg"]
+
+    def test_file_label_aware_dir_per_label(self, tmp_path):
+        for label, text in [("pos", "good"), ("neg", "bad")]:
+            d = tmp_path / label
+            d.mkdir()
+            (d / "doc0.txt").write_text(text)
+        it = FileLabelAwareIterator(str(tmp_path))
+        docs = sorted(it, key=lambda d: d.label)
+        assert [(d.label, d.content) for d in docs] == \
+            [("neg", "bad"), ("pos", "good")]
+        assert sorted(it.get_label_source().get_labels()) == ["neg", "pos"]
+
+    def test_filenames_label_aware(self, tmp_path):
+        (tmp_path / "doc_a.txt").write_text("alpha")
+        (tmp_path / "doc_b.txt").write_text("beta")
+        it = FilenamesLabelAwareIterator(str(tmp_path))
+        assert [(d.label, d.content) for d in it] == \
+            [("doc_a", "alpha"), ("doc_b", "beta")]
+
+    def test_async_label_aware_matches_plain(self):
+        docs = [LabelledDocument(f"d{i}", [f"L{i}"]) for i in range(200)]
+        it = AsyncLabelAwareIterator(SimpleLabelAwareIterator(docs),
+                                     buffer_size=8)
+        out = list(it)
+        assert [d.label for d in out] == [f"L{i}" for i in range(200)]
+        it.reset()
+        assert next(iter(it)).label == "L0"
+
+
+class TestFeedsSequenceVectors:
+    def test_word2vec_fit_iterator(self, tmp_path):
+        from deeplearning4j_tpu.text.word2vec import Word2Vec
+        p = tmp_path / "c.txt"
+        p.write_text("the cat sat\nthe dog ran\n" * 10)
+        w2v = Word2Vec(vector_size=8, min_count=1, negative=2, epochs=1,
+                       seed=1)
+        w2v.fit_iterator(LineSentenceIterator(str(p)))
+        assert w2v.has_word("cat") and w2v.has_word("dog")
+
+    def test_paragraph_vectors_fit_label_aware(self):
+        from deeplearning4j_tpu.text.paragraph_vectors import ParagraphVectors
+        it = BasicLabelAwareIterator(CollectionSentenceIterator(
+            ["cat dog pet cat dog", "car road drive car road"] * 5))
+        pv = ParagraphVectors(vector_size=8, min_count=1, negative=2,
+                              epochs=2, subsample=0, seed=2)
+        pv.fit_label_aware(it)
+        assert pv.get_doc_vector("SENT_0").shape == (8,)
+        assert "SENT_9" in pv.doc_labels
