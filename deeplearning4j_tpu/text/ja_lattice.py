@@ -189,23 +189,32 @@ def _unknown_candidates(text, i):
     return out
 
 
-def tokenize(text, user_entries=None):
-    """Viterbi lattice segmentation. Returns the token list (whitespace
-    tokens dropped). ``user_entries``: optional {surface: (cost, cls)} or
-    iterable of surfaces (added as mid-cost nouns) merged over the bundled
-    dictionary."""
-    dic = _DICT
+def merge_entries(user_entries):
+    """Merge a user lexicon over the bundled dictionary ONCE; pass the
+    result to ``tokenize(merged=...)`` in per-document loops (same
+    contract as zh_lattice.merge_entries). Returns (dict, max_word)."""
+    if not user_entries:
+        return (_DICT, _MAX_WORD)
+    dic = dict(_DICT)
     max_w = _MAX_WORD
-    if user_entries:
-        dic = dict(_DICT)
-        if isinstance(user_entries, dict):
-            extra = user_entries.items()
-        else:
-            extra = ((w, (2000, NOUN)) for w in user_entries)
-        for w, v in extra:
-            dic.setdefault(w, [])
-            dic[w] = dic[w] + [v if isinstance(v, tuple) else (2000, NOUN)]
-            max_w = max(max_w, len(w))
+    if isinstance(user_entries, dict):
+        extra = user_entries.items()
+    else:
+        extra = ((w, (2000, NOUN)) for w in user_entries)
+    for w, v in extra:
+        dic.setdefault(w, [])
+        dic[w] = dic[w] + [v if isinstance(v, tuple) else (2000, NOUN)]
+        max_w = max(max_w, len(w))
+    return (dic, max_w)
+
+
+def tokenize(text, user_entries=None, merged=None):
+    """Viterbi lattice segmentation. Returns the token list (whitespace
+    tokens dropped). ``user_entries``: one-off {surface: (cost, cls)} or
+    iterable of surfaces merged over the bundled dictionary (see
+    ``merge_entries`` for the cached form callers in loops should use)."""
+    dic, max_w = (merged if merged is not None
+                  else merge_entries(user_entries))
 
     # NFKC first — same normalization every factory path applies (half-width
     # katakana, full-width latin/digits fold to their canonical forms; the

@@ -272,15 +272,17 @@ class JapaneseTokenizerFactory(_CjkTokenizerFactoryBase):
         # dictionary cannot run, so that request selects maxmatch mode
         # (where max_word_len / self.lexicon keep their round-2 contract)
         self.mode = mode if use_default_lexicon else "maxmatch"
-        # user-supplied words feed the lattice as mid-cost noun entries
-        self._user_lexicon = set(lexicon) if lexicon else None
+        # user-supplied words feed the lattice as mid-cost noun entries,
+        # merged into the dictionary ONCE (create() runs per document)
+        from deeplearning4j_tpu.text import ja_lattice
+        self._merged = ja_lattice.merge_entries(set(lexicon)
+                                                if lexicon else None)
 
     def create(self, text: str) -> Tokenizer:
         if self.mode == "lattice":
             from deeplearning4j_tpu.text import ja_lattice
             return self._lattice_create(
-                text, ja_lattice.tokenize(text,
-                                          user_entries=self._user_lexicon))
+                text, ja_lattice.tokenize(text, merged=self._merged))
         return self._create_maxmatch(text)
 
     def _create_maxmatch(self, text: str) -> Tokenizer:
