@@ -156,72 +156,16 @@ def one_f_one_b_schedule(block, n_micro, n_stages, head_loss,
     stage_fn = _stage_fn_of(block)
 
     def run(local_blocks, head_p, x_mb, lab_mb):
-        s = lax.axis_index("stage")
-        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-        bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
-        n_slots = 2 * n_stages - 1  # max residual lifetime in ticks
-
-        zero_act = jnp.zeros_like(x_mb[0])
-        zero_blocks = jax.tree_util.tree_map(jnp.zeros_like, local_blocks)
-        zero_head = jax.tree_util.tree_map(jnp.zeros_like, head_p)
-
-        def tick(carry, t):
-            a_buf, g_buf, resid, gblocks, ghead, dx_acc, loss_acc = carry
-            # ---- forward half ----
-            m_f = t - s
-            f_active = (m_f >= 0) & (m_f < n_micro)
-            fresh = lax.dynamic_index_in_dim(
-                x_mb, jnp.clip(m_f, 0, n_micro - 1), axis=0, keepdims=False)
-            x_in = jnp.where(s == 0, fresh, a_buf)
-            y_f = stage_fn(local_blocks, x_in)
-            slot_f = jnp.mod(jnp.clip(m_f, 0, n_micro - 1), n_slots)
-            saved = jnp.where(f_active, x_in,
-                              lax.dynamic_index_in_dim(resid, slot_f, axis=0,
-                                                       keepdims=False))
-            resid = lax.dynamic_update_index_in_dim(resid, saved, slot_f,
-                                                    axis=0)
-            a_next = lax.ppermute(jnp.where(f_active, y_f, zero_act),
-                                  "stage", fwd_perm)
-            # ---- backward half ----
-            m_b = t - 2 * (n_stages - 1) + s
-            b_active = (m_b >= 0) & (m_b < n_micro)
-            m_bc = jnp.clip(m_b, 0, n_micro - 1)
-            slot_b = jnp.mod(m_bc, n_slots)
-            x_saved = lax.dynamic_index_in_dim(resid, slot_b, axis=0,
-                                               keepdims=False)
-            lab = lax.dynamic_index_in_dim(lab_mb, m_bc, axis=0,
-                                           keepdims=False)
-            y_b, vjp = jax.vjp(stage_fn, local_blocks, x_saved)
+        def bwd_seed(y_b, lab):
             loss_mb, head_vjp = jax.vjp(
                 lambda hp, h: head_loss(hp, h, lab), head_p, y_b)
             dhead_mb, dy_head = head_vjp(jnp.ones_like(loss_mb))
-            dy = jnp.where(s == n_stages - 1, dy_head, g_buf)
-            db_mb, dx_mb = vjp(dy)
-            bact = b_active.astype(jnp.float32)
-            gblocks = jax.tree_util.tree_map(
-                lambda g, d: g + bact * d, gblocks, db_mb)
-            last = (b_active & (s == n_stages - 1)).astype(jnp.float32)
-            ghead = jax.tree_util.tree_map(
-                lambda g, d: g + last * d, ghead, dhead_mb)
-            loss_acc = loss_acc + last * loss_mb
-            dx_keep = jnp.where(b_active & (s == 0), dx_mb,
-                                lax.dynamic_index_in_dim(dx_acc, m_bc,
-                                                         axis=0,
-                                                         keepdims=False))
-            dx_acc = lax.dynamic_update_index_in_dim(dx_acc, dx_keep, m_bc,
-                                                     axis=0)
-            g_next = lax.ppermute(jnp.where(b_active, dx_mb, zero_act),
-                                  "stage", bwd_perm)
-            return (a_next, g_next, resid, gblocks, ghead, dx_acc,
-                    loss_acc), None
+            return loss_mb, dhead_mb, dy_head
 
-        resid0 = jnp.zeros((n_slots,) + x_mb.shape[1:], x_mb.dtype)
-        dx0 = jnp.zeros_like(x_mb)
-        carry0 = (zero_act, zero_act, resid0, zero_blocks, zero_head, dx0,
-                  jnp.zeros((), jnp.float32))
-        ticks = jnp.arange(n_micro + 2 * (n_stages - 1))
-        (_, _, _, gblocks, ghead, dx_acc, loss_acc), _ = lax.scan(
-            tick, carry0, ticks)
+        zero_head = jax.tree_util.tree_map(jnp.zeros_like, head_p)
+        loss_acc, gblocks, ghead, dx_acc = run_combined_ticks(
+            stage_fn, bwd_seed, n_micro, n_stages, local_blocks, x_mb,
+            lab_mb, zero_aux=zero_head, collect_dx=True)
         # loss/head grads live on stage S-1, dx on stage 0: psums broadcast;
         # extra_axes shard the activation dims, so replicated-param grads
         # and the loss also sum over them
@@ -236,6 +180,90 @@ def one_f_one_b_schedule(block, n_micro, n_stages, head_loss,
         return loss, gblocks, ghead, dx_mb
 
     return run
+
+
+def run_combined_ticks(stage_fn, bwd_seed, n_micro, n_stages, stage_params,
+                       x_mb, lab_mb, *, zero_aux=None, collect_dx=False):
+    """The 1F1B combined-tick engine shared by every schedule variant
+    (the LM family above; the heterogeneous PipelinedNetwork). Call
+    inside shard_map over 'stage'.
+
+    ``stage_fn(stage_params, act) -> act`` is one stage's forward (its
+    VJP yields the stage grads). ``bwd_seed(y_last, lab) ->
+    (loss_mb, aux_grads, dy)`` computes one microbatch's scaled loss on
+    the LAST stage's output and seeds the backward wave; ``aux_grads``
+    (e.g. head grads) accumulate only on the last stage — pass
+    ``zero_aux`` with their structure, or None when the loss has no
+    parameters outside the stages. Returns the LOCAL
+    (loss_acc, gparams, aux_acc, dx_acc) — callers apply the psums their
+    sharding needs.
+    """
+    s = lax.axis_index("stage")
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    n_slots = 2 * n_stages - 1  # max residual lifetime in ticks
+
+    zero_act = jnp.zeros_like(x_mb[0])
+    zero_params = jax.tree_util.tree_map(jnp.zeros_like, stage_params)
+
+    def tick(carry, t):
+        a_buf, g_buf, resid, gparams, aux_acc, dx_acc, loss_acc = carry
+        # ---- forward half ----
+        m_f = t - s
+        f_active = (m_f >= 0) & (m_f < n_micro)
+        fresh = lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(m_f, 0, n_micro - 1), axis=0, keepdims=False)
+        x_in = jnp.where(s == 0, fresh, a_buf)
+        y_f = stage_fn(stage_params, x_in)
+        slot_f = jnp.mod(jnp.clip(m_f, 0, n_micro - 1), n_slots)
+        saved = jnp.where(f_active, x_in,
+                          lax.dynamic_index_in_dim(resid, slot_f, axis=0,
+                                                   keepdims=False))
+        resid = lax.dynamic_update_index_in_dim(resid, saved, slot_f,
+                                                axis=0)
+        a_next = lax.ppermute(jnp.where(f_active, y_f, zero_act),
+                              "stage", fwd_perm)
+        # ---- backward half ----
+        m_b = t - 2 * (n_stages - 1) + s
+        b_active = (m_b >= 0) & (m_b < n_micro)
+        m_bc = jnp.clip(m_b, 0, n_micro - 1)
+        slot_b = jnp.mod(m_bc, n_slots)
+        x_saved = lax.dynamic_index_in_dim(resid, slot_b, axis=0,
+                                           keepdims=False)
+        lab = lax.dynamic_index_in_dim(lab_mb, m_bc, axis=0,
+                                       keepdims=False)
+        y_b, vjp = jax.vjp(stage_fn, stage_params, x_saved)
+        loss_mb, aux_mb, dy_last = bwd_seed(y_b, lab)
+        dy = jnp.where(s == n_stages - 1, dy_last, g_buf)
+        dp_mb, dx_mb = vjp(dy)
+        bact = b_active.astype(jnp.float32)
+        gparams = jax.tree_util.tree_map(
+            lambda g, d: g + bact * d, gparams, dp_mb)
+        last = (b_active & (s == n_stages - 1)).astype(jnp.float32)
+        if aux_acc is not None:
+            aux_acc = jax.tree_util.tree_map(
+                lambda g, d: g + last * d, aux_acc, aux_mb)
+        loss_acc = loss_acc + last * loss_mb
+        if collect_dx:
+            dx_keep = jnp.where(b_active & (s == 0), dx_mb,
+                                lax.dynamic_index_in_dim(dx_acc, m_bc,
+                                                         axis=0,
+                                                         keepdims=False))
+            dx_acc = lax.dynamic_update_index_in_dim(dx_acc, dx_keep,
+                                                     m_bc, axis=0)
+        g_next = lax.ppermute(jnp.where(b_active, dx_mb, zero_act),
+                              "stage", bwd_perm)
+        return (a_next, g_next, resid, gparams, aux_acc, dx_acc,
+                loss_acc), None
+
+    resid0 = jnp.zeros((n_slots,) + x_mb.shape[1:], x_mb.dtype)
+    dx0 = jnp.zeros_like(x_mb) if collect_dx else jnp.zeros((), x_mb.dtype)
+    carry0 = (zero_act, zero_act, resid0, zero_params, zero_aux, dx0,
+              jnp.zeros((), jnp.float32))
+    ticks = jnp.arange(n_micro + 2 * (n_stages - 1))
+    (_, _, _, gparams, aux_acc, dx_acc, loss_acc), _ = lax.scan(
+        tick, carry0, ticks)
+    return loss_acc, gparams, aux_acc, dx_acc
 
 
 def lm_1f1b_loss_and_grads(embed, block, mesh, n_micro, n_stages,
