@@ -1,0 +1,155 @@
+"""ML-pipeline adapters: scikit-learn Estimator/Transformer wrappers.
+
+Reference analog: deeplearning4j-scaleout/spark/dl4j-spark-ml —
+``SparkDl4jNetwork.scala`` (an org.apache.spark.ml Estimator whose
+``train(DataFrame)`` fits a network and returns a ``SparkDl4jModel`` with
+``predict``) and ``AutoEncoder.scala`` (an unsupervised Transformer).
+That tier exists so networks drop into the host ecosystem's pipeline API
+(feature scaling -> model -> grid search). The Python ecosystem's
+pipeline API is scikit-learn, so the adapters implement the sklearn
+estimator contract instead of the JVM one: ``get_params``/``set_params``
+(clonable, GridSearchCV-compatible), ``fit``/``predict``/
+``predict_proba``/``transform``, and they compose inside
+``sklearn.pipeline.Pipeline``.
+
+The wrapped network is this framework's ``MultiLayerNetwork``; configs
+are the frozen dataclass DSL, so cloning an estimator shares the config
+object safely.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+try:
+    from sklearn.base import (BaseEstimator, ClassifierMixin, RegressorMixin,
+                              TransformerMixin)
+except ImportError:  # pragma: no cover - sklearn is in the target image
+    class BaseEstimator:  # minimal stand-ins keep import working
+        def get_params(self, deep=True):
+            return {k: v for k, v in self.__dict__.items()
+                    if not k.endswith("_")}
+
+        def set_params(self, **p):
+            for k, v in p.items():
+                setattr(self, k, v)
+            return self
+
+    class ClassifierMixin:
+        pass
+
+    class RegressorMixin:
+        pass
+
+    class TransformerMixin:
+        def fit_transform(self, X, y=None, **kw):
+            return self.fit(X, y, **kw).transform(X)
+
+
+def _fit_network(conf, X, Y, epochs, batch_size, seed):
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    net = MultiLayerNetwork(conf)
+    net.init(rng=None if seed is None else jax.random.PRNGKey(seed))
+    net.fit(np.asarray(X, np.float32), Y, epochs=epochs,
+            batch_size=batch_size)
+    return net
+
+
+class NeuralNetClassifier(ClassifierMixin, BaseEstimator):
+    """sklearn classifier over a MultiLayerConfiguration (reference:
+    SparkDl4jNetwork + SparkDl4jModel.predict = argmax). ``conf``'s output
+    layer width must match the number of classes."""
+
+    def __init__(self, conf=None, epochs=5, batch_size=32, seed=None):
+        self.conf = conf
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+
+    def fit(self, X, y):
+        assert self.conf is not None, "conf= is required"
+        y = np.asarray(y)
+        self.classes_ = np.unique(y)
+        width = self.conf.layers[-1].n_out
+        if len(self.classes_) > width:
+            raise ValueError(
+                f"y has {len(self.classes_)} classes but the conf's output "
+                f"layer is {width} wide")
+        # one-hot at the CONFIGURED width: a CV fold missing some classes
+        # still trains the right objective (unseen columns get no mass)
+        idx = np.searchsorted(self.classes_, y)
+        onehot = np.eye(width, dtype=np.float32)[idx]
+        self.net_ = _fit_network(self.conf, X, onehot, self.epochs,
+                                 self.batch_size, self.seed)
+        return self
+
+    def predict_proba(self, X):
+        out = np.asarray(self.net_.output(np.asarray(X, np.float32)))
+        return out / np.clip(out.sum(-1, keepdims=True), 1e-9, None)
+
+    def predict(self, X):
+        # argmax over the columns that correspond to observed classes
+        proba = self.predict_proba(X)[:, :len(self.classes_)]
+        return self.classes_[np.argmax(proba, axis=-1)]
+
+
+class NeuralNetRegressor(RegressorMixin, BaseEstimator):
+    """sklearn regressor (reference: SparkDl4jModel 'continuous for
+    regression')."""
+
+    def __init__(self, conf=None, epochs=5, batch_size=32, seed=None):
+        self.conf = conf
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+
+    def fit(self, X, y):
+        assert self.conf is not None, "conf= is required"
+        y = np.asarray(y, np.float32)
+        if y.ndim == 1:
+            y = y[:, None]
+        self.net_ = _fit_network(self.conf, X, y, self.epochs,
+                                 self.batch_size, self.seed)
+        return self
+
+    def predict(self, X):
+        out = np.asarray(self.net_.output(np.asarray(X, np.float32)))
+        return out[:, 0] if out.shape[-1] == 1 else out
+
+
+class AutoEncoderTransformer(TransformerMixin, BaseEstimator):
+    """Unsupervised encoder (reference: AutoEncoder.scala — fit the
+    autoencoder on features, transform = activations of the compressed
+    layer). ``conf`` must reconstruct its input (loss vs X itself);
+    ``code_layer`` indexes the layer whose OUTPUT is the code (default:
+    the middle layer)."""
+
+    def __init__(self, conf=None, code_layer=None, epochs=5, batch_size=32,
+                 seed=None):
+        self.conf = conf
+        self.code_layer = code_layer
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+
+    def fit(self, X, y=None):
+        assert self.conf is not None, "conf= is required"
+        X = np.asarray(X, np.float32)
+        self.net_ = _fit_network(self.conf, X, X, self.epochs,
+                                 self.batch_size, self.seed)
+        n = len(self.conf.layers)
+        self.code_layer_ = (self.code_layer if self.code_layer is not None
+                            else (n - 1) // 2)
+        return self
+
+    def transform(self, X):
+        # stop at the code layer — no need to run the decoder half
+        code, _ = self.net_.apply_fn(
+            self.net_.params, self.net_.state,
+            np.asarray(X, np.float32), train=False,
+            layer_limit=self.code_layer_ + 1)
+        return np.asarray(code)
+
+    def reconstruct(self, X):
+        return np.asarray(self.net_.output(np.asarray(X, np.float32)))

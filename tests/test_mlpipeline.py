@@ -1,0 +1,110 @@
+"""sklearn pipeline adapters (mlpipeline.py) — reference:
+dl4j-spark-ml SparkDl4jNetwork/SparkDl4jModel/AutoEncoder (the host
+ecosystem's Estimator/Transformer tier)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.mlpipeline import (AutoEncoderTransformer,
+                                           NeuralNetClassifier,
+                                           NeuralNetRegressor)
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.nn.conf.inputs import FeedForwardType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+
+pytestmark = pytest.mark.slow
+
+
+def _blobs(n=120, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = np.array([[2.0, 2.0], [-2.0, -2.0], [2.0, -2.0]])
+    y = rs.randint(0, 3, n)
+    X = centers[y] + 0.4 * rs.randn(n, 2)
+    return X.astype(np.float32), y
+
+
+def _clf_conf():
+    return NeuralNetConfig(seed=1, updater=U.Adam(learning_rate=0.05)).list(
+        L.DenseLayer(n_out=16, activation="tanh"),
+        L.OutputLayer(n_out=3, loss="mcxent"),
+        input_type=FeedForwardType(2))
+
+
+class TestClassifier:
+    def test_fit_predict_blobs(self):
+        X, y = _blobs()
+        clf = NeuralNetClassifier(conf=_clf_conf(), epochs=30, seed=0)
+        clf.fit(X, y)
+        acc = (clf.predict(X) == y).mean()
+        assert acc > 0.9, acc
+        proba = clf.predict_proba(X[:5])
+        np.testing.assert_allclose(proba.sum(-1), 1.0, atol=1e-5)
+
+    def test_noncontiguous_labels_map_back(self):
+        X, y = _blobs()
+        y = np.array([10, 20, 30])[y]  # arbitrary label values
+        clf = NeuralNetClassifier(conf=_clf_conf(), epochs=30, seed=0)
+        clf.fit(X, y)
+        assert set(np.unique(clf.predict(X))) <= {10, 20, 30}
+        assert (clf.predict(X) == y).mean() > 0.9
+
+    def test_sklearn_pipeline_and_clone(self):
+        sklearn = pytest.importorskip("sklearn")
+        from sklearn.base import clone
+        from sklearn.pipeline import Pipeline
+        from sklearn.preprocessing import StandardScaler
+        X, y = _blobs()
+        pipe = Pipeline([
+            ("scale", StandardScaler()),
+            ("net", NeuralNetClassifier(conf=_clf_conf(), epochs=30,
+                                        seed=0)),
+        ])
+        pipe.fit(X, y)
+        assert pipe.score(X, y) > 0.9
+        c2 = clone(pipe.named_steps["net"])  # clonable: params round-trip
+        assert c2.epochs == 30
+        assert len(c2.conf.layers) == len(pipe.named_steps["net"].conf.layers)
+        assert not hasattr(c2, "net_")  # unfitted clone
+
+    def test_grid_search_over_epochs(self):
+        pytest.importorskip("sklearn")
+        from sklearn.model_selection import GridSearchCV
+        X, y = _blobs(90)
+        gs = GridSearchCV(
+            NeuralNetClassifier(conf=_clf_conf(), seed=0),
+            {"epochs": [2, 20]}, cv=2, n_jobs=1)
+        gs.fit(X, y)
+        assert gs.best_params_["epochs"] in (2, 20)
+
+
+class TestRegressor:
+    def test_fit_predict_linear(self):
+        rs = np.random.RandomState(0)
+        X = rs.randn(200, 3).astype(np.float32)
+        y = X @ np.array([1.0, -2.0, 0.5]) + 0.3
+        conf = NeuralNetConfig(seed=2,
+                               updater=U.Adam(learning_rate=0.05)).list(
+            L.DenseLayer(n_out=16, activation="relu"),
+            L.OutputLayer(n_out=1, loss="mse", activation="identity"),
+            input_type=FeedForwardType(3))
+        reg = NeuralNetRegressor(conf=conf, epochs=60, seed=0)
+        reg.fit(X, y)
+        assert reg.score(X, y) > 0.9  # R^2 via RegressorMixin
+
+
+class TestAutoEncoder:
+    def test_transform_shape_and_reconstruction(self):
+        rs = np.random.RandomState(3)
+        X = rs.rand(100, 8).astype(np.float32)
+        conf = NeuralNetConfig(seed=3,
+                               updater=U.Adam(learning_rate=0.01)).list(
+            L.DenseLayer(n_out=3, activation="tanh"),
+            L.OutputLayer(n_out=8, loss="mse", activation="sigmoid"),
+            input_type=FeedForwardType(8))
+        ae = AutoEncoderTransformer(conf=conf, epochs=30, seed=0)
+        codes = ae.fit_transform(X)
+        assert codes.shape == (100, 3)  # middle layer = the code
+        err = np.mean((ae.reconstruct(X) - X) ** 2)
+        base = np.mean((X.mean(0) - X) ** 2)
+        assert err < base, (err, base)
