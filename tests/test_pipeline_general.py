@@ -297,3 +297,32 @@ class TestGeneralPipeline1F1B:
         np.testing.assert_allclose(
             jax.device_get(pg.params["stages"]),
             jax.device_get(pf.params["stages"]), atol=2e-5)
+
+
+class TestPipelineShardedCheckpoint:
+    def test_sharded_checkpoint_resume(self, tmp_path):
+        """PipelinedNetwork through the orbax sharded-checkpoint
+        lifecycle (utils/sharded_checkpoint): save mid-training, restore
+        into a fresh instance with the stage shardings preserved, and the
+        next step matches an uninterrupted run."""
+        from deeplearning4j_tpu.utils.sharded_checkpoint import (
+            restore_trainer, save_trainer)
+        conf = _conv_conf()
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2,), ("stage",))
+        pn = PipelinedNetwork(conf, mesh, n_microbatches=2).init()
+        rs = np.random.RandomState(11)
+        x, y = _data(rs)
+        for _ in range(2):
+            pn.step(x, y)
+        path = str(tmp_path / "pipe_ckpt")
+        save_trainer(path, pn)
+        l_next = float(pn.step(x, y))  # the uninterrupted third step
+
+        pn2 = PipelinedNetwork(conf, mesh, n_microbatches=2).init()
+        restore_trainer(path, pn2)
+        assert pn2.iteration == 2
+        # restored params keep the stage sharding
+        assert pn2.params["stages"].sharding.is_equivalent_to(
+            pn.params["stages"].sharding, pn.params["stages"].ndim)
+        l_resume = float(pn2.step(x, y))
+        assert abs(l_resume - l_next) < 1e-5
