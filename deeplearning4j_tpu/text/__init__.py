@@ -1,4 +1,6 @@
-from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory, NGramTokenizerFactory  # noqa: F401
+from deeplearning4j_tpu.text.tokenization import (  # noqa: F401
+    DefaultTokenizerFactory, NGramTokenizerFactory, StemmingPreprocessor,
+    UimaTokenizerFactory)
 from deeplearning4j_tpu.text.languages import (  # noqa: F401
     ChineseTokenizerFactory, JapaneseTokenizerFactory, KoreanTokenizerFactory,
 )

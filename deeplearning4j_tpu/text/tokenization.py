@@ -83,3 +83,123 @@ def default_tokenizer_factory():
     (reference: Word2Vec.Builder's DefaultTokenizerFactory +
     CommonPreprocessor default)."""
     return DefaultTokenizerFactory(CommonPreprocessor())
+
+
+class StemmingPreprocessor(CommonPreprocessor):
+    """CommonPreprocessor + English stemming (reference:
+    deeplearning4j-nlp-uima StemmingPreprocessor.java, which runs a
+    Snowball ``EnglishStemmer`` after the common cleanup; here the stemmer
+    is a self-contained Porter implementation — the algorithm Snowball's
+    English stemmer extends)."""
+
+    _VOWELS = set("aeiou")
+
+    def _cons(self, w, i):
+        ch = w[i]
+        if ch in self._VOWELS:
+            return False
+        if ch == "y":
+            return i == 0 or not self._cons(w, i - 1)
+        return True
+
+    def _measure(self, w):
+        """Porter's m: number of VC sequences in the word."""
+        forms = "".join("C" if self._cons(w, i) else "V"
+                        for i in range(len(w)))
+        import re as _re
+        return len(_re.findall("VC", forms))
+
+    def _has_vowel(self, w):
+        return any(not self._cons(w, i) for i in range(len(w)))
+
+    def _ends_double_cons(self, w):
+        return (len(w) >= 2 and w[-1] == w[-2] and self._cons(w, len(w) - 1))
+
+    def _cvc(self, w):
+        return (len(w) >= 3 and self._cons(w, len(w) - 3)
+                and not self._cons(w, len(w) - 2)
+                and self._cons(w, len(w) - 1) and w[-1] not in "wxy")
+
+    def stem(self, w):
+        if len(w) <= 2:
+            return w
+        # step 1a
+        for suf, rep in (("sses", "ss"), ("ies", "i"), ("ss", "ss"),
+                         ("s", "")):
+            if w.endswith(suf):
+                w = w[:-len(suf)] + rep
+                break
+        # step 1b
+        if w.endswith("eed"):
+            if self._measure(w[:-3]) > 0:
+                w = w[:-1]
+        else:
+            hit = None
+            for suf in ("ed", "ing"):
+                if w.endswith(suf) and self._has_vowel(w[:-len(suf)]):
+                    hit = w[:-len(suf)]
+                    break
+            if hit is not None:
+                w = hit
+                if w.endswith(("at", "bl", "iz")):
+                    w += "e"
+                elif self._ends_double_cons(w) and w[-1] not in "lsz":
+                    w = w[:-1]
+                elif self._measure(w) == 1 and self._cvc(w):
+                    w += "e"
+        # step 1c
+        if w.endswith("y") and self._has_vowel(w[:-1]):
+            w = w[:-1] + "i"
+        # step 2/3 (the high-frequency mappings)
+        for suf, rep in (("ational", "ate"), ("tional", "tion"),
+                         ("iveness", "ive"), ("fulness", "ful"),
+                         ("ousness", "ous"), ("ization", "ize"),
+                         ("biliti", "ble"), ("entli", "ent"),
+                         ("ation", "ate"), ("alism", "al"),
+                         ("aliti", "al"), ("iviti", "ive"),
+                         ("ousli", "ous"), ("izer", "ize"),
+                         ("alli", "al"), ("ator", "ate"), ("eli", "e"),
+                         ("icate", "ic"), ("ative", ""), ("alize", "al"),
+                         ("iciti", "ic"), ("ical", "ic"), ("ful", ""),
+                         ("ness", "")):
+            if w.endswith(suf) and self._measure(w[:-len(suf)]) > 0:
+                w = w[:-len(suf)] + rep
+                break
+        # step 4 (drop residual suffixes at m > 1)
+        for suf in ("ement", "ance", "ence", "able", "ible", "ment",
+                    "ant", "ent", "ism", "ate", "iti", "ous", "ive",
+                    "ize", "ion", "al", "er", "ic", "ou"):
+            if w.endswith(suf):
+                stem = w[:-len(suf)]
+                if self._measure(stem) > 1 and (
+                        suf != "ion" or (stem and stem[-1] in "st")):
+                    w = stem
+                break
+        # step 5
+        if w.endswith("e"):
+            m = self._measure(w[:-1])
+            if m > 1 or (m == 1 and not self._cvc(w[:-1])):
+                w = w[:-1]
+        if self._measure(w) > 1 and self._ends_double_cons(w) \
+                and w.endswith("l"):
+            w = w[:-1]
+        return w
+
+    def pre_process(self, token):
+        token = super().pre_process(token)
+        return self.stem(token) if token else token
+
+
+class UimaTokenizerFactory(DefaultTokenizerFactory):
+    """Sentence-annotation-driven tokenization (reference:
+    deeplearning4j-nlp-uima UimaTokenizerFactory.java — a UIMA
+    AnalysisEngine runs SentenceAnnotator + TokenizerAnnotator; here the
+    sentence annotator is languages.split_sentences and tokens come from
+    the standard tokenizer, preserving sentence order)."""
+
+    def create(self, text):
+        from deeplearning4j_tpu.text.languages import split_sentences
+        tokens = []
+        for sent in split_sentences(text):
+            tokens.extend(super().create(sent).get_tokens())
+        return Tokenizer(tokens)

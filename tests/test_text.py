@@ -613,3 +613,42 @@ class TestKoStemmer:
         from deeplearning4j_tpu.text.languages import KoreanTokenizerFactory
         f = KoreanTokenizerFactory()
         assert f.create("한국어").get_tokens() == ["한국어"]
+
+
+class TestUimaRoles:
+    """UIMA-pack roles self-contained (reference:
+    deeplearning4j-nlp-uima StemmingPreprocessor.java — Snowball English
+    stemming after common cleanup — and UimaTokenizerFactory.java —
+    sentence-annotation-driven tokenization)."""
+
+    def test_porter_stemming_canonical_samples(self):
+        from deeplearning4j_tpu.text.tokenization import StemmingPreprocessor
+        s = StemmingPreprocessor()
+        # canonical Porter vocabulary entries
+        goldens = {"caresses": "caress", "ponies": "poni", "cats": "cat",
+                   "feed": "feed", "agreed": "agre", "plastered": "plaster",
+                   "motoring": "motor", "sing": "sing", "running": "run",
+                   "happy": "happi", "sky": "sky", "relational": "relat",
+                   "conditional": "condit", "hopeful": "hope",
+                   "goodness": "good", "adjustable": "adjust",
+                   "formalize": "formal", "probate": "probat"}
+        for w, want in goldens.items():
+            assert s.stem(w) == want, (w, s.stem(w), want)
+
+    def test_stemming_preprocessor_in_word2vec(self):
+        from deeplearning4j_tpu.text.tokenization import (
+            DefaultTokenizerFactory, StemmingPreprocessor)
+        from deeplearning4j_tpu.text.word2vec import Word2Vec
+        w2v = Word2Vec(vector_size=8, min_count=1, epochs=1, seed=1,
+                       tokenizer_factory=DefaultTokenizerFactory(
+                           StemmingPreprocessor()))
+        w2v.fit_sentences(["the cats were running", "a cat runs daily"] * 5)
+        # inflected forms collapse onto one stem vector
+        assert w2v.has_word("cat") and w2v.has_word("run")
+        assert not w2v.has_word("cats") and not w2v.has_word("running")
+
+    def test_uima_tokenizer_factory_sentence_aware(self):
+        from deeplearning4j_tpu.text.tokenization import UimaTokenizerFactory
+        f = UimaTokenizerFactory(CommonPreprocessor())
+        toks = f.create("First one. Second two!").get_tokens()
+        assert toks == ["first", "one", "second", "two"]
