@@ -94,31 +94,59 @@ class StemmingPreprocessor(CommonPreprocessor):
 
     _VOWELS = set("aeiou")
 
-    def _cons(self, w, i):
-        ch = w[i]
-        if ch in self._VOWELS:
-            return False
-        if ch == "y":
-            return i == 0 or not self._cons(w, i - 1)
-        return True
+    # Porter steps 2 and 3 run SEQUENTIALLY (a step-2 output like
+    # 'hopeful' must still lose its 'ful' in step 3 so 'hopefulness'
+    # and 'hopeful' collapse to the same stem)
+    _STEP2 = (("ational", "ate"), ("tional", "tion"), ("iveness", "ive"),
+              ("fulness", "ful"), ("ousness", "ous"), ("ization", "ize"),
+              ("biliti", "ble"), ("entli", "ent"), ("ation", "ate"),
+              ("alism", "al"), ("aliti", "al"), ("iviti", "ive"),
+              ("ousli", "ous"), ("izer", "ize"), ("alli", "al"),
+              ("ator", "ate"), ("eli", "e"))
+    _STEP3 = (("icate", "ic"), ("ative", ""), ("alize", "al"),
+              ("iciti", "ic"), ("ical", "ic"), ("ful", ""), ("ness", ""))
+
+    def _forms(self, w):
+        """C/V classification, one iterative left-to-right pass ('y' is a
+        consonant at position 0 or after a vowel)."""
+        out = []
+        prev_cons = False
+        for i, ch in enumerate(w):
+            if ch in self._VOWELS:
+                cons = False
+            elif ch == "y":
+                cons = i == 0 or not prev_cons
+            else:
+                cons = True
+            out.append("C" if cons else "V")
+            prev_cons = cons
+        return out
 
     def _measure(self, w):
         """Porter's m: number of VC sequences in the word."""
-        forms = "".join("C" if self._cons(w, i) else "V"
-                        for i in range(len(w)))
-        import re as _re
-        return len(_re.findall("VC", forms))
+        forms = self._forms(w)
+        return sum(1 for i in range(len(forms) - 1)
+                   if forms[i] == "V" and forms[i + 1] == "C")
 
     def _has_vowel(self, w):
-        return any(not self._cons(w, i) for i in range(len(w)))
+        return "V" in self._forms(w)
 
     def _ends_double_cons(self, w):
-        return (len(w) >= 2 and w[-1] == w[-2] and self._cons(w, len(w) - 1))
+        return (len(w) >= 2 and w[-1] == w[-2]
+                and self._forms(w)[-1] == "C")
 
     def _cvc(self, w):
-        return (len(w) >= 3 and self._cons(w, len(w) - 3)
-                and not self._cons(w, len(w) - 2)
-                and self._cons(w, len(w) - 1) and w[-1] not in "wxy")
+        if len(w) < 3:
+            return False
+        f = self._forms(w)
+        return (f[-3] == "C" and f[-2] == "V" and f[-1] == "C"
+                and w[-1] not in "wxy")
+
+    def _map_suffixes(self, w, table):
+        for suf, rep in table:
+            if w.endswith(suf) and self._measure(w[:-len(suf)]) > 0:
+                return w[:-len(suf)] + rep
+        return w
 
     def stem(self, w):
         if len(w) <= 2:
@@ -150,21 +178,9 @@ class StemmingPreprocessor(CommonPreprocessor):
         # step 1c
         if w.endswith("y") and self._has_vowel(w[:-1]):
             w = w[:-1] + "i"
-        # step 2/3 (the high-frequency mappings)
-        for suf, rep in (("ational", "ate"), ("tional", "tion"),
-                         ("iveness", "ive"), ("fulness", "ful"),
-                         ("ousness", "ous"), ("ization", "ize"),
-                         ("biliti", "ble"), ("entli", "ent"),
-                         ("ation", "ate"), ("alism", "al"),
-                         ("aliti", "al"), ("iviti", "ive"),
-                         ("ousli", "ous"), ("izer", "ize"),
-                         ("alli", "al"), ("ator", "ate"), ("eli", "e"),
-                         ("icate", "ic"), ("ative", ""), ("alize", "al"),
-                         ("iciti", "ic"), ("ical", "ic"), ("ful", ""),
-                         ("ness", "")):
-            if w.endswith(suf) and self._measure(w[:-len(suf)]) > 0:
-                w = w[:-len(suf)] + rep
-                break
+        # steps 2 then 3
+        w = self._map_suffixes(w, self._STEP2)
+        w = self._map_suffixes(w, self._STEP3)
         # step 4 (drop residual suffixes at m > 1)
         for suf in ("ement", "ance", "ence", "able", "ible", "ment",
                     "ant", "ent", "ism", "ate", "iti", "ous", "ive",

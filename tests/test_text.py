@@ -652,3 +652,12 @@ class TestUimaRoles:
         f = UimaTokenizerFactory(CommonPreprocessor())
         toks = f.create("First one. Second two!").get_tokens()
         assert toks == ["first", "one", "second", "two"]
+
+    def test_stemming_idempotent_and_robust(self):
+        from deeplearning4j_tpu.text.tokenization import StemmingPreprocessor
+        s = StemmingPreprocessor()
+        # steps 2 then 3 run sequentially: variants collapse to ONE stem
+        assert s.stem("hopefulness") == s.stem("hopeful") == "hope"
+        # pathological letter-stretched tokens must not crash (iterative
+        # C/V classification, no recursion)
+        assert isinstance(s.stem("he" + "y" * 5000), str)
