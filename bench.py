@@ -524,12 +524,16 @@ def bench_transformer(batch=32, seq=512, d_model=512, n_layers=6,
     q_shape = (batch, seq, n_heads, d_model // n_heads)
     fused = attention_pallas.enabled() and attention_pallas.supported(
         q_shape, q_shape, None, jnp.bfloat16)
-    # MFU by the standard LM accounting: train FLOPs/token ~ 6*P (params
-    # in the matmul path) + 12*L*d*T (attention scores/values, causal
-    # halves the T^2 but fwd+bwd doubles it back)
+    # MFU by the standard LM accounting: train FLOPs/token ~ 6*P where P
+    # counts MATMUL-path params only (the input embedding + positional
+    # tables are a gather — counting them would inflate MFU ~14% at the
+    # default config), + 12*L*d*T for attention scores/values
     n_params = sum(int(np.prod(p.shape)) for p in
                    jax.tree_util.tree_leaves(net.params))
-    flops_per_token = 6.0 * n_params + 12.0 * n_layers * d_model * seq
+    n_embed = sum(int(np.prod(p.shape)) for p in
+                  jax.tree_util.tree_leaves(net.params[0]))
+    flops_per_token = (6.0 * (n_params - n_embed)
+                       + 12.0 * n_layers * d_model * seq)
     mfu = flops_per_token * tps / PEAK_FLOPS
     return {"metric": metric,
             "value": round(tps, 1), "unit": "tokens/sec/chip",

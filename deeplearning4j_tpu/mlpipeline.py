@@ -85,13 +85,14 @@ class NeuralNetClassifier(ClassifierMixin, BaseEstimator):
         return self
 
     def predict_proba(self, X):
+        # sklearn contract: one column PER OBSERVED CLASS, rows sum to 1
+        # (the conf's output may be wider when a CV fold misses classes)
         out = np.asarray(self.net_.output(np.asarray(X, np.float32)))
+        out = out[:, :len(self.classes_)]
         return out / np.clip(out.sum(-1, keepdims=True), 1e-9, None)
 
     def predict(self, X):
-        # argmax over the columns that correspond to observed classes
-        proba = self.predict_proba(X)[:, :len(self.classes_)]
-        return self.classes_[np.argmax(proba, axis=-1)]
+        return self.classes_[np.argmax(self.predict_proba(X), axis=-1)]
 
 
 class NeuralNetRegressor(RegressorMixin, BaseEstimator):

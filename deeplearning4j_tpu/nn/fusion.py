@@ -138,8 +138,8 @@ class FusedConvBNVertex(GraphVertex):
             + params["beta"].astype(ad)
         if r is not None:
             ypre = ypre + r.astype(ad)
-        if self.activation == "relu":
-            ypre = jnp.maximum(ypre, 0.0)
+        from deeplearning4j_tpu.nn import activations as _acts
+        ypre = _acts.get(self.activation)(ypre)
         return ypre.astype(z.dtype), new_state
 
     WEIGHT_KEYS = ("W", "gamma")

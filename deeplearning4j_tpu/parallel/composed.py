@@ -51,8 +51,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn import updaters as U
 from deeplearning4j_tpu.nn.conf import inputs as I
-from deeplearning4j_tpu.parallel.pipeline import (
-    gpipe_schedule, lm_1f1b_loss_and_grads, one_f_one_b_schedule)
+from deeplearning4j_tpu.parallel.pipeline import (gpipe_schedule,
+                                                  lm_1f1b_loss_and_grads)
 
 
 def _ln(x, g, b, eps=1e-5):

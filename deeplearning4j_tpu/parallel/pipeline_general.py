@@ -90,7 +90,8 @@ def balance_stages(conf, n_stages):
     counts = []
     key = jax.random.PRNGKey(0)
     for layer, it in zip(conf.layers, conf.layer_input_types()[0]):
-        p = layer.init(key, it)
+        # eval_shape: param COUNTS without allocating a second full model
+        p = jax.eval_shape(lambda k, _l=layer, _it=it: _l.init(k, _it), key)
         counts.append(sum(int(np.prod(l.shape))
                           for l in jax.tree_util.tree_leaves(p)))
     total = sum(counts) or 1

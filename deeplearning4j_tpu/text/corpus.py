@@ -270,6 +270,7 @@ class _PrefetchPump:
         self._size = buffer_size
         self._thread = None
         self._stop = None
+        self._error = None
         self.peek = None
 
     def _run(self, q, stop):
@@ -282,7 +283,9 @@ class _PrefetchPump:
                         break
                     except queue.Full:
                         continue
-        finally:
+        except BaseException as e:  # rethrown on the consumer side —
+            self._error = e         # a dead producer must NOT read as a
+        finally:                    # clean end-of-corpus
             if stop.is_set():
                 # shutdown path: nothing reads past the stop flag
                 try:
@@ -296,6 +299,10 @@ class _PrefetchPump:
 
     def advance(self):
         nxt = self._queue.get()
+        if nxt is self._DONE and self._error is not None:
+            err, self._error = self._error, None
+            self.peek = None
+            raise err
         self.peek = None if nxt is self._DONE else nxt
 
     def start(self):
@@ -426,6 +433,11 @@ class LabelsSource:
 
     def next_label(self):
         if self._given is not None:
+            if self._counter >= len(self._given):
+                raise ValueError(
+                    f"LabelsSource has {len(self._given)} predefined labels "
+                    f"but a {self._counter + 1}th document arrived — the "
+                    "label list must match the corpus size")
             label = self._given[self._counter]
         elif "%d" in self._template:
             label = self._template.replace("%d", str(self._counter))
