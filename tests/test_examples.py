@@ -29,6 +29,7 @@ EXAMPLES = [
     "examples/lenet_mnist.py",
     "examples/char_rnn_generation.py",
     "examples/resnet50_data_parallel.py",
+    "examples/sklearn_pipeline.py",
 ]
 
 
