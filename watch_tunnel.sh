@@ -1,0 +1,23 @@
+#!/bin/bash
+# Tunnel watcher: probe the axon TPU tunnel on an interval; the moment it
+# is live, run the round-4 measurement matrix (single-client tunnel — CPU
+# test runs with JAX_PLATFORMS=cpu are safe to keep running alongside).
+#
+#   bash watch_tunnel.sh [interval_s] 2>&1 | tee /tmp/watch_tunnel.log
+set -u
+cd "$(dirname "$0")"
+INTERVAL="${1:-300}"
+
+while true; do
+  ts="$(date -u +%H:%M:%S)"
+  if out=$(timeout 100 python -c "import jax; print(jax.devices())" 2>&1) \
+      && echo "$out" | grep -qi "tpu\|axon"; then
+    echo "[$ts] TUNNEL LIVE: $out"
+    echo "[$ts] launching measure_r4.sh"
+    bash measure_r4.sh 2>&1 | tee /tmp/measure_r4.log
+    echo "[$ts] matrix finished (records in BENCH_TPU_MEASURED.json)"
+    exit 0
+  fi
+  echo "[$ts] tunnel down (probe: $(echo "$out" | tail -1 | cut -c1-60))"
+  sleep "$INTERVAL"
+done
