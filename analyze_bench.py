@@ -1,0 +1,82 @@
+"""Summarize BENCH_TPU_MEASURED.json into the round-4 A/B tables.
+
+Run after a live-window `bash measure_r4.sh` (or anytime): groups the
+persisted records by config and prints the remat x fused ResNet50 matrix,
+the LSTM H-sweep / masked A/Bs, and the headline-vs-north-star status.
+
+    python analyze_bench.py [path]
+"""
+
+import json
+import sys
+
+
+def load(path="BENCH_TPU_MEASURED.json"):
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        recs = data.get("results") or data.get("records") or []
+    else:
+        recs = data
+    if isinstance(recs, dict):
+        recs = list(recs.values())
+    return [r for r in recs if isinstance(r, dict)]
+
+
+def fmt(v):
+    return "-" if v is None else (f"{v:.4g}" if isinstance(v, float) else v)
+
+
+def main(path):
+    recs = load(path)
+    print(f"{len(recs)} records from {path}\n")
+
+    rn = [r for r in recs if r.get("config") == "resnet50"
+          or "resnet50" in str(r.get("metric", ""))]
+    if rn:
+        print("== ResNet50 (north star mfu >= 0.35, target 0.4) ==")
+        print(f"{'remat':>6} {'fused':>6} {'batch':>6} {'mfu':>8} "
+              f"{'samples/s':>10} {'step ms':>8} {'cached':>7}")
+        for r in rn:
+            print(f"{str(r.get('remat', '-')):>6} "
+                  f"{str(r.get('fused_conv', '-')):>6} "
+                  f"{fmt(r.get('batch')):>6} {fmt(r.get('mfu')):>8} "
+                  f"{fmt(r.get('value')):>10} "
+                  f"{fmt(r.get('step_time_ms')):>8} "
+                  f"{str(r.get('cached', False)):>7}")
+        best = max((r.get("mfu") or 0) for r in rn
+                   if not r.get("cached") and not r.get("preflight")) \
+            if any(not r.get("cached") and not r.get("preflight")
+                   for r in rn) else None
+        if best is not None:
+            status = ("NORTH STAR MET" if best >= 0.4 else
+                      "bar met" if best >= 0.35 else "below bar")
+            print(f"best fresh-TPU mfu: {best:.4f} ({status})")
+        print()
+
+    ls = [r for r in recs if r.get("config") == "lstm"
+          or "lstm" in str(r.get("metric", ""))]
+    if ls:
+        print("== GravesLSTM (fused-vs-scan A/Bs) ==")
+        print(f"{'hidden':>7} {'masked':>7} {'fused':>6} {'tokens/s':>12} "
+              f"{'cached':>7}")
+        for r in ls:
+            print(f"{fmt(r.get('hidden')):>7} "
+                  f"{str(r.get('masked', '-')):>7} "
+                  f"{str(r.get('fused_kernel', '-')):>6} "
+                  f"{fmt(r.get('value')):>12} "
+                  f"{str(r.get('cached', False)):>7}")
+        print()
+
+    other = [r for r in recs if r.get("config") not in ("resnet50", "lstm")]
+    if other:
+        print("== other configs ==")
+        for r in other:
+            print(f"{r.get('config', '?'):>12}: {fmt(r.get('value'))} "
+                  f"{r.get('unit', '')} "
+                  f"mfu={fmt(r.get('mfu'))} "
+                  f"cached={r.get('cached', False)}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "BENCH_TPU_MEASURED.json")
