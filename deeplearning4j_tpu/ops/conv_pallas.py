@@ -101,7 +101,7 @@ def _mm_stats_kernel(nk, x_ref, w_ref, z_ref, s_ref, acc_s, st_s):
 
         @pl.when(i == ni - 1)
         def _():
-            s_ref[:] = st_s[:]
+            s_ref[:] = st_s[:]  # rows 0/1 live; 2-7 sublane padding
 
 
 def _matmul_stats(x2d, w2d, interpret):
@@ -132,17 +132,19 @@ def _matmul_stats(x2d, w2d, interpret):
         ],
         out_specs=[
             pl.BlockSpec((bn, bj), lambda j, i, k: (i, j)),
-            pl.BlockSpec((2, bj), lambda j, i, k: (0, j)),
+            # 8-sublane stats block: a 2-row output block trips the TPU
+            # (8, 128) tile rule (the round-2 lse lesson) — rows 2-7 pad
+            pl.BlockSpec((8, bj), lambda j, i, k: (0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((np_, jp), dt),
-            jax.ShapeDtypeStruct((2, jp), jnp.float32),
+            jax.ShapeDtypeStruct((8, jp), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((bn, bj), jnp.float32),
-                        pltpu.VMEM((2, bj), jnp.float32)],
+                        pltpu.VMEM((8, bj), jnp.float32)],
         interpret=interpret,
     )(xp, wp)
-    return z[:n, :cout], stats[:, :cout]
+    return z[:n, :cout], stats[:2, :cout]
 
 
 def _conv3x3_stats_kernel(stride, x0_ref, x1_ref, x2_ref, w_ref, z_ref,
@@ -235,16 +237,17 @@ def _conv3x3_stats(x, w, interpret, stride=1):
         ],
         out_specs=[
             pl.BlockSpec((bt, 1, wo, bj), lambda j, b, hh: (b, hh, 0, j)),
-            pl.BlockSpec((2, bj), lambda j, b, hh: (0, j)),
+            # 8-sublane stats block (see _matmul_stats): rows 2-7 pad
+            pl.BlockSpec((8, bj), lambda j, b, hh: (0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bp, ho, wo, jp), dt),
-            jax.ShapeDtypeStruct((2, jp), jnp.float32),
+            jax.ShapeDtypeStruct((8, jp), jnp.float32),
         ],
-        scratch_shapes=[pltpu.VMEM((2, bj), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((8, bj), jnp.float32)],
         interpret=interpret,
     )(xp, xp, xp, wp)
-    return z[:, :, :, :cout], stats[:, :cout]
+    return z[:, :, :, :cout], stats[:2, :cout]
 
 
 # ---------------------------------------------------------------------------
