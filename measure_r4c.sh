@@ -1,0 +1,58 @@
+#!/bin/bash
+# Round-4 remaining legs: everything the 03:46-04:10Z live window did NOT
+# get to before the tunnel wedged. Ordered by information value:
+#   1. fresh BASELINE resnet50 at bs 128/256 (the r4 matrix only re-measured
+#      baseline at bs64; remat legs need same-session baselines for an
+#      honest A/B — remat measured as a LOSS at every batch so far)
+#   2. xprof-profiled baseline run + ranked per-op table (the data that
+#      decides the next real MFU lever, both staged levers having lost)
+#   3. the LSTM H-sweep / masked A/Bs, word2vec production scale
+#   4. the standard sweep refresh
+#
+#   bash measure_r4c.sh 2>&1 | tee /tmp/measure_r4c.log
+set -u
+cd "$(dirname "$0")"
+
+run() { echo "=== ${CFG} $* ==="; env "$@" python bench.py "${CFG}"; }
+
+# success contract for the watcher's re-arm logic: at least one fresh
+# live-TPU record must have been merged (individual legs exit 0 even when
+# they fall back to CPU preflight, so leg rc alone means nothing)
+MARK_BEFORE=$(stat -c '%Y.%s' BENCH_TPU_MEASURED.json 2>/dev/null || echo none)
+
+CFG=resnet50 run BENCH_REMAT=0 BENCH_BATCH=128
+CFG=resnet50 run BENCH_REMAT=0 BENCH_BATCH=256
+
+rm -rf /tmp/prof_rn50 && mkdir -p /tmp/prof_rn50
+CFG=resnet50 run BENCH_REMAT=0 BENCH_BATCH=256 BENCH_PROFILE=/tmp/prof_rn50
+python - <<'EOF'
+try:
+    from deeplearning4j_tpu.utils.profiling import top_ops
+    rows = top_ops("/tmp/prof_rn50", k=40)
+    tot = sum(r["total_self_us"] or 0.0 for r in rows)
+    print(f"total self us (all ranked rows): {tot:.0f}")
+    for r in rows[:40]:
+        print(f'{r["total_self_us"]:>12.0f}us x{r["occurrences"]:<5} '
+              f'{str(r["category"]):<22} {str(r.get("bound_by")):<10} '
+              f'{str(r["expression"])[:90]}')
+except Exception as e:  # profile analysis must not kill the sweep
+    print(f"profile analysis failed: {type(e).__name__}: {e}")
+EOF
+
+CFG=lstm run BENCH_LSTM_HIDDEN=1024
+CFG=lstm run BENCH_LSTM_HIDDEN=1024 DL4J_TPU_FUSED_LSTM=0
+CFG=lstm run BENCH_LSTM_HIDDEN=2048
+CFG=lstm run BENCH_LSTM_HIDDEN=2048 DL4J_TPU_FUSED_LSTM=0
+CFG=lstm run BENCH_LSTM_MASKED=1
+CFG=lstm run BENCH_LSTM_MASKED=1 DL4J_TPU_FUSED_LSTM=0
+CFG=word2vec run BENCH_W2V_SCALE=production
+for c in lenet lstm word2vec parallel transformer longcontext; do
+  CFG=$c run _=;
+done
+
+MARK_AFTER=$(stat -c '%Y.%s' BENCH_TPU_MEASURED.json 2>/dev/null || echo none)
+if [ "$MARK_BEFORE" = "$MARK_AFTER" ]; then
+  echo "=== r4c FAILED: no leg merged a fresh TPU record (tunnel lost?) ==="
+  exit 1
+fi
+echo "=== r4c complete; records merged into BENCH_TPU_MEASURED.json ==="

@@ -13,11 +13,18 @@ while true; do
   if out=$(timeout 100 python -c "import jax; print(jax.devices())" 2>&1) \
       && echo "$out" | grep -qi "tpu\|axon"; then
     echo "[$ts] TUNNEL LIVE: $out"
-    echo "[$ts] launching measure_r4.sh"
-    bash measure_r4.sh 2>&1 | tee /tmp/measure_r4.log
-    echo "[$ts] matrix finished (records in BENCH_TPU_MEASURED.json)"
-    exit 0
+    echo "[$ts] launching measure_r4c.sh (remaining legs after the 03:46Z window)"
+    if [ ! -f measure_r4c.sh ]; then
+      echo "[$ts] FATAL: measure_r4c.sh missing — refusing to burn the window"
+      exit 1
+    fi
+    if (set -o pipefail; bash measure_r4c.sh 2>&1 | tee /tmp/measure_r4c.log); then
+      echo "[$ts] matrix finished (records in BENCH_TPU_MEASURED.json)"
+      exit 0
+    fi
+    echo "[$(date -u +%H:%M:%S)] matrix FAILED (no fresh TPU record) — re-arming"
+  else
+    echo "[$ts] tunnel down (probe: $(echo "$out" | tail -1 | cut -c1-60))"
   fi
-  echo "[$ts] tunnel down (probe: $(echo "$out" | tail -1 | cut -c1-60))"
   sleep "$INTERVAL"
 done
