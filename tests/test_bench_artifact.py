@@ -67,3 +67,100 @@ def test_bench_unreachable_tunnel_emits_cached_tpu_records():
     assert headline.get("config") == "resnet50"
     assert headline.get("cached") is True
     assert headline.get("mfu", 0) > 0
+
+
+def _import_bench():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_variant_key_separates_ab_legs():
+    """The r4 live window exposed config-keyed merging clobbering the A/B
+    matrix (the worst leg survived as 'the' resnet50 record). Records must
+    be keyed per variant: every A/B knob each config emits must produce a
+    distinct key, and a re-run of the same variant must supersede it."""
+    bench = _import_bench()
+    base = {"config": "resnet50", "batch": 64, "hw": 224, "remat": False,
+            "fused_conv": False, "metric": "m", "value": 1.0}
+    legs = [base,
+            dict(base, remat=True),
+            dict(base, fused_conv=True),
+            dict(base, batch=256),
+            dict(base, profile_dir="/tmp/prof"),
+            {"config": "lstm", "batch": 64, "seq": 128, "hidden": 512,
+             "masked": False, "fused_kernel": True},
+            {"config": "lstm", "batch": 64, "seq": 128, "hidden": 512,
+             "masked": False, "fused_kernel": False},   # scan A/B leg
+            {"config": "lstm", "batch": 64, "seq": 128, "hidden": 2048,
+             "masked": False, "fused_kernel": True},    # H-sweep leg
+            {"config": "word2vec", "vocab": 5000, "dim": 128},
+            {"config": "word2vec", "vocab": 100_000, "dim": 300},  # production
+            {"config": "parallel", "n_chips": 1},
+            {"config": "parallel", "n_chips": 8}]
+    keys = [bench._variant_key(r) for r in legs]
+    assert len(keys) == len(set(keys)), "A/B legs share a variant key"
+    assert bench._variant_key(dict(base, value=2.0)) == keys[0]
+
+
+def test_save_measured_keeps_all_variants_and_supersedes(tmp_path,
+                                                         monkeypatch):
+    bench = _import_bench()
+    path = tmp_path / "measured.json"
+    monkeypatch.setattr(bench, "_MEASURED_PATH", str(path))
+    a = {"config": "resnet50", "batch": 64, "remat": False, "metric": "m",
+         "value": 1.0}
+    b = dict(a, remat=True, value=0.5)
+    bench._save_measured(a)
+    bench._save_measured(b)
+    results = json.loads(path.read_text())["results"]
+    assert len(results) == 2
+    bench._save_measured(dict(a, value=3.0))  # same variant: supersede
+    results = json.loads(path.read_text())["results"]
+    assert len(results) == 2
+    assert {r["value"] for r in results} == {3.0, 0.5}
+
+
+def test_canonical_flag_semantics():
+    bench = _import_bench()
+    canon = {"config": "resnet50", "batch": 64, "hw": 224, "remat": False,
+             "fused_conv": False}
+    assert bench._is_canonical(canon)
+    assert not bench._is_canonical(dict(canon, remat=True))
+    assert not bench._is_canonical(dict(canon, batch=256))
+    assert not bench._is_canonical(dict(canon, profile_dir="/tmp/p"))
+    assert not bench._is_canonical(dict(canon, preflight=True))
+    lstm = {"config": "lstm", "batch": 64, "seq": 128, "hidden": 512,
+            "masked": False}
+    assert bench._is_canonical(lstm)
+    assert not bench._is_canonical(dict(lstm, hidden=2048))
+    assert not bench._is_canonical(dict(lstm, masked=True))
+
+
+def test_cached_headline_prefers_canonical_over_best_leg(tmp_path,
+                                                         monkeypatch):
+    """A faster-but-non-canonical leg (an H-sweep, a bigger batch) must not
+    displace the canonical record as the config's headline number. The
+    canonical flag is stamped through bench's own _is_canonical — the same
+    predicate the live save path applies — so a stamping regression fails
+    here rather than only in production."""
+    bench = _import_bench()
+    path = tmp_path / "measured.json"
+    monkeypatch.setattr(bench, "_MEASURED_PATH", str(path))
+    legs = [{"config": "resnet50", "batch": 64, "hw": 224, "remat": False,
+             "fused_conv": False, "metric": "m", "value": 100.0,
+             "mfu": 0.27},
+            {"config": "resnet50", "batch": 256, "hw": 224, "remat": True,
+             "fused_conv": False, "metric": "m", "value": 900.0,
+             "mfu": 0.30}]
+    for rec in legs:
+        rec["canonical"] = bench._is_canonical(rec)
+    assert [r["canonical"] for r in legs] == [True, False]
+    for rec in legs:
+        bench._save_measured(rec)
+    out = bench._emit_cached_tpu({"resnet50"})
+    assert out["resnet50"]["canonical"] is True
+    assert out["resnet50"]["value"] == 100.0
