@@ -45,7 +45,18 @@ def _build_dictionary():
 
     def add(words, cls, cost):
         for w in words.split():
-            d.setdefault(w, []).append((cost, cls))
+            entries = d.setdefault(w, [])
+            if (cost, cls) not in entries:  # hand-curated lists: dedupe
+                entries.append((cost, cls))
+
+    def add_te(words, cost):
+        """Te-form rows also register the matching ta-form (past): the
+        euphonic stem is identical, only the final て/で flips to た/だ —
+        kuromoji's dictionary lists both conjugated rows the same way."""
+        add(words, VERB, cost)
+        ta = " ".join(w[:-1] + ("た" if w[-1] == "て" else "だ")
+                      for w in words.split())
+        add(ta, VERB, cost)
 
     # --- nouns (common + domain) ---
     add("私 僕 君 彼 彼女 誰 何 人 方 物 事 所 時 日 年 月 週 分 秒 国 "
@@ -76,13 +87,13 @@ def _build_dictionary():
         "死ぬ 生まれる する いる ある なる 訓練する 勉強する", VERB, 2700)
     # --- te-forms (euphonic changes make them unreachable as stem+ending;
     # kuromoji's dictionary lists them as conjugated entries too) ---
-    add("食べて 飲んで 行って 来て 見て 聞いて 話して 読んで 書いて "
+    add_te("食べて 飲んで 行って 来て 見て 聞いて 話して 読んで 書いて "
         "思って 言って 使って 作って 入って 出て 会って 買って 売って "
         "立って 座って 歩いて 走って 泳いで 飛んで 寝て 起きて 働いて "
         "休んで 遊んで 学んで 教えて 覚えて 忘れて 始めて 終わって "
         "開けて 閉めて 待って 持って 取って 置いて 帰って 送って 受けて "
         "続けて 変わって 変えて 考えて 感じて 分かって できて 知って "
-        "住んで 死んで 生まれて して なって", VERB, 2600)
+        "住んで 死んで 生まれて して なって", 2600)
     # --- inflection endings / auxiliaries after verb stems ---
     add("ます ました ません ませんでした まして たい たく たかった "
         "ない なかった なくて られる られた れる れた させる させた "
@@ -101,7 +112,21 @@ def _build_dictionary():
     add("大きい 小さい 高い 安い 低い 新しい 古い 良い 悪い 早い 遅い "
         "近い 遠い 強い 弱い 長い 短い 広い 狭い 暑い 寒い 暖かい 涼しい "
         "楽しい 嬉しい 悲しい 難しい 易しい 面白い 美しい おいしい "
-        "きれい 静か 元気 有名 便利 大丈夫", ADJ, 2700)
+        "きれい 静か 元気 有名 便利 大丈夫 いい よい", ADJ, 2700)
+    # i-adjective conjugated rows (〜かった past, 〜くて te-form): the
+    # euphonic stem+ending split cannot reach them, same as verb te/ta
+    # rows — kuromoji lists conjugated adjective rows in the dictionary
+    add("よかった よくて 大きかった 小さかった 高かった 安かった "
+        "新しかった 古かった 悪かった 早かった 遅かった 近かった "
+        "遠かった 強かった 弱かった 長かった 短かった 広かった "
+        "狭かった 暑かった 寒かった 暖かかった 涼しかった 楽しかった "
+        "嬉しかった 悲しかった 難しかった 面白かった 美しかった "
+        "おいしかった 忙しかった 眠かった 痛かった 怖かった "
+        "可愛かった すごかった ひどかった 大きくて 小さくて 高くて "
+        "安くて 新しくて 古くて 良くて 悪くて 早くて 遅くて 強くて "
+        "長くて 短くて 広くて 暑くて 寒くて 楽しくて 嬉しくて "
+        "悲しくて 難しくて 面白くて 美しくて おいしくて 忙しくて",
+        ADJ, 2600)
     # --- adverbs ---
     add("とても すごく もっと 一番 少し ちょっと たくさん いつも 時々 "
         "もう まだ すぐ ゆっくり きっと たぶん 全然 絶対 本当に やはり "
@@ -116,6 +141,135 @@ def _build_dictionary():
     # --- katakana tech nouns ---
     add("データ モデル コンピュータ ネットワーク システム プログラム "
         "ソフトウェア インターネット テスト ニュース ゲーム", NOUN, 2400)
+    # --- numerals and counters (kuromoji lists numerals as nouns and
+    # counters as suffixes; the counter after a numeral binds cheaply
+    # through the noun→suffix connection) ---
+    add("一 二 三 四 五 六 七 八 九 十 百 千 万 億 兆 零 "
+        "一つ 二つ 三つ 四つ 五つ 六つ 七つ 八つ 九つ "
+        "一人 二人 三人 数人 何人 一度 今度 何度 一緒 半分 全部 一部",
+        NOUN, 2300)
+    add("時 時半 分 秒 日間 週間 ヶ月 か月 年間 番 番目 名 件 点 階 "
+        "頭 杯 足 着 軒 通 曲 話", SUF, 2400)
+    # --- time / calendar nouns ---
+    add("月曜日 火曜日 水曜日 木曜日 金曜日 土曜日 日曜日 週末 平日 "
+        "休日 祝日 誕生日 正月 夕方 深夜 早朝 今朝 今晩 先週 来週 "
+        "先月 来月 毎朝 毎晩 毎年 時代 瞬間 期間 予定 締切", NOUN, 2400)
+    # --- people / body / everyday nouns ---
+    add("頭 顔 目 耳 鼻 口 手 足 腕 指 背 腰 心 体 声 涙 笑顔 "
+        "赤 青 白 黒 緑 黄色 茶色 紫 色 "
+        "朝食 昼食 夕食 朝ご飯 昼ご飯 晩ご飯 ご飯 パン 肉 魚介 卵 "
+        "牛乳 茶 お茶 コーヒー 紅茶 酒 ビール 水道 料金 "
+        "部屋 台所 風呂 トイレ 窓 扉 壁 床 天井 庭 鍵 机 椅子 棚 "
+        "服 靴 帽子 傘 鞄 財布 眼鏡 時計 手紙 切手 封筒 荷物 "
+        "病気 風邪 熱 薬 病院 医者 看護師 警察 消防 銀行 郵便局 "
+        "図書館 公園 美術館 博物館 映画館 空港 港 橋 信号 交差点 "
+        "地図 切符 乗り物 地下鉄 新幹線 バス タクシー 船 "
+        "質問 答え 宿題 試験 授業 教室 黒板 辞書 雑誌 新聞 小説 物語 "
+        "趣味 旅行 散歩 買い物 掃除 洗濯 運動 練習 試合 選手 "
+        "お金 値段 給料 売上 利益 会議 資料 報告 連絡 相談 約束 "
+        "関係 影響 状況 状態 環境 条件 基準 水準 程度 割合 平均 "
+        "部分 全体 中心 周り 辺り 向こう 隣 間 奥 表 裏 横 角 "
+        "種類 形 大きさ 長さ 重さ 高さ 深さ 広さ 速さ 強さ", NOUN, 2500)
+    # --- more proper / regional nouns ---
+    add("北海道 東北 関東 関西 九州 沖縄 横浜 名古屋 福岡 神戸 札幌 "
+        "仙台 広島 奈良 中国 韓国 台湾 アメリカ イギリス フランス "
+        "ドイツ イタリア スペイン ロシア インド 英語 日本語 中国語 "
+        "韓国語 フランス語 ドイツ語", NOUN, 2400)
+    # --- more verb stems + dictionary + te/ta forms (same three-row
+    # pattern as the core set: euphonic te/ta forms are dictionary
+    # entries because stem+ending cannot reach them) ---
+    add("歌い 踊り 笑い 泣き 怒り 驚き 喜び 悲しみ 急ぎ 止まり 止め "
+        "動き 動かし 押し 引き 投げ 打ち 蹴り 運び 渡り 渡し 登り "
+        "降り 乗り 落ち 落とし 拾い 捨て 集め 集まり 選び 決め 決まり "
+        "調べ 探し 見つけ 見せ 示し 伝え 届け 頼み 助け 手伝い 守り "
+        "払い 借り 貸し 返し 戻り 戻し 進み 進め 直し 治り 壊れ 壊し "
+        "切り 切れ 折り 曲げ 伸び 伸ばし 増え 増やし 減り 減らし "
+        "残り 残し 消え 消し 付き 付け 外し 合い 合わせ 比べ 並び "
+        "並べ 積み 重ね 混ぜ 触り 握り 撮り 写し 描き 塗り 磨き "
+        "洗い 拭き 乾かし 温め 冷やし 焼き 煮 蒸し 揚げ 炒め 切望し "
+        "説明し 紹介し 案内し 準備し 用意し 確認し 報告し 連絡し "
+        "相談し 参加し 出席し 欠席し 出発し 到着し 帰国し 入学し "
+        "卒業し 就職し 結婚し 離婚し 成功し 失敗し 練習し 運動し "
+        "掃除し 洗濯し 料理し 買い物し 旅行し 散歩し 心配し 安心し "
+        "賛成し 反対し 約束し 注意し 利用し 使用し 活用し 予約し "
+        "注文し 販売し 生産し 製造し 輸入し 輸出し 発表し 発見し "
+        "発明し 開発し 実験し 分析し 評価し 判断し 決定し 選択し "
+        "比較し 計算し 測定し 記録し 登録し 保存し 削除し 更新し "
+        "検索し 翻訳し 入力し 出力し 実行し 処理し 管理し 運営し",
+        VERB, 2800)
+    add("歌う 踊る 笑う 泣く 怒る 驚く 喜ぶ 急ぐ 止まる 止める 動く "
+        "動かす 押す 引く 投げる 打つ 蹴る 運ぶ 渡る 渡す 登る 降りる "
+        "乗る 落ちる 落とす 拾う 捨てる 集める 集まる 選ぶ 決める "
+        "決まる 調べる 探す 見つける 見せる 示す 伝える 届ける 頼む "
+        "助ける 手伝う 守る 払う 借りる 貸す 返す 戻る 戻す 進む "
+        "進める 直す 治る 壊れる 壊す 切る 切れる 折る 曲げる 伸びる "
+        "伸ばす 増える 増やす 減る 減らす 残る 残す 消える 消す 付く "
+        "付ける 外す 合う 合わせる 比べる 並ぶ 並べる 積む 重ねる "
+        "混ぜる 触る 握る 撮る 写す 描く 塗る 磨く 洗う 拭く 乾かす "
+        "温める 冷やす 焼く 煮る 蒸す 揚げる 炒める 思い出す 思いつく "
+        "見える 聞こえる 笑える 泣ける もらう くれる あげる やる "
+        "いただく くださる 差し上げる おっしゃる いらっしゃる 申す "
+        "伺う 参る 拝見する 存じる", VERB, 2700)
+    add_te("歌って 踊って 笑って 泣いて 怒って 驚いて 喜んで 急いで "
+        "止まって 止めて 動いて 動かして 押して 引いて 投げて 打って "
+        "蹴って 運んで 渡って 渡して 登って 降りて 乗って 落ちて "
+        "落として 拾って 捨てて 集めて 集まって 選んで 決めて 決まって "
+        "調べて 探して 見つけて 見せて 示して 伝えて 届けて 頼んで "
+        "助けて 手伝って 守って 払って 借りて 貸して 返して 戻って "
+        "戻して 進んで 進めて 直して 治って 壊れて 壊して 切って "
+        "切れて 折って 曲げて 伸びて 伸ばして 増えて 増やして 減って "
+        "減らして 残って 残して 消えて 消して 付いて 付けて 外して "
+        "合って 合わせて 比べて 並んで 並べて 積んで 重ねて 混ぜて "
+        "触って 握って 撮って 写して 描いて 塗って 磨いて 洗って "
+        "拭いて 乾かして 温めて 冷やして 焼いて 煮て 蒸して 揚げて "
+        "炒めて もらって くれて あげて やって いただいて "
+        "降って 晴れて 曇って 咲いて 吹いて 鳴いて 光って 流れて "
+        "始まって 通って 向かって 続いて 過ぎて 慣れて 疲れて "
+        "遅れて 間に合って 気をつけて 頑張って", 2600)
+    add("晴れ 曇り 咲き 吹き 鳴き 光り 流れ 始まり 通り 向かい "
+        "続き 過ぎ 慣れ 疲れ 遅れ 間に合い 頑張り", VERB, 2800)
+    add("降る 晴れる 曇る 咲く 吹く 鳴く 光る 流れる 始まる 通る "
+        "向かう 続く 過ぎる 慣れる 疲れる 遅れる 間に合う 頑張る",
+        VERB, 2700)
+    # --- more i-adjectives + na-adjectives ---
+    add("明るい 暗い 重い 軽い 太い 細い 厚い 薄い 深い 浅い 多い "
+        "少ない 若い 危ない 忙しい 眠い 痛い 甘い 辛い 苦い 酸っぱい "
+        "塩辛い 温かい 冷たい 熱い ぬるい 優しい 厳しい 正しい "
+        "珍しい 懐かしい 恥ずかしい 羨ましい 恐ろしい 怖い 汚い "
+        "美味しい まずい 可愛い 格好いい 素晴らしい ひどい すごい "
+        "丸い 四角い 鋭い 鈍い 硬い 柔らかい", ADJ, 2700)
+    add("好き 嫌い 上手 下手 得意 苦手 丁寧 親切 真面目 熱心 素直 "
+        "正直 立派 豊か 貧しい 幸せ 不幸 安全 危険 自由 不便 複雑 "
+        "単純 特別 普通 変 同じ 別 大変 無理 可能 不可能 必要 不要 "
+        "十分 不足 新鮮 清潔 快適 適当 正確 確か 曖昧 明確 重要 "
+        "主要 基本的 具体的 抽象的 積極的 消極的 自動的 効果的 "
+        "代表的 一般的 個人的 国際的 伝統的 現代的 科学的 経済的",
+        ADJ, 2600)
+    # --- more adverbs / conjunctions ---
+    add("必ず 多分 おそらく もちろん 例えば 特に 主に 約 ほぼ やっと "
+        "ついに 既に もはや 突然 急に 次第に 徐々に だんだん どんどん "
+        "しっかり はっきり ちゃんと きちんと のんびり ぐっすり "
+        "そろそろ まず 次に 最後に 最初に 実は 実際 確かに 当然 "
+        "残念ながら 幸い なぜ どうして どう こう ああ なぜなら "
+        "それで だから ですから したがって ところが ところで さて "
+        "それでも それなら すると もし もしも たとえ", ADV, 2600)
+    # --- more katakana loanwords ---
+    add("アプリ サイト メール パソコン スマホ ケータイ キーボード "
+        "マウス ファイル フォルダ サーバ サーバー クラウド ウェブ "
+        "ブラウザ パスワード ログイン ダウンロード アップロード "
+        "インストール アップデート バージョン エラー バグ コード "
+        "アルゴリズム ライブラリ フレームワーク オープンソース "
+        "ホテル レストラン カフェ コンビニ スーパー デパート ビル "
+        "エレベーター エスカレーター ドア テーブル ソファ ベッド "
+        "テレビ ラジオ カメラ ビデオ スポーツ サッカー テニス "
+        "バスケットボール プール ジム チーム メンバー グループ "
+        "クラス レベル ポイント ルール マナー チャンス プレゼント "
+        "パーティー イベント スケジュール プラン アイデア イメージ "
+        "デザイン カラー サイズ タイプ スタイル バランス エネルギー "
+        "ストレス リラックス シャワー シャツ ズボン スカート コート "
+        "セーター ネクタイ ハンカチ タオル ジュース ワイン チーズ "
+        "ケーキ チョコレート アイスクリーム サラダ スープ カレー "
+        "ラーメン パスタ ピザ ハンバーガー サンドイッチ", NOUN, 2400)
     return d
 
 

@@ -37,7 +37,48 @@ _VERB_STEMS = set(
     "적 길 짧 높 낮 빠르 느리 예쁘 아름답 어렵 쉽 재미있 재미없 "
     "맛있 맛없 춥 덥 차갑 뜨겁 가 오 보이 들리 웃 울 입 벗 신 "
     "쉬 놀 일어서 돌아가 돌아오 들어가 들어오 나가 나오 올라가 "
-    "내려가 지나가 건너 떠나 도착하 출발하".split())
+    "내려가 지나가 건너 떠나 도착하 출발하 "
+    # additional high-frequency verb/adjective stems (twitter-korean-text
+    # ships a full dictionary; this is the same coverage direction)
+    "얘기하 대답하 질문하 설명하 소개하 부탁하 약속하 거짓말하 "
+    "인사하 축하하 걱정하 후회하 기억하 이해하 결정하 선택하 "
+    "결혼하 이사하 여행하 구경하 쇼핑하 청소하 빨래하 세수하 "
+    "목욕하 샤워하 산책하 데이트하 전화하 문자하 검색하 저장하 "
+    "삭제하 다운로드하 입력하 클릭하 가입하 로그인하 주문하 "
+    "예약하 계산하 취소하 확인하 신청하 제출하 발표하 토론하 "
+    "졸업하 입학하 취직하 퇴근하 출근하 지각하 성공하 실패하 "
+    "노력하 참석하 참가하 초대하 방문하 환영하 약하 강하 건강하 "
+    "피곤하 심심하 행복하 불행하 슬프 기쁘 즐겁 괴롭 외롭 그립 "
+    "무섭 부끄럽 부럽 귀엽 밉 고프 아프 바쁘 한가하 배고프 "
+    "배부르 목마르 졸리 똑똑하 멍청하 부지런하 게으르 착하 "
+    "친절하 무뚝뚝하 솔직하 정직하 용감하 유명하 신선하 편하 "
+    "불편하 편리하 간단하 복잡하 특별하 이상하 심하 급하 "
+    "늦 이르 멀 가깝 넓 좁 두껍 얇 무겁 가볍 밝 어둡 싸 비싸 "
+    "새롭 낡 젊 늙 굵 가늘 깊 얕 둥글 곧 굽 마르 젖 시원하 "
+    "따르 다르 같 틀리 맞 남 떠오르 모이 모으 바꾸 바뀌 고치 "
+    "부서지 깨지 끊 끊어지 이기 지 빌리 빌려주 갚 벌 쓰이 "
+    "보내 지내 견디 참 버리 줍 숨 숨기 잊 잊어버리 잃 잃어버리 "
+    "얻 구하 지키 어기 밀 당기 던지 잡 놓 놓치 누르 돌리 돌 "
+    "걸 걸리 풀 묶 싸우 화해하 안 업 끌 따라가 따라오 데려가 "
+    "데려오 가져가 가져오 꺼내 넣 채우 비우 더하 빼 곱하 나누 "
+    "세 재 달 낫 붓 짓 긋 눕 씻 익 태어나 자라 키우 가르 "
+    "날 날아가 흐르 멈추 움직이 떨어지 떨어뜨리 올리 내리 "
+    "늘 늘리 줄 줄이 오르 바라 바라보 쳐다보 살펴보 찾아보 "
+    "물 물어보 알아보 알리 알려주 보여주 들려주 믿 의심하 "
+    "느끼 원하 바꾸 권하 시키 말리 칭찬하 혼나 혼내 꾸짖 "
+    "웃기 울리 즐기 심 캐 따 뽑 꽂 얼 녹 끓 끓이 굽 볶 튀기 "
+    "무치 섞 자르 썰 다지 간 맛보 차리 치우 닦 쓸 털 걸레질하 "
+    "다리 꿰매 짜 풀리 감 감기 빗 바르 지우 그리 색칠하 접 "
+    "오리 붙 붙이 떼 쌓 허물 짚 기대 눕히 앉히 세우 태우 "
+    "내려주 마중하 배웅하 헤어지 사귀 어울리 싫증나 질리 "
+    "반하 빠지 취하 깨 깨우 꾸 설레 긴장하 떨 진정하 안심하 "
+    "포기하 도전하 시도하 극복하 해결하 처리하 관리하 운영하 "
+    "경영하 투자하 저축하 소비하 생산하 판매하 구매하 수출하 "
+    "수입하 개발하 발전하 변하 변화하 증가하 감소하 향상되 "
+    "개선되 발견하 발명하 실험하 분석하 조사하 측정하 기록하 "
+    "비교하 평가하 판단하 증명하 주장하 반대하 찬성하 동의하 "
+    "거절하 허락하 금지하 명령하 지시하 요구하 요청하 제안하 "
+    "추천하 보고하 전하 전달하 퍼지 퍼뜨리 소문나".split())
 
 #: verbal endings (eomi) — chains of up to 3 cover the conjugation space
 _EOMI = set(
@@ -52,6 +93,14 @@ _EOMI = set(
 _CONTRACTIONS = [
     ("했", "하였"), ("해", "하여"), ("됐", "되었"), ("돼", "되어"),
 ]
+
+#: conjugated 이다-copula endings after a noun, longest first
+#: (계획입니다 / 학생이에요 / 친구예요 / 사실이었습니다 ...)
+_COPULA_ENDINGS = sorted(
+    ("입니다", "입니까", "이에요", "예요", "이었습니다", "였습니다",
+     "이었어요", "였어요", "이다", "이며", "이라서", "이라고", "라고",
+     "인데", "이지만", "이니까", "일까요", "이겠지요"),
+    key=len, reverse=True)
 
 _MAX_EOMI_CHAIN = 3
 
@@ -214,6 +263,16 @@ def analyze_eojeol(eojeol, nouns, josa_sorted, *, max_word_len=8,
         if emit_suffixes:
             toks += endings
         candidates.append((2, toks))
+
+    # 3b. noun + 이다-copula conjugation (계획입니다 -> 계획): the copula
+    # conjugates like a verb but attaches to a noun, so it is stripped
+    # like an ending chain — open-korean-text's Noun+Josa(이다) pattern
+    for cop in _COPULA_ENDINGS:
+        if eojeol.endswith(cop) and len(eojeol) > len(cop):
+            body2 = eojeol[:-len(cop)]
+            toks = [body2, cop] if emit_suffixes else [body2]
+            candidates.append((1.5 if body2 in nouns else 2.5, toks))
+            break
 
     # 4. compound of known nouns (each piece known), optional trailing josa
     body, tail = eojeol, None

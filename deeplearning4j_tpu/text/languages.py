@@ -56,13 +56,72 @@ _JA_LEXICON = (
 _KO_LEXICON = (
     "우리 너희 그들 이것 그것 저것 여기 거기 어디 무엇 언제 누구 왜 "
     "어떻게 오늘 내일 어제 시간 문제 일 학교 선생님 학생 친구 한국 "
-    "서울 세계 사람 아이 감사합니다 안녕하세요 데이터 모델 학습 기계").split()
+    "서울 세계 사람 아이 감사합니다 안녕하세요 데이터 모델 학습 기계 "
+    # people / family / society
+    "나 저 당신 남자 여자 어른 아기 가족 부모 부모님 아버지 어머니 "
+    "아빠 엄마 형 누나 오빠 언니 동생 아들 딸 할아버지 할머니 이름 "
+    "생일 결혼 사랑 마음 생각 느낌 꿈 희망 약속 이야기 말 말씀 소리 "
+    "목소리 웃음 눈물 얼굴 눈 코 입 귀 머리 손 발 팔 다리 몸 건강 "
+    # time / calendar
+    "지금 아침 점심 저녁 밤 낮 오전 오후 요일 월요일 화요일 수요일 "
+    "목요일 금요일 토요일 일요일 주말 평일 휴일 올해 작년 내년 달 "
+    "주 날 날짜 계절 봄 여름 가을 겨울 날씨 비 눈 바람 구름 하늘 "
+    # places / travel
+    "집 방 부엌 화장실 문 창문 마당 길 거리 동네 도시 시골 나라 "
+    "고향 회사 사무실 공장 가게 시장 마트 백화점 식당 카페 은행 "
+    "병원 약국 우체국 도서관 공원 극장 영화관 박물관 역 정류장 "
+    "공항 호텔 바다 강 산 섬 북한 미국 중국 일본 영국 부산 인천 "
+    "대구 대전 광주 지하철 버스 기차 택시 자동차 자전거 비행기 배 "
+    "표 지도 여행 길거리 "
+    # school / work / study
+    "공부 수업 교실 숙제 시험 질문 대답 책 공책 연필 볼펜 종이 "
+    "사전 신문 잡지 소설 글 글자 한글 영어 한국어 일본어 중국어 "
+    "외국어 단어 문장 뜻 의미 번역 발음 문법 역사 과학 수학 음악 "
+    "미술 체육 대학 대학교 교수 박사 전공 졸업 입학 취직 직업 "
+    "회의 보고 보고서 계획 목표 결과 이유 방법 준비 연습 경험 "
+    "실력 능력 성공 실패 노력 기회 책임 "
+    # food / daily life
+    "밥 물 차 커피 우유 주스 맥주 술 빵 과일 사과 배 포도 수박 "
+    "바나나 채소 고기 소고기 돼지고기 닭고기 생선 계란 김치 국 "
+    "찌개 라면 국수 떡 과자 사탕 설탕 소금 맛 아침밥 점심밥 저녁밥 "
+    "요리 음식 식사 메뉴 그릇 접시 컵 숟가락 젓가락 옷 바지 치마 "
+    "셔츠 신발 양말 모자 안경 가방 지갑 우산 시계 선물 돈 값 가격 "
+    "전화 전화번호 핸드폰 휴대폰 컴퓨터 노트북 인터넷 이메일 사진 "
+    "영화 노래 춤 그림 운동 축구 야구 농구 수영 등산 산책 쇼핑 "
+    "청소 빨래 목욕 샤워 잠 침대 의자 책상 텔레비전 냉장고 에어컨 "
+    # abstract / misc
+    "것 수 때 곳 분 년 월 일월 이월 삼월 앞 뒤 위 아래 안 밖 옆 "
+    "사이 가운데 근처 오른쪽 왼쪽 동쪽 서쪽 남쪽 북쪽 처음 마지막 "
+    "다음 이번 저번 전 후 중 모두 전부 일부 반 정도 크기 모양 색 "
+    "색깔 종류 번호 숫자 나이 키 무게 속도 온도 소식 뉴스 정보 "
+    "사실 거짓말 인생 삶 죽음 전쟁 평화 자유 정부 법 경찰 군인 "
+    "의사 간호사 요리사 가수 배우 작가 기자 운전사 손님 주인 "
+    "이웃 인기 취미 재미 걱정 고민 스트레스 기분 행복 슬픔 화 "
+    "용기 힘 도움 인사 축하 칭찬 사과문 질서 규칙 문화 전통 종교 "
+    "예술 기술 경제 정치 사회 환경 자연 동물 식물 개 고양이 새 "
+    "물고기 소 돼지 닭 꽃 나무 풀 잎 열매 씨 해 달 별 땅 "
+    "불 공기 돌 흙 금 은 유리 플라스틱 프로그램 게임 시스템 "
+    "네트워크 파일 화면 키보드 마우스 버튼 비밀번호 회원 가입 "
+    "웹사이트 블로그 댓글 동영상 방송 광고 기사 "
+    # adverbs — listed whole so the josa stripper never unravels them
+    # (많이 is NOT 많+이)
+    "많이 빨리 천천히 일찍 늦게 같이 함께 혼자 열심히 자주 가끔 "
+    "항상 언제나 늘 벌써 아직 이미 곧 방금 바로 먼저 나중에 "
+    "정말 진짜 아주 매우 너무 조금 좀 더 덜 가장 제일 잘 못 안 "
+    "다시 또 계속 갑자기 천천 아마 물론 특히 역시 그냥 거의 "
+    "별로 전혀 서로 모두 다 약간 꽤 상당히 완전히 확실히 "
+    "그리고 그러나 하지만 그래서 그러면 그런데 그래도 또는 "
+    "즉 만약 비록").split()
 
 #: common Korean particles (josa), longest first for greedy suffix matching
 _KO_JOSA = sorted(
     ("은", "는", "이", "가", "을", "를", "에", "의", "와", "과", "도", "만",
      "로", "으로", "에서", "에게", "한테", "께서", "부터", "까지", "보다",
-     "처럼", "마다", "조차", "밖에", "이나", "나", "라도", "든지"),
+     "처럼", "마다", "조차", "밖에", "이나", "나", "라도", "든지",
+     # chain-closers and formal/instrumental/comitative variants
+     "께", "이라도", "으로서", "로서", "으로써", "로써", "이며", "이랑",
+     "랑", "에게서", "한테서", "에다", "이든지", "이라는",
+     "라는", "이란", "란", "야말로", "이야말로"),
     key=len, reverse=True)
 
 #: common Japanese particles used to split long hiragana runs

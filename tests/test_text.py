@@ -225,7 +225,8 @@ class TestLanguagePacks:
         goldens = {
             "私は学生です": ["私", "は", "学生", "です"],
             "東京に行きました": ["東京", "に", "行き", "ました"],
-            "猫が魚を食べた": ["猫", "が", "魚", "を", "食べ", "た"],
+            # past forms are whole dictionary rows, like te-forms (add_te)
+            "猫が魚を食べた": ["猫", "が", "魚", "を", "食べた"],
             "彼女は本を読んでいます":
                 ["彼女", "は", "本", "を", "読んで", "います"],
             "今日はとても暑いですね":
@@ -594,8 +595,12 @@ class TestKoStemmer:
         # CHAINED particles normalize to the same stem (에서+는, 에게+도)
         assert f.create("학교에서는").get_tokens() == ["학교"]
         assert f.create("친구에게도").get_tokens() == ["친구"]
-        # but a single-char particle cannot chain (lookalike endings)
-        assert f.create("바나나").get_tokens() == ["바나"]  # one strip max
+        # a lexicon word with a lookalike particle ending is kept whole
+        assert f.create("바나나").get_tokens() == ["바나나"]
+        # but an UNKNOWN stem still takes exactly one single-char strip
+        # (one strip max — the chain rule that keeps lookalike endings
+        # from unravelling)
+        assert f.create("조랑말가").get_tokens() == ["조랑말"]
 
     def test_emit_suffixes_returns_endings(self):
         from deeplearning4j_tpu.text.languages import KoreanTokenizerFactory

@@ -10,6 +10,13 @@ INTERVAL="${1:-300}"
 
 while true; do
   ts="$(date -u +%H:%M:%S)"
+  # egress probe (VERDICT r3 missing #3: one genuine DL4J zoo zip would
+  # convert the ModelSerializer reader from spec-compliant to
+  # artifact-proven; egress has been dead every probe so far)
+  if timeout 10 curl -s -o /dev/null -w "%{http_code}" \
+      https://dl4jdata.blob.core.windows.net/ 2>/dev/null | grep -qv "^000$"; then
+    echo "[$ts] EGRESS LIVE — fetch a zoo zip NOW (see modelimport/dl4j.py)"
+  fi
   if out=$(timeout 100 python -c "import jax; print(jax.devices())" 2>&1) \
       && echo "$out" | grep -qi "tpu\|axon"; then
     echo "[$ts] TUNNEL LIVE: $out"
