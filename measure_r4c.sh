@@ -52,6 +52,16 @@ CFG=lstm run BENCH_LSTM_HIDDEN=2048 DL4J_TPU_FUSED_LSTM=0
 CFG=lstm run BENCH_LSTM_MASKED=1
 CFG=lstm run BENCH_LSTM_MASKED=1 DL4J_TPU_FUSED_LSTM=0
 CFG=word2vec run BENCH_W2V_SCALE=production
+# flash-attention block-size sweep at seq 4096 (the 512x512 default has
+# never been hardware-tuned; longcontext MFU ~0.14 suggests headroom).
+# Caveat for reading the table: the backward pass is a jax scan tiled by
+# BLOCK_K only (ops/attention_pallas._bwd_core) — the Q axis tunes the
+# Pallas forward alone, so whole-step deltas on Q are diluted ~3x; K
+# moves both forward grid and backward scan width.
+CFG=longcontext run DL4J_TPU_FLASH_BLOCK_Q=256 DL4J_TPU_FLASH_BLOCK_K=256
+CFG=longcontext run DL4J_TPU_FLASH_BLOCK_Q=1024 DL4J_TPU_FLASH_BLOCK_K=1024
+CFG=longcontext run DL4J_TPU_FLASH_BLOCK_Q=256 DL4J_TPU_FLASH_BLOCK_K=1024
+CFG=longcontext run DL4J_TPU_FLASH_BLOCK_Q=1024 DL4J_TPU_FLASH_BLOCK_K=256
 for c in lenet lstm word2vec parallel transformer longcontext; do
   CFG=$c run _=;
 done

@@ -164,3 +164,15 @@ def test_cached_headline_prefers_canonical_over_best_leg(tmp_path,
     out = bench._emit_cached_tpu({"resnet50"})
     assert out["resnet50"]["canonical"] is True
     assert out["resnet50"]["value"] == 100.0
+
+
+def test_flash_block_legs_are_separate_noncanonical_variants():
+    """Kernel-tuning sweep points must neither clobber the canonical
+    longcontext record nor ever be selected as canonical themselves."""
+    bench = _import_bench()
+    canon = {"config": "longcontext", "batch": 4, "seq": 4096,
+             "d_model": 512, "n_layers": 6}
+    tuned = dict(canon, flash_block="256x1024")
+    assert bench._variant_key(canon) != bench._variant_key(tuned)
+    assert bench._is_canonical(canon)
+    assert not bench._is_canonical(tuned)
