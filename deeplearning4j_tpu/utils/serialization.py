@@ -91,3 +91,43 @@ def load_model(path):
 
 restore_multilayer_network = load_model
 restore_computation_graph = load_model
+
+
+def add_normalizer_to_model(path, normalizer):
+    """Attach a fitted normalizer to an existing checkpoint zip.
+
+    Reference: ModelSerializer.addNormalizerToModel (util/
+    ModelSerializer.java) — the reference appends a Java-serialized
+    normalizer.bin; here the entry is normalizer.json (the Java object
+    stream is JVM-private, so genuine DL4J normalizer.bin entries are NOT
+    readable — config+params of such zips still load, see
+    modelimport/dl4j.py)."""
+    entry = normalizer.to_json()
+    with zipfile.ZipFile(path, "a", zipfile.ZIP_DEFLATED) as z:
+        if "normalizer.json" in z.namelist():
+            raise ValueError(f"{path} already contains a normalizer")
+        z.writestr("normalizer.json", entry)
+    return path
+
+
+def restore_normalizer(path):
+    """The fitted normalizer attached to a checkpoint, or None.
+
+    Reference: ModelSerializer.restoreNormalizerFromFile."""
+    from deeplearning4j_tpu.datasets.normalizers import _FittedNormalizer
+    with zipfile.ZipFile(path) as z:
+        names = z.namelist()
+        if "normalizer.json" in names:
+            return _FittedNormalizer.from_json(
+                z.read("normalizer.json").decode())
+        if "normalizer.bin" in names:
+            # a genuine DL4J zip with a Java-serialized normalizer: do NOT
+            # silently return None — the user would serve un-normalized
+            # inputs with no signal anything was lost
+            raise ValueError(
+                f"{path} contains a JVM-serialized normalizer.bin (DL4J "
+                "ModelSerializer format), which is not readable here. "
+                "Re-fit the normalizer (datasets.normalizers) on the "
+                "training data, or export its statistics from the JVM "
+                "side; the model config+params in this zip still load.")
+        return None

@@ -436,3 +436,139 @@ class TestShardedIterator:
         assert all(rounds <= d <= rounds + 1 for d in decoded)
         if n_batches % count == 0:
             assert decoded == [rounds] * count
+
+
+class TestNormalizers:
+    """DataNormalization family (NormalizerStandardize / MinMaxScaler /
+    ImagePreProcessingScaler) + the ModelSerializer.addNormalizerToModel
+    attach/restore analog."""
+
+    def test_standardize_fit_transform_revert(self, np_rng):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerStandardize)
+        x = np_rng.rand(200, 5).astype(np.float32) * 7 + 3
+        n = NormalizerStandardize().fit(x)
+        t = np.asarray(n.transform(x))
+        assert np.allclose(t.mean(0), 0, atol=1e-4)
+        assert np.allclose(t.std(0), 1, atol=1e-3)
+        assert np.allclose(np.asarray(n.revert(t)), x, atol=1e-4)
+
+    def test_standardize_streaming_equals_full(self, np_rng):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerStandardize)
+        x = np_rng.rand(300, 4).astype(np.float32)
+        full = NormalizerStandardize().fit(x)
+        stream = NormalizerStandardize()
+        for i in range(0, 300, 64):
+            stream.partial_fit(x[i:i + 64])
+        assert np.allclose(full.mean, stream.mean, atol=1e-6)
+        assert np.allclose(full.std, stream.std, atol=1e-6)
+
+    def test_standardize_constant_column_no_nan(self):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerStandardize)
+        x = np.ones((50, 3), np.float32)
+        t = np.asarray(NormalizerStandardize().fit(x).transform(x))
+        assert np.isfinite(t).all()
+
+    def test_minmax_range_and_revert(self, np_rng):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerMinMaxScaler)
+        x = np_rng.randn(100, 3).astype(np.float32) * 5
+        n = NormalizerMinMaxScaler(-1, 1).fit(x)
+        t = np.asarray(n.transform(x))
+        assert t.min() >= -1 - 1e-5 and t.max() <= 1 + 1e-5
+        assert np.allclose(np.asarray(n.revert(t)), x, atol=1e-3)
+
+    def test_image_scaler(self):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            ImagePreProcessingScaler)
+        img = np.arange(256, dtype=np.float32).reshape(4, 8, 8, 1)
+        s = ImagePreProcessingScaler()
+        t = np.asarray(s.transform(img))
+        assert t.min() == 0.0 and t.max() == 1.0
+        assert np.allclose(np.asarray(s.revert(t)), img)
+
+    def test_per_channel_image_statistics(self, np_rng):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerStandardize)
+        x = np_rng.rand(10, 8, 8, 3).astype(np.float32)
+        x[..., 2] *= 100  # channel 2 has a very different scale
+        n = NormalizerStandardize().fit(x)
+        assert n.mean.shape == (3,)
+        t = np.asarray(n.transform(x))
+        assert abs(t[..., 2].std() - 1) < 1e-2
+
+    def test_fit_iterator(self, np_rng):
+        from deeplearning4j_tpu.datasets.iterator import ArrayDataSetIterator
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerStandardize)
+        x = np_rng.rand(120, 6).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np_rng.randint(0, 2, 120)]
+        it = ArrayDataSetIterator(x, y, batch_size=32)
+        n = NormalizerStandardize().fit_iterator(it)
+        full = NormalizerStandardize().fit(x)
+        assert np.allclose(n.mean, full.mean, atol=1e-6)
+
+    def test_attach_restore_round_trip(self, np_rng, tmp_path):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerMinMaxScaler, NormalizerStandardize)
+        from deeplearning4j_tpu.nn import layers as L, updaters as U
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.utils import serialization as S
+
+        from deeplearning4j_tpu.nn.conf.inputs import feed_forward
+
+        conf = NeuralNetConfig(seed=7, updater=U.Sgd(0.1)).list(
+            L.DenseLayer(n_out=4, activation="relu"),
+            L.OutputLayer(n_out=2, loss="mcxent"),
+            input_type=feed_forward(3))
+        net = MultiLayerNetwork(conf)
+        net.init()
+        path = str(tmp_path / "model.zip")
+        S.save_model(net, path)
+        assert S.restore_normalizer(path) is None
+        x = np_rng.rand(50, 3).astype(np.float32)
+        S.add_normalizer_to_model(path, NormalizerStandardize().fit(x))
+        back = S.restore_normalizer(path)
+        assert isinstance(back, NormalizerStandardize)
+        assert np.allclose(np.asarray(back.transform(x)).mean(0), 0,
+                           atol=1e-4)
+        # the model in the zip still loads alongside the normalizer
+        net2 = S.load_model(path)
+        out = net2.output(jnp.asarray(back.transform(x)))
+        assert np.asarray(out).shape == (50, 2)
+        # double-attach is an error, JSON kinds round-trip for minmax too
+        try:
+            S.add_normalizer_to_model(path, NormalizerMinMaxScaler())
+            assert False, "expected ValueError"
+        except ValueError:
+            pass
+
+    def test_standardize_large_offset_no_cancellation(self, np_rng):
+        """Timestamp-scale features (mean ~1.7e9, std ~1) must normalize
+        correctly — the naive sumsq - mean^2 form cancels to var=0 here."""
+        from deeplearning4j_tpu.datasets.normalizers import (
+            NormalizerStandardize)
+        x = (1.7e9 + np_rng.randn(4000, 1)).astype(np.float64)
+        n = NormalizerStandardize()
+        for i in range(0, 4000, 256):
+            n.partial_fit(x[i:i + 256])
+        assert abs(n.std[0] - 1.0) < 0.05, n.std
+        t = np.asarray(n.transform(x))
+        assert abs(t.std() - 1.0) < 0.05
+
+    def test_restore_normalizer_raises_on_jvm_bin(self, tmp_path):
+        import zipfile
+        from deeplearning4j_tpu.utils import serialization as S
+        path = str(tmp_path / "dl4j.zip")
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr("configuration.json", "{}")
+            z.writestr("normalizer.bin", b"\xac\xed\x00\x05")  # java serial
+        try:
+            S.restore_normalizer(path)
+            assert False, "expected ValueError"
+        except ValueError as e:
+            assert "normalizer.bin" in str(e)
