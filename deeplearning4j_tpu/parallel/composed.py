@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax import shard_map
+from deeplearning4j_tpu.parallel import mesh as _mesh
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.nn import layers as L
@@ -409,8 +410,8 @@ class ComposedParallelLM:
         if self._step_fn is None:
             self._step_fn = self._build_step()
         sh = NamedSharding(self.mesh, P("data"))
-        ids = jax.device_put(jnp.asarray(ids), sh)
-        labels = jax.device_put(jnp.asarray(labels), sh)
+        ids = _mesh.ensure_sharded(ids, sh)
+        labels = _mesh.ensure_sharded(labels, sh)
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state, ids, labels, self.iteration)
         self.iteration += 1

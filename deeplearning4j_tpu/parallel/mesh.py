@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -65,3 +66,19 @@ def shard_batch(mesh: Mesh, batch):
     """Place a host batch sharded over the data axis."""
     return jax.tree_util.tree_map(
         lambda a: jax.device_put(a, data_sharded(mesh)), batch)
+
+
+def ensure_sharded(a, sharding):
+    """``device_put`` to ``sharding`` — skipped when ``a`` is already a
+    device array with exactly that sharding. The skip matters on the
+    tunneled TPU backend, where every dispatch (even a no-op placement)
+    costs real per-step latency; steady-state training loops feed
+    already-sharded arrays and should pay zero placement dispatches."""
+    if isinstance(a, jax.Array) and a.sharding == sharding:
+        return a
+    return jax.device_put(jnp.asarray(a), sharding)
+
+
+def ensure_data_sharded(mesh: Mesh, a):
+    """`ensure_sharded` onto the data axis of ``mesh``."""
+    return ensure_sharded(a, data_sharded(mesh))

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from deeplearning4j_tpu.parallel import mesh as _mesh
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
@@ -455,10 +456,9 @@ class PipelineParallelLM:
             self.init()
         if self._step_fn is None:
             self._step_fn = self._build_step()
-        ids = jax.device_put(jnp.asarray(ids),
-                             NamedSharding(self.mesh, P("data")))
-        labels = jax.device_put(jnp.asarray(labels),
-                                NamedSharding(self.mesh, P("data")))
+        ids = _mesh.ensure_sharded(ids, NamedSharding(self.mesh, P("data")))
+        labels = _mesh.ensure_sharded(labels,
+                                      NamedSharding(self.mesh, P("data")))
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state, ids, labels, self.iteration)
         self.iteration += 1

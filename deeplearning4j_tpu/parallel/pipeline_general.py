@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from deeplearning4j_tpu.parallel import mesh as _mesh
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
@@ -401,8 +402,8 @@ class PipelinedNetwork:
             self._step_fn = self._build_step()
         data_ax = "data" if "data" in self.mesh.axis_names else None
         dsh = NamedSharding(self.mesh, P(data_ax))
-        x = jax.device_put(jnp.asarray(x), dsh)
-        y = jax.device_put(jnp.asarray(y), dsh)
+        x = _mesh.ensure_sharded(x, dsh)
+        y = _mesh.ensure_sharded(y, dsh)
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state, x, y, self.iteration)
         self.iteration += 1
