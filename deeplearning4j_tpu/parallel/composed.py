@@ -409,9 +409,8 @@ class ComposedParallelLM:
             self.init()
         if self._step_fn is None:
             self._step_fn = self._build_step()
-        sh = NamedSharding(self.mesh, P("data"))
-        ids = _mesh.ensure_sharded(ids, sh)
-        labels = _mesh.ensure_sharded(labels, sh)
+        ids = _mesh.ensure_data_sharded(self.mesh, ids)
+        labels = _mesh.ensure_data_sharded(self.mesh, labels)
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state, ids, labels, self.iteration)
         self.iteration += 1

@@ -456,9 +456,8 @@ class PipelineParallelLM:
             self.init()
         if self._step_fn is None:
             self._step_fn = self._build_step()
-        ids = _mesh.ensure_sharded(ids, NamedSharding(self.mesh, P("data")))
-        labels = _mesh.ensure_sharded(labels,
-                                      NamedSharding(self.mesh, P("data")))
+        ids = _mesh.ensure_data_sharded(self.mesh, ids)
+        labels = _mesh.ensure_data_sharded(self.mesh, labels)
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state, ids, labels, self.iteration)
         self.iteration += 1

@@ -13,7 +13,35 @@
 set -u
 cd "$(dirname "$0")"
 
-run() { echo "=== ${CFG} $* ==="; env "$@" python bench.py "${CFG}"; }
+# run one leg, streaming output; if the leg reports the tunnel
+# unreachable, abort the whole matrix (exit 2) — every further leg would
+# burn ~4 min of probe timeouts producing CPU-preflight noise, and the
+# re-armed watcher re-runs the matrix at the next window anyway (records
+# already persisted are kept; same-variant re-runs supersede).
+run() {
+  echo "=== ${CFG} $* ==="
+  local legf rc
+  legf=$(mktemp /tmp/r4c_leg.XXXXXX)
+  # stream the leg's output (visible live, survives a mid-leg kill) AND
+  # keep a copy to grep. 900 s ceiling: a single-config bench runs
+  # IN-process (no subprocess watchdog), so a mid-leg tunnel wedge would
+  # otherwise hang the matrix at a device_get forever.
+  timeout 900 env "$@" python bench.py "${CFG}" 2>&1 | tee "$legf"
+  rc=${PIPESTATUS[0]}
+  if [ "$rc" = 124 ]; then
+    # slow leg OR wedge — disambiguate with a fresh probe before deciding
+    if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+      echo "=== ${CFG} hit the 900s leg ceiling but tunnel is alive: skipping leg ==="
+    else
+      echo "=== ${CFG} wedged and tunnel is dead: aborting matrix (watcher re-arms) ==="
+      rm -f "$legf"; exit 2
+    fi
+  elif grep -q '"event": "backend_unreachable"' "$legf"; then
+    echo "=== tunnel lost at ${CFG}: aborting matrix (watcher re-arms) ==="
+    rm -f "$legf"; exit 2
+  fi
+  rm -f "$legf"
+}
 
 # success contract for the watcher's re-arm logic: at least one fresh
 # live-TPU record must have been merged (individual legs exit 0 even when
