@@ -4,6 +4,7 @@ from deeplearning4j_tpu.modelimport.keras import (
     KerasImportError,
     import_keras_model_and_weights,
     import_keras_sequential_config,
+    import_keras_sequential_config_and_weights,
     import_keras_sequential_model_and_weights,
 )
 
@@ -11,5 +12,6 @@ __all__ = [
     "KerasImportError",
     "import_keras_model_and_weights",
     "import_keras_sequential_config",
+    "import_keras_sequential_config_and_weights",
     "import_keras_sequential_model_and_weights",
 ]

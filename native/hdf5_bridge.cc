@@ -401,11 +401,15 @@ int64_t dl4j_h5_read_attr_str(hid_t file, const char* obj_path,
     h5::tclose_(mt);
   } else {
     size_t sz = h5::tget_size_(ty);
-    std::vector<char> buf(sz + 1, 0);
+    // memory type one byte LARGER than the file type: a null-PADDED file
+    // string of exactly sz chars (h5py's fixed-length layout) converted
+    // into a null-TERMINATED memory string of the same size would have
+    // its final character truncated to make room for the terminator
+    std::vector<char> buf(sz + 2, 0);
     hid_t mt = h5::tcopy_(h5::C_S1);
-    h5::tset_size_(mt, sz);
+    h5::tset_size_(mt, sz + 1);
     if (h5::aread_(at, mt, buf.data()) >= 0) {
-      len = (int64_t)strnlen(buf.data(), sz);
+      len = (int64_t)strnlen(buf.data(), sz + 1);
       if (len + 1 <= cap) {
         std::memcpy(out, buf.data(), (size_t)len);
         out[len] = 0;
@@ -454,14 +458,19 @@ int64_t dl4j_h5_read_attr_strs(hid_t file, const char* obj_path,
     h5::tclose_(mt);
   } else {
     size_t sz = h5::tget_size_(ty);
-    std::vector<char> buf((size_t)n * sz, 0);
+    // sz+1 memory stride for the same null-padded-vs-terminated reason
+    // as dl4j_h5_read_attr_str: equal-size conversion truncates the
+    // final character of exact-length fixed strings (found by the
+    // reference's genuine tfscope/model.h5 fixture: 'dense_1_W:0' came
+    // back as 'dense_1_W:')
+    std::vector<char> buf((size_t)n * (sz + 1), 0);
     hid_t mt = h5::tcopy_(h5::C_S1);
-    h5::tset_size_(mt, sz);
+    h5::tset_size_(mt, sz + 1);
     if (h5::aread_(at, mt, buf.data()) >= 0) {
       count = n;
       for (hssize_t i = 0; i < n; ++i) {
-        const char* s = buf.data() + (size_t)i * sz;
-        joined.append(s, strnlen(s, sz));
+        const char* s = buf.data() + (size_t)i * (sz + 1);
+        joined.append(s, strnlen(s, sz + 1));
         joined += '\n';
       }
     }
