@@ -77,6 +77,10 @@ def _input_type_from_shape(shape, dim_ordering="tf"):
     once at import)."""
     dims = [d for d in shape[1:]]
     if len(dims) == 1:
+        if dims[0] is None:
+            # [batch, None]: a variable-length token-id sequence (the only
+            # Keras input this shape can mean — e.g. an Embedding consumer)
+            return I.recurrent(1, None)
         return I.feed_forward(int(dims[0]))
     if len(dims) == 2:
         t, f = dims
@@ -267,7 +271,14 @@ def import_keras_sequential_config(model_config_json: str,
         if input_type is None and shape is not None:
             if "input_shape" in lcfg and "batch_input_shape" not in lcfg:
                 shape = [None] + list(shape)
-            input_type = _input_type_from_shape(shape, dim_ordering)
+            if lcls == "Embedding":
+                # [batch, T] TOKEN IDS (possibly variable-length), not T
+                # scalar features — the imdb_lstm fixtures declare
+                # batch_input_shape [null, null]
+                t = shape[1] if len(shape) > 1 else None
+                input_type = I.recurrent(1, None if t is None else int(t))
+            else:
+                input_type = _input_type_from_shape(shape, dim_ordering)
         layer, wmap = map_layer(lcls, lcfg, keras_version, dim_ordering)
         if layer is None:
             records.append((None, name, wmap))
@@ -390,12 +401,27 @@ _MERGE_MODES = {
 }
 
 
+def import_keras_model_config(model_config_json, keras_version: int = 2,
+                              dim_ordering: str | None = None):
+    """Keras functional-model config (JSON string or dict) -> an
+    initialized ComputationGraph + weight records, no weights file needed
+    (reference: KerasModelImport.importKerasModelConfiguration:66 — the
+    config-only entry its KerasModelConfigurationTest drives)."""
+    model_cfg = json.loads(model_config_json) if isinstance(
+        model_config_json, str) else model_config_json
+    cls, keras_layers = _layer_list(model_cfg)
+    if cls == "Sequential":
+        raise KerasImportError("use import_keras_sequential_config "
+                               "for Sequential models")
+    ordering = dim_ordering or _model_dim_ordering(
+        keras_layers, keras_version=keras_version)
+    return _graph_from_config(model_cfg, keras_layers, keras_version,
+                              ordering)
+
+
 def import_keras_model_and_weights(path: str):
     """Load a Keras functional .h5 into a ComputationGraph (reference:
     KerasModelImport.importKerasModelAndWeights:103)."""
-    from deeplearning4j_tpu.nn.graph import (
-        ComputationGraph, ElementWiseVertex, GraphBuilder, MergeVertex)
-
     with _open(path) as archive:
         version = _keras_version(archive)
         model_cfg = _model_config(archive)
@@ -404,72 +430,8 @@ def import_keras_model_and_weights(path: str):
             raise KerasImportError("use import_keras_sequential_model_and_weights "
                                    "for Sequential models")
         ordering = _model_dim_ordering(keras_layers, _backend(archive), version)
-        cfg = model_cfg["config"]
-        builder = GraphBuilder(updater=_updaters.Sgd(0.01))
-        input_names = [inp[0] for inp in cfg.get("input_layers", [])]
-        output_names = [out[0] for out in cfg.get("output_layers", [])]
-        records = []  # (vertex_name, keras_name, weight_mapper)
-
-        input_types = {}
-        for kl in keras_layers:
-            lcls = kl["class_name"]
-            lcfg = kl.get("config", {})
-            name = kl.get("name") or lcfg.get("name")
-            inbound = kl.get("inbound_nodes", [])
-            # flatten keras's [[["src", node_idx, tensor_idx, {}], ...]] form
-            srcs = []
-            if inbound:
-                if len(inbound) > 1:
-                    raise KerasImportError(
-                        f"Layer {name!r} is applied {len(inbound)} times "
-                        "(shared layer); shared-layer functional models are "
-                        "not supported")
-                node = inbound[0]
-                if isinstance(node, dict):  # keras 3 style {"args": ...}
-                    raise KerasImportError("Keras 3 saved-model configs are "
-                                           "not supported; save as .h5 from "
-                                           "Keras 2")
-                for entry in node:
-                    srcs.append(entry[0])
-            if lcls == "InputLayer":
-                shape = lcfg.get("batch_input_shape") or lcfg.get("batch_shape")
-                input_types[name] = _input_type_from_shape(shape, ordering)
-                continue
-            kind = _MERGE_MODES.get(lcls)
-            if kind is not None:
-                if kind[0] == "elementwise":
-                    builder.add_vertex(name, ElementWiseVertex(op=kind[1]), *srcs)
-                else:
-                    builder.add_vertex(name, MergeVertex(), *srcs)
-                continue
-            layer, wmap = map_layer(lcls, lcfg, version, ordering)
-            if layer is None:
-                # structural no-op: alias by inserting an identity activation
-                builder.add_vertex(
-                    name, _identity_vertex(), *srcs)
-                continue
-            chain = layer if isinstance(layer, list) else [layer]
-            if len(chain) == 1:
-                builder.add_layer(name, chain[0], *srcs)
-                records.append((name, name, wmap))
-            else:
-                # param layer gets an internal name; downstream consumers see
-                # the chain's final output under the Keras name
-                inner = f"{name}__0"
-                builder.add_layer(inner, chain[0], *srcs)
-                records.append((inner, name, wmap))
-                prev = inner
-                for j, extra in enumerate(chain[1:-1], 1):
-                    nm = f"{name}__{j}"
-                    builder.add_layer(nm, extra, prev)
-                    prev = nm
-                builder.add_layer(name, chain[-1], prev)
-
-        builder.add_inputs(*input_names)
-        builder.set_input_types(*[input_types[n] for n in input_names])
-        builder.set_outputs(*output_names)
-        graph = ComputationGraph(builder.build())
-        graph.init()
+        graph, records = _graph_from_config(model_cfg, keras_layers,
+                                            version, ordering)
 
         params = dict(graph.params)
         state = dict(graph.state)
@@ -494,6 +456,88 @@ def import_keras_model_and_weights(path: str):
         graph.params = params
         graph.state = state
         return graph
+
+
+def _graph_from_config(model_cfg, keras_layers, version, ordering):
+    """(initialized ComputationGraph, [(vertex, keras_name, wmap)])."""
+    from deeplearning4j_tpu.nn.graph import (
+        ComputationGraph, ElementWiseVertex, GraphBuilder, MergeVertex)
+
+    cfg = model_cfg["config"]
+    builder = GraphBuilder(updater=_updaters.Sgd(0.01))
+    input_names = [inp[0] for inp in cfg.get("input_layers", [])]
+    output_names = [out[0] for out in cfg.get("output_layers", [])]
+    records = []  # (vertex_name, keras_name, weight_mapper)
+
+    input_types = {}
+    for kl in keras_layers:
+        lcls = kl["class_name"]
+        lcfg = kl.get("config", {})
+        name = kl.get("name") or lcfg.get("name")
+        inbound = kl.get("inbound_nodes", [])
+        # flatten keras's [[["src", node_idx, tensor_idx, {}], ...]] form
+        srcs = []
+        if inbound:
+            if len(inbound) > 1:
+                raise KerasImportError(
+                    f"Layer {name!r} is applied {len(inbound)} times "
+                    "(shared layer); shared-layer functional models are "
+                    "not supported")
+            node = inbound[0]
+            if isinstance(node, dict):  # keras 3 style {"args": ...}
+                raise KerasImportError("Keras 3 saved-model configs are "
+                                       "not supported; save as .h5 from "
+                                       "Keras 2")
+            for entry in node:
+                srcs.append(entry[0])
+        if lcls == "InputLayer":
+            shape = lcfg.get("batch_input_shape") or lcfg.get("batch_shape")
+            input_types[name] = _input_type_from_shape(shape, ordering)
+            continue
+        kind = _MERGE_MODES.get(lcls)
+        if kind is not None:
+            if kind[0] == "elementwise":
+                builder.add_vertex(name, ElementWiseVertex(op=kind[1]), *srcs)
+            else:
+                builder.add_vertex(name, MergeVertex(), *srcs)
+            continue
+        layer, wmap = map_layer(lcls, lcfg, version, ordering)
+        if lcls == "Embedding":
+            # an Embedding consumer means its source Input is a [B, T]
+            # token-id sequence, not T scalar features — reinterpret the
+            # recorded input type (same rule as the Sequential path)
+            for src in srcs:
+                it = input_types.get(src)
+                if isinstance(it, I.FeedForwardType):
+                    input_types[src] = I.recurrent(1, it.size)
+        if layer is None:
+            # structural no-op: alias by inserting an identity activation
+            builder.add_vertex(
+                name, _identity_vertex(), *srcs)
+            continue
+        chain = layer if isinstance(layer, list) else [layer]
+        if len(chain) == 1:
+            builder.add_layer(name, chain[0], *srcs)
+            records.append((name, name, wmap))
+        else:
+            # param layer gets an internal name; downstream consumers see
+            # the chain's final output under the Keras name
+            inner = f"{name}__0"
+            builder.add_layer(inner, chain[0], *srcs)
+            records.append((inner, name, wmap))
+            prev = inner
+            for j, extra in enumerate(chain[1:-1], 1):
+                nm = f"{name}__{j}"
+                builder.add_layer(nm, extra, prev)
+                prev = nm
+            builder.add_layer(name, chain[-1], prev)
+
+    builder.add_inputs(*input_names)
+    builder.set_input_types(*[input_types[n] for n in input_names])
+    builder.set_outputs(*output_names)
+    graph = ComputationGraph(builder.build())
+    graph.init()
+    return graph, records
 
 
 def _identity_vertex():

@@ -389,9 +389,22 @@ def _map_simple_rnn(c: Cfg):
 
 
 def _map_embedding(c: Cfg):
-    return (L.EmbeddingLayer(
+    # a Keras Embedding is ALWAYS sequential ([B, T] ids -> [B, T, D]);
+    # the sequence layer is the faithful mapping (imdb_lstm configs in the
+    # reference's own test resources are Embedding -> LSTM stacks)
+    return (L.EmbeddingSequenceLayer(
         n_in=int(c.require("input_dim")),
-        n_out=int(c.require("output_dim"))), _embedding_weights)
+        n_out=int(c.require("output_dim", "units"))), _embedding_weights)
+
+
+def _map_time_distributed_dense(c: Cfg):
+    # Keras-1 legacy TimeDistributedDense: dense applied per timestep with
+    # the time axis PRESERVED ([B,T,F] -> [B,T,n_out]); a bare DenseLayer
+    # would fold time into batch and lose it for everything downstream
+    return (L.TimeDistributedDenseLayer(
+        n_out=int(c.require("output_dim", "units")),
+        activation=activation(c.get("activation", default="linear"))),
+        _dense_weights)
 
 
 def _map_dropout(c: Cfg):
@@ -473,6 +486,7 @@ MAPPERS = {
     "LSTM": _map_lstm,
     "SimpleRNN": _map_simple_rnn,
     "Embedding": _map_embedding,
+    "TimeDistributedDense": _map_time_distributed_dense,
     "Dropout": _map_dropout,
     "SpatialDropout1D": _map_dropout,
     "SpatialDropout2D": _map_dropout,

@@ -2,6 +2,7 @@ from deeplearning4j_tpu.nn.layers.base import Layer, ParamLayer  # noqa: F401
 from deeplearning4j_tpu.nn.layers.core import (  # noqa: F401
     DenseLayer, OutputLayer, LossLayer, ActivationLayer, DropoutLayer,
     EmbeddingLayer, EmbeddingSequenceLayer, AutoEncoder,
+    TimeDistributedDenseLayer,
 )
 from deeplearning4j_tpu.nn.layers.conv import (  # noqa: F401
     ConvolutionLayer, Convolution1DLayer, Deconvolution2DLayer,

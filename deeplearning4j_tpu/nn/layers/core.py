@@ -189,6 +189,38 @@ class EmbeddingLayer(ParamLayer):
 
 @register_config
 @dataclasses.dataclass(frozen=True)
+class TimeDistributedDenseLayer(DenseLayer):
+    """Dense applied independently at every timestep: [B, T, F] ->
+    [B, T, n_out], time axis preserved (reference analog: Keras-1
+    TimeDistributedDense / DL4J's DenseLayer wrapped in RnnToFeedForward +
+    FeedForwardToRnn preprocessors — here the matmul simply broadcasts
+    over the leading axes, no fold/unfold round-trip)."""
+
+    input_family = _inputs.RecurrentType
+
+    def output_type(self, input_type):
+        return _inputs.RecurrentType(self.n_out, input_type.timesteps)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        n_in = input_type.size
+        p = {"W": _init.init_weight(self.weight_init, key,
+                                    (n_in, self.n_out),
+                                    n_in, self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        b, t, f = x.shape
+        z = matmul(x.reshape(b * t, f), params["W"]).reshape(
+            b, t, self.n_out)
+        if self.has_bias:
+            z = z + params["b"]
+        return self.activation_fn()(z), state
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
 class EmbeddingSequenceLayer(ParamLayer):
     """Per-timestep index -> vector lookup for sequence models: [B, T] (or
     [B, T, 1]) integer ids -> [B, T, n_out], with an optional learned
