@@ -279,6 +279,12 @@ def import_keras_sequential_config(model_config_json: str,
                 input_type = I.recurrent(1, None if t is None else int(t))
             else:
                 input_type = _input_type_from_shape(shape, dim_ordering)
+        if (lcls == "Embedding" and not layers
+                and isinstance(input_type, I.FeedForwardType)):
+            # explicit InputLayer([None, T]) followed by Embedding: T is a
+            # token-sequence length, not T scalar features (same
+            # reinterpretation the functional path applies to the source)
+            input_type = I.recurrent(1, input_type.size)
         layer, wmap = map_layer(lcls, lcfg, keras_version, dim_ordering)
         if layer is None:
             records.append((None, name, wmap))

@@ -403,7 +403,8 @@ def _map_time_distributed_dense(c: Cfg):
     # would fold time into batch and lose it for everything downstream
     return (L.TimeDistributedDenseLayer(
         n_out=int(c.require("output_dim", "units")),
-        activation=activation(c.get("activation", default="linear"))),
+        activation=activation(c.get("activation", default="linear")),
+        has_bias=bool(c.get("use_bias", "bias", default=True))),
         _dense_weights)
 
 

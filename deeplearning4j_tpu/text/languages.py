@@ -321,7 +321,7 @@ class JapaneseTokenizerFactory(_CjkTokenizerFactoryBase):
 
     def __init__(self, lexicon=None, preprocessor=None, max_word_len=8,
                  mode="lattice", use_default_lexicon=True,
-                 lattice_mode="normal"):
+                 lattice_mode="normal", user_dict_path=None):
         super().__init__(lexicon=lexicon, preprocessor=preprocessor,
                          max_word_len=max_word_len,
                          use_default_lexicon=use_default_lexicon)
@@ -348,13 +348,22 @@ class JapaneseTokenizerFactory(_CjkTokenizerFactoryBase):
         from deeplearning4j_tpu.text import ja_lattice
         self._merged = ja_lattice.merge_entries(set(lexicon)
                                                 if lexicon else None)
+        # kuromoji user-dictionary CSV (surface,custom segmentation,...):
+        # matching surfaces are force-segmented ahead of the lattice
+        if user_dict_path and self.mode != "lattice":
+            raise ValueError(
+                "user_dict_path requires mode='lattice' (maxmatch never "
+                "consults the user dictionary)")
+        self._user_dict = (ja_lattice.UserDictionary.load(user_dict_path)
+                           if user_dict_path else None)
 
     def create(self, text: str) -> Tokenizer:
         if self.mode == "lattice":
             from deeplearning4j_tpu.text import ja_lattice
             return self._lattice_create(
                 text, ja_lattice.tokenize(text, merged=self._merged,
-                                          mode=self.lattice_mode))
+                                          mode=self.lattice_mode,
+                                          user_dict=self._user_dict))
         return self._create_maxmatch(text)
 
     def _create_maxmatch(self, text: str) -> Tokenizer:

@@ -116,3 +116,26 @@ def test_factory_lattice_mode_passthrough():
         "ソフトウェア", "エンジニア"]
     with pytest.raises(ValueError):
         JapaneseTokenizerFactory(lattice_mode="bogus")
+
+
+def test_genuine_kuromoji_user_dictionary():
+    """The reference's own userdict.txt (kuromoji UserDictionary CSV):
+    matching surfaces are force-segmented with the custom segmentation
+    (日本経済新聞 -> 日本 経済 新聞) or kept whole (朝青龍), taking
+    precedence over the lattice."""
+    from deeplearning4j_tpu.text import ja_lattice
+    from deeplearning4j_tpu.text.languages import JapaneseTokenizerFactory
+
+    path = os.path.join(BASE, "userdict.txt")
+    ud = ja_lattice.UserDictionary.load(path)
+    assert ud.entries["日本経済新聞"] == ["日本", "経済", "新聞"]
+    assert ud.entries["関西国際空港"] == ["関西", "国際", "空港"]
+    assert ud.entries["朝青龍"] == ["朝青龍"]
+
+    f = JapaneseTokenizerFactory(user_dict_path=path)
+    assert f.create("日本経済新聞を読む").get_tokens() == \
+        ["日本", "経済", "新聞", "を", "読む"]
+    assert f.create("朝青龍は強い").get_tokens() == ["朝青龍", "は", "強い"]
+    # non-matching text still flows through the normal lattice
+    assert f.create("猫は魚が好きです").get_tokens() == \
+        ["猫", "は", "魚", "が", "好き", "です"]
