@@ -48,6 +48,9 @@ SYMBOLS = {
         "BenchmarkDataSetIterator", "MultipleEpochsIterator",
         "EarlyTerminationIterator", "ShardedDataSetIterator"],
     "deeplearning4j_tpu.datasets.fetchers": [],
+    "deeplearning4j_tpu.datasets.records": [
+        "csv_dataset", "CSVSequenceRecordReader", "sequence_dataset",
+        "read_csv_records"],
     "deeplearning4j_tpu.datasets.normalizers": [
         "NormalizerStandardize", "NormalizerMinMaxScaler",
         "ImagePreProcessingScaler"],
