@@ -1,0 +1,65 @@
+"""Image directory loading: the DataVec ImageRecordReader role.
+
+Reference analog: org.datavec.image ImageRecordReader(height, width,
+channels, ParentPathLabelGenerator) — directory-per-class image trees,
+as the reference's Spark data tests drive against
+dl4j-spark/src/test/resources/imagetest/{0,1}/*.bmp
+(TestDataVecDataSetFunctions.java, the image path). Decoding via PIL;
+output is NHWC float32 (the TPU-native conv layout) with one-hot labels
+from the parent directory name, sorted for a stable class index.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+
+
+def load_image(path, *, height=None, width=None, channels=3):
+    """[H, W, C] float32 in [0, 255] (use datasets.normalizers.
+    ImagePreProcessingScaler for 0-1 scaling, like the reference)."""
+    from PIL import Image
+
+    if channels not in (1, 3, 4):
+        raise ValueError(f"channels must be 1, 3 or 4, got {channels}")
+    img = Image.open(path)
+    img = img.convert({1: "L", 3: "RGB", 4: "RGBA"}[channels])
+    if height is not None and width is not None:
+        img = img.resize((width, height))
+    arr = np.asarray(img, np.float32)
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    return arr
+
+
+def image_dataset(root, *, height, width, channels=3, extensions=None):
+    """(images [N, H, W, C], labels [N, n_classes], class_names) from a
+    directory-per-class tree — the ImageRecordReader +
+    ParentPathLabelGenerator contract. Classes are the sorted child
+    directory names; every readable image under each contributes one
+    example."""
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    if not classes:
+        raise ValueError(f"{root}: no class subdirectories")
+    exts = tuple(e.lower() for e in (extensions
+                                     or ("bmp", "png", "jpg", "jpeg",
+                                         "gif")))
+    xs, ys = [], []
+    for ci, cname in enumerate(classes):
+        # extension match is case-insensitive (.BMP/.JPG from cameras)
+        files = sorted(
+            os.path.join(root, cname, f) for f in
+            os.listdir(os.path.join(root, cname))
+            if "." in f and f.rsplit(".", 1)[1].lower() in exts)
+        if not files:
+            raise ValueError(f"{root}/{cname}: no images matching {exts}")
+        for p in files:
+            xs.append(load_image(p, height=height, width=width,
+                                 channels=channels))
+            ys.append(ci)
+    x = np.stack(xs)
+    y = np.eye(len(classes), dtype=np.float32)[np.asarray(ys)]
+    return x, y, classes

@@ -83,7 +83,7 @@ class CSVSequenceRecordReader:
         return [self.read(p) for p in paths]
 
 
-def sequence_dataset(feature_files, label_files, *, n_classes,
+def sequence_dataset(feature_files, label_files, *, n_classes=None,
                      skip_lines=0, delimiter=",",
                      regression=False, align="equal"):
     """(features [B, T, F], labels [B, T, C], feature_mask [B, T],
@@ -104,9 +104,14 @@ def sequence_dataset(feature_files, label_files, *, n_classes,
     end."""
     if align not in ("equal", "end"):
         raise ValueError(f"unknown align {align!r}")
+    if not regression and not n_classes:
+        raise ValueError("n_classes is required for classification labels "
+                         "(or pass regression=True)")
     rr = CSVSequenceRecordReader(skip_lines, delimiter)
     feats = rr.read_all(feature_files)
     labs = rr.read_all(label_files)
+    if not feats:
+        raise ValueError(f"no feature sequences found for {feature_files!r}")
     if len(feats) != len(labs):
         raise ValueError(f"{len(feats)} feature sequences vs "
                          f"{len(labs)} label sequences")

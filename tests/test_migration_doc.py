@@ -51,6 +51,7 @@ SYMBOLS = {
     "deeplearning4j_tpu.datasets.records": [
         "csv_dataset", "CSVSequenceRecordReader", "sequence_dataset",
         "read_csv_records"],
+    "deeplearning4j_tpu.datasets.images": ["image_dataset", "load_image"],
     "deeplearning4j_tpu.datasets.normalizers": [
         "NormalizerStandardize", "NormalizerMinMaxScaler",
         "ImagePreProcessingScaler"],

@@ -124,3 +124,54 @@ class TestGenuineFixtures:
         p2.write_text("header only\n")
         with pytest.raises(ValueError, match="no data rows"):
             read_csv_records(str(p2), skip_lines=1)
+
+
+class TestImageRecordReader:
+    """ImageRecordReader role vs the reference's genuine imagetest BMPs
+    (directory-per-class: imagetest/{0,1}/{a,b}.bmp)."""
+
+    ROOT = os.path.join(SPARK_RES, "imagetest")
+
+    def test_directory_per_class_loading(self):
+        from deeplearning4j_tpu.datasets.images import image_dataset
+        x, y, classes = image_dataset(self.ROOT, height=8, width=8,
+                                      channels=3)
+        assert classes == ["0", "1"]
+        assert x.shape == (4, 8, 8, 3) and y.shape == (4, 2)
+        assert y.sum(0).tolist() == [2.0, 2.0]
+        assert x.min() >= 0 and x.max() <= 255
+
+    def test_grayscale_and_scaler_compose(self):
+        from deeplearning4j_tpu.datasets.images import image_dataset
+        from deeplearning4j_tpu.datasets.normalizers import (
+            ImagePreProcessingScaler)
+        x, y, _ = image_dataset(self.ROOT, height=6, width=6, channels=1)
+        assert x.shape == (4, 6, 6, 1)
+        t = np.asarray(ImagePreProcessingScaler().transform(x))
+        assert 0 <= t.min() and t.max() <= 1.0
+
+    def test_trains_a_tiny_cnn(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.datasets.images import image_dataset
+        from deeplearning4j_tpu.datasets.normalizers import (
+            ImagePreProcessingScaler)
+        from deeplearning4j_tpu.nn import layers as L, updaters as U
+        from deeplearning4j_tpu.nn.conf.inputs import convolutional
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        x, y, _ = image_dataset(self.ROOT, height=8, width=8, channels=3)
+        xs = jnp.asarray(np.asarray(
+            ImagePreProcessingScaler().transform(x)))
+        net = MultiLayerNetwork(NeuralNetConfig(
+            seed=1, updater=U.Adam(2e-2)).list(
+            L.ConvolutionLayer(n_out=4, kernel=(3, 3), padding="same",
+                               activation="relu"),
+            L.GlobalPoolingLayer(mode="avg"),
+            L.OutputLayer(n_out=2, loss="mcxent"),
+            input_type=convolutional(8, 8, 3)))
+        net.init()
+        l0 = float(net.score(xs, jnp.asarray(y)))
+        net.fit(xs, jnp.asarray(y), epochs=40)
+        l1 = float(net.score(xs, jnp.asarray(y)))
+        assert l1 < l0
