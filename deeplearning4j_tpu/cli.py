@@ -36,8 +36,15 @@ def _build_parser():
     src = t.add_mutually_exclusive_group(required=True)
     src.add_argument("--model-path", help="checkpoint zip to resume")
     src.add_argument("--zoo", help="zoo model name (e.g. lenet)")
-    t.add_argument("--data", required=True, help=".npy features")
-    t.add_argument("--labels", required=True, help=".npy labels (one-hot)")
+    t.add_argument("--data", required=True,
+                   help=".npy features, or a labelled .csv/.dat file")
+    t.add_argument("--labels", help=".npy labels (one-hot); unused for CSV")
+    t.add_argument("--label-column", type=int, default=-1,
+                   help="CSV label column (default: last)")
+    t.add_argument("--n-classes", type=int,
+                   help="one-hot CSV labels to this many classes")
+    t.add_argument("--skip-lines", type=int, default=0,
+                   help="CSV header lines to skip")
     t.add_argument("--epochs", type=int, default=1)
     t.add_argument("--workers", type=int, default=0,
                    help="mesh data-axis size (0 = all local devices)")
@@ -58,9 +65,14 @@ def _build_parser():
     esrc = e.add_mutually_exclusive_group(required=True)
     esrc.add_argument("--model-path", help="checkpoint zip")
     esrc.add_argument("--zoo", help="zoo model name (fresh init)")
-    e.add_argument("--data", required=True, help=".npy features")
-    e.add_argument("--labels", required=True,
-                   help=".npy labels (one-hot or class indices)")
+    e.add_argument("--data", required=True,
+                   help=".npy features, or a labelled .csv/.dat file")
+    e.add_argument("--label-column", type=int, default=-1)
+    e.add_argument("--n-classes", type=int)
+    e.add_argument("--skip-lines", type=int, default=0)
+    e.add_argument("--labels",
+                   help=".npy labels (one-hot or class indices); "
+                        "unused for CSV")
     e.add_argument("--batch-size", type=int, default=128)
     e.add_argument("--regression", action="store_true",
                    help="report regression metrics instead of classification")
@@ -92,6 +104,31 @@ def _load_model(args):
     return net
 
 
+
+
+def _load_xy(args):
+    """Features+labels from .npy pairs or a single labelled CSV.
+
+    --data model.csv with --label-column/--n-classes routes through
+    datasets.records.csv_dataset (the RecordReaderDataSetIterator CLI
+    shape); .npy keeps the original contract."""
+    if args.data.endswith(".csv") or args.data.endswith(".dat"):
+        from deeplearning4j_tpu.datasets.records import csv_dataset
+        x, y = csv_dataset(args.data, label_column=args.label_column,
+                           n_classes=args.n_classes,
+                           skip_lines=args.skip_lines)
+        if y.ndim == 1:
+            # no --n-classes: raw label column — make it an explicit
+            # [N, 1] regression target (a 1-D y would silently broadcast
+            # into a wrong loss downstream)
+            y = y[:, None]
+        return x, y
+    if not getattr(args, "labels", None):
+        raise SystemExit("--labels is required with .npy features")
+    x = np.load(args.data)
+    y = np.load(args.labels)
+    return x, y
+
 def _cmd_train(args):
     import jax
     from jax.sharding import Mesh
@@ -99,8 +136,7 @@ def _cmd_train(args):
         DistributedMultiLayer, ParameterAveragingTrainingMaster,
         SharedTrainingMaster)
 
-    x = np.load(args.data)
-    y = np.load(args.labels)
+    x, y = _load_xy(args)
     n_devices = len(jax.devices())
     n_workers = args.workers or n_devices
     if n_workers > n_devices:
@@ -170,8 +206,7 @@ def _cmd_eval(args):
     """(reference role: Evaluation printed from MultiLayerNetwork.evaluate /
     the examples' eval.stats() tail — here as a CLI verb)."""
     net = _load_model(args)
-    x = np.load(args.data)
-    y = np.load(args.labels)
+    x, y = _load_xy(args)
     preds = []
     for i in range(0, x.shape[0], args.batch_size):
         out = net.output(x[i:i + args.batch_size])

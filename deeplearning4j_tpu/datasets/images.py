@@ -24,9 +24,12 @@ def load_image(path, *, height=None, width=None, channels=3):
 
     if channels not in (1, 3, 4):
         raise ValueError(f"channels must be 1, 3 or 4, got {channels}")
+    if (height is None) != (width is None):
+        raise ValueError("pass BOTH height and width to resize (got "
+                         f"height={height}, width={width})")
     img = Image.open(path)
     img = img.convert({1: "L", 3: "RGB", 4: "RGBA"}[channels])
-    if height is not None and width is not None:
+    if height is not None:
         img = img.resize((width, height))
     arr = np.asarray(img, np.float32)
     if arr.ndim == 2:

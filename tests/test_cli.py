@@ -88,3 +88,30 @@ class TestEvalCommand:
         out = capsys.readouterr().out
         assert "ccuracy" in out
         assert "F1" in out or "onfusion" in out
+
+
+def test_train_and_eval_from_genuine_iris_csv(tmp_path, eight_devices):
+    """CSV route (RecordReaderDataSetIterator CLI shape) against the
+    reference's genuine iris.dat."""
+    import os
+    iris = ("/root/reference/deeplearning4j-scaleout/dl4j-streaming/"
+            "src/test/resources/iris.dat")
+    if not os.path.exists(iris):
+        pytest.skip("reference iris.dat not present")
+    net = MultiLayerNetwork(
+        NeuralNetConfig(seed=1, updater=U.Adam(learning_rate=0.05)).list(
+            L.DenseLayer(n_out=12, activation="relu"),
+            L.OutputLayer(n_out=3, loss="mcxent"),
+            input_type=I.FeedForwardType(4)))
+    net.init()
+    mp = str(tmp_path / "iris_model.zip")
+    save_model(net, mp)
+    out = str(tmp_path / "iris_out.zip")
+    rc = main(["train", "--model-path", mp, "--data", iris,
+               "--n-classes", "3", "--epochs", "30",
+               "--batch-size-per-worker", "8",
+               "--model-output-path", out])
+    assert rc == 0
+    rc = main(["eval", "--model-path", out, "--data", iris,
+               "--n-classes", "3"])
+    assert rc == 0
