@@ -47,7 +47,12 @@ def _build_dictionary():
     def add(words, cls, cost):
         for w in words.split():
             entries = d.setdefault(w, [])
-            if (cost, cls) not in entries:  # hand-curated lists: dedupe
+            for i, (c0, k0) in enumerate(entries):
+                if k0 == cls:  # same class listed twice: keep the cheaper
+                    # cost (identical to what Viterbi's min would pick)
+                    entries[i] = (min(c0, cost), cls)
+                    break
+            else:
                 entries.append((cost, cls))
 
     def add_te(words, cost):

@@ -40,7 +40,12 @@ def _build_dictionary():
     def add(words, cls, cost):
         for w in words.split():
             entries = d.setdefault(w, [])
-            if (cost, cls) not in entries:  # hand-curated lists: dedupe
+            for i, (c0, k0) in enumerate(entries):
+                if k0 == cls:  # same class listed twice: keep the cheaper
+                    # cost (identical to what Viterbi's min would pick)
+                    entries[i] = (min(c0, cost), cls)
+                    break
+            else:
                 entries.append((cost, cls))
 
     # --- pronouns / demonstratives ---
@@ -181,6 +186,19 @@ def _build_dictionary():
         "模糊 准确 正确 错误 合适 合理 公平 积极 消极 主动 被动",
         ADJ, 2400)
     # --- more adverbs / time words ---
+    # --- 家/者/员-derived professions (ansj's derivational nouns) ---
+    add("科学家 艺术家 作家 画家 音乐家 专家 企业家 政治家 思想家 "
+        "教育家 文学家 数学家 物理学家 化学家 历史学家 哲学家 "
+        "发明家 探险家 银行家 记者 学者 读者 作者 译者 消费者 "
+        "志愿者 爱好者 工作者 研究者 演员 教员 职员 店员 服务员 "
+        "售货员 驾驶员 飞行员 管理员 程序员", NOUN, 2200)
+    # --- abstract nouns + common idioms (chengyu enter ansj's core
+    # dictionary whole) ---
+    add("和平 美好 幸福 自由 正义 真理 理想 信念 信心 勇气 "
+        "荣誉 尊严 价值 意义 精神 灵魂 命运 奇迹 "
+        "青山绿水 绿水青山 山清水秀 万事如意 一帆风顺 四面八方 "
+        "五颜六色 七上八下 十全十美 百花齐放 千方百计 万紫千红 "
+        "自言自语 全心全意 实事求是 名副其实", NOUN, 2200)
     # --- locatives + 每-compounds + campus/tech words the held-out
     # sentences exposed as missing ---
     add("里 外 上 下 内 中 旁 边 处", NOUN, 2100)

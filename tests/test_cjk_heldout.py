@@ -111,3 +111,32 @@ def test_suites_are_nontrivial(lang):
              "ko": TestKoreanHeldOut.CASES}[lang]
     assert len(cases) >= 7
     assert all(len(toks) >= 3 for toks in cases.values())
+
+
+class TestGenuineReferencePackCases:
+    """The exact sentences the reference's own nlp-chinese / nlp-korean
+    pack tests assert (ChineseTokenizerTest.java, KoreanTokenizerTest
+    .java), consumed as external goldens."""
+
+    def test_ansj_reference_sentence_exact(self):
+        from deeplearning4j_tpu.text import zh_lattice
+        s = "青山绿水和伟大的科学家让世界更美好和平"
+        # the reference's expected ansj output, token for token
+        assert zh_lattice.tokenize(s) == [
+            "青山绿水", "和", "伟大", "的", "科学家", "让", "世界", "更",
+            "美好", "和平"]
+
+    def test_korean_reference_sentence(self):
+        """twitter-korean-text emits 딥|러닝 and 입니|다 at morpheme
+        granularity; this analyzer keeps 딥러닝 (one loanword) and the
+        conjugated copula whole — same word boundaries everywhere else,
+        pinned here with the convention difference documented."""
+        from deeplearning4j_tpu.text.languages import KoreanTokenizerFactory
+        s = "세계 최초의 상용 수준 오픈소스 딥러닝 라이브러리입니다"
+        got = KoreanTokenizerFactory(emit_josa=True).create(s).get_tokens()
+        assert got == ["세계", "최초", "의", "상용", "수준", "오픈소스",
+                       "딥러닝", "라이브러리", "입니다"]
+        # stem-normalized default drops the particles/copula
+        bare = KoreanTokenizerFactory().create(s).get_tokens()
+        assert bare == ["세계", "최초", "상용", "수준", "오픈소스",
+                        "딥러닝", "라이브러리"]
