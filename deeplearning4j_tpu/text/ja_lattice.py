@@ -181,6 +181,15 @@ def _build_dictionary():
         "仙台 広島 奈良 中国 韓国 台湾 アメリカ イギリス フランス "
         "ドイツ イタリア スペイン ロシア インド 英語 日本語 中国語 "
         "韓国語 フランス語 ドイツ語", NOUN, 2400)
+    # --- common Japanese surnames + famous literary names (ipadic's
+    # person-name entries; the zh lattice has a surname RULE, Japanese
+    # name readings are too irregular for one — dictionary entries are
+    # the kuromoji way) ---
+    add("田中 鈴木 佐藤 高橋 伊藤 渡辺 山本 中村 小林 加藤 吉田 山田 "
+        "佐々木 松本 井上 木村 清水 斎藤 阿部 森 池田 橋本 石川 山口 "
+        "前田 藤田 小川 岡田 長谷川 村上 近藤 石井 遠藤 青木 坂本 "
+        "夏目 漱石 芥川 龍之介 太宰 治 川端 康成 三島 由紀夫 "
+        "村上春樹 宮崎 黒澤", NOUN, 2400)
     # --- more verb stems + dictionary + te/ta forms (same three-row
     # pattern as the core set: euphonic te/ta forms are dictionary
     # entries because stem+ending cannot reach them) ---
@@ -380,8 +389,12 @@ def _unknown_candidates(text, i):
         out.append((text[i:i + run], 0, SYM))
     else:
         # one token PER symbol (kuromoji's convention: 、 。 》 each its
-        # own token), not one per run — adjacent punctuation stays apart
-        out.append((text[i:i + 1], 3000, SYM))
+        # own token) — EXCEPT a repeat-run of the same symbol (----,
+        # 。。。), which ipadic's unknown handling keeps whole
+        j2 = i
+        while j2 < i + run and text[j2] == text[i]:
+            j2 += 1
+        out.append((text[i:j2], 3000, SYM))
     return out
 
 
