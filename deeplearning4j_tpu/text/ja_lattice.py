@@ -178,7 +178,8 @@ def _build_dictionary():
         "種類 形 大きさ 長さ 重さ 高さ 深さ 広さ 速さ 強さ", NOUN, 2500)
     # --- more proper / regional nouns ---
     add("北海道 東北 関東 関西 九州 沖縄 横浜 名古屋 福岡 神戸 札幌 "
-        "仙台 広島 奈良 中国 韓国 台湾 アメリカ イギリス フランス "
+        "仙台 広島 奈良 青森 岩手 秋田 山形 福島 新潟 長野 静岡 岡山 "
+        "熊本 鹿児島 千葉 埼玉 中国 韓国 台湾 アメリカ イギリス フランス "
         "ドイツ イタリア スペイン ロシア インド 英語 日本語 中国語 "
         "韓国語 フランス語 ドイツ語", NOUN, 2400)
     # --- common Japanese surnames + famous literary names (ipadic's
