@@ -84,13 +84,31 @@ class ZooModel:
 
 
 def restore_checkpoint(path, input_type=None):
-    """Restore either checkpoint format by sniffing the zip: the
-    reference's ModelSerializer layout (``configuration.json`` +
+    """Restore ANY supported model file by sniffing its format (the
+    reference's ModelGuesser role, util/ModelGuesser.java): the
+    reference's ModelSerializer zip layout (``configuration.json`` +
     ``coefficients.bin`` — what every zoo ``pretrainedUrl`` serves,
     ZooModel.java:40-52) goes through modelimport.dl4j; this framework's
-    own layout goes through utils.serialization."""
+    own zip layout goes through utils.serialization; a Keras HDF5 file
+    (signature ``\\x89HDF``) goes through modelimport.keras
+    (Sequential -> MultiLayerNetwork, functional -> ComputationGraph)."""
     import json
     import zipfile
+    with open(path, "rb") as f:
+        magic = f.read(8)
+    if magic.startswith(b"\x89HDF"):
+        from deeplearning4j_tpu.modelimport.keras import (
+            _layer_list, _model_config, _open,
+            import_keras_model_and_weights,
+            import_keras_sequential_model_and_weights)
+        with _open(path) as archive:
+            cls, _ = _layer_list(_model_config(archive))
+        # dispatch on the declared model class (the reference's
+        # KerasModelImport sniff) — exception-driven fallback would mask
+        # the real diagnostic of a failed Sequential import
+        if cls == "Sequential":
+            return import_keras_sequential_model_and_weights(path)
+        return import_keras_model_and_weights(path)
     with zipfile.ZipFile(path) as zf:
         names = set(zf.namelist())
         cfg = (json.loads(zf.read("configuration.json").decode("utf-8"))

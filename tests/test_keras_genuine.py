@@ -148,3 +148,20 @@ def _writable():
     import inspect
     from deeplearning4j_tpu.native.h5 import Hdf5Archive
     return "mode" in inspect.signature(Hdf5Archive.__init__).parameters
+
+
+def test_restore_checkpoint_guesses_keras_h5():
+    """models.zoo.restore_checkpoint plays the ModelGuesser role: pointed
+    at a genuine Keras .h5 it sniffs the HDF5 signature and routes
+    through the Keras importer instead of failing as a bad zip."""
+    from deeplearning4j_tpu.models.zoo import restore_checkpoint
+    from deeplearning4j_tpu.native.h5 import Hdf5Archive
+
+    path = os.path.join(FIXTURES, "model.h5")
+    net = restore_checkpoint(path)
+    a = Hdf5Archive(path)
+    try:
+        chain = _raw_dense_chain(a, "model_weights/")
+    finally:
+        a.close()
+    _assert_import_matches(net, chain)
