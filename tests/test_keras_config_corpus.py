@@ -98,3 +98,26 @@ def test_config_builds_runnable_network(name, shape, out_shape):
         assert out.shape == (2, t.timesteps or 8, n_out), out.shape
     else:
         assert out.shape == out_shape, out.shape
+
+
+@pytest.mark.slow
+def test_functional_multiloss_config_runs():
+    """The genuine mlp_fapi_multiloss functional config builds a 2-output
+    ComputationGraph that forwards on both heads."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.modelimport.keras import (
+        import_keras_model_config)
+
+    path = os.path.join(BASE, "keras1/mlp_fapi_multiloss_config.json")
+    cfg = json.load(open(path))
+    graph, records = import_keras_model_config(cfg, 1)
+    assert len(graph.conf.outputs) == 2
+    rs = np.random.RandomState(0)
+    feeds = {name: jnp.asarray(rs.rand(
+        3, graph._types[name].size).astype(np.float32))
+        for name in graph.conf.inputs}
+    assert len(feeds) == 2  # the genuine config is two-input two-output
+    out = graph.output(feeds)
+    assert set(out) == set(graph.conf.outputs)
+    for head, arr in out.items():
+        assert np.isfinite(np.asarray(arr)).all(), head
