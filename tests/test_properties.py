@@ -1,0 +1,128 @@
+"""Property-based tests (hypothesis) for invariants unit cases can miss.
+
+SURVEY.md §4.9 notes the reference has NO property-based testing — this
+suite goes beyond its strategy on three load-bearing invariants:
+
+* config JSON serde is a lossless round trip for arbitrary layer stacks;
+* the CJK lattice tokenizers preserve every non-whitespace character of
+  their (NFKC-normalized) input, for ANY string — a tokenizer that drops
+  or duplicates text corrupts every downstream pipeline silently;
+* the normalizers are exact inverses (revert . transform = id).
+
+Bounded example counts keep the fast tier fast.
+"""
+
+import unicodedata
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+MAX_EXAMPLES = 25
+
+
+# ---------------------------------------------------------------------------
+# config serde round trip
+# ---------------------------------------------------------------------------
+
+_ACTS = st.sampled_from(["relu", "tanh", "sigmoid", "identity"])
+
+
+@st.composite
+def _dense_stacks(draw):
+    from deeplearning4j_tpu.nn import layers as L
+
+    n = draw(st.integers(1, 4))
+    layers = [L.DenseLayer(n_out=draw(st.integers(1, 16)),
+                           activation=draw(_ACTS),
+                           has_bias=draw(st.booleans()),
+                           dropout=draw(st.one_of(
+                               st.none(), st.floats(0.05, 0.9))))
+              for _ in range(n)]
+    layers.append(L.OutputLayer(n_out=draw(st.integers(2, 8)),
+                                loss="mcxent"))
+    return layers
+
+
+@settings(max_examples=MAX_EXAMPLES, deadline=None)
+@given(stack=_dense_stacks(), n_in=st.integers(1, 12),
+       seed=st.integers(0, 2**31 - 1))
+def test_config_json_round_trip(stack, n_in, seed):
+    from deeplearning4j_tpu.nn.conf.inputs import feed_forward
+    from deeplearning4j_tpu.nn.conf.network import (
+        MultiLayerConfiguration, NeuralNetConfig)
+
+    conf = NeuralNetConfig(seed=seed).list(*stack,
+                                           input_type=feed_forward(n_in))
+    back = MultiLayerConfiguration.from_json(conf.to_json())
+    assert back == conf
+
+
+# ---------------------------------------------------------------------------
+# tokenizer character preservation
+# ---------------------------------------------------------------------------
+
+_JA_ALPHABET = st.characters(
+    codec="utf-8",
+    categories=("Lo", "Ll", "Lu", "Nd", "Po", "Ps", "Pe"))
+_TEXT = st.text(alphabet=_JA_ALPHABET, max_size=60)
+
+
+def _assert_preserves(tokens, text):
+    joined = "".join(tokens)
+    want = "".join(unicodedata.normalize("NFKC", text).split())
+    got = "".join(joined.split())
+    assert got == want, (got, want)
+
+
+@settings(max_examples=MAX_EXAMPLES, deadline=None)
+@given(text=_TEXT)
+def test_ja_lattice_preserves_characters(text):
+    from deeplearning4j_tpu.text import ja_lattice
+    _assert_preserves(ja_lattice.tokenize(text), text)
+
+
+@settings(max_examples=MAX_EXAMPLES, deadline=None)
+@given(text=_TEXT)
+def test_ja_search_mode_preserves_characters(text):
+    from deeplearning4j_tpu.text import ja_lattice
+    _assert_preserves(ja_lattice.tokenize(text, mode="search"), text)
+
+
+@settings(max_examples=MAX_EXAMPLES, deadline=None)
+@given(text=_TEXT)
+def test_zh_lattice_preserves_characters(text):
+    from deeplearning4j_tpu.text import zh_lattice
+    _assert_preserves(zh_lattice.tokenize(text), text)
+
+
+# ---------------------------------------------------------------------------
+# normalizer inverse
+# ---------------------------------------------------------------------------
+
+@settings(max_examples=MAX_EXAMPLES, deadline=None)
+@given(n=st.integers(2, 40), f=st.integers(1, 6),
+       scale=st.floats(0.1, 1e4), offset=st.floats(-1e4, 1e4),
+       seed=st.integers(0, 2**31 - 1))
+def test_standardize_revert_is_inverse(n, f, scale, offset, seed):
+    from deeplearning4j_tpu.datasets.normalizers import (
+        NormalizerStandardize)
+    x = (np.random.RandomState(seed).randn(n, f) * scale
+         + offset).astype(np.float32)
+    norm = NormalizerStandardize().fit(x)
+    back = np.asarray(norm.revert(np.asarray(norm.transform(x))))
+    assert np.allclose(back, x, rtol=1e-4,
+                       atol=1e-4 * max(1.0, abs(offset) + scale))
+
+
+@settings(max_examples=MAX_EXAMPLES, deadline=None)
+@given(n=st.integers(2, 40), f=st.integers(1, 6),
+       lo=st.floats(-2.0, 0.0), hi=st.floats(0.5, 3.0),
+       seed=st.integers(0, 2**31 - 1))
+def test_minmax_revert_is_inverse(n, f, lo, hi, seed):
+    from deeplearning4j_tpu.datasets.normalizers import (
+        NormalizerMinMaxScaler)
+    x = np.random.RandomState(seed).randn(n, f).astype(np.float32) * 7
+    norm = NormalizerMinMaxScaler(lo, hi).fit(x)
+    t = np.asarray(norm.transform(x))
+    assert t.min() >= lo - 1e-4 and t.max() <= hi + 1e-4
+    assert np.allclose(np.asarray(norm.revert(t)), x, atol=1e-3)
