@@ -92,6 +92,9 @@ SYMBOLS = {
     "deeplearning4j_tpu.graphlib.graph": [],
     "deeplearning4j_tpu.graphlib.walks": [],
     "deeplearning4j_tpu.graphlib.deepwalk": [],
+    "deeplearning4j_tpu.graphlib.loader": [
+        "load_undirected_edge_list", "load_weighted_edge_list",
+        "load_graph"],
     "deeplearning4j_tpu.clustering.vptree": ["VPTree"],
     "deeplearning4j_tpu.clustering.kdtree": ["KDTree"],
     "deeplearning4j_tpu.clustering.server": [
