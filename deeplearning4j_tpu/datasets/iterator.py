@@ -362,3 +362,36 @@ class ShardedDataSetIterator(DataSetIterator):
     @property
     def batch_size(self):
         return self.source.batch_size
+
+
+def iter_batches(data, labels=None, batch_size=None, mask=None):
+    """Unified minibatch source shared by the training facades
+    (MultiLayerNetwork.fit, ParallelTrainer.fit): yields (x, y, mask)
+    from a DataSetIterator-style iterable (DataSet objects, dicts,
+    2/3-tuples), an (x, y) pair, or feature+label arrays sliced by
+    ``batch_size``."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    if labels is None and hasattr(data, "__iter__") \
+            and not isinstance(data, (tuple, list, np.ndarray,
+                                      jnp.ndarray)):
+        for item in data:
+            if hasattr(item, "features") and hasattr(item, "labels"):
+                yield item.features, item.labels, item.features_mask
+            elif isinstance(item, dict):
+                yield item["features"], item["labels"], item.get("mask")
+            elif len(item) == 3:
+                yield item
+            else:
+                yield item[0], item[1], None
+        return
+    if labels is None and hasattr(data, "shape"):
+        raise ValueError("labels are required with array features "
+                         "(pass an iterator or (x, y) pair otherwise)")
+    x, y = (data, labels) if labels is not None else data
+    n = x.shape[0]
+    bs = batch_size or n
+    for i in range(0, n, bs):
+        m = mask[i:i + bs] if mask is not None else None
+        yield x[i:i + bs], y[i:i + bs], m

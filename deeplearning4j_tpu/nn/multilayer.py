@@ -348,23 +348,8 @@ class MultiLayerNetwork:
         return self
 
     def _batches(self, data, labels, batch_size, mask):
-        if labels is None and hasattr(data, "__iter__") and not isinstance(data, (tuple, list, np.ndarray, jnp.ndarray)):
-            for item in data:
-                if hasattr(item, "features") and hasattr(item, "labels"):  # DataSet
-                    yield item.features, item.labels, item.features_mask
-                elif isinstance(item, dict):
-                    yield item["features"], item["labels"], item.get("mask")
-                elif len(item) == 3:
-                    yield item
-                else:
-                    yield item[0], item[1], None
-            return
-        x, y = (data, labels) if labels is not None else data
-        n = x.shape[0]
-        bs = batch_size or n
-        for i in range(0, n, bs):
-            m = mask[i:i + bs] if mask is not None else None
-            yield x[i:i + bs], y[i:i + bs], m
+        from deeplearning4j_tpu.datasets.iterator import iter_batches
+        yield from iter_batches(data, labels, batch_size, mask)
 
     def output(self, x, train=False, mask=None):
         """Inference forward pass (reference: MultiLayerNetwork.output:1993)."""

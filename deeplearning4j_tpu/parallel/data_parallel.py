@@ -197,14 +197,53 @@ class ParallelTrainer:
         self.iteration += 1
         return loss
 
-    def fit(self, x, y, *, epochs=1, batch_size=None, mask=None):
-        n = x.shape[0]
-        bs = batch_size or n
+    def fit(self, x, y=None, *, epochs=1, batch_size=None, mask=None):
+        """Train on arrays, an (x, y) pair, OR any DataSetIterator (the
+        reference's signature entry point,
+        ParallelWrapper.fit(DataSetIterator) at ParallelWrapper.java:58 —
+        async/prefetching iterators included; batch unpacking is shared
+        with MultiLayerNetwork.fit via datasets.iterator.iter_batches).
+
+        Batches whose leading dim is not divisible by the mesh 'data'
+        axis are SKIPPED (the data sharding cannot place them) and
+        counted in ``self.examples_dropped`` — the array path has always
+        dropped the ragged tail the same way."""
+        import warnings
+
+        from deeplearning4j_tpu.datasets.iterator import iter_batches
+
+        is_iterator = (y is None and hasattr(x, "__iter__")
+                       and not isinstance(x, (tuple, list))
+                       and not hasattr(x, "shape"))
+        if is_iterator and (batch_size is not None or mask is not None):
+            raise ValueError("batch_size/mask have no effect with an "
+                             "iterator input: the iterator owns its own "
+                             "batching and per-batch masks")
+        data_size = self.mesh.shape["data"]
+        self.examples_dropped = 0
         last = None
-        for _ in range(epochs):
-            for i in range(0, n - bs + 1, bs):
-                m = None if mask is None else mask[i:i + bs]
-                last = self.step(x[i:i + bs], y[i:i + bs], mask=m)
+        for epoch in range(epochs):
+            steps = 0
+            for bx, by, bm in iter_batches(x, y, batch_size, mask):
+                if bx.shape[0] % data_size:
+                    self.examples_dropped += int(bx.shape[0])
+                    continue
+                last = self.step(bx, by, mask=bm)
+                steps += 1
+            if steps == 0 and epoch == 0:
+                raise ValueError(
+                    "no trainable batches: every batch's leading dim must "
+                    f"be divisible by the data-axis size {data_size}")
+            if steps == 0 and epoch > 0:
+                # a plain generator exhausts after one epoch — silently
+                # "training" zero steps for the rest would lie to the caller
+                raise ValueError(
+                    f"input exhausted before epoch {epoch + 1}: pass a "
+                    "resettable DataSetIterator (or arrays) for epochs>1")
+        if self.examples_dropped:
+            warnings.warn(f"ParallelTrainer.fit dropped "
+                          f"{self.examples_dropped} examples in ragged "
+                          f"batches not divisible by data={data_size}")
         return last
 
     def score(self, x, y, mask=None):
