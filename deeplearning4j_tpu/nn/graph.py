@@ -970,6 +970,82 @@ class ComputationGraph:
                                train=False, mask=mask)
         return float(loss)
 
+    def _eval_batches(self, data, labels, batch_size):
+        """(x, y, mask) batches for the evaluate family: dict-keyed
+        inputs/labels (the multi-input graph form iter_batches cannot
+        slice) batch by slicing every entry in step; everything else goes
+        through the shared iter_batches."""
+        from deeplearning4j_tpu.datasets.iterator import iter_batches
+
+        if isinstance(data, dict):
+            n = next(iter(data.values())).shape[0]
+            bs = batch_size or n
+            for i in range(0, n, bs):
+                bx = {k: v[i:i + bs] for k, v in data.items()}
+                by = ({k: v[i:i + bs] for k, v in labels.items()}
+                      if isinstance(labels, dict) else labels[i:i + bs])
+                yield bx, by, None
+            return
+        yield from iter_batches(data, labels, batch_size, None)
+
+    def evaluate(self, data, labels=None, *, batch_size=None,
+                 evaluation=None, output_name=None):
+        """Classification Evaluation over arrays, an (x, y) pair, dict
+        inputs/labels (multi-input graphs), or any DataSetIterator
+        (reference: ComputationGraph.evaluate(DataSetIterator);
+        ``output_name`` selects a head on multi-output graphs)."""
+        from deeplearning4j_tpu.eval.classification import Evaluation
+
+        e = evaluation if evaluation is not None else Evaluation()
+        head = output_name or self.conf.outputs[0]
+        for bx, by, bm in self._eval_batches(data, labels, batch_size):
+            out = self.output(bx, mask=bm)
+            pred = out[head] if isinstance(out, dict) else out
+            if isinstance(by, dict):
+                by = by[head]
+            e.eval(np.asarray(by), np.asarray(pred),
+                   mask=None if bm is None else np.asarray(bm))
+        return e
+
+    def evaluate_regression(self, data, labels=None, *, batch_size=None,
+                            output_name=None):
+        """RegressionEvaluation (reference:
+        ComputationGraph.evaluateRegression)."""
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+
+        e = RegressionEvaluation()
+        head = output_name or self.conf.outputs[0]
+        for bx, by, bm in self._eval_batches(data, labels, batch_size):
+            out = self.output(bx, mask=bm)
+            pred = out[head] if isinstance(out, dict) else out
+            if isinstance(by, dict):
+                by = by[head]
+            e.eval(np.asarray(by), np.asarray(pred),
+                   mask=None if bm is None else np.asarray(bm))
+        return e
+
+    def evaluate_roc(self, data, labels=None, *, batch_size=None,
+                     threshold_steps=0, output_name=None):
+        """ROC / ROCMultiClass (reference: ComputationGraph.evaluateROC /
+        evaluateROCMultiClass)."""
+        from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass
+
+        roc = None
+        head = output_name or self.conf.outputs[0]
+        for bx, by, bm in self._eval_batches(data, labels, batch_size):
+            out = self.output(bx, mask=bm)
+            pred = np.asarray(out[head] if isinstance(out, dict) else out)
+            if isinstance(by, dict):
+                by = by[head]
+            if roc is None:
+                roc = (ROC(threshold_steps) if pred.shape[-1] <= 2
+                       else ROCMultiClass(threshold_steps))
+            roc.eval(np.asarray(by), pred,
+                     mask=None if bm is None else np.asarray(bm))
+        if roc is None:
+            raise ValueError("no data to evaluate")
+        return roc
+
     def num_params(self):
         return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
 

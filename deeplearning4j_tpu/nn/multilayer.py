@@ -388,6 +388,56 @@ class MultiLayerNetwork:
                                jnp.asarray(y), train=False, mask=mask)
         return float(loss)
 
+    def evaluate(self, data, labels=None, *, batch_size=None,
+                 evaluation=None):
+        """Classification Evaluation over arrays, an (x, y) pair, or any
+        DataSetIterator (reference: MultiLayerNetwork.evaluate(
+        DataSetIterator) at MultiLayerNetwork.java:2621 — the API every
+        reference example ends with: ``print(net.evaluate(it).stats())``).
+        Pass ``evaluation=`` to accumulate into an existing instance
+        (e.g. a cost-array or top-N one)."""
+        from deeplearning4j_tpu.datasets.iterator import iter_batches
+        from deeplearning4j_tpu.eval.classification import Evaluation
+
+        e = evaluation if evaluation is not None else Evaluation()
+        for bx, by, bm in iter_batches(data, labels, batch_size, None):
+            out = self.output(bx, mask=bm)
+            e.eval(np.asarray(by), np.asarray(out),
+                   mask=None if bm is None else np.asarray(bm))
+        return e
+
+    def evaluate_regression(self, data, labels=None, *, batch_size=None):
+        """RegressionEvaluation over the same input shapes (reference:
+        MultiLayerNetwork.evaluateRegression)."""
+        from deeplearning4j_tpu.datasets.iterator import iter_batches
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+
+        e = RegressionEvaluation()
+        for bx, by, bm in iter_batches(data, labels, batch_size, None):
+            e.eval(np.asarray(by), np.asarray(self.output(bx, mask=bm)),
+                   mask=None if bm is None else np.asarray(bm))
+        return e
+
+    def evaluate_roc(self, data, labels=None, *, batch_size=None,
+                     threshold_steps=0):
+        """ROC (binary) or ROCMultiClass over the same input shapes
+        (reference: MultiLayerNetwork.evaluateROC / evaluateROCMultiClass)."""
+        from deeplearning4j_tpu.datasets.iterator import iter_batches
+        from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass
+
+        roc = None
+        for bx, by, bm in iter_batches(data, labels, batch_size, None):
+            out = np.asarray(self.output(bx, mask=bm))
+            if roc is None:
+                binary = out.shape[-1] <= 2
+                roc = (ROC(threshold_steps) if binary
+                       else ROCMultiClass(threshold_steps))
+            roc.eval(np.asarray(by), out,
+                     mask=None if bm is None else np.asarray(bm))
+        if roc is None:
+            raise ValueError("no data to evaluate")
+        return roc
+
     def num_params(self):
         return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params))
 

@@ -500,3 +500,98 @@ class TestEvaluationBinaryROC:
         eb.eval(np.array([[1.0]]), np.array([[0.9]]))
         with pytest.raises(ValueError, match="roc_binary_steps"):
             eb.auc(0)
+
+
+class TestNetworkEvaluateEntryPoints:
+    """net.evaluate(DataSetIterator) — the API every reference example
+    ends with (MultiLayerNetwork.java:2621) — plus the regression and
+    ROC variants, on both containers."""
+
+    def _net(self, np_rng):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn import layers as L, updaters as U
+        from deeplearning4j_tpu.nn.conf.inputs import feed_forward
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        x = np_rng.rand(120, 5).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[
+            (x.sum(1) * 2).astype(int) % 3]
+        net = MultiLayerNetwork(NeuralNetConfig(
+            seed=3, updater=U.Adam(2e-2)).list(
+            L.DenseLayer(n_out=16, activation="relu"),
+            L.OutputLayer(n_out=3, loss="mcxent"),
+            input_type=feed_forward(5)))
+        net.init()
+        net.fit(jnp.asarray(x), jnp.asarray(y), epochs=30, batch_size=40)
+        return net, x, y
+
+    def test_evaluate_iterator_matches_arrays(self, np_rng):
+        from deeplearning4j_tpu.datasets.iterator import (
+            ArrayDataSetIterator)
+        net, x, y = self._net(np_rng)
+        e_arr = net.evaluate(x, y)
+        e_it = net.evaluate(ArrayDataSetIterator(x, y, batch_size=32))
+        assert e_arr.accuracy() == e_it.accuracy()
+        assert "Accuracy" in e_it.stats()
+
+    def test_evaluate_regression(self, np_rng):
+        net, x, y = self._net(np_rng)
+        r = net.evaluate_regression(x, y)
+        assert np.isfinite(r.average_mean_squared_error()) \
+            if hasattr(r, "average_mean_squared_error") else r.stats()
+
+    def test_evaluate_roc_multiclass(self, np_rng):
+        net, x, y = self._net(np_rng)
+        roc = net.evaluate_roc(x, y)
+        # trained net should beat chance on at least one class
+        aucs = [roc.calculate_auc(c) for c in range(3)] \
+            if hasattr(roc, "calculate_auc") else []
+        assert not aucs or max(aucs) > 0.5
+
+    def test_graph_evaluate(self, np_rng):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn import layers as L, updaters as U
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+
+        g = GraphBuilder(updater=U.Adam(2e-2), seed=1)
+        g.add_inputs("in")
+        g.set_input_types(I.feed_forward(4))
+        g.add_layer("d", L.DenseLayer(n_out=8, activation="relu"), "in")
+        g.add_layer("out", L.OutputLayer(n_out=2, loss="mcxent"), "d")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build())
+        net.init()
+        x = np_rng.rand(60, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(1) > 2).astype(int)]
+        net.fit({"in": jnp.asarray(x)}, {"out": jnp.asarray(y)}, epochs=25)
+        e = net.evaluate(x, y)
+        assert e.accuracy() > 0.5
+
+    def test_graph_evaluate_regression_and_roc_with_dict_inputs(self,
+                                                                np_rng):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn import layers as L, updaters as U
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.graph import (ComputationGraph,
+                                                 GraphBuilder)
+
+        g = GraphBuilder(updater=U.Adam(2e-2), seed=2)
+        g.add_inputs("in")
+        g.set_input_types(I.feed_forward(4))
+        g.add_layer("d", L.DenseLayer(n_out=8, activation="relu"), "in")
+        g.add_layer("out", L.OutputLayer(n_out=2, loss="mcxent"), "d")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build())
+        net.init()
+        x = np_rng.rand(48, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(1) > 2).astype(int)]
+        net.fit({"in": jnp.asarray(x)}, {"out": jnp.asarray(y)}, epochs=20)
+        # dict-keyed inputs/labels batch correctly (multi-input form)
+        e = net.evaluate({"in": x}, {"out": y}, batch_size=16)
+        assert 0.0 <= e.accuracy() <= 1.0
+        r = net.evaluate_regression({"in": x}, {"out": y})
+        assert r.stats()
+        roc = net.evaluate_roc({"in": x}, {"out": y})
+        assert roc is not None
