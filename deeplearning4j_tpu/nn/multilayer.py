@@ -388,6 +388,19 @@ class MultiLayerNetwork:
                                jnp.asarray(y), train=False, mask=mask)
         return float(loss)
 
+    def predict(self, x, mask=None):
+        """Predicted class indices [batch] (reference:
+        MultiLayerNetwork.predict(INDArray) at MultiLayerNetwork.java:
+        the argmax convenience over output())."""
+        out = np.asarray(self.output(x, mask=mask))
+        return np.argmax(out, axis=-1)
+
+    def f1_score(self, x, y, mask=None):
+        """Macro F1 over a labelled batch (reference: the Classifier
+        interface's f1Score entry)."""
+        e = self.evaluate(x, y)
+        return e.f1()
+
     def evaluate(self, data, labels=None, *, batch_size=None,
                  evaluation=None):
         """Classification Evaluation over arrays, an (x, y) pair, or any

@@ -595,3 +595,11 @@ class TestNetworkEvaluateEntryPoints:
         assert r.stats()
         roc = net.evaluate_roc({"in": x}, {"out": y})
         assert roc is not None
+
+    def test_predict_and_f1_score(self, np_rng):
+        net, x, y = self._net(np_rng)
+        preds = net.predict(x)
+        assert preds.shape == (120,)
+        acc = float((preds == y.argmax(1)).mean())
+        assert acc == net.evaluate(x, y).accuracy()
+        assert 0.0 <= net.f1_score(x, y) <= 1.0
