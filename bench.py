@@ -655,7 +655,13 @@ def _is_canonical(rec):
 
 
 def _variant_key(rec):
-    return (rec.get("config"),) + tuple(rec.get(f) for f in _VARIANT_FIELDS)
+    def norm(f):
+        v = rec.get(f)
+        # profile_dir names a throwaway trace directory: key only on
+        # "was profiled", so a later profiled run of the same config
+        # supersedes the earlier one instead of accreting forever
+        return bool(v) if f == "profile_dir" else v
+    return (rec.get("config"),) + tuple(norm(f) for f in _VARIANT_FIELDS)
 
 
 def _save_measured(rec):

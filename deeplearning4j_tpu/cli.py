@@ -113,6 +113,11 @@ def _load_xy(args):
     datasets.records.csv_dataset (the RecordReaderDataSetIterator CLI
     shape); .npy keeps the original contract."""
     if args.data.endswith(".csv") or args.data.endswith(".dat"):
+        if getattr(args, "labels", None):
+            raise SystemExit(
+                "--labels cannot be combined with a labelled CSV --data "
+                "file: the CSV's --label-column is the label source. "
+                "Drop --labels, or pass .npy features instead.")
         from deeplearning4j_tpu.datasets.records import csv_dataset
         x, y = csv_dataset(args.data, label_column=args.label_column,
                            n_classes=args.n_classes,

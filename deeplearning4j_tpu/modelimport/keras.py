@@ -336,13 +336,24 @@ def _sequential_net_with_weights(conf, records, archive, ordering,
     params = list(net.params)
     state = list(net.state)
     pre_types = _pre_adaptation_types(conf) if ordering == "th" else None
+    n_expected = sum(1 for idx, _, wmap in records
+                     if idx is not None and wmap is not None)
+    n_loaded = 0
     for idx, keras_name, wmap in records:
         if idx is None or wmap is None:
             continue
         weights = _read_layer_weights(archive, keras_name,
                                       prefix=weights_prefix)
         if not weights:
+            # a save_weights() archive keeps layer groups at the root while
+            # a full-model .h5 nests them under /model_weights — a caller
+            # guessing the wrong flavour would otherwise get a silently
+            # random-initialized net posing as the import
+            alt = "" if weights_prefix else "model_weights/"
+            weights = _read_layer_weights(archive, keras_name, prefix=alt)
+        if not weights:
             continue
+        n_loaded += 1
         mapped_p, mapped_s = wmap(conf.layers[idx], weights)
         if (pre_types is not None
                 and isinstance(pre_types[idx], I.ConvolutionalType)
@@ -357,6 +368,12 @@ def _sequential_net_with_weights(conf, records, archive, ordering,
         for skey, arr in (mapped_s or {}).items():
             if arr is not None and skey in state[idx]:
                 state[idx][skey] = jnp.asarray(np.asarray(arr, np.float32))
+    if n_expected and not n_loaded:
+        raise KerasImportError(
+            "no layer group in the weights archive matched any "
+            "weighted layer of the config (tried prefixes "
+            f"{weights_prefix!r} and its alternate) — refusing to return "
+            "a randomly initialized network posing as the import")
     net.params = params
     net.state = state
     return net

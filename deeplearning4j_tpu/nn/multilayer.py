@@ -397,8 +397,14 @@ class MultiLayerNetwork:
 
     def f1_score(self, x, y, mask=None):
         """Macro F1 over a labelled batch (reference: the Classifier
-        interface's f1Score entry)."""
-        e = self.evaluate(x, y)
+        interface's f1Score entry). A label mask excludes padded
+        timesteps/examples from the tally, matching evaluate()'s
+        iterator path."""
+        from deeplearning4j_tpu.eval.classification import Evaluation
+        e = Evaluation()
+        out = self.output(x, mask=mask)
+        e.eval(np.asarray(y), np.asarray(out),
+               mask=None if mask is None else np.asarray(mask))
         return e.f1()
 
     def evaluate(self, data, labels=None, *, batch_size=None,
