@@ -110,6 +110,41 @@ def resnet50(height=224, width=224, channels=3, n_classes=1000, updater=None,
     return g.build()
 
 
+def resnet50_mln(height=224, width=224, channels=3, n_classes=1000,
+                 updater=None, seed=12345, stages=None, stem_filters=64):
+    """ResNet50 as a flat MultiLayerNetwork stack of ResidualBottleneck
+    composite layers (same geometry as :func:`resnet50`, block-internal
+    shortcuts). This is the PIPELINABLE expression of the flagship:
+    parallel/pipeline_general.PipelinedNetwork stages MultiLayerNetwork
+    configs, and bottleneck blocks are stage-atomic. ``stages`` overrides
+    the (filters, blocks, stride) table for reduced-size variants
+    (tests / CPU-mesh loss pins)."""
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+
+    stages = stages if stages is not None else [
+        (64, 3, (1, 1)), (128, 4, (2, 2)), (256, 6, (2, 2)), (512, 3, (2, 2))]
+    layers = [
+        L.ConvolutionLayer(n_out=stem_filters, kernel=(7, 7), stride=(2, 2),
+                           padding="same", has_bias=False,
+                           weight_init="relu"),
+        L.BatchNormalization(activation="relu"),
+        L.SubsamplingLayer(kernel=(3, 3), stride=(2, 2), padding="same",
+                           mode="max"),
+    ]
+    for filters, blocks, stride in stages:
+        for bi in range(blocks):
+            layers.append(L.ResidualBottleneck(
+                filters=filters, stride=stride if bi == 0 else (1, 1),
+                project=bi == 0))
+    layers += [
+        L.GlobalPoolingLayer(mode="avg"),
+        L.OutputLayer(n_out=n_classes, loss="mcxent", weight_init="xavier"),
+    ]
+    return NeuralNetConfig(seed=seed,
+                           updater=updater or U.Adam(learning_rate=1e-3)).list(
+        *layers, input_type=I.ConvolutionalType(height, width, channels))
+
+
 def resnet50_flops_per_example(height=224, width=224, channels=3, n_classes=1000):
     """Approximate forward FLOPs (2*MACs) for MFU accounting.
 

@@ -9,7 +9,7 @@ from deeplearning4j_tpu.nn.layers.conv import (  # noqa: F401
     SeparableConvolution2DLayer, SubsamplingLayer, Subsampling1DLayer,
     Upsampling1DLayer, Upsampling2DLayer, ZeroPaddingLayer, ZeroPadding1DLayer,
     BatchNormalization, LocalResponseNormalization, GlobalPoolingLayer,
-    SpaceToDepthLayer, SpaceToBatchLayer,
+    SpaceToDepthLayer, SpaceToBatchLayer, ResidualBottleneck,
 )
 from deeplearning4j_tpu.nn.layers.rnn import (  # noqa: F401
     LSTM, GravesLSTM, GravesBidirectionalLSTM, SimpleRnn, RnnOutputLayer,

@@ -495,6 +495,117 @@ class LocalResponseNormalization(Layer):
 
 @register_config
 @dataclasses.dataclass(frozen=True)
+class ResidualBottleneck(ParamLayer):
+    """ResNet-v1 bottleneck (1x1 reduce -> 3x3 -> 1x1 expand-4x, shortcut
+    add, relu) packaged as ONE composite sequential layer.
+
+    Reference analog: the s{i}b{j} block subgraphs ResNet50.java builds
+    (/root/reference/deeplearning4j-zoo/.../zoo/model/ResNet50.java);
+    models/resnet.py expresses them as ComputationGraph vertices. This
+    layer packages the same block as a MultiLayerNetwork layer so residual
+    CNNs are expressible as a flat layer STACK — which makes the flagship
+    conv-BN family stageable by parallel/pipeline_general.PipelinedNetwork
+    (skip connections are block-internal, so they never cross a stage
+    boundary). Geometry mirrors models/resnet._bottleneck exactly:
+    filters f -> (f, f, 4f), stride on the first 1x1, projection shortcut
+    (1x1 stride conv + BN) whenever the shortcut shape changes.
+    """
+
+    filters: int = 64
+    stride: tuple = (1, 1)
+    project: bool = False  # force a projection shortcut (auto when shapes differ)
+    decay: float = 0.9  # BN running-average momentum
+    eps: float = 1e-5
+
+    input_family = _inputs.ConvolutionalType
+
+    def _needs_proj(self, input_type):
+        return (self.project or input_type.channels != 4 * self.filters
+                or _pair(self.stride) != (1, 1))
+
+    def _plan(self, input_type):
+        """[(name, sublayer, its input type)] — main chain then shortcut."""
+        f = self.filters
+        subs, t = [], input_type
+        for tag, k, s, act, nout in (("a", (1, 1), self.stride, "relu", f),
+                                     ("b", (3, 3), (1, 1), "relu", f),
+                                     ("c", (1, 1), (1, 1), "identity", 4 * f)):
+            cl = ConvolutionLayer(n_out=nout, kernel=k, stride=s,
+                                  padding="same", has_bias=False,
+                                  weight_init="relu")
+            subs.append((f"{tag}_conv", cl, t))
+            t = cl.output_type(t)
+            subs.append((f"{tag}_bn",
+                         BatchNormalization(decay=self.decay, eps=self.eps,
+                                            activation=act), t))
+        if self._needs_proj(input_type):
+            pc = ConvolutionLayer(n_out=4 * f, kernel=(1, 1),
+                                  stride=self.stride, padding="same",
+                                  has_bias=False, weight_init="relu")
+            subs.append(("proj_conv", pc, input_type))
+            subs.append(("proj_bn",
+                         BatchNormalization(decay=self.decay, eps=self.eps,
+                                            activation="identity"),
+                         pc.output_type(input_type)))
+        return subs
+
+    def output_type(self, input_type):
+        assert isinstance(input_type, _inputs.ConvolutionalType), \
+            f"{type(self).__name__} needs CNN input, got {input_type}"
+        sh, sw = _pair(self.stride)
+        return _inputs.ConvolutionalType(-(-input_type.height // sh),
+                                         -(-input_type.width // sw),
+                                         4 * self.filters)
+
+    def init(self, key, input_type, dtype=jnp.float32):
+        out = {}
+        for name, sub, t in self._plan(input_type):
+            key, sk = jax.random.split(key)
+            p = sub.init(sk, t, dtype)
+            if p:
+                out[name] = p
+        return out
+
+    def init_state(self, input_type, dtype=jnp.float32):
+        return {name: sub.init_state(t, dtype)
+                for name, sub, t in self._plan(input_type)
+                if isinstance(sub, BatchNormalization)}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        it = _inputs.ConvolutionalType(x.shape[1], x.shape[2], x.shape[3])
+        new_state = dict(state)
+        h, shortcut = x, x
+        for name, sub, _t in self._plan(it):
+            on_shortcut = name.startswith("proj")
+            y, st = sub.apply(params.get(name, {}), state.get(name, {}),
+                              shortcut if on_shortcut else h,
+                              train=train, rng=rng)
+            if name in state:
+                new_state[name] = st
+            if on_shortcut:
+                shortcut = y
+            else:
+                h = y
+        return jax.nn.relu(h + shortcut), new_state
+
+    def regularization_penalty(self, params):
+        """L1/L2 on the conv kernels only — BN gamma/beta excluded, matching
+        the reference's default of unregularized BatchNormalization params."""
+        if not (self.l1 or self.l2):
+            return 0.0
+        pen = 0.0
+        for name, sub in params.items():
+            if name.endswith("_conv"):
+                w = sub["W"]
+                if self.l1:
+                    pen = pen + self.l1 * jnp.sum(jnp.abs(w))
+                if self.l2:
+                    pen = pen + 0.5 * self.l2 * jnp.sum(w * w)
+        return pen
+
+
+@register_config
+@dataclasses.dataclass(frozen=True)
 class GlobalPoolingLayer(Layer):
     """Pool over time (RNN) or space (CNN) (reference: conf/layers/
     GlobalPoolingLayer.java — MAX/AVG/SUM/PNORM with mask support)."""

@@ -62,8 +62,8 @@ class TestGeneralPipeline:
         pn.init(from_params=net.params)
         rs = np.random.RandomState(1)
         x, y = _data(rs)
-        g_pipe = jax.grad(pn._loss_fn)(pn.params, jnp.asarray(x),
-                                       jnp.asarray(y))
+        g_pipe, _ = jax.grad(pn._loss_fn, has_aux=True)(
+            pn.params, pn.state, jnp.asarray(x), jnp.asarray(y), None)
         unpacked = pn.unpack(g_pipe["stages"])
         _, _, g_ref = net.compute_gradients(net.params, net.state,
                                             jnp.asarray(x), jnp.asarray(y))
@@ -113,15 +113,28 @@ class TestGeneralPipeline:
         assert [i for g in groups for i in g] == list(range(5))
         assert all(g for g in groups)
 
-    def test_stateful_layer_refused(self):
+    def test_stateful_refused_only_under_1f1b(self):
+        """BN stacks now pipeline under gpipe (VERDICT r4 #3); the 1F1B
+        engine's pure-recompute contract still requires stateless."""
         conf = NeuralNetConfig(seed=1).list(
             L.ConvolutionLayer(n_out=4, kernel=(3, 3), padding="same"),
             L.BatchNormalization(),
             L.OutputLayer(n_out=3, loss="mcxent"),
             input_type=ConvolutionalType(4, 4, 1))
         mesh = Mesh(np.array(jax.devices()[:2]).reshape(2,), ("stage",))
-        with pytest.raises(AssertionError, match="stateful"):
-            PipelinedNetwork(conf, mesh)
+        PipelinedNetwork(conf, mesh)  # gpipe: accepted
+        with pytest.raises(AssertionError, match="stateless"):
+            PipelinedNetwork(conf, mesh, schedule="1f1b")
+
+    def test_dropout_refused_only_under_1f1b(self):
+        conf = NeuralNetConfig(seed=1).list(
+            L.DenseLayer(n_out=8, activation="relu"),
+            L.OutputLayer(n_out=3, loss="mcxent", dropout=0.5),
+            input_type=ConvolutionalType(4, 4, 1))
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2,), ("stage",))
+        PipelinedNetwork(conf, mesh)  # gpipe: accepted
+        with pytest.raises(AssertionError, match="dropout"):
+            PipelinedNetwork(conf, mesh, schedule="1f1b")
 
 
 class TestOneFOneB:
@@ -297,6 +310,134 @@ class TestGeneralPipeline1F1B:
         np.testing.assert_allclose(
             jax.device_get(pg.params["stages"]),
             jax.device_get(pf.params["stages"]), atol=2e-5)
+
+
+class TestStatefulPipeline:
+    """VERDICT r4 #3: BN running stats as per-stage carried state +
+    per-stage rng fold for dropout — the flagship conv-BN family staged."""
+
+    def _resnet_conf(self):
+        from deeplearning4j_tpu.models.resnet import resnet50_mln
+        return resnet50_mln(height=16, width=16, channels=3, n_classes=5,
+                            stages=[(4, 2, (1, 1)), (8, 2, (2, 2))],
+                            stem_filters=4, seed=9)
+
+    def _seq_microbatch_run(self, net, x, y, n_micro, rng=None):
+        """Sequential per-microbatch reference: same microbatch split,
+        same per-microbatch keys, state threaded mb k -> k+1."""
+        b = x.shape[0]
+        mb = b // n_micro
+        state, losses = net.state, []
+        for k in range(n_micro):
+            rk = None if rng is None else jax.random.fold_in(rng, k)
+            l, (state, _) = net.loss_fn(
+                net.params, state, jnp.asarray(x[k * mb:(k + 1) * mb]),
+                jnp.asarray(y[k * mb:(k + 1) * mb]), train=True, rng=rk)
+            losses.append(float(l))
+        return float(np.mean(losses)), state
+
+    def test_reduced_resnet50_loss_and_state_pin(self):
+        """Pipelined reduced ResNet50 (BN in every bottleneck): loss AND
+        final running stats pinned to a sequential per-microbatch run on
+        the same params."""
+        conf = self._resnet_conf()
+        net = MultiLayerNetwork(conf)
+        net.init()
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(4,), ("stage",))
+        pn = PipelinedNetwork(conf, mesh, n_microbatches=2)
+        pn.init(from_params=net.params, from_state=net.state)
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 16, 16, 3).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[rs.randint(0, 5, 8)]
+        l_ref, st_ref = self._seq_microbatch_run(net, x, y, 2)
+        l_pipe, new_states = pn._loss_fn(pn.params, pn.state,
+                                         jnp.asarray(x), jnp.asarray(y),
+                                         None)
+        assert abs(float(l_pipe) - l_ref) < 2e-5
+        unpacked = pn.unpack_state(new_states["stages"])
+        for a, b in zip(unpacked, st_ref):
+            assert set(a) == set(b)
+            for k in a:
+                va = a[k] if not isinstance(a[k], dict) else a[k]
+                for leaf_a, leaf_b in zip(
+                        jax.tree_util.tree_leaves(a[k]),
+                        jax.tree_util.tree_leaves(b[k])):
+                    np.testing.assert_allclose(np.asarray(leaf_a),
+                                               np.asarray(leaf_b),
+                                               atol=1e-5, err_msg=k)
+
+    def test_dropout_pipeline_loss_pin(self):
+        """Dropout inside pipelined stages: the stage branches replicate
+        MultiLayerNetwork.apply_fn's key-split chain, so the loss with a
+        shared step key equals the sequential per-microbatch run with the
+        same per-microbatch keys (bit-identical masks)."""
+        conf = NeuralNetConfig(seed=5).list(
+            L.ConvolutionLayer(n_out=6, kernel=(3, 3), padding="same",
+                               activation="relu"),
+            L.BatchNormalization(),
+            L.DenseLayer(n_out=24, activation="relu", dropout=0.4),
+            L.DenseLayer(n_out=16, activation="relu"),
+            L.OutputLayer(n_out=5, loss="mcxent", dropout=0.3),
+            input_type=ConvolutionalType(6, 6, 2))
+        net = MultiLayerNetwork(conf)
+        net.init()
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2,), ("stage",))
+        pn = PipelinedNetwork(conf, mesh, n_microbatches=2)
+        pn.init(from_params=net.params, from_state=net.state)
+        rs = np.random.RandomState(3)
+        x = rs.randn(8, 6, 6, 2).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[rs.randint(0, 5, 8)]
+        key = jax.random.PRNGKey(77)
+        l_ref, _ = self._seq_microbatch_run(net, x, y, 2, rng=key)
+        l_pipe, _ = pn._loss_fn(pn.params, pn.state, jnp.asarray(x),
+                                jnp.asarray(y), key)
+        assert abs(float(l_pipe) - l_ref) < 2e-5
+        # and WITHOUT a key the losses differ (dropout really fired)
+        l_nodrop, _ = pn._loss_fn(pn.params, pn.state, jnp.asarray(x),
+                                  jnp.asarray(y), None)
+        assert abs(float(l_nodrop) - float(l_pipe)) > 1e-6
+
+    def test_resnet_training_reduces_loss_and_updates_stats(self):
+        conf = self._resnet_conf()
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "stage"))
+        pn = PipelinedNetwork(conf, mesh, n_microbatches=2)
+        pn.init()
+        st0 = jax.device_get(pn.state["stages"]).copy()
+        rs = np.random.RandomState(2)
+        x = rs.randn(8, 16, 16, 3).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[rs.randint(0, 5, 8)]
+        l0 = float(pn.step(x, y))
+        for _ in range(5):
+            l = float(pn.step(x, y))
+        assert l < l0
+        st1 = jax.device_get(pn.state["stages"])
+        assert not np.allclose(st0, st1)  # running stats actually moved
+
+    def test_stateful_sharded_checkpoint_roundtrip(self, tmp_path):
+        """BN running stats + the dropout step key survive the orbax
+        trainer lifecycle (utils/sharded_checkpoint picks up .state and
+        ._rng automatically)."""
+        from deeplearning4j_tpu.utils.sharded_checkpoint import (
+            restore_trainer, save_trainer)
+        conf = self._resnet_conf()
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2,), ("stage",))
+        pn = PipelinedNetwork(conf, mesh, n_microbatches=2).init()
+        rs = np.random.RandomState(4)
+        x = rs.randn(4, 16, 16, 3).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[rs.randint(0, 5, 4)]
+        for _ in range(2):
+            pn.step(x, y)
+        path = str(tmp_path / "bn_pipe_ckpt")
+        save_trainer(path, pn)
+        st_saved = jax.device_get(pn.state["stages"]).copy()
+        l_next = float(pn.step(x, y))
+        pn2 = PipelinedNetwork(conf, mesh, n_microbatches=2).init()
+        restore_trainer(path, pn2)
+        np.testing.assert_allclose(jax.device_get(pn2.state["stages"]),
+                                   st_saved)
+        l_resume = float(pn2.step(x, y))
+        assert abs(l_resume - l_next) < 1e-5
 
 
 class TestPipelineShardedCheckpoint:
