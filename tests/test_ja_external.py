@@ -16,14 +16,22 @@ resources, consumed here in place (read-only):
   (~69k tokens), IPADIC ground truth.
 
 Scoring is span-F1 over character-boundary spans after applying the
-tokenizer's own NFKC normalization to the gold and dropping gold
-whitespace tokens. Thresholds are the MEASURED capability of the
-bundled ~2k-form starter dictionary (ipadic has ~400k entries), pinned
-so regressions fail; they are floors, not aspirations. One systematic
-convention difference depresses the novel's score: IPADIC emits
-verb-stem + て/た as two tokens where this dictionary lists whole
-te/ta-forms (食べて vs 食べ|て) — every such token costs both precision
-and recall here even though both segmentations are defensible.
+tokenizer's own NFKC normalization to the gold and removing whitespace
+(both whitespace-only gold tokens AND whitespace embedded inside gold
+tokens — the bocchan file carries one indented chapter heading whose
+leading spaces, if kept, desynchronize every downstream span once the
+tokenizer drops them: round 4's 0.351 bocchan measurement was exactly
+that artifact; the aligned score of the same round-4 analyzer is 0.68).
+Thresholds are the MEASURED capability of the bundled starter
+dictionary (ipadic has ~400k entries), pinned so regressions fail;
+they are floors, not aspirations.
+
+Two conventions are scored: the default textbook dictionary (whole
+te/ta conjugations, 食べて) and ``convention="ipadic"`` — the
+systematically derived IPADIC-granularity dictionary (食べ|て, まし|た,
+勉強|し|て; ja_lattice._build_ipadic_variant) matching the convention
+the ground-truth files themselves use. The ipadic convention scores
+higher against ipadic gold by construction; both are pinned.
 """
 
 import os
@@ -45,7 +53,8 @@ def _gold_tokens(feat_file):
         for line in f:
             if "\t" in line:
                 t = unicodedata.normalize("NFKC", line.split("\t")[0])
-                if t.strip():
+                t = "".join(t.split())  # see module docstring
+                if t:
                     toks.append(t)
     return toks
 
@@ -99,6 +108,14 @@ def test_jawiki_sentences_span_f1():
     assert f1 >= 0.60, f"jawiki span-F1 regressed to {f1:.3f}"  # measured 0.645
 
 
+def test_jawiki_sentences_span_f1_ipadic_convention():
+    from deeplearning4j_tpu.text import ja_lattice
+    gold = _gold_tokens("jawikisentences-ipadic-features.txt")
+    got = ja_lattice.tokenize("".join(gold), convention="ipadic")
+    f1 = _span_f1(gold, got)
+    assert f1 >= 0.59, f"jawiki/ipadic span-F1 regressed to {f1:.3f}"  # 0.638
+
+
 @pytest.mark.slow
 def test_bocchan_novel_span_f1():
     from deeplearning4j_tpu.text import ja_lattice
@@ -106,7 +123,31 @@ def test_bocchan_novel_span_f1():
     assert len(gold) > 60_000
     got = ja_lattice.tokenize("".join(gold))
     f1 = _span_f1(gold, got)
-    assert f1 >= 0.33, f"bocchan span-F1 regressed to {f1:.3f}"  # measured 0.351
+    assert f1 >= 0.65, f"bocchan span-F1 regressed to {f1:.3f}"  # measured 0.693
+
+
+@pytest.mark.slow
+def test_bocchan_novel_span_f1_ipadic_convention():
+    """VERDICT r4 #5 target was >=0.55; the aligned ipadic-convention
+    measurement is 0.778 (conjugation-row generation + the te/ta split
+    + the alignment fix documented in the module docstring)."""
+    from deeplearning4j_tpu.text import ja_lattice
+    gold = _gold_tokens("bocchan-ipadic-features.txt")
+    got = ja_lattice.tokenize("".join(gold), convention="ipadic")
+    f1 = _span_f1(gold, got)
+    assert f1 >= 0.74, f"bocchan/ipadic span-F1 regressed to {f1:.3f}"  # 0.778
+
+
+def test_ipadic_convention_splits_conjugations():
+    """The derivation's signature splits, asserted directly."""
+    from deeplearning4j_tpu.text import ja_lattice
+    assert ja_lattice.tokenize("本を読んだ", convention="ipadic") == \
+        ["本", "を", "読ん", "だ"]
+    assert ja_lattice.tokenize("学校に行って勉強した",
+                               convention="ipadic") == \
+        ["学校", "に", "行っ", "て", "勉強", "し", "た"]
+    # default convention unchanged (golden-suite contract)
+    assert ja_lattice.tokenize("本を読んだ") == ["本", "を", "読んだ"]
 
 
 def test_factory_lattice_mode_passthrough():
