@@ -307,15 +307,40 @@ class PipelinedNetwork:
             return jnp.pad(flat, ((0, 0), (0, self._amax - flat.shape[1])))
         return fn
 
+    def _chain_keys(self, rng_mb):
+        """Replicate MultiLayerNetwork.apply_fn's key-split chain over ALL
+        layers, OUTSIDE the stage switch (the chain depends only on the
+        per-microbatch key and the static layer list, never on the stage).
+        Returns stacked [L, 2] uint32 key arrays (dropout key, layer key,
+        weight-noise key per layer) so every switch branch consumes the
+        same uniform operands — keeping threefry out of the branches,
+        whose residual structures must match under partial-eval."""
+        drop_k, layer_k, noise_k = [], [], []
+        rng = rng_mb
+        zero = jnp.zeros((2,), jnp.uint32)
+        for layer in self.conf.layers:
+            if layer.dropout:
+                rng, sub_d = jax.random.split(rng)
+            else:
+                sub_d = zero
+            rng, sub = jax.random.split(rng)
+            if getattr(layer, "weight_noise", None) is not None:
+                sub, nk = jax.random.split(sub)
+            else:
+                nk = zero
+            drop_k.append(sub_d)
+            layer_k.append(sub)
+            noise_k.append(nk)
+        return (jnp.stack(drop_k), jnp.stack(layer_k), jnp.stack(noise_k))
+
     def _stage_fn_full(self, s):
         """Stateful gpipe stage program: (slab [Lmax], state slab [Smax],
-        flat act [mb, Amax], mb_idx, step key) -> (flat out, new state
-        slab). Replicates MultiLayerNetwork.apply_fn's rng split chain
-        over ALL layers so dropout/noise draws are bit-identical to a
-        sequential run of the same microbatch with the same key."""
+        flat act [mb, Amax], per-layer key stacks) -> (flat out, new
+        state slab). Keys come pre-split from ``_chain_keys`` so
+        dropout/noise draws are bit-identical to a sequential run of the
+        same microbatch with the same per-microbatch key."""
         from deeplearning4j_tpu.nn.layers.base import dropout_mask
         g = self.groups[s]
-        gset = set(g)
         in_type = self.layer_inputs[g[0]]
         mb = self._mb
         in_shape = _type_shape(in_type, mb)
@@ -325,48 +350,42 @@ class PipelinedNetwork:
         smax = self._smax
         use_rng = self._rng_active
 
-        def fn(slab, svec, aflat, mb_idx, step_key):
+        def fn(slab, svec, aflat, drop_k, layer_k, noise_k):
             pl_ = unflat(slab)
             sl_ = sunflat(svec)
             x = aflat[:, :in_size].reshape(in_shape)
             cur_type = in_type
-            rng = jax.random.fold_in(step_key, mb_idx) if use_rng else None
             new_states = list(sl_)
-            li = 0
-            for i, layer in enumerate(self.conf.layers):
-                mine = i in gset
-                if mine:
-                    fam = layer.input_family
-                    if fam is not None and not isinstance(cur_type, fam):
-                        x = _inputs.adapt(x, cur_type, fam)
-                        cur_type = _inputs.adapted_type(cur_type, fam)
-                # the split chain advances for EVERY layer, mine or not —
-                # that is what keeps this stage's subkeys identical to the
-                # sequential chain's
-                if layer.dropout and rng is not None:
-                    rng, sub_d = jax.random.split(rng)
-                    if mine:
-                        x = dropout_mask(sub_d, x, layer.dropout)
-                if rng is not None:
-                    rng, sub = jax.random.split(rng)
-                else:
-                    sub = None
-                if mine:
-                    p = pl_[li]
-                    wn = getattr(layer, "weight_noise", None)
-                    if wn is not None and sub is not None and p:
-                        sub, noise_rng = jax.random.split(sub)
-                        p = wn.perturb(noise_rng, layer, p)
-                    x, new_states[li] = layer.apply(p, sl_[li], x,
-                                                    train=True, rng=sub)
-                    cur_type = layer.output_type(cur_type)
-                    li += 1
+            for li, i in enumerate(g):
+                layer = self.conf.layers[i]
+                fam = layer.input_family
+                if fam is not None and not isinstance(cur_type, fam):
+                    x = _inputs.adapt(x, cur_type, fam)
+                    cur_type = _inputs.adapted_type(cur_type, fam)
+                if use_rng and layer.dropout:
+                    x = dropout_mask(drop_k[i], x, layer.dropout)
+                p = pl_[li]
+                wn = getattr(layer, "weight_noise", None)
+                if use_rng and wn is not None and p:
+                    p = wn.perturb(noise_k[i], layer, p)
+                x, new_states[li] = layer.apply(
+                    p, sl_[li], x, train=True,
+                    rng=layer_k[i] if use_rng else None)
+                cur_type = layer.output_type(cur_type)
             flat = x.reshape(mb, -1)
             sflat, _, _ = _flatten_tree(new_states)
             sout = jnp.pad(sflat, (0, smax - sflat.shape[0]))
-            return (jnp.pad(flat,
-                            ((0, 0), (0, self._amax - flat.shape[1]))),
-                    sout)
+            # uniform tangent structure: lax.switch's partial-eval (under
+            # value_and_grad) requires every branch to expose the SAME
+            # known/unknown output structure. State is a side effect
+            # (running stats) — stop_gradient makes its tangent a symbolic
+            # zero in EVERY branch; the activation gets an explicit
+            # param-tangent tie so even a paramless stage's output is
+            # tangent-carrying like the others.
+            out = jnp.pad(flat,
+                          ((0, 0), (0, self._amax - flat.shape[1])))
+            out = out + slab[0] * 0
+            return out, lax.stop_gradient(sout)
         return fn
 
     def _boundary_sizes(self, mb):
@@ -408,10 +427,19 @@ class PipelinedNetwork:
         x_flat = x.reshape(n_micro, mb, -1)
         x_mb = jnp.pad(x_flat, ((0, 0), (0, 0),
                                 (0, self._amax - x_flat.shape[-1])))
-        key_arg = (rng if self._rng_active
-                   else jnp.zeros((2,), jnp.uint32))
+        n_layers = len(self.conf.layers)
+        if self._rng_active:
+            # per-microbatch key chains, precomputed for ALL microbatches
+            # ([M, L, 2] each) — stage-independent, so they live outside
+            # the switch (see _chain_keys)
+            keysets = [jnp.stack(ks) for ks in zip(*(
+                self._chain_keys(jax.random.fold_in(rng, m))
+                for m in range(self.n_micro)))]
+        else:
+            keysets = [jnp.zeros((self.n_micro, n_layers, 2), jnp.uint32)
+                       for _ in range(3)]
 
-        def run(stages, svec, x_mb, step_key):
+        def run(stages, svec, x_mb, drop_ks, layer_ks, noise_ks):
             s = lax.axis_index("stage")
             slab = stages[0]  # local [1, Lmax] -> [Lmax]
             st0 = svec[0]
@@ -425,8 +453,11 @@ class PipelinedNetwork:
                     x_mb, jnp.clip(t, 0, n_micro - 1), axis=0,
                     keepdims=False)
                 x_in = jnp.where(s == 0, fresh, buf)
+                pick = lambda ks: lax.dynamic_index_in_dim(  # noqa: E731
+                    ks, mb_idx, axis=0, keepdims=False)
                 yv, st_new = lax.switch(s, branches, slab, st, x_in,
-                                        mb_idx, step_key)
+                                        pick(drop_ks), pick(layer_ks),
+                                        pick(noise_ks))
                 # state advances only on active ticks -> microbatch-order
                 # sequential updates, same sequence as a per-microbatch
                 # sequential run
@@ -450,10 +481,11 @@ class PipelinedNetwork:
         data_ax = "data" if "data" in self.mesh.axis_names else None
         piped, new_sbuf = shard_map(
             run, mesh=self.mesh,
-            in_specs=(P("stage"), P("stage"), P(None, data_ax), P()),
+            in_specs=(P("stage"), P("stage"), P(None, data_ax),
+                      P(), P(), P()),
             out_specs=(P(None, data_ax), P("stage")),
             check_vma=False,
-        )(params["stages"], states["stages"], x_mb, key_arg)
+        )(params["stages"], states["stages"], x_mb, *keysets)
         out_size = self._boundary_sizes(mb)[-1]
         preds = piped[:, :, :out_size].reshape(
             (b,) + _type_shape(self.output_type, mb)[1:])

@@ -25,6 +25,7 @@ TUTORIALS = [
     "examples/tutorials/t12_migrating_from_dl4j.py",
     "examples/tutorials/t13_pipeline_any_network_and_cjk.py",
     "examples/tutorials/t14_data_loading_and_genuine_fixtures.py",
+    "examples/tutorials/t15_training_dashboard.py",
 ]
 EXAMPLES = [
     "examples/lenet_mnist.py",
