@@ -184,7 +184,8 @@ def one_f_one_b_schedule(block, n_micro, n_stages, head_loss,
 
 
 def run_combined_ticks(stage_fn, bwd_seed, n_micro, n_stages, stage_params,
-                       x_mb, lab_mb, *, zero_aux=None, collect_dx=False):
+                       x_mb, lab_mb, *, zero_aux=None, collect_dx=False,
+                       state0=None):
     """The 1F1B combined-tick engine shared by every schedule variant
     (the LM family above; the heterogeneous PipelinedNetwork). Call
     inside shard_map over 'stage'.
@@ -198,25 +199,45 @@ def run_combined_ticks(stage_fn, bwd_seed, n_micro, n_stages, stage_params,
     parameters outside the stages. Returns the LOCAL
     (loss_acc, gparams, aux_acc, dx_acc) — callers apply the psums their
     sharding needs.
+
+    ``state0`` (optional) threads MUTABLE stage state (BN running stats)
+    through the schedule: stage_fn's signature becomes
+    ``stage_fn(params, act, state, mb_idx) -> (act, new_state)`` and a
+    fifth element — the final state — is returned. The forward half
+    advances state in microbatch order; the backward half RECOMPUTES the
+    forward against the current state, which is exact only when the
+    stage forward is state-independent in train mode (true of BN, which
+    normalizes with batch statistics — the running stats are a side
+    effect). ``mb_idx`` lets stage programs select per-microbatch
+    dropout keys deterministically, so the recompute redraws identical
+    masks (same contract as jax.checkpoint over dropout).
     """
     s = lax.axis_index("stage")
     fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
     n_slots = 2 * n_stages - 1  # max residual lifetime in ticks
+    stateful = state0 is not None
 
     zero_act = jnp.zeros_like(x_mb[0])
     zero_params = jax.tree_util.tree_map(jnp.zeros_like, stage_params)
 
     def tick(carry, t):
-        a_buf, g_buf, resid, gparams, aux_acc, dx_acc, loss_acc = carry
+        (a_buf, g_buf, resid, gparams, aux_acc, dx_acc, loss_acc,
+         st) = carry
         # ---- forward half ----
         m_f = t - s
         f_active = (m_f >= 0) & (m_f < n_micro)
-        fresh = lax.dynamic_index_in_dim(
-            x_mb, jnp.clip(m_f, 0, n_micro - 1), axis=0, keepdims=False)
+        m_fc = jnp.clip(m_f, 0, n_micro - 1)
+        fresh = lax.dynamic_index_in_dim(x_mb, m_fc, axis=0,
+                                         keepdims=False)
         x_in = jnp.where(s == 0, fresh, a_buf)
-        y_f = stage_fn(stage_params, x_in)
-        slot_f = jnp.mod(jnp.clip(m_f, 0, n_micro - 1), n_slots)
+        if stateful:
+            y_f, st_new = stage_fn(stage_params, x_in, st, m_fc)
+            st = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(f_active, new, old), st_new, st)
+        else:
+            y_f = stage_fn(stage_params, x_in)
+        slot_f = jnp.mod(m_fc, n_slots)
         saved = jnp.where(f_active, x_in,
                           lax.dynamic_index_in_dim(resid, slot_f, axis=0,
                                                    keepdims=False))
@@ -233,7 +254,13 @@ def run_combined_ticks(stage_fn, bwd_seed, n_micro, n_stages, stage_params,
                                            keepdims=False)
         lab = lax.dynamic_index_in_dim(lab_mb, m_bc, axis=0,
                                        keepdims=False)
-        y_b, vjp = jax.vjp(stage_fn, stage_params, x_saved)
+        if stateful:
+            st_c = jax.tree_util.tree_map(lax.stop_gradient, st)
+            y_b, vjp = jax.vjp(
+                lambda p, x: stage_fn(p, x, st_c, m_bc)[0],
+                stage_params, x_saved)
+        else:
+            y_b, vjp = jax.vjp(stage_fn, stage_params, x_saved)
         loss_mb, aux_mb, dy_last = bwd_seed(y_b, lab)
         dy = jnp.where(s == n_stages - 1, dy_last, g_buf)
         dp_mb, dx_mb = vjp(dy)
@@ -255,15 +282,18 @@ def run_combined_ticks(stage_fn, bwd_seed, n_micro, n_stages, stage_params,
         g_next = lax.ppermute(jnp.where(b_active, dx_mb, zero_act),
                               "stage", bwd_perm)
         return (a_next, g_next, resid, gparams, aux_acc, dx_acc,
-                loss_acc), None
+                loss_acc, st), None
 
     resid0 = jnp.zeros((n_slots,) + x_mb.shape[1:], x_mb.dtype)
     dx0 = jnp.zeros_like(x_mb) if collect_dx else jnp.zeros((), x_mb.dtype)
     carry0 = (zero_act, zero_act, resid0, zero_params, zero_aux, dx0,
-              jnp.zeros((), jnp.float32))
+              jnp.zeros((), jnp.float32),
+              state0 if stateful else jnp.zeros((), jnp.float32))
     ticks = jnp.arange(n_micro + 2 * (n_stages - 1))
-    (_, _, _, gparams, aux_acc, dx_acc, loss_acc), _ = lax.scan(
+    (_, _, _, gparams, aux_acc, dx_acc, loss_acc, st_fin), _ = lax.scan(
         tick, carry0, ticks)
+    if stateful:
+        return loss_acc, gparams, aux_acc, dx_acc, st_fin
     return loss_acc, gparams, aux_acc, dx_acc
 
 

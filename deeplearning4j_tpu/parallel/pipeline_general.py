@@ -53,12 +53,16 @@ static dispatch:
   loss is bit-identical to ``MultiLayerNetwork.loss_fn`` on the same
   params.
 
-Remaining constraints (asserted at build): no masks inside the pipelined
-region, no aux-loss layers (MoE — their load-balancing term lives in the
-activation path, not the state path), and the 1F1B schedule — whose
-shared engine (pipeline.run_combined_ticks) is a pure params x activation
-recomputation — still requires stateless, noise-free stages; run BN /
-dropout stacks under the default GPipe schedule.
+Both schedules take BN state and dropout: GPipe threads the state slab
+through its tick scan; 1F1B threads it through the shared combined-tick
+engine's ``state0`` path (pipeline.run_combined_ticks), whose backward
+half recomputes stage forwards — exact because BN's train forward is
+state-independent and the dropout keys are deterministic per-microbatch
+operands (the recompute redraws identical masks, the jax.checkpoint
+contract). Remaining constraints: aux-loss layers (MoE) are refused at
+build — their load-balancing term lives in the activation path, not the
+state path; and the pipeline API carries no mask inputs (masked
+sequence batches belong on the data-parallel tiers).
 """
 
 from __future__ import annotations
@@ -168,28 +172,16 @@ class PipelinedNetwork:
         assert flat_idx == list(range(len(conf.layers))), \
             "stage_layers must be contiguous groups covering every layer"
         self.layer_inputs, self.output_type = conf.layer_input_types()
-        stateful = any(
-            jax.tree_util.tree_leaves(layer.init_state(it))
-            for layer, it in zip(conf.layers, self.layer_inputs))
-        noisy = any(
-            getattr(layer, "dropout", 0.0) not in (0.0, None)
-            or getattr(layer, "weight_noise", None) is not None
-            for layer in conf.layers)
         for layer in conf.layers:
             assert not hasattr(layer, "aux_loss_weight"), \
                 f"{type(layer).__name__} emits an aux loss; aux-loss " \
                 "layers (MoE) are not supported inside pipelined stages " \
                 "(use parallel/moe.py's expert-parallel tier)"
-        if schedule == "1f1b":
-            # run_combined_ticks recomputes stage forwards as pure
-            # params x activation functions — no state thread, no rng
-            assert not stateful, \
-                "1f1b stages must be stateless (BN running stats need " \
-                "the gpipe schedule's state thread)"
-            assert not noisy, \
-                "no dropout/weight-noise under the 1f1b schedule (the " \
-                "recompute would redraw different masks); use gpipe"
-        self.use_rng = noisy
+        # both schedules thread BN state + per-microbatch dropout keys
+        self.use_rng = any(
+            getattr(layer, "dropout", 0.0) not in (0.0, None)
+            or getattr(layer, "weight_noise", None) is not None
+            for layer in conf.layers)
         self.params = None
         self.state = None
         self.opt_state = None
@@ -281,32 +273,6 @@ class PipelinedNetwork:
         return self
 
     # -- stage programs --------------------------------------------------
-    def _stage_fn(self, s):
-        """Pure fn: (stage slab [Lmax], flat act [mb, Amax]) -> flat out.
-        Stateless/noise-free variant — the 1F1B engine's stage_apply."""
-        g = self.groups[s]
-        layers = [self.conf.layers[i] for i in g]
-        in_type = self.layer_inputs[g[0]]
-        mb = self._mb
-        in_shape = _type_shape(in_type, mb)
-        in_size = int(np.prod(in_shape[1:]))
-        unflat = self._unflats[s]
-
-        def fn(slab, aflat):
-            pl_ = unflat(slab)
-            x = aflat[:, :in_size].reshape(in_shape)
-            cur_type = in_type
-            for layer, p in zip(layers, pl_):
-                fam = layer.input_family
-                if fam is not None and not isinstance(cur_type, fam):
-                    x = _inputs.adapt(x, cur_type, fam)
-                    cur_type = _inputs.adapted_type(cur_type, fam)
-                x, _ = layer.apply(p, {}, x, train=True, rng=None)
-                cur_type = layer.output_type(cur_type)
-            flat = x.reshape(mb, -1)
-            return jnp.pad(flat, ((0, 0), (0, self._amax - flat.shape[1])))
-        return fn
-
     def _chain_keys(self, rng_mb):
         """Replicate MultiLayerNetwork.apply_fn's key-split chain over ALL
         layers, OUTSIDE the stage switch (the chain depends only on the
@@ -332,6 +298,21 @@ class PipelinedNetwork:
             layer_k.append(sub)
             noise_k.append(nk)
         return (jnp.stack(drop_k), jnp.stack(layer_k), jnp.stack(noise_k))
+
+    def _keysets(self, rng):
+        """[M, L, 2] uint32 key stacks for all microbatches — THE shared
+        derivation both schedules use (their cross-schedule equality pin
+        depends on it staying single-sourced). Zeros when rng is off."""
+        if self._rng_active:
+            return [jnp.stack(ks) for ks in zip(*(
+                self._chain_keys(jax.random.fold_in(rng, m))
+                for m in range(self.n_micro)))]
+        return [jnp.zeros((self.n_micro, len(self.conf.layers), 2),
+                          jnp.uint32) for _ in range(3)]
+
+    @staticmethod
+    def _pick_keys(ks, m):
+        return lax.dynamic_index_in_dim(ks, m, axis=0, keepdims=False)
 
     def _stage_fn_full(self, s):
         """Stateful gpipe stage program: (slab [Lmax], state slab [Smax],
@@ -427,17 +408,9 @@ class PipelinedNetwork:
         x_flat = x.reshape(n_micro, mb, -1)
         x_mb = jnp.pad(x_flat, ((0, 0), (0, 0),
                                 (0, self._amax - x_flat.shape[-1])))
-        n_layers = len(self.conf.layers)
-        if self._rng_active:
-            # per-microbatch key chains, precomputed for ALL microbatches
-            # ([M, L, 2] each) — stage-independent, so they live outside
-            # the switch (see _chain_keys)
-            keysets = [jnp.stack(ks) for ks in zip(*(
-                self._chain_keys(jax.random.fold_in(rng, m))
-                for m in range(self.n_micro)))]
-        else:
-            keysets = [jnp.zeros((self.n_micro, n_layers, 2), jnp.uint32)
-                       for _ in range(3)]
+        # per-microbatch key chains, precomputed for ALL microbatches —
+        # stage-independent, so they live outside the switch
+        keysets = self._keysets(rng)
 
         def run(stages, svec, x_mb, drop_ks, layer_ks, noise_ks):
             s = lax.axis_index("stage")
@@ -453,11 +426,10 @@ class PipelinedNetwork:
                     x_mb, jnp.clip(t, 0, n_micro - 1), axis=0,
                     keepdims=False)
                 x_in = jnp.where(s == 0, fresh, buf)
-                pick = lambda ks: lax.dynamic_index_in_dim(  # noqa: E731
-                    ks, mb_idx, axis=0, keepdims=False)
                 yv, st_new = lax.switch(s, branches, slab, st, x_in,
-                                        pick(drop_ks), pick(layer_ks),
-                                        pick(noise_ks))
+                                        self._pick_keys(drop_ks, mb_idx),
+                                        self._pick_keys(layer_ks, mb_idx),
+                                        self._pick_keys(noise_ks, mb_idx))
                 # state advances only on active ticks -> microbatch-order
                 # sequential updates, same sequence as a per-microbatch
                 # sequential run
@@ -502,21 +474,26 @@ class PipelinedNetwork:
         return l
 
     # -- 1F1B (explicit-VJP) schedule ------------------------------------
-    def _loss_and_grads_1f1b(self, params, x, y):
-        """Loss + grads via the shared combined-tick 1F1B engine
-        (pipeline.run_combined_ticks). Differences from the LM family:
-        the LOSS lives in the last stage's branch (the output layer's
-        params are stage params, there is no external head) and stage
-        dispatch is the lax.switch over heterogeneous branches. Residual
-        stash: 2S-1 stage inputs. Requires a mean-reduction per-example
-        loss (the standard output layers) so microbatch contributions
-        recompose exactly. Stateless stages only (asserted at build)."""
+    def _loss_and_grads_1f1b(self, params, states, x, y, rng=None):
+        """Loss + grads + new state via the shared combined-tick 1F1B
+        engine (pipeline.run_combined_ticks, state0 thread). Differences
+        from the LM family: the LOSS lives in the last stage's branch
+        (the output layer's params are stage params, there is no external
+        head) and stage dispatch is the lax.switch over heterogeneous
+        branches. Residual stash: 2S-1 stage inputs; the backward half
+        recomputes the stage forward — exact for BN (state-independent
+        train forward) and for dropout (keys are deterministic [M, L, 2]
+        operands indexed by microbatch, so the recompute redraws the same
+        masks). Requires a mean-reduction per-example loss (the standard
+        output layers) so microbatch contributions recompose exactly."""
         from deeplearning4j_tpu.parallel.pipeline import run_combined_ticks
         b = x.shape[0]
         mb = b // self.n_micro
         self._mb = mb // self.mesh.shape.get("data", 1)
         self._amax = max(self._boundary_sizes(mb))
-        branches = [self._stage_fn(s) for s in range(self.n_stages)]
+        self._smax = int(states["stages"].shape[1])
+        self._rng_active = self.use_rng and rng is not None
+        branches = [self._stage_fn_full(s) for s in range(self.n_stages)]
         n_micro, n_stages = self.n_micro, self.n_stages
         out_layer = self.conf.layers[-1]
         out_shape = _type_shape(self.output_type, self._mb)
@@ -526,6 +503,7 @@ class PipelinedNetwork:
                                 (0, self._amax - x_flat.shape[-1])))
         y_mb = y.reshape((n_micro, mb) + y.shape[1:])
         scale = self._mb / b  # per-mb mean -> full-batch mean
+        keysets = self._keysets(rng)
 
         def mb_loss(yflat, lab):
             preds = yflat[:, :out_size].reshape(out_shape)
@@ -533,45 +511,52 @@ class PipelinedNetwork:
 
         data_ax = "data" if "data" in self.mesh.axis_names else None
 
-        def run(stages, x_mb, y_mb):
+        def run(stages, svec, x_mb, y_mb, drop_ks, layer_ks, noise_ks):
             s = lax.axis_index("stage")
             slab = stages[0]
+            st0 = svec[0]
 
-            def stage_apply(sl, a):
-                return lax.switch(s, branches, sl, a)
+            def stage_apply(sl, a, st, m):
+                return lax.switch(s, branches, sl, st, a,
+                                  self._pick_keys(drop_ks, m),
+                                  self._pick_keys(layer_ks, m),
+                                  self._pick_keys(noise_ks, m))
 
             def bwd_seed(y_b, lab):
                 loss_mb, lvjp = jax.vjp(lambda h: mb_loss(h, lab), y_b)
                 (dy_last,) = lvjp(jnp.ones_like(loss_mb))
                 return loss_mb, None, dy_last
 
-            loss_acc, gslab, _, _ = run_combined_ticks(
+            loss_acc, gslab, _, _, st_fin = run_combined_ticks(
                 stage_apply, bwd_seed, n_micro, n_stages, slab, x_mb,
-                y_mb, zero_aux=None, collect_dx=False)
+                y_mb, zero_aux=None, collect_dx=False, state0=st0)
             axes = ("stage",) if data_ax is None else ("stage", data_ax)
             loss = lax.psum(loss_acc, axes)
             if data_ax is not None:
                 gslab = lax.psum(gslab, data_ax)
-            return loss, gslab[None]
+                st_fin = lax.pmean(st_fin, data_ax)  # ghost BN, as gpipe
+            return loss, gslab[None], st_fin[None]
 
-        loss, gstages = shard_map(
+        loss, gstages, new_sbuf = shard_map(
             run, mesh=self.mesh,
-            in_specs=(P("stage"), P(None, data_ax), P(None, data_ax)),
-            out_specs=(P(), P("stage")),
+            in_specs=(P("stage"), P("stage"), P(None, data_ax),
+                      P(None, data_ax), P(), P(), P()),
+            out_specs=(P(), P("stage"), P("stage")),
             check_vma=False,
-        )(params["stages"], x_mb, y_mb)
+        )(params["stages"], states["stages"], x_mb, y_mb, *keysets)
         # L1/L2 penalties live outside the schedule (the gpipe path
         # carries them in-loss via the same _reg_penalty helper)
         pen, dpen = jax.value_and_grad(self._reg_penalty)(params["stages"])
-        return loss + pen, {"stages": gstages + dpen}
+        return (loss + pen, {"stages": gstages + dpen},
+                {"stages": lax.stop_gradient(new_sbuf)})
 
     def _build_step(self):
         upd = self.updater
 
         def step(params, states, opt_state, x, y, it, rng):
             if self.schedule == "1f1b":
-                loss, grads = self._loss_and_grads_1f1b(params, x, y)
-                new_states = states
+                loss, grads, new_states = self._loss_and_grads_1f1b(
+                    params, states, x, y, rng)
             else:
                 (loss, new_states), grads = jax.value_and_grad(
                     self._loss_fn, has_aux=True)(params, states, x, y, rng)

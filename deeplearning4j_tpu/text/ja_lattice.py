@@ -295,7 +295,9 @@ def _build_dictionary():
         "新聞 新聞社 出版 出版社 放送 放送局 銀行員 省 庁 局 部門 "
         "課 係 支店 本店 本社 支社 工場 事務所 窓口", NOUN, 2400)
     # --- business / tech / title katakana (compound pieces) ---
-    add("シニア ジュニア エンジニア エンジニアリング プロジェクト "
+    add("アルパイン マテリアルズ セミ コンダクター エクィップメント "
+        "オリエンタル チエン マース リレハンメル "
+        "シニア ジュニア エンジニア エンジニアリング プロジェクト "
         "マネジャー マネージャー マネジメント セールス マーケティング "
         "アーキテクト アドミニストレータ アドミニストレーター "
         "コンサルタント ディレクター プロデューサー デザイナー "

@@ -86,10 +86,11 @@ def test_kuromoji_search_mode_suite():
     assert len(cases) == 45
     exact = sum(ja_lattice.tokenize(t, mode="search") == w
                 for t, w in cases)
-    # measured 38/45; the remainder are out-of-dictionary company names
-    # (リレハンメル, エクィップメント, ...) plus cases the file itself
-    # flags as kuromoji heuristic weaknesses (アンチ|ョビパスタ)
-    assert exact >= 36, f"search-mode exact dropped to {exact}/45"
+    # measured 43/45 (r4: 38 — the company-name sub-words are dictionary
+    # entries now); the two remaining are splits the file itself flags
+    # as kuromoji heuristic weaknesses (アンチ|ョビパスタ mid-kana cut,
+    # ジェイ|ティエン|ジニア|リング misaligned piece boundaries)
+    assert exact >= 42, f"search-mode exact dropped to {exact}/45"
 
 
 def test_search_mode_does_not_change_normal_mode():
