@@ -252,8 +252,10 @@ def run_combined_ticks(stage_fn, bwd_seed, n_micro, n_stages, stage_params,
         slot_b = jnp.mod(m_bc, n_slots)
         x_saved = lax.dynamic_index_in_dim(resid, slot_b, axis=0,
                                            keepdims=False)
-        lab = lax.dynamic_index_in_dim(lab_mb, m_bc, axis=0,
-                                       keepdims=False)
+        # lab_mb may be a pytree (labels + per-microbatch masks)
+        lab = jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, m_bc, axis=0,
+                                               keepdims=False), lab_mb)
         if stateful:
             st_c = jax.tree_util.tree_map(lax.stop_gradient, st)
             y_b, vjp = jax.vjp(

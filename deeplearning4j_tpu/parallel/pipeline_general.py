@@ -59,10 +59,12 @@ engine's ``state0`` path (pipeline.run_combined_ticks), whose backward
 half recomputes stage forwards — exact because BN's train forward is
 state-independent and the dropout keys are deterministic per-microbatch
 operands (the recompute redraws identical masks, the jax.checkpoint
-contract). Remaining constraints: aux-loss layers (MoE) are refused at
-build — their load-balancing term lives in the activation path, not the
-state path; and the pipeline API carries no mask inputs (masked
-sequence batches belong on the data-parallel tiers).
+contract). Sequence masks ride along as a per-microbatch [M, mb, T]
+operand handed to mask-aware layers and the output loss (the
+MultiLayerNetwork mask contract), so padded RNN batches stage too. The
+one remaining constraint, asserted at build: no aux-loss layers (MoE —
+their load-balancing term lives in the activation path, not the state
+path).
 """
 
 from __future__ import annotations
@@ -76,6 +78,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from deeplearning4j_tpu.nn.conf import inputs as _inputs
+
+
+# the SAME mask-awareness predicate MultiLayerNetwork uses — the
+# loss-pin equivalence depends on both paths masking identical layers
+from deeplearning4j_tpu.nn.multilayer import _accepts_mask  # noqa: E402
 
 
 def _type_shape(it, mb):
@@ -172,6 +179,7 @@ class PipelinedNetwork:
         assert flat_idx == list(range(len(conf.layers))), \
             "stage_layers must be contiguous groups covering every layer"
         self.layer_inputs, self.output_type = conf.layer_input_types()
+        self._mask_aware = [_accepts_mask(layer) for layer in conf.layers]
         for layer in conf.layers:
             assert not hasattr(layer, "aux_loss_weight"), \
                 f"{type(layer).__name__} emits an aux loss; aux-loss " \
@@ -330,8 +338,9 @@ class PipelinedNetwork:
         sunflat = self._state_unflats[s]
         smax = self._smax
         use_rng = self._rng_active
+        use_mask = self._mask_active
 
-        def fn(slab, svec, aflat, drop_k, layer_k, noise_k):
+        def fn(slab, svec, aflat, mask, drop_k, layer_k, noise_k):
             pl_ = unflat(slab)
             sl_ = sunflat(svec)
             x = aflat[:, :in_size].reshape(in_shape)
@@ -349,9 +358,11 @@ class PipelinedNetwork:
                 wn = getattr(layer, "weight_noise", None)
                 if use_rng and wn is not None and p:
                     p = wn.perturb(noise_k[i], layer, p)
+                kwargs = ({"mask": mask}
+                          if use_mask and self._mask_aware[i] else {})
                 x, new_states[li] = layer.apply(
                     p, sl_[li], x, train=True,
-                    rng=layer_k[i] if use_rng else None)
+                    rng=layer_k[i] if use_rng else None, **kwargs)
                 cur_type = layer.output_type(cur_type)
             flat = x.reshape(mb, -1)
             sflat, _, _ = _flatten_tree(new_states)
@@ -389,12 +400,22 @@ class PipelinedNetwork:
                         .regularization_penalty(tree[j])
         return pen
 
+    def _mask_mb(self, mask, mb):
+        """Per-microbatch mask stack [M, mb, ...] (a dummy when off —
+        switch operands must exist either way)."""
+        if mask is not None:
+            return jnp.asarray(mask).reshape(
+                (self.n_micro, mb) + jnp.asarray(mask).shape[1:])
+        return jnp.zeros((self.n_micro, mb, 1), jnp.float32)
+
     # -- loss / step -----------------------------------------------------
-    def _loss_fn(self, params, states, x, y, rng=None):
+    def _loss_fn(self, params, states, x, y, rng=None, mask=None):
         """Returns (loss, new state slab dict) — differentiate with
         ``has_aux=True``. ``rng=None`` disables dropout/weight noise
         (matching MultiLayerNetwork.loss_fn's rng=None contract); BN
-        still runs in train mode with microbatch statistics."""
+        still runs in train mode with microbatch statistics. ``mask``
+        [B, T] reaches mask-aware layers AND the output loss (the
+        MultiLayerNetwork.loss_fn mask contract)."""
         b = x.shape[0]
         mb = b // self.n_micro
         # stage branches run INSIDE shard_map: the microbatch axis is
@@ -403,16 +424,18 @@ class PipelinedNetwork:
         self._amax = max(self._boundary_sizes(mb))
         self._smax = int(states["stages"].shape[1])
         self._rng_active = self.use_rng and rng is not None
+        self._mask_active = mask is not None
         branches = [self._stage_fn_full(s) for s in range(self.n_stages)]
         n_micro, n_stages = self.n_micro, self.n_stages
         x_flat = x.reshape(n_micro, mb, -1)
         x_mb = jnp.pad(x_flat, ((0, 0), (0, 0),
                                 (0, self._amax - x_flat.shape[-1])))
+        mask_mb = self._mask_mb(mask, mb)
         # per-microbatch key chains, precomputed for ALL microbatches —
         # stage-independent, so they live outside the switch
         keysets = self._keysets(rng)
 
-        def run(stages, svec, x_mb, drop_ks, layer_ks, noise_ks):
+        def run(stages, svec, x_mb, mask_mb, drop_ks, layer_ks, noise_ks):
             s = lax.axis_index("stage")
             slab = stages[0]  # local [1, Lmax] -> [Lmax]
             st0 = svec[0]
@@ -427,6 +450,7 @@ class PipelinedNetwork:
                     keepdims=False)
                 x_in = jnp.where(s == 0, fresh, buf)
                 yv, st_new = lax.switch(s, branches, slab, st, x_in,
+                                        self._pick_keys(mask_mb, mb_idx),
                                         self._pick_keys(drop_ks, mb_idx),
                                         self._pick_keys(layer_ks, mb_idx),
                                         self._pick_keys(noise_ks, mb_idx))
@@ -454,27 +478,29 @@ class PipelinedNetwork:
         piped, new_sbuf = shard_map(
             run, mesh=self.mesh,
             in_specs=(P("stage"), P("stage"), P(None, data_ax),
-                      P(), P(), P()),
+                      P(None, data_ax), P(), P(), P()),
             out_specs=(P(None, data_ax), P("stage")),
             check_vma=False,
-        )(params["stages"], states["stages"], x_mb, *keysets)
+        )(params["stages"], states["stages"], x_mb, mask_mb, *keysets)
         out_size = self._boundary_sizes(mb)[-1]
         preds = piped[:, :, :out_size].reshape(
             (b,) + _type_shape(self.output_type, mb)[1:])
         out_layer = self.conf.layers[-1]
-        loss = out_layer.compute_loss(preds, y, None)
+        loss = out_layer.compute_loss(preds, y, mask)
         # state must not leak gradients into the backward pass (the
         # running-stat update is a side effect, reference semantics)
         new_states = {"stages": lax.stop_gradient(new_sbuf)}
         return loss + self._reg_penalty(params["stages"]), new_states
 
-    def loss(self, x, y):
+    def loss(self, x, y, mask=None):
         l, _ = self._loss_fn(self.params, self.state, jnp.asarray(x),
-                             jnp.asarray(y), None)
+                             jnp.asarray(y), None,
+                             None if mask is None else jnp.asarray(mask))
         return l
 
     # -- 1F1B (explicit-VJP) schedule ------------------------------------
-    def _loss_and_grads_1f1b(self, params, states, x, y, rng=None):
+    def _loss_and_grads_1f1b(self, params, states, x, y, rng=None,
+                             mask=None):
         """Loss + grads + new state via the shared combined-tick 1F1B
         engine (pipeline.run_combined_ticks, state0 thread). Differences
         from the LM family: the LOSS lives in the last stage's branch
@@ -493,6 +519,7 @@ class PipelinedNetwork:
         self._amax = max(self._boundary_sizes(mb))
         self._smax = int(states["stages"].shape[1])
         self._rng_active = self.use_rng and rng is not None
+        self._mask_active = mask is not None
         branches = [self._stage_fn_full(s) for s in range(self.n_stages)]
         n_micro, n_stages = self.n_micro, self.n_stages
         out_layer = self.conf.layers[-1]
@@ -502,34 +529,48 @@ class PipelinedNetwork:
         x_mb = jnp.pad(x_flat, ((0, 0), (0, 0),
                                 (0, self._amax - x_flat.shape[-1])))
         y_mb = y.reshape((n_micro, mb) + y.shape[1:])
+        mask_mb = self._mask_mb(mask, mb)
         scale = self._mb / b  # per-mb mean -> full-batch mean
+        # masked losses are mask-count-weighted means (losses.
+        # _apply_mask_and_mean), so exact recomposition weights each
+        # microbatch by its LOCAL mask count over the GLOBAL count
+        denom_g = (jnp.maximum(jnp.sum(mask), 1.0)
+                   if self._mask_active else jnp.ones((), jnp.float32))
         keysets = self._keysets(rng)
 
-        def mb_loss(yflat, lab):
+        def mb_loss(yflat, lab, lmask, dg):
             preds = yflat[:, :out_size].reshape(out_shape)
+            if self._mask_active:
+                return (out_layer.compute_loss(preds, lab, lmask)
+                        * jnp.sum(lmask) / dg)
             return out_layer.compute_loss(preds, lab, None) * scale
 
         data_ax = "data" if "data" in self.mesh.axis_names else None
 
-        def run(stages, svec, x_mb, y_mb, drop_ks, layer_ks, noise_ks):
+        def run(stages, svec, x_mb, y_mb, mask_mb, denom_g, drop_ks,
+                layer_ks, noise_ks):
             s = lax.axis_index("stage")
             slab = stages[0]
             st0 = svec[0]
 
             def stage_apply(sl, a, st, m):
                 return lax.switch(s, branches, sl, st, a,
+                                  self._pick_keys(mask_mb, m),
                                   self._pick_keys(drop_ks, m),
                                   self._pick_keys(layer_ks, m),
                                   self._pick_keys(noise_ks, m))
 
             def bwd_seed(y_b, lab):
-                loss_mb, lvjp = jax.vjp(lambda h: mb_loss(h, lab), y_b)
+                loss_mb, lvjp = jax.vjp(
+                    lambda h: mb_loss(h, lab["y"], lab["m"], denom_g),
+                    y_b)
                 (dy_last,) = lvjp(jnp.ones_like(loss_mb))
                 return loss_mb, None, dy_last
 
             loss_acc, gslab, _, _, st_fin = run_combined_ticks(
                 stage_apply, bwd_seed, n_micro, n_stages, slab, x_mb,
-                y_mb, zero_aux=None, collect_dx=False, state0=st0)
+                {"y": y_mb, "m": mask_mb}, zero_aux=None,
+                collect_dx=False, state0=st0)
             axes = ("stage",) if data_ax is None else ("stage", data_ax)
             loss = lax.psum(loss_acc, axes)
             if data_ax is not None:
@@ -540,10 +581,12 @@ class PipelinedNetwork:
         loss, gstages, new_sbuf = shard_map(
             run, mesh=self.mesh,
             in_specs=(P("stage"), P("stage"), P(None, data_ax),
-                      P(None, data_ax), P(), P(), P()),
+                      P(None, data_ax), P(None, data_ax), P(),
+                      P(), P(), P()),
             out_specs=(P(), P("stage"), P("stage")),
             check_vma=False,
-        )(params["stages"], states["stages"], x_mb, y_mb, *keysets)
+        )(params["stages"], states["stages"], x_mb, y_mb, mask_mb,
+          denom_g, *keysets)
         # L1/L2 penalties live outside the schedule (the gpipe path
         # carries them in-loss via the same _reg_penalty helper)
         pen, dpen = jax.value_and_grad(self._reg_penalty)(params["stages"])
@@ -553,13 +596,14 @@ class PipelinedNetwork:
     def _build_step(self):
         upd = self.updater
 
-        def step(params, states, opt_state, x, y, it, rng):
+        def step(params, states, opt_state, x, y, it, rng, mask):
             if self.schedule == "1f1b":
                 loss, grads, new_states = self._loss_and_grads_1f1b(
-                    params, states, x, y, rng)
+                    params, states, x, y, rng, mask)
             else:
                 (loss, new_states), grads = jax.value_and_grad(
-                    self._loss_fn, has_aux=True)(params, states, x, y, rng)
+                    self._loss_fn, has_aux=True)(params, states, x, y,
+                                                 rng, mask)
             updates, opt_state = upd.update(grads, opt_state, params, it)
             params = jax.tree_util.tree_map(jnp.add, params, updates)
             return params, new_states, opt_state, loss
@@ -568,13 +612,16 @@ class PipelinedNetwork:
         data_sh = NamedSharding(self.mesh, P(data_ax))
         return jax.jit(
             step,
+            # mask's sharding stays unspecified: the argument is None for
+            # unmasked nets and ensure_sharded already placed it otherwise
             in_shardings=(self.param_shardings, self.state_shardings,
-                          self._opt_sh, data_sh, data_sh, None, None),
+                          self._opt_sh, data_sh, data_sh, None, None,
+                          None),
             out_shardings=(self.param_shardings, self.state_shardings,
                            self._opt_sh, NamedSharding(self.mesh, P())),
             donate_argnums=(0, 1, 2))
 
-    def step(self, x, y):
+    def step(self, x, y, mask=None):
         if self.params is None:
             self.init()
         if self._step_fn is None:
@@ -583,12 +630,14 @@ class PipelinedNetwork:
         dsh = NamedSharding(self.mesh, P(data_ax))
         x = _mesh.ensure_sharded(x, dsh)
         y = _mesh.ensure_sharded(y, dsh)
+        if mask is not None:
+            mask = _mesh.ensure_sharded(jnp.asarray(mask), dsh)
         if self.use_rng:
             self._rng, step_key = jax.random.split(self._rng)
         else:
             step_key = jnp.zeros((2,), jnp.uint32)
         self.params, self.state, self.opt_state, loss = self._step_fn(
             self.params, self.state, self.opt_state, x, y, self.iteration,
-            step_key)
+            step_key, mask)
         self.iteration += 1
         return loss
