@@ -6,7 +6,10 @@ apply to. ``parallel/pipeline.py`` pipelines the homogeneous stacked
 transformer trunk; this module generalizes the same GPipe schedule to any
 ``MultiLayerNetwork`` configuration (VGG16, the char-RNN, an MLP, and —
 via the ResidualBottleneck composite layer — ResNet50, VERDICT r3 #5 /
-r4 #3), split into ``n_stages`` contiguous layer groups.
+r4 #3), split into ``n_stages`` contiguous layer groups — and, via
+``PipelinedGraph`` at the bottom of the module, to any single-input /
+single-output ``ComputationGraph`` DAG (the real 141-vertex ResNet50
+graph included).
 
 TPU-first design: the obstacle to heterogeneous stages under SPMD is that
 ``shard_map`` traces ONE program for all devices while each stage owns a
@@ -115,9 +118,33 @@ def _flatten_tree(tree):
     return flat, unflatten, sum(sizes)
 
 
+def _greedy_balance(counts, n_stages):
+    """Contiguous group bounds over per-item param counts (greedy: close
+    each group once it reaches the ideal share). Shared by the layer and
+    vertex balancers — returns [(start, end)] index pairs."""
+    total = sum(counts) or 1
+    ideal = total / n_stages
+    bounds, acc = [], 0.0
+    for i, c in enumerate(counts):
+        acc += c
+        remaining = len(counts) - i - 1
+        rem_stages = n_stages - len(bounds) - 1
+        if acc >= ideal and rem_stages > 0 and remaining >= rem_stages:
+            bounds.append(i + 1)
+            acc = 0.0
+    while len(bounds) < n_stages - 1:  # degenerate: force non-empty stages
+        cand = [i for i in range(1, len(counts)) if i not in bounds]
+        bounds.append(cand[0])
+        bounds.sort()
+    out, prev = [], 0
+    for b in bounds + [len(counts)]:
+        out.append((prev, b))
+        prev = b
+    return out
+
+
 def balance_stages(conf, n_stages):
-    """Contiguous stage boundaries balancing per-stage param counts
-    (greedy: close each stage once it reaches the ideal share)."""
+    """Contiguous stage boundaries balancing per-stage param counts."""
     assert n_stages <= len(conf.layers), \
         f"{n_stages} stages need at least that many layers " \
         f"(got {len(conf.layers)})"
@@ -128,27 +155,8 @@ def balance_stages(conf, n_stages):
         p = jax.eval_shape(lambda k, _l=layer, _it=it: _l.init(k, _it), key)
         counts.append(sum(int(np.prod(l.shape))
                           for l in jax.tree_util.tree_leaves(p)))
-    total = sum(counts) or 1
-    ideal = total / n_stages
-    bounds, acc, start = [], 0.0, 0
-    for i, c in enumerate(counts):
-        acc += c
-        remaining_layers = len(counts) - i - 1
-        remaining_stages = n_stages - len(bounds) - 1
-        if (acc >= ideal and remaining_stages > 0
-                and remaining_layers >= remaining_stages):
-            bounds.append(i + 1)
-            acc = 0.0
-    while len(bounds) < n_stages - 1:  # degenerate: force non-empty stages
-        cand = [i for i in range(1, len(counts)) if i not in bounds]
-        bounds.append(cand[0])
-        bounds.sort()
-    groups = []
-    prev = 0
-    for b in bounds + [len(counts)]:
-        groups.append(list(range(prev, b)))
-        prev = b
-    return groups
+    return [list(range(a, b))
+            for a, b in _greedy_balance(counts, n_stages)]
 
 
 class PipelinedNetwork:
@@ -180,6 +188,13 @@ class PipelinedNetwork:
             "stage_layers must be contiguous groups covering every layer"
         self.layer_inputs, self.output_type = conf.layer_input_types()
         self._mask_aware = [_accepts_mask(layer) for layer in conf.layers]
+        assert conf.gradient_normalization in (None, "none"), \
+            "PipelinedNetwork does not apply gradient normalization; " \
+            "clip on the sequential MultiLayerNetwork path"
+        assert not hasattr(conf.layers[-1], "loss_from_features"), \
+            "feature-loss heads (CenterLossOutputLayer) need the " \
+            "pre-head activations MultiLayerNetwork.loss_fn threads " \
+            "specially; not stageable"
         for layer in conf.layers:
             assert not hasattr(layer, "aux_loss_weight"), \
                 f"{type(layer).__name__} emits an aux loss; aux-loss " \
@@ -639,5 +654,352 @@ class PipelinedNetwork:
         self.params, self.state, self.opt_state, loss = self._step_fn(
             self.params, self.state, self.opt_state, x, y, self.iteration,
             step_key, mask)
+        self.iteration += 1
+        return loss
+
+
+# ---------------------------------------------------------------------------
+# ComputationGraph pipelining
+# ---------------------------------------------------------------------------
+
+def balance_graph_stages(conf, n_stages, order=None, types=None):
+    """Contiguous topological-order stage boundaries for a
+    GraphConfiguration, balancing per-stage param counts (the
+    balance_stages greedy applied to vertices)."""
+    order = order if order is not None else conf.topological_order()
+    types = types if types is not None else conf.vertex_types()
+    types = dict(types)
+    for name, it in zip(conf.inputs, conf.input_types):
+        types[name] = it
+    defs = {v.name: v for v in conf.vertices}
+    assert n_stages <= len(order)
+    key = jax.random.PRNGKey(0)
+    counts = []
+    for name in order:
+        v = defs[name]
+        in_types = [types[i] for i in v.inputs]
+        p = jax.eval_shape(lambda k, _v=v.vertex, _t=in_types:
+                           _v.init(k, _t), key)
+        counts.append(sum(int(np.prod(l.shape))
+                          for l in jax.tree_util.tree_leaves(p)))
+    return [order[a:b] for a, b in _greedy_balance(counts, n_stages)]
+
+
+class PipelinedGraph:
+    """GPipe-pipeline any single-input / single-output ComputationGraph
+    over a mesh 'stage' axis (reference role: ParallelWrapper.java:58
+    wraps any Model — ComputationGraph included).
+
+    The DAG is cut into contiguous topological-order vertex groups; each
+    stage boundary carries EVERY tensor still live across it (outputs of
+    earlier groups consumed by later ones), flattened and concatenated
+    into the rotating [mb, Amax] GPipe buffer. Skip connections of any
+    span therefore stage without restriction: a tensor crossing several
+    boundaries simply rides the buffer through the intermediate stages.
+    BN running stats thread through the per-stage state slab exactly as
+    in PipelinedNetwork; the output vertex's forward runs in the last
+    stage and the loss (+ L1/L2) is computed outside the pipelined
+    region, so the loss is pinned to ComputationGraph.loss_fn on the
+    same params. Constraints (asserted): no dropout / weight noise / aux
+    losses inside the pipelined region, no masks, GPipe schedule.
+    """
+
+    def __init__(self, conf, mesh: Mesh, *, n_microbatches=4,
+                 stage_vertices=None, updater=None, seed=None):
+        assert "stage" in mesh.axis_names, "mesh needs a 'stage' axis"
+        assert len(conf.inputs) == 1 and len(conf.outputs) == 1, \
+            "PipelinedGraph stages single-input/single-output graphs"
+        self.conf = conf
+        self.mesh = mesh
+        self.n_micro = n_microbatches
+        self.n_stages = mesh.shape["stage"]
+        self.updater = updater or conf.updater
+        self.seed = conf.seed if seed is None else seed
+        self.order = conf.topological_order()
+        assert self.order[-1] == conf.outputs[0], \
+            "the output vertex must be the topological sink"
+        self.defs = {v.name: v for v in conf.vertices}
+        self.types = dict(conf.vertex_types())
+        self.types[conf.inputs[0]] = conf.input_types[0]
+        assert conf.gradient_normalization in (None, "none"), \
+            "PipelinedGraph does not apply gradient normalization; " \
+            "clip on the sequential ComputationGraph path"
+        for v in conf.vertices:
+            layer = getattr(v.vertex, "layer", None)
+            assert getattr(layer, "dropout", 0.0) in (0.0, None), \
+                f"vertex {v.name}: no dropout inside PipelinedGraph"
+            assert getattr(layer, "weight_noise", None) is None, \
+                f"vertex {v.name}: no weight noise inside PipelinedGraph"
+            assert not hasattr(layer, "aux_loss_weight") \
+                and not hasattr(v.vertex, "aux_loss_weight"), \
+                f"vertex {v.name}: aux-loss layers are not stageable"
+        out_v = self.defs[conf.outputs[0]]
+        assert not hasattr(getattr(out_v.vertex, "layer", None),
+                           "loss_from_features"), \
+            "feature-loss heads (CenterLossOutputLayer) compute their " \
+            "loss from pre-head activations ComputationGraph.loss_fn " \
+            "threads specially; not stageable — use the sequential graph"
+        self.groups = (stage_vertices if stage_vertices is not None
+                       else balance_graph_stages(conf, self.n_stages,
+                                                 self.order, self.types))
+        assert len(self.groups) == self.n_stages
+        assert [n for g in self.groups for n in g] == self.order, \
+            "stage_vertices must be contiguous topo-order groups"
+        self._boundaries = self._compute_boundaries()
+        self.params = None
+        self.state = None
+        self.opt_state = None
+        self._step_fn = None
+        self.iteration = 0
+
+    # -- structure -------------------------------------------------------
+    def _compute_boundaries(self):
+        """boundaries[k] = ordered tensor names live ENTERING stage k:
+        the graph input for k=0; for k>0, outputs of groups <k (or the
+        input) still consumed by groups >=k. An extra final entry holds
+        the output vertex alone (what leaves the last stage)."""
+        in_name = self.conf.inputs[0]
+        consumed_at = {}  # name -> last stage index that consumes it
+        for k, g in enumerate(self.groups):
+            for vn in g:
+                for src in self.defs[vn].inputs:
+                    consumed_at[src] = max(consumed_at.get(src, -1), k)
+        bounds = [[in_name]]
+        for k in range(1, self.n_stages):
+            produced = [in_name] + [n for g in self.groups[:k] for n in g]
+            live = [n for n in produced
+                    if consumed_at.get(n, -1) >= k]
+            bounds.append(live)
+        bounds.append([self.conf.outputs[0]])
+        return bounds
+
+    def _flat_size(self, name, mb):
+        return int(np.prod(_type_shape(self.types[name], mb)[1:]))
+
+    def _boundary_sizes(self, mb):
+        return [sum(self._flat_size(n, mb) for n in b)
+                for b in self._boundaries]
+
+    # -- packing ---------------------------------------------------------
+    def _pack(self, vertex_params):
+        flats, unflats, sizes = [], [], []
+        for g in self.groups:
+            f, u, n = _flatten_tree({vn: vertex_params[vn] for vn in g})
+            flats.append(f)
+            unflats.append(u)
+            sizes.append(n)
+        lmax = max(max(sizes), 1)
+        buf = jnp.stack([jnp.pad(f, (0, lmax - f.shape[0]))
+                         for f in flats])
+        self._unflats = unflats
+        return buf
+
+    def _pack_state(self, vertex_states):
+        flats, unflats, sizes = [], [], []
+        for g in self.groups:
+            f, u, n = _flatten_tree({vn: vertex_states[vn] for vn in g})
+            flats.append(f)
+            unflats.append(u)
+            sizes.append(n)
+        smax = max(max(sizes), 1)
+        buf = jnp.stack([jnp.pad(f, (0, smax - f.shape[0]))
+                         for f in flats])
+        self._state_unflats = unflats
+        return buf
+
+    def unpack(self, buf=None):
+        """Stage buffer -> {vertex: params} (ComputationGraph.params
+        shape — checkpoint/export interop)."""
+        buf = self.params["stages"] if buf is None else buf
+        buf = jax.device_get(buf)
+        out = {}
+        for s in range(self.n_stages):
+            out.update(self._unflats[s](jnp.asarray(buf[s])))
+        return out
+
+    def unpack_state(self, buf=None):
+        buf = self.state["stages"] if buf is None else buf
+        buf = jax.device_get(buf)
+        out = {}
+        for s in range(self.n_stages):
+            out.update(self._state_unflats[s](jnp.asarray(buf[s])))
+        return out
+
+    def init(self, rng=None, from_params=None, from_state=None):
+        if from_params is not None:
+            ptrees = from_params
+        else:
+            rng = rng if rng is not None else jax.random.PRNGKey(self.seed)
+            ptrees = {}
+            for name in self.order:
+                rng, sub = jax.random.split(rng)
+                v = self.defs[name]
+                in_types = [self.types[i] for i in v.inputs]
+                ptrees[name] = v.vertex.init(sub, in_types)
+        st_trees = (from_state if from_state is not None else {
+            name: self.defs[name].vertex.init_state(
+                [self.types[i] for i in self.defs[name].inputs])
+            for name in self.order})
+        buf = self._pack(ptrees)
+        sbuf = self._pack_state(st_trees)
+        sh = NamedSharding(self.mesh, P("stage"))
+        self.params = {"stages": jax.device_put(buf, sh)}
+        self.param_shardings = {"stages": sh}
+        self.state = {"stages": jax.device_put(sbuf, sh)}
+        self.state_shardings = {"stages": sh}
+        opt = self.updater.init(self.params)
+        repl = NamedSharding(self.mesh, P())
+        self._opt_sh = jax.tree_util.tree_map(
+            lambda x: sh if getattr(x, "shape", None) == buf.shape
+            else repl, opt)
+        self.opt_state = jax.tree_util.tree_map(jax.device_put, opt,
+                                                self._opt_sh)
+        return self
+
+    # -- stage programs --------------------------------------------------
+    def _stage_fn(self, k):
+        """(slab [Lmax], state slab [Smax], boundary flat [mb, Amax]) ->
+        (next boundary flat, new state slab)."""
+        group = self.groups[k]
+        in_names = self._boundaries[k]
+        out_names = self._boundaries[k + 1]
+        mb = self._mb
+        in_shapes = [_type_shape(self.types[n], mb) for n in in_names]
+        in_sizes = [int(np.prod(sh[1:])) for sh in in_shapes]
+        unflat = self._unflats[k]
+        sunflat = self._state_unflats[k]
+        smax = self._smax
+
+        def fn(slab, svec, bflat):
+            pl_ = unflat(slab)
+            sl_ = sunflat(svec)
+            vals, off = {}, 0
+            for name, sh, sz in zip(in_names, in_shapes, in_sizes):
+                vals[name] = bflat[:, off:off + sz].reshape(sh)
+                off += sz
+            new_states = dict(sl_)
+            for name in group:
+                v = self.defs[name]
+                xs = [vals[i] for i in v.inputs]
+                y, st = v.vertex.apply(pl_[name], sl_[name], xs,
+                                       train=True, rng=None)
+                vals[name] = y
+                new_states[name] = st
+            flat = jnp.concatenate(
+                [vals[n].reshape(mb, -1) for n in out_names], axis=1)
+            sflat, _, _ = _flatten_tree(new_states)
+            sout = jnp.pad(sflat, (0, smax - sflat.shape[0]))
+            out = jnp.pad(flat, ((0, 0), (0, self._amax - flat.shape[1])))
+            # uniform tangent structure across switch branches (see
+            # PipelinedNetwork._stage_fn_full)
+            return out + slab[0] * 0, lax.stop_gradient(sout)
+        return fn
+
+    def _reg_penalty(self, pstages):
+        pen = 0.0
+        for s, g in enumerate(self.groups):
+            tree = self._unflats[s](pstages[s])
+            for name in g:
+                if tree[name]:
+                    pen = pen + self.defs[name].vertex \
+                        .regularization_penalty(tree[name])
+        return pen
+
+    # -- loss / step -----------------------------------------------------
+    def _loss_fn(self, params, states, x, y):
+        """(loss, new state slab dict) — has_aux. Same tick loop as
+        PipelinedNetwork._loss_fn over the graph stage programs."""
+        b = x.shape[0]
+        mb = b // self.n_micro
+        self._mb = mb // self.mesh.shape.get("data", 1)
+        self._amax = max(self._boundary_sizes(mb))
+        self._smax = int(states["stages"].shape[1])
+        branches = [self._stage_fn(s) for s in range(self.n_stages)]
+        n_micro, n_stages = self.n_micro, self.n_stages
+        x_flat = x.reshape(n_micro, mb, -1)
+        x_mb = jnp.pad(x_flat, ((0, 0), (0, 0),
+                                (0, self._amax - x_flat.shape[-1])))
+
+        def run(stages, svec, x_mb):
+            s = lax.axis_index("stage")
+            slab = stages[0]
+            st0 = svec[0]
+            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+            def tick(carry, t):
+                buf, st = carry
+                active = (t >= s) & (t - s < n_micro)
+                fresh = lax.dynamic_index_in_dim(
+                    x_mb, jnp.clip(t, 0, n_micro - 1), axis=0,
+                    keepdims=False)
+                x_in = jnp.where(s == 0, fresh, buf)
+                yv, st_new = lax.switch(s, branches, slab, st, x_in)
+                st = jnp.where(active, st_new, st)
+                yv = jnp.where(active, yv, buf)
+                out = jnp.where((s == n_stages - 1) & active, yv,
+                                jnp.zeros_like(yv))
+                nxt = lax.ppermute(yv, "stage", perm)
+                return (nxt, st), out
+
+            ticks = jnp.arange(n_micro + n_stages - 1)
+            (_, st_fin), outs = lax.scan(
+                tick, (jnp.zeros_like(x_mb[0]), st0), ticks)
+            outs = outs[n_stages - 1:]
+            if data_ax is not None:
+                st_fin = lax.pmean(st_fin, data_ax)  # ghost batch norm
+            return lax.psum(outs, "stage"), st_fin[None]
+
+        data_ax = "data" if "data" in self.mesh.axis_names else None
+        piped, new_sbuf = shard_map(
+            run, mesh=self.mesh,
+            in_specs=(P("stage"), P("stage"), P(None, data_ax)),
+            out_specs=(P(None, data_ax), P("stage")),
+            check_vma=False,
+        )(params["stages"], states["stages"], x_mb)
+        out_name = self.conf.outputs[0]
+        out_size = self._flat_size(out_name, mb)
+        preds = piped[:, :, :out_size].reshape(
+            (b,) + _type_shape(self.types[out_name], mb)[1:])
+        out_layer = self.defs[out_name].vertex.layer
+        loss = out_layer.compute_loss(preds, y, None)
+        new_states = {"stages": lax.stop_gradient(new_sbuf)}
+        return loss + self._reg_penalty(params["stages"]), new_states
+
+    def loss(self, x, y):
+        l, _ = self._loss_fn(self.params, self.state, jnp.asarray(x),
+                             jnp.asarray(y))
+        return l
+
+    def _build_step(self):
+        upd = self.updater
+
+        def step(params, states, opt_state, x, y, it):
+            (loss, new_states), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(params, states, x, y)
+            updates, opt_state = upd.update(grads, opt_state, params, it)
+            params = jax.tree_util.tree_map(jnp.add, params, updates)
+            return params, new_states, opt_state, loss
+
+        data_ax = "data" if "data" in self.mesh.axis_names else None
+        data_sh = NamedSharding(self.mesh, P(data_ax))
+        return jax.jit(
+            step,
+            in_shardings=(self.param_shardings, self.state_shardings,
+                          self._opt_sh, data_sh, data_sh, None),
+            out_shardings=(self.param_shardings, self.state_shardings,
+                           self._opt_sh, NamedSharding(self.mesh, P())),
+            donate_argnums=(0, 1, 2))
+
+    def step(self, x, y):
+        if self.params is None:
+            self.init()
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        data_ax = "data" if "data" in self.mesh.axis_names else None
+        dsh = NamedSharding(self.mesh, P(data_ax))
+        x = _mesh.ensure_sharded(x, dsh)
+        y = _mesh.ensure_sharded(y, dsh)
+        self.params, self.state, self.opt_state, loss = self._step_fn(
+            self.params, self.state, self.opt_state, x, y, self.iteration)
         self.iteration += 1
         return loss

@@ -77,7 +77,8 @@ SYMBOLS = {
     "deeplearning4j_tpu.parallel.distributed": [
         "ParameterAveragingTrainingMaster", "SharedTrainingMaster",
         "initialize_distributed"],
-    "deeplearning4j_tpu.parallel.pipeline_general": ["PipelinedNetwork"],
+    "deeplearning4j_tpu.parallel.pipeline_general": ["PipelinedNetwork",
+                                                     "PipelinedGraph"],
     "deeplearning4j_tpu.parallel.composed": ["ComposedParallelLM"],
     "deeplearning4j_tpu.parallel.data_utils": [],
     "deeplearning4j_tpu.text.word2vec": ["Word2Vec", "SequenceVectors"],
