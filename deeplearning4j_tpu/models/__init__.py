@@ -1,7 +1,8 @@
 """Model zoo (reference: deeplearning4j-zoo, SURVEY.md §2.6)."""
 
 from deeplearning4j_tpu.models.lenet import lenet  # noqa: F401
-from deeplearning4j_tpu.models.resnet import resnet50  # noqa: F401
+from deeplearning4j_tpu.models.resnet import (  # noqa: F401
+    resnet50, resnet50_mln)
 from deeplearning4j_tpu.models.vgg import vgg16, vgg19  # noqa: F401
 from deeplearning4j_tpu.models.misc import (  # noqa: F401
     alexnet, darknet19, simple_cnn, text_generation_lstm, tiny_yolo,

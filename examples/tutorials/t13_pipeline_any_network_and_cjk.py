@@ -1,18 +1,25 @@
 """Tutorial 13 — Pipelining ANY network, the 1F1B schedule, and CJK text.
 
-Round-4 capabilities on top of tutorial 10's parallelism axes:
+Capabilities on top of tutorial 10's parallelism axes:
 
 1. ``PipelinedNetwork`` pipelines an arbitrary ``MultiLayerNetwork``
-   configuration — conv pyramids, conv->FC transitions, LSTM stacks —
-   over a mesh 'stage' axis, not just the homogeneous transformer trunk.
-   (Reference analog: ParallelWrapper.java wraps ANY Model.)
-2. ``schedule="1f1b"`` on the LM pipeline classes: same math as GPipe
+   configuration — conv pyramids, conv->FC transitions, LSTM stacks,
+   and (round 5) BN running stats, dropout, and masked sequence
+   batches — over a mesh 'stage' axis, not just the homogeneous
+   transformer trunk. (Reference analog: ParallelWrapper.java wraps
+   ANY Model.)
+2. ``schedule="1f1b"`` on every pipeline surface: same math as GPipe
    (loss-identical), but backward for each microbatch starts as soon as
    its forward clears the last stage, so the activation stash stays
    bounded by pipeline depth instead of microbatch count.
-3. The CJK language packs are real morphological analyzers now:
-   Chinese Viterbi lattice segmentation, Japanese kuromoji-design
-   lattice, Korean best-parse stemming (먹었어요 -> 먹다).
+3. ``PipelinedGraph`` (round 5) stages any single-input/single-output
+   ``ComputationGraph`` — including the real ResNet50 DAG, whose
+   ElementWise-add skip connections ride the stage boundary buffers.
+4. The CJK language packs are real morphological analyzers:
+   Chinese Viterbi lattice segmentation (optionally over the reference
+   pack's genuine 85k-word ansj dictionary), Japanese kuromoji-design
+   lattice (textbook or IPADIC conventions), Korean best-parse
+   stemming (먹었어요 -> 먹다) with a morpheme mode.
 
 Run:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       JAX_PLATFORMS=cpu python t13_pipeline_any_network_and_cjk.py
@@ -96,7 +103,23 @@ def step_2_one_f_one_b():
     assert abs(lg - lf) < 1e-4
 
 
-def step_3_cjk_tokenization():
+def step_3_pipeline_the_resnet_graph():
+    """The flagship itself: reduced ResNet50 as the ComputationGraph
+    models/resnet.py builds, staged over 4 devices — BN stats in the
+    per-stage state slab, skips riding the boundary buffers."""
+    from deeplearning4j_tpu.models.resnet import resnet50
+    from deeplearning4j_tpu.parallel.pipeline_general import PipelinedGraph
+    conf = resnet50(height=16, width=16, channels=3, n_classes=4, seed=9)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4,), ("stage",))
+    pg = PipelinedGraph(conf, mesh, n_microbatches=2).init()
+    x = rs.rand(4, 16, 16, 3).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 4)]
+    losses = [float(pg.step(x, y)) for _ in range(3)]
+    print(f"[3] pipelined ResNet50 graph ({len(conf.vertices)} vertices, "
+          f"4 stages): loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+
+
+def step_4_cjk_tokenization():
     """The three CJK packs feed any SequenceVectors consumer."""
     from deeplearning4j_tpu.text.languages import (
         ChineseTokenizerFactory, JapaneseTokenizerFactory,
@@ -104,14 +127,15 @@ def step_3_cjk_tokenization():
     zh = ChineseTokenizerFactory().create("我们在学校学习汉语").get_tokens()
     ja = JapaneseTokenizerFactory().create("私は学校に行きました").get_tokens()
     ko = KoreanTokenizerFactory().create("친구를 만났어요").get_tokens()
-    print(f"[3] zh: {zh}")
-    print(f"[3] ja: {ja}")
-    print(f"[3] ko: {ko}  (먹었어요-style conjugations stem to 다-form)")
+    print(f"[4] zh: {zh}")
+    print(f"[4] ja: {ja}")
+    print(f"[4] ko: {ko}  (먹었어요-style conjugations stem to 다-form)")
     assert "学校" in zh and "学校" in ja and "만나다" in ko
 
 
 if __name__ == "__main__":
     step_1_pipeline_a_convnet()
     step_2_one_f_one_b()
-    step_3_cjk_tokenization()
+    step_3_pipeline_the_resnet_graph()
+    step_4_cjk_tokenization()
     print("tutorial 13 complete")
