@@ -700,17 +700,23 @@ class PipelinedGraph:
     in PipelinedNetwork; the output vertex's forward runs in the last
     stage and the loss (+ L1/L2) is computed outside the pipelined
     region, so the loss is pinned to ComputationGraph.loss_fn on the
-    same params. Constraints (asserted): no dropout / weight noise / aux
-    losses inside the pipelined region, no masks, GPipe schedule.
+    same params. ``schedule="1f1b"`` runs the combined-tick engine with
+    the state thread (exact: BN's train forward is state-independent
+    and stages are rng-free here, so the backward-half recompute is
+    bit-faithful). Constraints (asserted): no dropout / weight noise /
+    aux losses inside the pipelined region, no masks.
     """
 
     def __init__(self, conf, mesh: Mesh, *, n_microbatches=4,
-                 stage_vertices=None, updater=None, seed=None):
+                 stage_vertices=None, updater=None, seed=None,
+                 schedule="gpipe"):
         assert "stage" in mesh.axis_names, "mesh needs a 'stage' axis"
+        assert schedule in ("gpipe", "1f1b"), schedule
         assert len(conf.inputs) == 1 and len(conf.outputs) == 1, \
             "PipelinedGraph stages single-input/single-output graphs"
         self.conf = conf
         self.mesh = mesh
+        self.schedule = schedule
         self.n_micro = n_microbatches
         self.n_stages = mesh.shape["stage"]
         self.updater = updater or conf.updater
@@ -970,12 +976,81 @@ class PipelinedGraph:
                              jnp.asarray(y))
         return l
 
+    # -- 1F1B (explicit-VJP) schedule ------------------------------------
+    def _loss_and_grads_1f1b(self, params, states, x, y):
+        """Loss + grads + new state via the shared combined-tick engine
+        (pipeline.run_combined_ticks, state0 thread) over the graph
+        stage programs — the PipelinedNetwork 1f1b path minus keys and
+        masks (stages here are rng-free by construction)."""
+        from deeplearning4j_tpu.parallel.pipeline import run_combined_ticks
+        b = x.shape[0]
+        mb = b // self.n_micro
+        self._mb = mb // self.mesh.shape.get("data", 1)
+        self._amax = max(self._boundary_sizes(mb))
+        self._smax = int(states["stages"].shape[1])
+        branches = [self._stage_fn(s) for s in range(self.n_stages)]
+        n_micro, n_stages = self.n_micro, self.n_stages
+        out_name = self.conf.outputs[0]
+        out_layer = self.defs[out_name].vertex.layer
+        out_shape = _type_shape(self.types[out_name], self._mb)
+        out_size = int(np.prod(out_shape[1:]))
+        x_flat = x.reshape(n_micro, mb, -1)
+        x_mb = jnp.pad(x_flat, ((0, 0), (0, 0),
+                                (0, self._amax - x_flat.shape[-1])))
+        y_mb = y.reshape((n_micro, mb) + y.shape[1:])
+        scale = self._mb / b  # per-mb mean -> full-batch mean
+
+        def mb_loss(yflat, lab):
+            preds = yflat[:, :out_size].reshape(out_shape)
+            return out_layer.compute_loss(preds, lab, None) * scale
+
+        data_ax = "data" if "data" in self.mesh.axis_names else None
+
+        def run(stages, svec, x_mb, y_mb):
+            s = lax.axis_index("stage")
+            slab = stages[0]
+            st0 = svec[0]
+
+            def stage_apply(sl, a, st, m):
+                del m  # rng-free stages: microbatch index unused
+                return lax.switch(s, branches, sl, st, a)
+
+            def bwd_seed(y_b, lab):
+                loss_mb, lvjp = jax.vjp(lambda h: mb_loss(h, lab), y_b)
+                (dy_last,) = lvjp(jnp.ones_like(loss_mb))
+                return loss_mb, None, dy_last
+
+            loss_acc, gslab, _, _, st_fin = run_combined_ticks(
+                stage_apply, bwd_seed, n_micro, n_stages, slab, x_mb,
+                y_mb, zero_aux=None, collect_dx=False, state0=st0)
+            axes = ("stage",) if data_ax is None else ("stage", data_ax)
+            loss = lax.psum(loss_acc, axes)
+            if data_ax is not None:
+                gslab = lax.psum(gslab, data_ax)
+                st_fin = lax.pmean(st_fin, data_ax)  # ghost BN, as gpipe
+            return loss, gslab[None], st_fin[None]
+
+        loss, gstages, new_sbuf = shard_map(
+            run, mesh=self.mesh,
+            in_specs=(P("stage"), P("stage"), P(None, data_ax),
+                      P(None, data_ax)),
+            out_specs=(P(), P("stage"), P("stage")),
+            check_vma=False,
+        )(params["stages"], states["stages"], x_mb, y_mb)
+        pen, dpen = jax.value_and_grad(self._reg_penalty)(params["stages"])
+        return (loss + pen, {"stages": gstages + dpen},
+                {"stages": lax.stop_gradient(new_sbuf)})
+
     def _build_step(self):
         upd = self.updater
 
         def step(params, states, opt_state, x, y, it):
-            (loss, new_states), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True)(params, states, x, y)
+            if self.schedule == "1f1b":
+                loss, grads, new_states = self._loss_and_grads_1f1b(
+                    params, states, x, y)
+            else:
+                (loss, new_states), grads = jax.value_and_grad(
+                    self._loss_fn, has_aux=True)(params, states, x, y)
             updates, opt_state = upd.update(grads, opt_state, params, it)
             params = jax.tree_util.tree_map(jnp.add, params, updates)
             return params, new_states, opt_state, loss

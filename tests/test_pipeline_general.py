@@ -707,6 +707,33 @@ class TestPipelinedGraph:
         with pytest.raises(AssertionError, match="gradient normalization"):
             PipelinedGraph(g2.build(), mesh)
 
+    @pytest.mark.parametrize("shape,axes", [((4,), ("stage",)),
+                                            ((2, 2), ("data", "stage"))])
+    def test_graph_1f1b_matches_gpipe(self, shape, axes):
+        """The ResNet50 graph under BOTH schedules: identical loss,
+        post-update params, and final BN running stats (incl. the
+        data-axis grad psum / stats pmean path)."""
+        from deeplearning4j_tpu.parallel.pipeline_general import \
+            PipelinedGraph
+        conf = self._resnet_conf()
+        mesh = Mesh(np.array(jax.devices()[:int(np.prod(shape))])
+                    .reshape(shape), axes)
+        pgp = PipelinedGraph(conf, mesh, n_microbatches=2).init()
+        pf = PipelinedGraph(conf, mesh, n_microbatches=2,
+                            schedule="1f1b")
+        pf.init(from_params=pgp.unpack(), from_state=pgp.unpack_state())
+        rs = np.random.RandomState(3)
+        x, y = self._data(rs)
+        lg = float(pgp.step(x, y))
+        lf = float(pf.step(x, y))
+        assert abs(lg - lf) < 5e-5, (lg, lf)
+        np.testing.assert_allclose(
+            jax.device_get(pgp.params["stages"]),
+            jax.device_get(pf.params["stages"]), atol=2e-5)
+        np.testing.assert_allclose(
+            jax.device_get(pgp.state["stages"]),
+            jax.device_get(pf.state["stages"]), atol=1e-5)
+
     def test_graph_sharded_checkpoint_roundtrip(self, tmp_path):
         """PipelinedGraph through the orbax trainer lifecycle: BN slab +
         params + opt state + iteration restore, next step matches the
