@@ -341,6 +341,17 @@ _DICT = _build_dictionary()
 _MAX_WORD = max(len(w) for w in _DICT)
 
 
+# generated-conjugation-row cost offsets over the dictionary form's cost
+# (ambiguity knobs: cheap rows segment more conjugations but over-split
+# ordinary text; values are tuned against the genuine corpora and pinned
+# by test_ja_external's floors)
+_OFF_MIZEN = 300    # godan a-column stem (書か)
+_OFF_RENYO = 200    # godan i-column stem (書き)
+_OFF_KATEI = 400    # godan e-column stem (書け)
+_OFF_ADJ_KU = 200   # i-adjective 〜く / 〜かっ rows
+_OFF_ADJ_RARE = 500  # i-adjective 〜かろ / 〜けれ rows
+
+
 def _build_ipadic_variant():
     """Derive the IPADIC-convention dictionary from the bundled one.
 
@@ -446,24 +457,25 @@ def _build_ipadic_variant():
                 # dictionary-form verb: generate IPADIC conjugation rows.
                 # ichidan (stem already a dictionary VERB row, 食べ) needs
                 # none; godan gets mizenkei (書か), renyoukei (書き) and
-                # kateikei/meireikei (書け) stems
+                # kateikei/meireikei (書け) stems. Offsets empirically
+                # tuned on the genuine corpora (test_ja_external floors).
                 add(w, cost, cls)
                 stem = w[:-1]
                 is_ichidan = w[-1] == "る" and any(
                     k == VERB for _c, k in _DICT.get(stem, ()))
                 if not is_ichidan and stem:
-                    add(stem + _A_COL[w[-1]], cost + 300, VERB)
-                    add(stem + _I_COL[w[-1]], cost + 200, VERB)
-                    add(stem + _E_COL[w[-1]], cost + 400, VERB)
+                    add(stem + _A_COL[w[-1]], cost + _OFF_MIZEN, VERB)
+                    add(stem + _I_COL[w[-1]], cost + _OFF_RENYO, VERB)
+                    add(stem + _E_COL[w[-1]], cost + _OFF_KATEI, VERB)
                 continue
             if cls == ADJ and w.endswith("い") and len(w) >= 2:
                 # i-adjective: 高く / 高かっ / 高かろ / 高けれ rows
                 add(w, cost, cls)
                 stem = w[:-1]
-                add(stem + "く", cost + 200, ADJ)
-                add(stem + "かっ", cost + 200, ADJ)
-                add(stem + "かろ", cost + 500, ADJ)
-                add(stem + "けれ", cost + 500, ADJ)
+                add(stem + "く", cost + _OFF_ADJ_KU, ADJ)
+                add(stem + "かっ", cost + _OFF_ADJ_KU, ADJ)
+                add(stem + "かろ", cost + _OFF_ADJ_RARE, ADJ)
+                add(stem + "けれ", cost + _OFF_ADJ_RARE, ADJ)
                 continue
             add(w, cost, cls)
 
