@@ -125,8 +125,34 @@ class ParallelTrainer:
         self.state = None
         self.opt_state = None
         self.iteration = 0
+        self.epoch = 0
         self.score_value = None
+        self.listeners = []
         self._rng = jax.random.PRNGKey(net.conf.seed)
+
+    def add_listener(self, listener):
+        """Attach a TrainingListener fired once per fit() iteration plus
+        on_epoch_end per epoch (reference: ParallelWrapper.setListeners —
+        score/stats listeners observe the parallel fit exactly as they
+        observe a plain net.fit). NOTE: firing needs the loss on host, so
+        each iteration pays one device sync — attach listeners only when
+        you want the telemetry (the bare step() loop stays sync-free)."""
+        self.listeners.append(listener)
+        return self
+
+    def num_params(self):
+        return self.net.num_params()
+
+    @property
+    def conf(self):
+        return self.net.conf
+
+    def output(self, x, mask=None):
+        """Inference through the trained params (EvaluativeListener and
+        friends call this on the model they observe): sync the latest
+        mesh params into the wrapped net, then run its output."""
+        self.sync_to_net()
+        return self.net.output(x, mask=mask)
 
     def init(self, rng=None):
         params, state = self.net.init(rng)
@@ -230,6 +256,12 @@ class ParallelTrainer:
                     continue
                 last = self.step(bx, by, mask=bm)
                 steps += 1
+                if self.listeners:
+                    # post-increment 1-based index + one host sync, the
+                    # MultiLayerNetwork.fit firing convention exactly
+                    score = float(last)
+                    for li in self.listeners:
+                        li.iteration_done(self, self.iteration, score)
             if steps == 0 and epoch == 0:
                 raise ValueError(
                     "no trainable batches: every batch's leading dim must "
@@ -240,6 +272,9 @@ class ParallelTrainer:
                 raise ValueError(
                     f"input exhausted before epoch {epoch + 1}: pass a "
                     "resettable DataSetIterator (or arrays) for epochs>1")
+            for li in self.listeners:
+                li.on_epoch_end(self)
+            self.epoch += 1
         if self.examples_dropped:
             warnings.warn(f"ParallelTrainer.fit dropped "
                           f"{self.examples_dropped} examples in ragged "

@@ -210,7 +210,22 @@ class PipelinedNetwork:
         self.opt_state = None
         self._step_fn = None
         self.iteration = 0
+        self.listeners = []
         self._rng = jax.random.PRNGKey(self.seed)
+
+    def add_listener(self, listener):
+        """TrainingListener fired after every step (reference:
+        ParallelWrapper.setListeners). Firing syncs the loss to host —
+        attach only when the telemetry is wanted. (Param-stat listeners
+        see the packed stage slab, whose zero padding dilutes per-param
+        statistics; num_params() reports the true unpadded count.)"""
+        self.listeners.append(listener)
+        return self
+
+    def num_params(self):
+        """True (unpadded) parameter count — the packed [S, Lmax] slab
+        carries zero padding up to the largest stage."""
+        return self._n_params
 
     # -- packing ---------------------------------------------------------
     def _init_trees(self, rng):
@@ -231,6 +246,7 @@ class PipelinedNetwork:
         lmax = max(max(sizes), 1)
         buf = jnp.stack([jnp.pad(f, (0, lmax - f.shape[0])) for f in flats])
         self._unflats = unflats
+        self._n_params = sum(sizes)
         return buf
 
     def _pack_state(self, layer_states):
@@ -655,6 +671,10 @@ class PipelinedNetwork:
             self.params, self.state, self.opt_state, x, y, self.iteration,
             step_key, mask)
         self.iteration += 1
+        if self.listeners:
+            score = float(loss)  # one host sync, shared by all listeners
+            for li in self.listeners:
+                li.iteration_done(self, self.iteration, score)
         return loss
 
 
@@ -757,6 +777,19 @@ class PipelinedGraph:
         self.opt_state = None
         self._step_fn = None
         self.iteration = 0
+        self.listeners = []
+
+    def add_listener(self, listener):
+        """TrainingListener fired after every step (reference:
+        ParallelWrapper.setListeners). Firing syncs the loss to host —
+        attach only when the telemetry is wanted. (Param-stat listeners
+        see the packed stage slab; num_params() is the true count.)"""
+        self.listeners.append(listener)
+        return self
+
+    def num_params(self):
+        """True (unpadded) parameter count of the packed stage slab."""
+        return self._n_params
 
     # -- structure -------------------------------------------------------
     def _compute_boundaries(self):
@@ -798,6 +831,7 @@ class PipelinedGraph:
         buf = jnp.stack([jnp.pad(f, (0, lmax - f.shape[0]))
                          for f in flats])
         self._unflats = unflats
+        self._n_params = sum(sizes)
         return buf
 
     def _pack_state(self, vertex_states):
@@ -1077,4 +1111,8 @@ class PipelinedGraph:
         self.params, self.state, self.opt_state, loss = self._step_fn(
             self.params, self.state, self.opt_state, x, y, self.iteration)
         self.iteration += 1
+        if self.listeners:
+            score = float(loss)  # one host sync, shared by all listeners
+            for li in self.listeners:
+                li.iteration_done(self, self.iteration, score)
         return loss

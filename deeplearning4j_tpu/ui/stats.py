@@ -83,11 +83,26 @@ class StatsListener(TrainingListener):
         self._init_posted = False
 
     def _post_init(self, model):
+        # duck-typed over everything that fires iteration_done: plain
+        # networks expose num_params()/conf, the parallel trainers and
+        # pipeline classes expose a params pytree (ParallelWrapper's
+        # setListeners routed the same listener family)
+        if model.params is None:
+            n_params = 0
+        elif hasattr(model, "num_params"):
+            n_params = model.num_params()
+        else:
+            import jax
+            n_params = int(sum(
+                np.prod(l.shape)
+                for l in jax.tree_util.tree_leaves(model.params)))
+        conf = getattr(model, "conf",
+                       getattr(getattr(model, "net", None), "conf", None))
         info = {"type": "init", "session": self.session_id,
                 "time": time.time(),
-                "num_params": model.num_params() if model.params is not None else 0,
-                "num_layers": len(getattr(model.conf, "layers", ())) or
-                len(getattr(model.conf, "vertices", ()))}
+                "num_params": n_params,
+                "num_layers": len(getattr(conf, "layers", ())) or
+                len(getattr(conf, "vertices", ()))}
         # hardware info (reference: system tab's JVM/hardware section)
         try:
             import platform

@@ -97,6 +97,53 @@ class TestDataParallel:
         assert out.shape == (32, 2)
 
 
+class TestParallelListeners:
+    def test_trainer_fires_listeners_and_feeds_the_dashboard(
+            self, eight_devices):
+        """ParallelWrapper.setListeners role: score listeners and the
+        stats pipeline observe a parallel fit exactly as a plain
+        net.fit (reference: ParallelWrapper.java setListeners routing
+        to the UI's StatsStorage)."""
+        from deeplearning4j_tpu.nn.listeners import CollectScoresListener
+        from deeplearning4j_tpu.ui.stats import StatsListener
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+        x, y = _data(64)
+        trainer = ParallelTrainer(_net(), make_mesh(MeshSpec(data=8)))
+        trainer.init()
+        coll = CollectScoresListener()
+        storage = InMemoryStatsStorage()
+        trainer.add_listener(coll)
+        trainer.add_listener(StatsListener(storage, session_id="pw"))
+        from deeplearning4j_tpu.nn.listeners import EvaluativeListener
+        ev = EvaluativeListener(x[:16], y[:16], frequency=2)
+        trainer.add_listener(ev)
+        trainer.fit(x, y, epochs=3, batch_size=32)
+        assert len(coll.scores) == 6  # 2 batches x 3 epochs
+        recs = storage.get_records(type_="stats")
+        assert len(recs) == 6 and all("score" in r for r in recs)
+        # 1-based firing, matching plain net.fit: iterations 1..6 fired,
+        # EvaluativeListener hit at 2/4/6 through trainer.output()
+        assert coll.iterations == [1, 2, 3, 4, 5, 6]
+        assert len(ev.results) == 3
+        # epoch hooks reached the stats pipeline too
+        assert len(storage.get_records(type_="epoch_end")) == 3
+
+    def test_pipelined_network_fires_listeners(self):
+        from deeplearning4j_tpu.nn.listeners import CollectScoresListener
+        from deeplearning4j_tpu.parallel.pipeline_general import \
+            PipelinedNetwork
+        from jax.sharding import Mesh
+        conf = _net().conf
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2,), ("stage",))
+        pn = PipelinedNetwork(conf, mesh, n_microbatches=2).init()
+        coll = CollectScoresListener()
+        pn.add_listener(coll)
+        x, y = _data(8)
+        for _ in range(3):
+            pn.step(x.astype(np.float32), y.astype(np.float32))
+        assert len(coll.scores) == 3
+
+
 class TestParallelInference:
     def test_output_matches_direct(self):
         net = _net()
