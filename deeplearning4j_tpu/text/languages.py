@@ -278,12 +278,21 @@ class ChineseTokenizerFactory(_CjkTokenizerFactoryBase):
     default_lexicon = _ZH_LEXICON
 
     def __init__(self, lexicon=None, preprocessor=None, max_word_len=8,
-                 mode="lattice", use_default_lexicon=True):
+                 mode="lattice", use_default_lexicon=True,
+                 merge_num_quantifier=False):
         super().__init__(lexicon=lexicon, preprocessor=preprocessor,
                          max_word_len=max_word_len,
                          use_default_lexicon=use_default_lexicon)
         if mode not in ("lattice", "maxmatch"):
             raise ValueError(f"unknown mode {mode!r}")
+        #: ansj's optional NumRecognition (数量词合并): numeral + measure
+        #: word fuse into one token — a lattice-path feature (the merge
+        #: uses the Viterbi classes), so a maxmatch factory can't honor it
+        if merge_num_quantifier and (mode != "lattice"
+                                     or not use_default_lexicon):
+            raise ValueError("merge_num_quantifier requires the lattice "
+                             "mode (with its bundled dictionary)")
+        self.merge_num_quantifier = merge_num_quantifier
         # same contract as the Japanese factory: without its bundled
         # dictionary a lattice cannot run, so that request means maxmatch
         self.mode = mode if use_default_lexicon else "maxmatch"
@@ -297,7 +306,9 @@ class ChineseTokenizerFactory(_CjkTokenizerFactoryBase):
         if self.mode == "lattice":
             from deeplearning4j_tpu.text import zh_lattice
             return self._lattice_create(
-                text, zh_lattice.tokenize(text, merged=self._merged))
+                text, zh_lattice.tokenize(
+                    text, merged=self._merged,
+                    merge_num_quantifier=self.merge_num_quantifier))
         return super().create(text)
 
 

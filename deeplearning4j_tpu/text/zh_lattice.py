@@ -404,10 +404,14 @@ def load_ansj_core_dic(path=ANSJ_CORE_DIC, merge_bundled=True):
     return out
 
 
-def tokenize(text, user_entries=None, merged=None):
+def tokenize(text, user_entries=None, merged=None,
+             merge_num_quantifier=False):
     """Viterbi lattice segmentation. Returns the token list (whitespace
     dropped). ``user_entries``: one-off lexicon merge (see
-    ``merge_entries`` for the cached form callers in loops should use)."""
+    ``merge_entries`` for the cached form callers in loops should use).
+    ``merge_num_quantifier``: ansj's optional NumRecognition pass —
+    an adjacent numeral + measure-word pair fuses into one token
+    (三 + 点 -> 三点), matching ansj's 数量词合并 recognition."""
     dic, max_w = merged if merged is not None else merge_entries(user_entries)
 
     text = unicodedata.normalize("NFKC", text)
@@ -442,7 +446,18 @@ def tokenize(text, user_entries=None, merged=None):
     toks = []
     while pos > 0:
         _, prev, pcls, surface = best[pos][cls]
-        toks.append(surface)
+        toks.append((surface, cls))
         pos, cls = prev, pcls
     toks.reverse()
-    return [t for t in toks if t.strip()]
+    if merge_num_quantifier:
+        merged_toks, i = [], 0
+        while i < len(toks):
+            if (i + 1 < len(toks) and toks[i][1] == NUM
+                    and toks[i + 1][1] == MEAS):
+                merged_toks.append((toks[i][0] + toks[i + 1][0], NUM))
+                i += 2
+            else:
+                merged_toks.append(toks[i])
+                i += 1
+        toks = merged_toks
+    return [t for t, _c in toks if t.strip()]
