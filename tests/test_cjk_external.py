@@ -26,6 +26,7 @@ pytestmark = pytest.mark.skipif(
 
 
 def _genuine():
+    # parsed once per process: load_ansj_core_dic caches by path
     from deeplearning4j_tpu.text import zh_lattice
     return zh_lattice.load_ansj_core_dic(CORE_DIC)
 
@@ -38,6 +39,9 @@ def _spans(tokens):
     return out
 
 
+@pytest.mark.slow  # genuine-fixture tier: 85k-dict Viterbi legs (the
+# Korean class below stays in the smoke tier — it never touches the
+# dictionary; same per-leg tiering as test_ja_external's corpus tests)
 class TestChineseGenuineDictionary:
     def test_loads_the_full_core_dic(self):
         dic, max_w = _genuine()
