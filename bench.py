@@ -55,8 +55,23 @@ BASELINES = {
 PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
 
 
+_write_jsonl = None
+
+
 def _emit(rec):
-    print(json.dumps(rec), flush=True)
+    """One JSON record per line on stdout, via the telemetry JSONL writer
+    (one schema, one serializer for every machine-readable artifact). The
+    import is lazy and guarded: bench must produce numbers even if the
+    package is mid-refactor."""
+    global _write_jsonl
+    if _write_jsonl is None:
+        try:
+            from deeplearning4j_tpu.telemetry.registry import (
+                write_jsonl as _write_jsonl)
+        except Exception:
+            def _write_jsonl(r, stream=None):
+                print(json.dumps(r, default=str), flush=True)
+    _write_jsonl(rec)
 
 
 def _probe_backend(timeout_s=120, retries=2):

@@ -79,6 +79,21 @@ def _build_parser():
 
     b = sub.add_parser("bench", help="run a BASELINE.md bench config")
     b.add_argument("config", nargs="?", default="all")
+
+    tl = sub.add_parser(
+        "telemetry",
+        help="dump a metrics snapshot (local registry, or scrape a "
+             "running server's /metrics)")
+    tl.add_argument("--url",
+                    help="scrape this /metrics endpoint (e.g. "
+                         "http://127.0.0.1:9000/metrics) instead of the "
+                         "local registry")
+    tl.add_argument("--format", choices=("prom", "json", "jsonl"),
+                    default="prom",
+                    help="local-registry output format (scrapes are always "
+                         "the server's Prometheus text)")
+    tl.add_argument("--chrome-trace",
+                    help="also export the host-span Chrome trace JSON here")
     return p
 
 
@@ -241,6 +256,45 @@ def _cmd_eval(args):
     return 0
 
 
+def _cmd_telemetry(args):
+    """Dump the unified telemetry snapshot — the 'what is this process (or
+    that server) doing right now' CLI verb."""
+    import json
+
+    from deeplearning4j_tpu import telemetry
+
+    if args.url:
+        if args.chrome_trace:
+            raise SystemExit(
+                "--chrome-trace cannot be combined with --url: the host-span "
+                "tracer lives in the traced process, and this fresh CLI "
+                "process has recorded nothing — export the trace from the "
+                "instrumented process instead "
+                "(telemetry.get_tracer().export(path)).")
+        import urllib.request
+        with urllib.request.urlopen(args.url, timeout=10) as r:
+            sys.stdout.write(r.read().decode())
+    else:
+        reg = telemetry.get_registry()
+        if not any(m["series"] for m in reg.snapshot().values()):
+            # a fresh CLI process has recorded nothing — say so instead of
+            # letting an empty dump read as "telemetry is broken"
+            print("note: local registry is empty (each process has its "
+                  "own); run instrumented work in THIS process, or scrape "
+                  "a live server with --url http://host:port/metrics",
+                  file=sys.stderr)
+        if args.format == "json":
+            print(json.dumps(reg.snapshot(), indent=1, default=str))
+        elif args.format == "jsonl":
+            reg.to_jsonl(sys.stdout)
+        else:
+            sys.stdout.write(reg.to_prometheus())
+    if args.chrome_trace:
+        path = telemetry.get_tracer().export(args.chrome_trace)
+        print(f"chrome trace: {path}", file=sys.stderr)
+    return 0
+
+
 def main(argv=None):
     args = _build_parser().parse_args(argv)
     if args.command == "train":
@@ -251,6 +305,8 @@ def main(argv=None):
         return _cmd_bench(args)
     if args.command == "eval":
         return _cmd_eval(args)
+    if args.command == "telemetry":
+        return _cmd_telemetry(args)
     return 1
 
 

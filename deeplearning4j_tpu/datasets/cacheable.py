@@ -21,7 +21,14 @@ import shutil
 import tarfile
 import zipfile
 
+from deeplearning4j_tpu import telemetry as _tm
 from deeplearning4j_tpu.datasets import fetchers as _f
+
+
+def _cache_counter():
+    return _tm.get_registry().counter(
+        "dataset_cache_requests_total",
+        "dataset cache lookups, labeled outcome=hit|miss")
 
 
 class ChecksumError(RuntimeError):
@@ -50,6 +57,7 @@ def ensure_file(relpath, url=None, md5=None, root=None):
     root = root or _f.data_dir()
     path = os.path.join(root, relpath)
     if not os.path.exists(path):
+        _cache_counter().inc(outcome="miss")
         if url is None or not downloads_allowed():
             raise FileNotFoundError(
                 f"Dataset file {relpath} not found under {root}. This "
@@ -59,8 +67,11 @@ def ensure_file(relpath, url=None, md5=None, root=None):
         os.makedirs(os.path.dirname(path), exist_ok=True)
         import urllib.request
         tmp = path + ".part"
-        urllib.request.urlretrieve(url, tmp)
+        with _tm.span("etl.download", file=relpath):
+            urllib.request.urlretrieve(url, tmp)
         os.replace(tmp, path)
+    else:
+        _cache_counter().inc(outcome="hit")
     if md5 is not None:
         # memoize verification in a sidecar marker so repeated fetcher
         # construction doesn't re-hash multi-GB archives every call; the
@@ -73,7 +84,8 @@ def ensure_file(relpath, url=None, md5=None, root=None):
             with open(marker) as f:
                 if f.read().strip() == stamp:
                     return path
-        got = _md5(path)
+        with _tm.span("etl.checksum", file=relpath):
+            got = _md5(path)
         if got != md5:
             os.remove(path)
             if os.path.exists(marker):

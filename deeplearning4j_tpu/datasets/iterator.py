@@ -17,9 +17,12 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
+import time
 
 import jax
 import numpy as np
+
+from deeplearning4j_tpu import telemetry as _tm
 
 
 @dataclasses.dataclass
@@ -112,6 +115,16 @@ class AsyncDataSetIterator(DataSetIterator):
         self._queue = None
         self._thread = None
         self._error = None
+        reg = self._reg = _tm.get_registry()
+        # fetch stall = time the TRAINING thread spent blocked waiting for
+        # the prefetcher — the "where the MFU target is usually lost" series
+        self._m_stall = reg.histogram(
+            "etl_fetch_stall_seconds",
+            "consumer time blocked waiting on the prefetch queue")
+        self._m_batches = reg.counter(
+            "etl_batches_total", "batches delivered by async prefetch")
+        self._m_depth = reg.gauge(
+            "etl_queue_depth", "prefetched batches ready in the queue")
 
     @property
     def batch_size(self):
@@ -142,11 +155,13 @@ class AsyncDataSetIterator(DataSetIterator):
     def _producer(self):
         try:
             while True:
-                try:
-                    ds = next(self.base)
-                except StopIteration:
-                    break
-                self._queue.put(self._put_device(ds))
+                with _tm.span("etl.prefetch"):
+                    try:
+                        ds = next(self.base)
+                    except StopIteration:
+                        break
+                    item = self._put_device(ds)
+                self._queue.put(item)
         except Exception as e:  # surfaced on the consumer side
             self._error = e
         finally:
@@ -155,11 +170,19 @@ class AsyncDataSetIterator(DataSetIterator):
     def __next__(self):
         if self._queue is None:
             self.reset()
-        item = self._queue.get()
+        if self._reg.enabled:
+            t0 = time.perf_counter()
+            item = self._queue.get()
+            self._m_stall.observe(time.perf_counter() - t0)
+            self._m_depth.set(self._queue.qsize())
+        else:
+            item = self._queue.get()
         if item is _SENTINEL:
             if self._error is not None:
                 raise self._error
             raise StopIteration
+        if self._reg.enabled:
+            self._m_batches.inc()
         return item
 
     def _shutdown(self):

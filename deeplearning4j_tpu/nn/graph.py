@@ -28,7 +28,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import telemetry as _tm
 from deeplearning4j_tpu.nn import gradnorm as _gradnorm
+from deeplearning4j_tpu.nn import listeners as _listeners
 from deeplearning4j_tpu.nn import updaters as _updaters
 from deeplearning4j_tpu.nn.conf import inputs as _inputs
 from deeplearning4j_tpu.nn.layers import base as _base_layers
@@ -918,31 +920,66 @@ class ComputationGraph:
             self._train_step = self.make_train_step()
         n = next(iter(inputs.values())).shape[0]
         bs = batch_size or n
-        for _ in range(epochs):
-            for l in self.listeners:
-                l.on_epoch_start(self)
-            for i in range(0, n, bs):
-                bi = {k: v[i:i + bs] for k, v in inputs.items()}
-                bl = {k: v[i:i + bs] for k, v in labels.items()}
-                bm = mask[i:i + bs] if mask is not None else None
-                if use_tbptt:   # TBPTT per minibatch, as MLN
-                    self._fit_tbptt(bi, bl, bm)
-                    continue
-                bi = {k: jnp.asarray(v) for k, v in bi.items()}
-                bl = {k: jnp.asarray(v) for k, v in bl.items()}
-                bm = jnp.asarray(bm) if bm is not None else None
-                self._rng, sub = jax.random.split(self._rng)
-                (self.params, self.state, self.opt_state,
-                 loss) = self._train_step(
-                    self.params, self.state, self.opt_state, bi, bl,
-                    self.iteration, sub, bm)
-                self.score_value = loss  # device scalar; float() on demand
-                self.iteration += 1
-                for l in self.listeners:
-                    l.iteration_done(self, self.iteration, float(loss))
-            for l in self.listeners:
-                l.on_epoch_end(self)
-            self.epoch += 1
+        reg, step_h, etl_h, iters_c, score_g = _tm.train_metrics()
+        try:
+            with _tm.span("fit", net=type(self).__name__):
+                for _ in range(epochs):
+                    for l in self.listeners:
+                        l.on_epoch_start(self)
+                    for i in range(0, n, bs):
+                        bi = {k: v[i:i + bs] for k, v in inputs.items()}
+                        bl = {k: v[i:i + bs] for k, v in labels.items()}
+                        bm = mask[i:i + bs] if mask is not None else None
+                        if use_tbptt:   # TBPTT per minibatch, as MLN
+                            t_tb = time.perf_counter()
+                            with _tm.span("fit.step", tbptt=True):
+                                tb_score = self._fit_tbptt(bi, bl, bm)
+                            if reg.enabled:
+                                # one macro-batch = one recorded step, the
+                                # same granularity as the MLN TBPTT branch
+                                step_h.observe(time.perf_counter() - t_tb)
+                                iters_c.inc()
+                                score_g.set(tb_score)
+                            continue
+                        etl_start = time.perf_counter()
+                        with _tm.span("fit.etl"):
+                            bi = {k: jnp.asarray(v) for k, v in bi.items()}
+                            bl = {k: jnp.asarray(v) for k, v in bl.items()}
+                            bm = jnp.asarray(bm) if bm is not None else None
+                        etl_time = time.perf_counter() - etl_start
+                        # for PerformanceListener batch-size inference +
+                        # activation-visualizing listeners (MLN convention)
+                        self.last_input = next(iter(bi.values()))
+                        score = None
+                        rec = reg.enabled  # one read: a mid-iteration
+                        # enable() must not see half-initialized locals
+                        with _tm.span("fit.step", iteration=self.iteration):
+                            self._rng, sub = jax.random.split(self._rng)
+                            (self.params, self.state, self.opt_state,
+                             loss) = self._train_step(
+                                self.params, self.state, self.opt_state, bi, bl,
+                                self.iteration, sub, bm)
+                            self.score_value = loss  # device scalar
+                            self.iteration += 1
+                            if rec:
+                                score = float(loss)  # sync inside the span
+                        if rec:
+                            step_h.observe(time.perf_counter() - etl_start
+                                           - etl_time)
+                            etl_h.observe(etl_time)
+                            iters_c.inc()
+                            score_g.set(score)
+                        if self.listeners:
+                            if score is None:
+                                score = float(loss)
+                            for l in self.listeners:
+                                l.iteration_done(self, self.iteration, score,
+                                                 etl_time)
+                    for l in self.listeners:
+                        l.on_epoch_end(self)
+                    self.epoch += 1
+        finally:
+            _listeners.run_fit_end_hooks(self)
         return self
 
     def output(self, inputs, mask=None):

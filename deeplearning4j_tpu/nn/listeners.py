@@ -15,6 +15,22 @@ import time
 logger = logging.getLogger("deeplearning4j_tpu")
 
 
+def run_fit_end_hooks(model):
+    """Invoke every listener's on_fit_end from the fit loops' finally
+    blocks. Each hook is isolated: a raising cleanup must neither mask the
+    original training exception nor starve later listeners of THEIR
+    cleanup (the hook exists to release resources like an open profiler
+    trace — leaking the rest of the list would defeat it)."""
+    for l in getattr(model, "listeners", ()):
+        hook = getattr(l, "on_fit_end", None)
+        if callable(hook):
+            try:
+                hook(model)
+            except Exception:
+                logger.warning("on_fit_end failed for %s",
+                               type(l).__name__, exc_info=True)
+
+
 class TrainingListener:
     def on_epoch_start(self, model):
         pass
@@ -24,6 +40,11 @@ class TrainingListener:
 
     def iteration_done(self, model, iteration, score, etl_time=0.0):
         pass
+
+    def on_fit_end(self, model):
+        """Invoked by the fit loops in a ``finally`` block — fires whether
+        fit() completed, returned early, or raised. Listeners holding open
+        resources (a profiler trace window, a file) release them here."""
 
 
 class ScoreIterationListener(TrainingListener):
@@ -49,19 +70,29 @@ class PerformanceListener(TrainingListener):
         self._last = None
         self.records = []
 
+    @staticmethod
+    def _infer_batch_size(model):
+        """Leading dim of the batch the fit loop just consumed (both fit
+        loops stash it as ``last_input``) — so samples/sec reports without
+        an explicit report_batch_size instead of being silently omitted."""
+        x = getattr(model, "last_input", None)
+        shape = getattr(x, "shape", None)
+        return shape[0] if shape else None
+
     def iteration_done(self, model, iteration, score, etl_time=0.0):
         now = time.perf_counter()
         if self._last is not None:
             dt = now - self._last
+            bs = self.batch_size or self._infer_batch_size(model)
             rec = {"iteration": iteration, "iter_time_s": dt, "etl_time_s": etl_time,
                    "batches_per_sec": 1.0 / dt if dt > 0 else 0.0}
-            if self.batch_size:
-                rec["samples_per_sec"] = self.batch_size / dt if dt > 0 else 0.0
+            if bs:
+                rec["samples_per_sec"] = bs / dt if dt > 0 else 0.0
             self.records.append(rec)
             if iteration % self.frequency == 0:
                 self.print_fn(
                     f"iteration {iteration}: {dt * 1e3:.2f} ms/iter"
-                    + (f", {rec.get('samples_per_sec', 0):.1f} samples/sec" if self.batch_size else "")
+                    + (f", {rec.get('samples_per_sec', 0):.1f} samples/sec" if bs else "")
                     + f", etl {etl_time * 1e3:.2f} ms")
         self._last = now
 
@@ -128,12 +159,17 @@ class ProfilerListener(TrainingListener):
     """
 
     def __init__(self, log_dir, *, start_iteration=10, n_iterations=5,
-                 memory_profile=False, print_fn=None):
+                 memory_profile=False, print_fn=None,
+                 close_on_fit_end=True):
         self.log_dir = str(log_dir)
         self.start_iteration = start_iteration
         self.n_iterations = n_iterations
         self.memory_profile = memory_profile
         self.print_fn = print_fn or (lambda s: logger.info(s))
+        # close_on_fit_end=False lets one window span several fit() calls
+        # (fit-per-epoch loops, early stopping) — the caller then owns
+        # calling close(), and accepts the leak risk the default removes
+        self.close_on_fit_end = close_on_fit_end
         self._active = False
         self.completed = False
         self.traced_iterations = 0
@@ -169,6 +205,13 @@ class ProfilerListener(TrainingListener):
                         getattr(model, "params", []))[:1])
                 self.close()
 
+    def on_fit_end(self, model):
+        # fit() returned (or raised) before the trace window completed: a
+        # dangling jax.profiler.start_trace would leak the active trace
+        # session into the next fit/profile attempt
+        if self.close_on_fit_end:
+            self.close()
+
     def close(self):
         """Stop the trace. Called automatically when the window completes;
         call explicitly if training can end before the window does."""
@@ -183,6 +226,11 @@ class ProfilerListener(TrainingListener):
             prof = jax.profiler.device_memory_profile()
             with open(os.path.join(self.log_dir, "memory.pprof"), "wb") as f:
                 f.write(prof)
+        truncated = ("" if self.traced_iterations >= self.n_iterations
+                     else f" (window truncated: {self.n_iterations} "
+                          f"requested; pass close_on_fit_end=False to span "
+                          f"multiple fit() calls)")
         self.print_fn(
             f"profiler trace: {self.traced_iterations} iterations in "
-            f"{time.perf_counter() - self._t0:.2f}s -> {self.log_dir}")
+            f"{time.perf_counter() - self._t0:.2f}s -> {self.log_dir}"
+            + truncated)

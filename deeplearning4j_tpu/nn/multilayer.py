@@ -29,7 +29,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import telemetry as _tm
 from deeplearning4j_tpu.nn import gradnorm as _gradnorm
+from deeplearning4j_tpu.nn import listeners as _listeners
 from deeplearning4j_tpu.nn.conf import inputs as _inputs
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers import base as _base
@@ -319,32 +321,59 @@ class MultiLayerNetwork:
             self.init()
         if self._train_step is None:
             self._train_step = self.make_train_step()
-        for _ in range(epochs):
-            for l in self.listeners:
-                l.on_epoch_start(self)
-            batches = self._batches(data, labels, batch_size, mask)
-            for batch in batches:
-                x, y, m = batch
-                etl_start = time.perf_counter()
-                x, y = jnp.asarray(x), jnp.asarray(y)
-                m = jnp.asarray(m) if m is not None else None
-                etl_time = time.perf_counter() - etl_start
-                self.last_input = x  # for activation-visualizing listeners
-                if (self.conf.backprop_type == "tbptt" and x.ndim == 3
-                        and y.ndim == 3 and x.shape[1] > self.conf.tbptt_fwd_length):
-                    loss = self._fit_tbptt(x, y, m)
-                else:
-                    self._rng, step_rng = jax.random.split(self._rng)
-                    self.params, self.state, self.opt_state, loss = self._train_step(
-                        self.params, self.state, self.opt_state, x, y,
-                        self.iteration, step_rng, m)
-                    self.score_value = loss
-                    self.iteration += 1
-                for l in self.listeners:
-                    l.iteration_done(self, self.iteration, float(loss), etl_time)
-            for l in self.listeners:
-                l.on_epoch_end(self)
-            self.epoch += 1
+        reg, step_h, etl_h, iters_c, score_g = _tm.train_metrics()
+        try:
+            with _tm.span("fit", net=type(self).__name__):
+                for _ in range(epochs):
+                    for l in self.listeners:
+                        l.on_epoch_start(self)
+                    batches = self._batches(data, labels, batch_size, mask)
+                    for batch in batches:
+                        x, y, m = batch
+                        etl_start = time.perf_counter()
+                        with _tm.span("fit.etl"):
+                            x, y = jnp.asarray(x), jnp.asarray(y)
+                            m = jnp.asarray(m) if m is not None else None
+                        etl_time = time.perf_counter() - etl_start
+                        self.last_input = x  # for activation-visualizing listeners
+                        step_start = etl_start + etl_time
+                        score = None
+                        rec = reg.enabled  # one read: a mid-iteration
+                        # enable() must not see half-initialized locals
+                        with _tm.span("fit.step", iteration=self.iteration):
+                            if (self.conf.backprop_type == "tbptt" and x.ndim == 3
+                                    and y.ndim == 3
+                                    and x.shape[1] > self.conf.tbptt_fwd_length):
+                                loss = self._fit_tbptt(x, y, m)
+                            else:
+                                self._rng, step_rng = jax.random.split(self._rng)
+                                self.params, self.state, self.opt_state, loss = \
+                                    self._train_step(
+                                        self.params, self.state, self.opt_state,
+                                        x, y, self.iteration, step_rng, m)
+                                self.score_value = loss
+                                self.iteration += 1
+                            if rec:
+                                # sync INSIDE the span so step time covers the
+                                # device work, not just the async dispatch;
+                                # disabled, no host round-trip is added
+                                score = float(loss)
+                        if rec:
+                            step_h.observe(time.perf_counter() - step_start)
+                            etl_h.observe(etl_time)
+                            iters_c.inc()
+                            score_g.set(score)
+                        if self.listeners:
+                            if score is None:
+                                score = float(loss)
+                            for l in self.listeners:
+                                l.iteration_done(self, self.iteration, score,
+                                                 etl_time)
+                    for l in self.listeners:
+                        l.on_epoch_end(self)
+                    self.epoch += 1
+        finally:
+            _listeners.run_fit_end_hooks(self)
         return self
 
     def _batches(self, data, labels, batch_size, mask):

@@ -40,15 +40,18 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu import telemetry as _tm
 from deeplearning4j_tpu.native import codec as _codec
 from deeplearning4j_tpu.native.queue import FancyBlockingQueue
 from deeplearning4j_tpu.parallel import mesh as _mesh
+from deeplearning4j_tpu.utils import compat as _compat
 
 tree_map = jax.tree_util.tree_map
 
@@ -91,6 +94,19 @@ class TrainingMaster:
     # stats hook (reference: TrainingMaster.setCollectTrainingStats)
     def training_stats(self):
         return dict(self._stats) if hasattr(self, "_stats") else {}
+
+    @staticmethod
+    def _round_metrics():
+        """(registry, round_hist, rounds_counter) — per-round sync/averaging
+        time series shared by every master, split by a ``master`` label."""
+        reg = _tm.get_registry()
+        return (reg,
+                reg.histogram(
+                    "distributed_round_seconds",
+                    "wall time of one distributed round (local steps + "
+                    "parameter/gradient exchange), labeled by master"),
+                reg.counter("distributed_rounds_total",
+                            "distributed rounds executed, labeled by master"))
 
 
 def _stack_worker_dim(tree, n):
@@ -153,7 +169,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             return (ex(p), ex(s), ex(o),
                     jax.lax.pmean(jnp.mean(losses), "data"))
 
-        fn = jax.shard_map(
+        fn = _compat.shard_map(
             split_step, mesh=self.mesh,
             in_specs=(P("data"), P("data"), P("data"), P("data"), P("data"),
                       P(), P("data")),
@@ -183,6 +199,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         rng = jax.random.PRNGKey(net.conf.seed + 1)
         loss = None
         listeners = list(getattr(net, "listeners", []))
+        reg, round_h, rounds_c = self._round_metrics()
         rem = n % split_examples
         for ep in range(epochs):
             # rotate the window each epoch so a ragged tail is not always the
@@ -191,17 +208,29 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             self._stats["examples_dropped"] = self._stats.get(
                 "examples_dropped", 0) + rem
             for s0 in range(start, n - split_examples + 1, split_examples):
-                xs = np.asarray(data[s0:s0 + split_examples]).reshape(
-                    (w, f, b) + data.shape[1:])
-                ys = np.asarray(labels[s0:s0 + split_examples]).reshape(
-                    (w, f, b) + labels.shape[1:])
-                rng, *subs = jax.random.split(rng, w + 1)
-                rngs = _put(jnp.stack(subs), mesh, "data")
-                params, state, opt, loss = self._split_fn(
-                    params, state, opt,
-                    _put(jnp.asarray(xs), mesh, "data"),
-                    _put(jnp.asarray(ys), mesh, "data"),
-                    it0, rngs)
+                t_round = time.perf_counter()
+                with _tm.span("distributed.round",
+                              master="parameter_averaging"):
+                    xs = np.asarray(data[s0:s0 + split_examples]).reshape(
+                        (w, f, b) + data.shape[1:])
+                    ys = np.asarray(labels[s0:s0 + split_examples]).reshape(
+                        (w, f, b) + labels.shape[1:])
+                    rng, *subs = jax.random.split(rng, w + 1)
+                    rngs = _put(jnp.stack(subs), mesh, "data")
+                    params, state, opt, loss = self._split_fn(
+                        params, state, opt,
+                        _put(jnp.asarray(xs), mesh, "data"),
+                        _put(jnp.asarray(ys), mesh, "data"),
+                        it0, rngs)
+                    if reg.enabled:
+                        # block inside the span so the round time covers the
+                        # collective, not just the async dispatch; disabled,
+                        # no extra sync is added to the round loop
+                        jax.block_until_ready(loss)
+                if reg.enabled:
+                    round_h.observe(time.perf_counter() - t_round,
+                                    master="parameter_averaging")
+                    rounds_c.inc(master="parameter_averaging")
                 it0 += f
                 self._stats["splits"] += 1
                 self._stats["worker_steps"] += w * f
@@ -294,7 +323,7 @@ class SharedTrainingMaster(TrainingMaster):
             return (new_params, new_state, new_opt, resid, tau,
                     jax.lax.pmean(loss, "data"))
 
-        fn = jax.shard_map(
+        fn = _compat.shard_map(
             step, mesh=self.mesh,
             in_specs=(P(), P(), P(), P("data"), P(), P("data"), P("data"),
                       P(), P()),
@@ -323,19 +352,28 @@ class SharedTrainingMaster(TrainingMaster):
         it = int(getattr(net, "iteration", 0))  # resume-aware schedules
         loss = None
         listeners = list(getattr(net, "listeners", []))
+        reg, round_h, rounds_c = self._round_metrics()
         rem = n % step_examples
         for ep in range(epochs):
             start = (ep * rem) % (rem + 1) if rem else 0
             self._stats["examples_dropped"] = self._stats.get(
                 "examples_dropped", 0) + rem
             for s0 in range(start, n - step_examples + 1, step_examples):
-                x = jax.device_put(jnp.asarray(data[s0:s0 + step_examples]),
-                                   data_sh)
-                y = jax.device_put(jnp.asarray(labels[s0:s0 + step_examples]),
-                                   data_sh)
-                rng, sub = jax.random.split(rng)
-                params, state, opt, resid, tau, loss = self._step_fn(
-                    params, state, opt, resid, tau, x, y, it, sub)
+                t_round = time.perf_counter()
+                with _tm.span("distributed.round", master="shared"):
+                    x = jax.device_put(
+                        jnp.asarray(data[s0:s0 + step_examples]), data_sh)
+                    y = jax.device_put(
+                        jnp.asarray(labels[s0:s0 + step_examples]), data_sh)
+                    rng, sub = jax.random.split(rng)
+                    params, state, opt, resid, tau, loss = self._step_fn(
+                        params, state, opt, resid, tau, x, y, it, sub)
+                    if reg.enabled:
+                        jax.block_until_ready(loss)  # cover the all-reduce
+                if reg.enabled:
+                    round_h.observe(time.perf_counter() - t_round,
+                                    master="shared")
+                    rounds_c.inc(master="shared")
                 it += 1
                 self._stats["steps"] += 1
                 for l in listeners:  # per-step callback (forces a host sync)

@@ -15,12 +15,18 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import telemetry as _tm
 from deeplearning4j_tpu.parallel import mesh as _mesh
+
+#: fill-ratio buckets: eighths of the padded batch — "how much of each
+#: compiled max_batch forward was real work vs padding"
+_FILL_BUCKETS = tuple(i / 8.0 for i in range(1, 9))
 
 
 class ParallelInference:
@@ -48,6 +54,21 @@ class ParallelInference:
         self._queue: queue.Queue = queue.Queue()
         self._thread = None
         self._stop = threading.Event()
+        reg = self._reg = _tm.get_registry()
+        self._m_depth = reg.gauge(
+            "serving_queue_depth", "pending requests in the serving queue")
+        self._m_fill = reg.histogram(
+            "serving_batch_fill_ratio",
+            "fraction of each padded device batch holding real examples",
+            buckets=_FILL_BUCKETS)
+        self._m_latency = reg.histogram(
+            "serving_request_latency_seconds",
+            "request latency by mode (direct / batched / sequential)")
+        self._m_requests = reg.counter(
+            "serving_requests_total",
+            "examples: served (mode=direct/batched/sequential) and "
+            "enqueued (mode=queued); queued - batched - sequential = "
+            "failed or in flight")
 
     def _compile(self, net):
         """(net, fwd, fwd_one): the served model and its jitted forwards —
@@ -71,17 +92,34 @@ class ParallelInference:
 
     def output(self, x):
         """Direct batched inference (pads to max_batch internally)."""
+        enabled = self._reg.enabled
+        t0 = time.perf_counter() if enabled else 0.0
+        with _tm.span("serving.output"):
+            out = self._forward_padded(np.asarray(x))
+        if enabled:
+            self._m_latency.observe(time.perf_counter() - t0, mode="direct")
+            self._m_requests.inc(out.shape[0], mode="direct")
+            self._m_depth.set(self._queue.qsize())
+        return out
+
+    def _forward_padded(self, x):
+        """The padded chunk loop shared by output() and the batched worker;
+        observes per-chunk batch-fill so padding waste is a visible series."""
         net, fwd, _ = self._serving  # one atomic snapshot per call
-        x = np.asarray(x)
         n = x.shape[0]
         outs = []
         for i in range(0, n, self.max_batch):
             chunk = x[i:i + self.max_batch]
-            pad = self.max_batch - chunk.shape[0]
+            real = chunk.shape[0]
+            pad = self.max_batch - real
             if pad:
                 chunk = np.concatenate([chunk, np.zeros((pad,) + chunk.shape[1:], chunk.dtype)])
-            y = fwd(net.params, net.state, self._place(jnp.asarray(chunk)))
-            outs.append(np.asarray(y)[:self.max_batch - pad])
+            with _tm.span("serving.forward", fill=real / self.max_batch):
+                y = fwd(net.params, net.state, self._place(jnp.asarray(chunk)))
+                y = np.asarray(y)[:real]
+            if self._reg.enabled:
+                self._m_fill.observe(real / self.max_batch)
+            outs.append(y)
         return np.concatenate(outs)
 
     def _output_one(self, x):
@@ -115,8 +153,21 @@ class ParallelInference:
     def submit(self, x):
         """Submit one example; returns a Future-like holder."""
         holder = _Result()
-        self._queue.put((np.asarray(x), holder))
+        enabled = self._reg.enabled
+        self._queue.put((np.asarray(x), holder,
+                         time.perf_counter() if enabled else 0.0))
+        if enabled:
+            self._m_requests.inc(mode="queued")
+            self._m_depth.set(self._queue.qsize())
         return holder
+
+    def _finish(self, holder, value, t_submit, mode):
+        holder._set(value)
+        if self._reg.enabled:
+            self._m_requests.inc(mode=mode)  # completions, per mode
+            if t_submit:
+                self._m_latency.observe(time.perf_counter() - t_submit,
+                                        mode=mode)
 
     def _worker(self):
         while not self._stop.is_set():
@@ -133,20 +184,26 @@ class ParallelInference:
                     batch.append(self._queue.get(timeout=self.timeout_s))
                 except queue.Empty:
                     break
+            if self._reg.enabled:
+                self._m_depth.set(self._queue.qsize())
             # a failing forward (bad input shape, mid-swap architecture
             # mismatch) must fail THESE requests, not kill the serving loop
             try:
                 if self.inference_mode == "sequential":
-                    for x, holder in batch:
-                        holder._set(self._output_one(x))
+                    for x, holder, t_sub in batch:
+                        with _tm.span("serving.sequential"):
+                            y = self._output_one(x)
+                        self._finish(holder, y, t_sub, "sequential")
                     continue
-                xs = np.stack([b[0] for b in batch])
-                ys = self.output(xs)
-                for (_, holder), y in zip(batch, ys):
-                    holder._set(y)
+                with _tm.span("serving.batch", size=len(batch)):
+                    xs = np.stack([b[0] for b in batch])
+                    ys = self._forward_padded(xs)
+                for (_, holder, t_sub), y in zip(batch, ys):
+                    self._finish(holder, y, t_sub, "batched")
             except Exception as e:  # noqa: BLE001 — propagate to waiters
-                for _, holder in batch:
-                    holder._set_error(e)
+                for _, holder, _t in batch:
+                    if not holder._event.is_set():  # don't poison requests
+                        holder._set_error(e)       # already served (seq mode)
 
 
 class _Result:

@@ -78,7 +78,7 @@ import numpy as np
 from jax import lax
 from deeplearning4j_tpu.parallel import mesh as _mesh
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.utils.compat import shard_map
 
 from deeplearning4j_tpu.nn.conf import inputs as _inputs
 

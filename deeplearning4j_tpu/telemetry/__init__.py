@@ -1,0 +1,71 @@
+"""Unified telemetry: metrics registry + host-side span tracing.
+
+One coherent observability layer over what the reference scatters across
+PerformanceListener / BaseStatsListener / OpProfiler (SURVEY.md §5):
+
+* ``get_registry()`` — process-wide MetricsRegistry (counters, gauges,
+  fixed-bucket histograms; JSONL + Prometheus exporters). Instrumented
+  layers: the fit loops (step/ETL time, score), ParallelInference (queue
+  depth, batch fill, request latency), the distributed training masters
+  (per-round sync time), dataset caching/prefetch (hits, stalls) and the
+  UIServer (scrape ``/metrics``).
+* ``span("name")`` — host-side tracing into a Chrome trace-event buffer
+  (``get_tracer().export(path)``), forwarded to
+  ``jax.profiler.TraceAnnotation`` so host spans line up with XLA device
+  ops in xprof.
+
+Off by default; switch on per process with ``DL4J_TPU_TELEMETRY=1`` or at
+runtime::
+
+    from deeplearning4j_tpu import telemetry
+    telemetry.enable()
+    net.fit(x, y, epochs=2)
+    print(telemetry.get_registry().to_prometheus())
+    telemetry.get_tracer().export("/tmp/host_trace.json")
+
+Disabled, the instrumentation costs one branch per site — no allocations,
+no clock reads, and never a device->host sync.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.telemetry import tracing as _tracing
+from deeplearning4j_tpu.telemetry.registry import (DEFAULT_BUCKETS, Counter,
+                                                   Gauge, Histogram,
+                                                   MetricsRegistry,
+                                                   get_registry, write_jsonl)
+from deeplearning4j_tpu.telemetry.tracing import Tracer, get_tracer, span
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
+           "DEFAULT_BUCKETS", "get_registry", "get_tracer", "span",
+           "write_jsonl", "enable", "disable", "enabled"]
+
+
+def enable():
+    """Turn on metrics recording and span tracing process-wide (the
+    default registry's ``enabled`` setter flips both)."""
+    get_registry().enabled = True
+
+
+def disable():
+    get_registry().enabled = False
+
+
+def enabled():
+    return get_registry().enabled
+
+
+def train_metrics():
+    """(registry, step_hist, etl_hist, iterations_counter, score_gauge) —
+    the per-iteration instruments shared by the MultiLayerNetwork and
+    ComputationGraph fit loops (one naming authority, so the dashboards and
+    the /metrics scrape see a single series family whichever trainer ran)."""
+    reg = get_registry()
+    return (reg,
+            reg.histogram("train_step_seconds",
+                          "wall time of one optimizer step (fit loop)"),
+            reg.histogram("train_etl_seconds",
+                          "host-side batch assembly/placement per iteration"),
+            reg.counter("train_iterations_total",
+                        "optimizer iterations completed"),
+            reg.gauge("train_score", "last training score (loss)"))

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.utils import compat as _compat
 from deeplearning4j_tpu.utils.hostsync import fetch_losses
 from deeplearning4j_tpu.text.vocab import (VocabCache, VocabConstructor,
                                            flatten_corpus)
@@ -259,7 +260,7 @@ def _dist_fns(math_fn, mesh):
         def sharded(syn0, syn1, *rest):
             batch, lr = rest[:-1], rest[-1]
             spec = P(None, "data") if scan_dim else P("data")
-            f = jax.shard_map(
+            f = _compat.shard_map(
                 fn, mesh=mesh,
                 in_specs=(P(), P()) + tuple(spec for _ in batch) + (P(),),
                 out_specs=(P(), P(), P()),
@@ -325,7 +326,7 @@ def _dist_fns_table_sharded(mesh, rows):
     def make(fn):
         def sharded(syn0, syn1, *rest):
             batch, lr = rest[:-1], rest[-1]
-            f = jax.shard_map(
+            f = _compat.shard_map(
                 fn, mesh=mesh,
                 in_specs=(P("data"), P("data")) + tuple(
                     P() for _ in batch) + (P(),),

@@ -89,6 +89,21 @@ class UIServer:
             def do_GET(self):
                 url = urlparse(self.path)
                 q = parse_qs(url.query)
+                server._count_request(url.path)
+                if url.path == "/metrics":
+                    # Prometheus text exposition of the process-wide
+                    # telemetry registry (reference role: the system tab's
+                    # numbers, now scrapeable by standard tooling)
+                    from deeplearning4j_tpu import telemetry
+                    body = telemetry.get_registry().to_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if url.path in ("/", "/train", "/train/overview.html"):
                     self._html(_PAGE)
                     return
@@ -157,12 +172,32 @@ class UIServer:
         self.port = self._httpd.server_address[1]
         self._thread = None
         self._remote = None
+        self._request_counter = None
 
     @classmethod
     def get_instance(cls, port=0):
         if cls._instance is None:
             cls._instance = cls(port=port).start()
         return cls._instance
+
+    _KNOWN_PATHS = frozenset((
+        "/", "/metrics", "/train", "/train/overview.html", "/train/sessions",
+        "/train/overview", "/train/model", "/train/model.html",
+        "/train/system", "/train/system.html", "/remote"))
+
+    def _count_request(self, path):
+        try:
+            counter = self._request_counter
+            if counter is None:
+                from deeplearning4j_tpu import telemetry
+                counter = self._request_counter = \
+                    telemetry.get_registry().counter(
+                        "ui_requests_total", "UI server requests by path")
+            # bucket unknown paths: a port scanner hitting random URLs must
+            # not mint unbounded label series in the process-wide registry
+            counter.inc(path=path if path in self._KNOWN_PATHS else "other")
+        except Exception:  # metrics must never break a page load
+            pass
 
     def _remote_storage(self):
         if self._remote is None:

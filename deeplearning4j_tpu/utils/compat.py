@@ -1,0 +1,27 @@
+"""jax version-compatibility shims.
+
+The production target is a current jax (TPU v5e image); the CI/tier-1
+environment may carry an older release. Every cross-version API this repo
+depends on gets ONE canonical entry point here so call sites stay clean.
+
+``shard_map``: promoted out of jax.experimental (and ``check_rep`` renamed
+to ``check_vma``) across jax releases. Call sites import from here with the
+NEW calling convention; on old jax the kwarg is translated.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_new_shard_map = getattr(jax, "shard_map", None)
+if _new_shard_map is None:
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """jax.shard_map with the current-jax signature on every jax."""
+    if _new_shard_map is not None:
+        return _new_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=check_vma)
+    return _old_shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)

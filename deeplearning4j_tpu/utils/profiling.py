@@ -33,18 +33,12 @@ def find_xplane(trace_dir):
     return paths[-1]
 
 
-def top_ops(trace_dir, k=15):
-    """Ranked per-op rows from a trace: list of dicts with keys
-    ``total_self_us``, ``occurrences``, ``category``, ``bound_by``,
-    ``expression`` (plus every other hlo_stats column, snake-cased as-is).
-    """
-    from xprof.convert import raw_to_tool_data as rtd
-
-    path = find_xplane(trace_dir)
-    data, _ = rtd.xspace_to_tool_data([path], "hlo_stats", {})
-    if isinstance(data, bytes):
-        data = data.decode()
-    tbl = json.loads(data)
+def rows_from_table(tbl):
+    """Flatten an hlo_stats gviz table ({cols: [{id}], rows: [{c: [{v}]}]})
+    into row dicts with the canonical keys ``total_self_us``,
+    ``occurrences``, ``category``, ``bound_by``, ``expression`` (plus every
+    other column, snake-cased as-is). Pure — unit-testable on a synthetic
+    table with no TPU or xprof capture."""
     cols = [c["id"] for c in tbl["cols"]]
     rows = []
     for r in tbl.get("rows", []):
@@ -57,13 +51,52 @@ def top_ops(trace_dir, k=15):
             "expression": d.get("hlo_op_expression"),
             **d,
         })
-    rows.sort(key=lambda r: r["total_self_us"] or 0.0, reverse=True)
-    return rows[:k]
+    return rows
 
 
-def summarize(trace_dir, k=10):
-    """Human-readable top-k table (one string), for logs and reports."""
-    rows = top_ops(trace_dir, k)
+def merge_rows(rows):
+    """Merge rows sharing an expression: self-times and occurrence counts
+    add; the first row's other columns win. Needed when one trace window
+    yields several tables (multi-host captures produce one xplane per
+    process) or when hlo_stats splits an op across program ids."""
+    merged = {}
+    order = []
+    for r in rows:
+        key = r.get("expression")
+        cur = merged.get(key)
+        if cur is None or key is None:
+            # None expressions never merge with each other — keep them apart
+            key = key if key is not None else object()
+            merged[key] = dict(r)
+            order.append(key)
+            continue
+        cur["total_self_us"] = ((cur.get("total_self_us") or 0.0)
+                                + (r.get("total_self_us") or 0.0))
+        cur["occurrences"] = ((cur.get("occurrences") or 0)
+                              + (r.get("occurrences") or 0))
+    return [merged[k] for k in order]
+
+
+def rank_ops(rows, k=None):
+    """Rows sorted by descending self-time; ``k`` truncates (None = all)."""
+    out = sorted(rows, key=lambda r: r["total_self_us"] or 0.0, reverse=True)
+    return out if k is None else out[:k]
+
+
+def top_ops(trace_dir, k=15):
+    """Ranked per-op rows from the newest xplane under a captured trace
+    directory (duplicate expressions within the table merge first)."""
+    from xprof.convert import raw_to_tool_data as rtd
+
+    path = find_xplane(trace_dir)
+    data, _ = rtd.xspace_to_tool_data([path], "hlo_stats", {})
+    if isinstance(data, bytes):
+        data = data.decode()
+    return rank_ops(merge_rows(rows_from_table(json.loads(data))), k)
+
+
+def format_rows(rows):
+    """Human-readable ranked-op table (one string), for logs and reports."""
     lines = [f"{'self us':>10}  {'%':>5}  {'x':>5}  {'category':<18} expression"]
     total = sum(r["total_self_us"] or 0.0 for r in rows) or 1.0
     for r in rows:
@@ -73,3 +106,8 @@ def summarize(trace_dir, k=10):
             f"{us:>10.1f}  {100.0 * us / total:>4.1f}  {occ:>5.0f}  "
             f"{(r['category'] or '?'):<18} {(r['expression'] or '')[:90]}")
     return "\n".join(lines)
+
+
+def summarize(trace_dir, k=10):
+    """Human-readable top-k table for a captured trace directory."""
+    return format_rows(top_ops(trace_dir, k))

@@ -7,7 +7,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from deeplearning4j_tpu.utils.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.nn import layers as L

@@ -1,0 +1,137 @@
+"""utils/profiling ranking/merge tests on a synthetic hlo_stats table —
+no TPU, no xprof capture (ISSUE 1 satellite)."""
+
+import pytest
+
+from deeplearning4j_tpu.utils import profiling
+
+
+def _table(rows):
+    """Build a gviz-style hlo_stats table like xprof's hlo_stats tool."""
+    cols = [{"id": "hlo_op_expression"}, {"id": "category"},
+            {"id": "total_self_time"}, {"id": "occurrences"},
+            {"id": "bound_by"}]
+    return {"cols": cols,
+            "rows": [{"c": [{"v": v} for v in r]} for r in rows]}
+
+
+_ROWS = [
+    ("%fusion.1 = dot(...)", "convolution", 120.0, 3, "compute"),
+    ("%dus.2 = dynamic-update-slice(...)", "data formatting", 480.0, 64,
+     "memory"),
+    ("%add.3 = add(...)", "elementwise", 15.0, 10, "memory"),
+    ("%reduce.4 = reduce(...)", "reduction", 240.0, 8, "memory"),
+]
+
+
+class TestRowsFromTable:
+    def test_canonical_keys_mapped(self):
+        rows = profiling.rows_from_table(_table(_ROWS))
+        assert len(rows) == 4
+        r = rows[0]
+        assert r["total_self_us"] == 120.0
+        assert r["occurrences"] == 3
+        assert r["category"] == "convolution"
+        assert r["bound_by"] == "compute"
+        assert r["expression"].startswith("%fusion.1")
+        # raw columns survive snake-cased as-is
+        assert r["total_self_time"] == 120.0
+
+    def test_missing_cells_become_none(self):
+        tbl = _table([(None, None, None, None, None)])
+        r = profiling.rows_from_table(tbl)[0]
+        assert r["total_self_us"] is None
+        assert r["expression"] is None
+
+
+class TestRankOps:
+    def test_descending_self_time(self):
+        ranked = profiling.rank_ops(profiling.rows_from_table(_table(_ROWS)))
+        assert [r["total_self_us"] for r in ranked] == [480.0, 240.0, 120.0,
+                                                        15.0]
+
+    def test_k_truncates(self):
+        ranked = profiling.rank_ops(
+            profiling.rows_from_table(_table(_ROWS)), k=2)
+        assert [r["expression"][:8] for r in ranked] == ["%dus.2 =",
+                                                         "%reduce."]
+
+    def test_none_self_time_sorts_last(self):
+        rows = profiling.rows_from_table(
+            _table([("a", "c", None, 1, "m"), ("b", "c", 5.0, 1, "m")]))
+        ranked = profiling.rank_ops(rows)
+        assert ranked[0]["expression"] == "b"
+
+
+class TestMergeRows:
+    def test_duplicate_expressions_merge(self):
+        rows = profiling.rows_from_table(_table([
+            ("%dot.1", "conv", 100.0, 2, "compute"),
+            ("%dot.1", "conv", 50.0, 1, "compute"),
+            ("%add.2", "elementwise", 10.0, 5, "memory"),
+        ]))
+        merged = profiling.merge_rows(rows)
+        assert len(merged) == 2
+        dot = next(r for r in merged if r["expression"] == "%dot.1")
+        assert dot["total_self_us"] == 150.0
+        assert dot["occurrences"] == 3
+        assert dot["category"] == "conv"  # first row's columns win
+
+    def test_none_self_times_merge_as_zero(self):
+        rows = profiling.rows_from_table(_table([
+            ("%x", "c", None, None, "m"), ("%x", "c", 7.0, 2, "m")]))
+        merged = profiling.merge_rows(rows)
+        assert len(merged) == 1
+        assert merged[0]["total_self_us"] == 7.0
+        assert merged[0]["occurrences"] == 2
+
+    def test_none_expressions_never_merge(self):
+        rows = profiling.rows_from_table(_table([
+            (None, "c", 1.0, 1, "m"), (None, "c", 2.0, 1, "m")]))
+        assert len(profiling.merge_rows(rows)) == 2
+
+    def test_order_preserved(self):
+        rows = profiling.rows_from_table(_table(_ROWS))
+        merged = profiling.merge_rows(rows)
+        assert [r["expression"] for r in merged] == \
+            [r["expression"] for r in rows]
+
+
+class TestFormatRows:
+    def test_table_text(self):
+        ranked = profiling.rank_ops(profiling.rows_from_table(_table(_ROWS)))
+        text = profiling.format_rows(ranked)
+        lines = text.splitlines()
+        assert "expression" in lines[0]
+        assert len(lines) == 5
+        # top row first, with its share of the listed total (480/855)
+        assert "%dus.2" in lines[1]
+        assert "56.1" in lines[1]
+        assert "data formatting" in lines[1]
+
+    def test_handles_none_fields(self):
+        text = profiling.format_rows([{"total_self_us": None,
+                                       "occurrences": None,
+                                       "category": None,
+                                       "expression": None}])
+        assert "?" in text
+
+
+class TestFindXplane:
+    def test_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            profiling.find_xplane(tmp_path)
+
+    def test_newest_wins(self, tmp_path):
+        import os
+        import time
+        a = tmp_path / "plugins" / "profile" / "run1"
+        a.mkdir(parents=True)
+        old = a / "host.xplane.pb"
+        old.write_bytes(b"old")
+        new = a / "host2.xplane.pb"
+        new.write_bytes(b"new")
+        t = time.time()
+        os.utime(old, (t - 100, t - 100))
+        os.utime(new, (t, t))
+        assert profiling.find_xplane(tmp_path) == str(new)

@@ -1,0 +1,680 @@
+"""Unified telemetry tests: metrics registry, exporters, span tracing, and
+the instrumented training/serving/ETL stack (ISSUE 1 acceptance: a 2-layer
+MLP fit + a ParallelInference round-trip yield step-time, ETL-time,
+queue-depth and latency-histogram series plus a nested host-span Chrome
+trace; disabled, the instrumentation records nothing)."""
+
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.telemetry import tracing as _tracing
+from deeplearning4j_tpu.telemetry.registry import MetricsRegistry, write_jsonl
+
+
+@pytest.fixture
+def fresh():
+    """Enabled, empty default registry + tracer; disabled and cleared after."""
+    reg = telemetry.get_registry()
+    reg.reset()
+    telemetry.get_tracer().clear()
+    telemetry.enable()
+    yield reg
+    telemetry.disable()
+    reg.reset()
+    telemetry.get_tracer().clear()
+
+
+def _mlp(n_in=4, seed=0):
+    from deeplearning4j_tpu.nn import layers as L
+    from deeplearning4j_tpu.nn import updaters as U
+    from deeplearning4j_tpu.nn.conf import inputs as I
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = NeuralNetConfig(seed=seed, updater=U.Adam(learning_rate=0.01)).list(
+        L.DenseLayer(n_out=8, activation="tanh"),
+        L.OutputLayer(n_out=2, loss="mcxent"),
+        input_type=I.FeedForwardType(n_in))
+    return MultiLayerNetwork(conf)
+
+
+def _xy(n=64, n_in=4, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, n_in).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, n)]
+    return x, y
+
+
+# ----------------------------------------------------------------------
+# registry
+# ----------------------------------------------------------------------
+
+class TestMetricsRegistry:
+    def test_counter_inc_and_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("requests_total", "reqs")
+        c.inc()
+        c.inc(2, mode="batched")
+        c.inc(3, mode="batched")
+        assert c.value() == 1
+        assert c.value(mode="batched") == 5
+        assert {"mode": "batched"} in c.labelsets()
+
+    def test_counter_rejects_negative(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("c").inc(-1)
+
+    def test_gauge_set_inc_dec(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("depth")
+        g.set(7)
+        g.inc(2)
+        g.dec()
+        assert g.value() == 8
+
+    def test_histogram_counts_sum(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        assert h.count() == 5
+        assert h.sum() == pytest.approx(56.05)
+        snap = h.snapshot()["series"][0]["value"]
+        # raw per-bucket counts: <=0.1, (0.1,1], (1,10], overflow
+        assert list(snap["buckets"].values()) == [1, 2, 1, 1]
+        assert list(snap["buckets"]) == ["0.1", "1.0", "10.0", "+Inf"]
+
+    def test_histogram_percentile(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5,) * 50 + (1.5,) * 50:
+            h.observe(v)
+        p25, p75 = h.percentile(25), h.percentile(75)
+        assert 0.0 < p25 <= 1.0 < p75 <= 2.0
+        assert h.percentile(50, missing="labels") is None
+
+    def test_get_or_create_same_object(self):
+        reg = MetricsRegistry()
+        assert reg.counter("x") is reg.counter("x")
+
+    def test_kind_mismatch_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_thread_safety(self):
+        reg = MetricsRegistry()
+        c = reg.counter("n")
+        h = reg.histogram("h", buckets=(0.5,))
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+                h.observe(0.1)
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert c.value() == 8000
+        assert h.count() == 8000
+
+    def test_histogram_bucket_mismatch_raises(self):
+        reg = MetricsRegistry()
+        reg.histogram("lat", buckets=(0.1, 1.0))
+        with pytest.raises(ValueError, match="buckets"):
+            reg.histogram("lat", buckets=(0.5, 2.0))
+        # same bounds (any order/type) resolve to the same instrument
+        assert reg.histogram("lat", buckets=[1, 0.1]) is reg.get("lat")
+
+    def test_default_registry_enabled_attr_also_toggles_spans(self):
+        reg = telemetry.get_registry()
+        telemetry.get_tracer().clear()
+        try:
+            reg.enabled = True  # the attribute, not telemetry.enable()
+            with telemetry.span("via-attr"):
+                pass
+            names = {e["name"] for e in
+                     telemetry.get_tracer().chrome_trace()["traceEvents"]}
+            assert "via-attr" in names
+        finally:
+            reg.enabled = False
+            reg.reset()
+            telemetry.get_tracer().clear()
+        assert not _tracing.enabled()
+
+    def test_reset_preserves_metric_objects(self):
+        reg = MetricsRegistry()
+        c = reg.counter("n")
+        c.inc(5)
+        reg.reset()
+        assert c.value() == 0
+        c.inc()  # cached instrument reference still records
+        assert reg.counter("n").value() == 1
+
+
+# ----------------------------------------------------------------------
+# exporters
+# ----------------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{([a-zA-Z_][a-zA-Z0-9_]*="[^"]*",?)*\})? '
+    r'[-+0-9.eE]+(inf|nan)?$')
+
+
+def _check_prometheus(text):
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _PROM_LINE.match(line), f"bad prometheus line: {line!r}"
+
+
+class TestExporters:
+    def _populated(self):
+        reg = MetricsRegistry()
+        reg.counter("reqs_total", "requests").inc(3, mode="direct")
+        reg.gauge("depth", "queue depth").set(2)
+        h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 2.0):
+            h.observe(v, mode="direct")
+        return reg
+
+    def test_prometheus_text_parses(self):
+        text = self._populated().to_prometheus()
+        _check_prometheus(text)
+        assert "# TYPE reqs_total counter" in text
+        assert "# TYPE lat_seconds histogram" in text
+        assert 'reqs_total{mode="direct"} 3.0' in text
+
+    def test_prometheus_histogram_buckets_cumulative(self):
+        text = self._populated().to_prometheus()
+        buckets = re.findall(r'lat_seconds_bucket\{le="([^"]+)",mode="direct"\} (\d+)',
+                             text)
+        assert [(le, int(n)) for le, n in buckets] == [
+            ("0.1", 1), ("1.0", 2), ("+Inf", 3)]
+        assert 'lat_seconds_count{mode="direct"} 3' in text
+
+    def test_jsonl_one_parseable_line_per_series(self):
+        lines = self._populated().to_jsonl().strip().splitlines()
+        recs = [json.loads(l) for l in lines]
+        assert len(recs) == 3
+        by_name = {r["metric"]: r for r in recs}
+        assert by_name["reqs_total"]["value"] == 3.0
+        assert by_name["lat_seconds"]["value"]["count"] == 3
+
+    def test_write_jsonl_shared_writer(self, capsys):
+        write_jsonl({"metric": "m", "value": 1})
+        out = capsys.readouterr().out.strip()
+        assert json.loads(out) == {"metric": "m", "value": 1}
+
+    def test_snapshot_shape(self):
+        snap = self._populated().snapshot()
+        assert snap["depth"]["kind"] == "gauge"
+        assert snap["reqs_total"]["series"][0]["labels"] == {"mode": "direct"}
+
+
+# ----------------------------------------------------------------------
+# spans / tracer
+# ----------------------------------------------------------------------
+
+class TestSpans:
+    def test_nested_spans_in_chrome_trace(self, fresh):
+        with telemetry.span("outer", phase="test"):
+            with telemetry.span("inner"):
+                pass
+        evs = telemetry.get_tracer().chrome_trace()["traceEvents"]
+        by = {e["name"]: e for e in evs}
+        outer, inner = by["outer"], by["inner"]
+        assert outer["ph"] == inner["ph"] == "X"
+        assert outer["args"] == {"phase": "test"}
+        # inner nests inside outer on the timeline
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+
+    def test_span_set_attrs_mid_span(self, fresh):
+        with telemetry.span("s") as sp:
+            sp.set(hit=True)
+        ev = telemetry.get_tracer().chrome_trace()["traceEvents"][-1]
+        assert ev["args"] == {"hit": True}
+
+    def test_export_loadable_json(self, fresh, tmp_path):
+        with telemetry.span("a"):
+            pass
+        path = telemetry.get_tracer().export(tmp_path / "trace.json")
+        with open(path) as f:
+            data = json.load(f)
+        assert data["traceEvents"][0]["name"] == "a"
+        assert data["displayTimeUnit"] == "ms"
+
+    def test_bounded_buffer_drops_and_counts(self):
+        tr = _tracing.Tracer(max_events=2)
+        for i in range(4):
+            tr.add_complete(f"e{i}", 0.0, 1.0)
+        out = tr.chrome_trace()
+        assert len(out["traceEvents"]) == 2
+        assert out["droppedEventCount"] == 2
+
+
+class TestDisabled:
+    def test_disabled_span_is_shared_noop(self):
+        telemetry.disable()
+        telemetry.get_tracer().clear()
+        s1 = telemetry.span("a")
+        s2 = telemetry.span("b", k=1)
+        assert s1 is s2  # no allocation on the disabled path
+        with s1:
+            pass
+        assert telemetry.get_tracer().chrome_trace()["traceEvents"] == []
+
+    def test_disabled_registry_records_nothing(self):
+        reg = MetricsRegistry(enabled=False)
+        reg.counter("c").inc()
+        reg.gauge("g").set(1)
+        reg.histogram("h").observe(0.1)
+        assert all(not m["series"] for m in reg.snapshot().values())
+
+    def test_disabled_overhead_smoke(self):
+        # not a benchmark — a regression tripwire: 30k disabled records +
+        # spans must be branch-cheap (sub-second leaves ~30us/op headroom,
+        # orders of magnitude above the intended cost)
+        import time
+        reg = MetricsRegistry(enabled=False)
+        h = reg.histogram("h")
+        t0 = time.perf_counter()
+        for _ in range(30000):
+            h.observe(0.1)
+            with telemetry.span("s"):
+                pass
+        assert time.perf_counter() - t0 < 1.0
+
+    def test_disabled_instrumented_fit_records_nothing(self):
+        telemetry.disable()
+        reg = telemetry.get_registry()
+        reg.reset()
+        telemetry.get_tracer().clear()
+        x, y = _xy()
+        _mlp().fit(x, y, epochs=2, batch_size=32)
+        assert all(not m["series"] for m in reg.snapshot().values())
+        assert telemetry.get_tracer().chrome_trace()["traceEvents"] == []
+
+
+# ----------------------------------------------------------------------
+# instrumented stack (ISSUE 1 acceptance)
+# ----------------------------------------------------------------------
+
+class TestInstrumentedStack:
+    def test_mlp_fit_and_parallel_inference_snapshot(self, fresh):
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+        x, y = _xy()
+        net = _mlp()
+        net.fit(x, y, epochs=2, batch_size=16)
+        pi = ParallelInference(net, max_batch_size=8)
+        out = pi.output(x[:13])
+        assert out.shape == (13, 2)
+
+        snap = fresh.snapshot()
+        for name in ("train_step_seconds", "train_etl_seconds",
+                     "train_iterations_total", "train_score",
+                     "serving_queue_depth", "serving_batch_fill_ratio",
+                     "serving_request_latency_seconds"):
+            assert snap[name]["series"], f"{name} has no series"
+        assert fresh.get("train_iterations_total").value() == 8
+        assert fresh.get("train_step_seconds").count() == 8
+        # 13 examples through max_batch=8 -> fills 8/8 and 5/8
+        fill = snap["serving_batch_fill_ratio"]["series"][0]["value"]
+        assert fill["count"] == 2
+        assert fresh.get("serving_request_latency_seconds").percentile(
+            99, mode="direct") is not None
+
+        evs = telemetry.get_tracer().chrome_trace()["traceEvents"]
+        names = {e["name"] for e in evs}
+        assert {"fit", "fit.step", "fit.etl",
+                "serving.output", "serving.forward"} <= names
+        # nested: every fit.step lies inside the fit span
+        fit_ev = next(e for e in evs if e["name"] == "fit")
+        for e in evs:
+            if e["name"] == "fit.step":
+                assert fit_ev["ts"] <= e["ts"]
+                assert (e["ts"] + e["dur"]
+                        <= fit_ev["ts"] + fit_ev["dur"] + 1e-3)
+
+    def test_batched_serving_queue_metrics(self, fresh):
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+        x, _ = _xy(8)
+        net = _mlp()
+        net.init()
+        pi = ParallelInference(net, max_batch_size=4,
+                               timeout_s=0.01).start()
+        try:
+            holders = [pi.submit(x[i]) for i in range(6)]
+            outs = [h.get(timeout=10) for h in holders]
+        finally:
+            pi.stop()
+        assert all(o.shape == (2,) for o in outs)
+        reqs = fresh.get("serving_requests_total")
+        assert reqs.value(mode="queued") == 6
+        assert reqs.value(mode="batched") == 6  # completions counted too
+        lat = fresh.get("serving_request_latency_seconds")
+        assert lat.count(mode="batched") == 6
+        assert fresh.snapshot()["serving_queue_depth"]["series"]
+
+    def test_sequential_failure_does_not_poison_served_requests(self, fresh):
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+        x, _ = _xy(4)
+        net = _mlp()
+        net.init()
+        pi = ParallelInference(net, max_batch_size=4, timeout_s=0.05,
+                               inference_mode="sequential").start()
+        try:
+            good = pi.submit(x[0])
+            bad = pi.submit(np.zeros(99, np.float32))  # wrong feature dim
+            assert good.get(timeout=10).shape == (2,)
+            with pytest.raises(Exception):
+                bad.get(timeout=10)
+        finally:
+            pi.stop()
+
+    def test_ui_request_paths_bucketed(self, fresh):
+        from deeplearning4j_tpu.ui import UIServer
+
+        server = UIServer(port=0).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            urllib.request.urlopen(f"{base}/metrics").read()
+            for p in ("/scan1", "/scan2"):
+                with pytest.raises(urllib.error.HTTPError):
+                    urllib.request.urlopen(base + p)
+        finally:
+            server.stop()
+        c = fresh.get("ui_requests_total")
+        assert c.value(path="/metrics") == 1
+        assert c.value(path="other") == 2  # unknown paths share one series
+        assert len(c.labelsets()) == 2
+
+    def test_async_prefetch_metrics(self, fresh):
+        from deeplearning4j_tpu.datasets.iterator import (
+            ArrayDataSetIterator, AsyncDataSetIterator)
+
+        x, y = _xy(32)
+        it = AsyncDataSetIterator(ArrayDataSetIterator(x, y, batch_size=8),
+                                  device_put=False)
+        batches = list(it)
+        assert len(batches) == 4
+        assert fresh.get("etl_batches_total").value() == 4
+        assert fresh.get("etl_fetch_stall_seconds").count() >= 4
+        names = {e["name"]
+                 for e in telemetry.get_tracer().chrome_trace()["traceEvents"]}
+        assert "etl.prefetch" in names
+
+    def test_graph_tbptt_records_train_metrics(self, fresh):
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.nn import updaters as U
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+
+        g = (GraphBuilder(updater=U.Adam(5e-3), seed=3,
+                          backprop_type="tbptt", tbptt_fwd_length=8,
+                          tbptt_back_length=8)
+             .add_inputs("in").set_input_types(I.recurrent(4, 32))
+             .add_layer("lstm", L.LSTM(n_out=8, activation="tanh"), "in")
+             .add_layer("out", L.RnnOutputLayer(n_out=4,
+                                                activation="softmax"),
+                        "lstm")
+             .set_outputs("out"))
+        net = ComputationGraph(g.build())
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 4, (4, 32))
+        x = np.eye(4, dtype=np.float32)[ids]
+        y = np.eye(4, dtype=np.float32)[np.roll(ids, -1, axis=1)]
+        net.fit(x, y, epochs=1)
+        # one macro-batch = one recorded step (parity with the MLN branch)
+        assert fresh.get("train_iterations_total").value() == 1
+        assert fresh.get("train_step_seconds").count() == 1
+        assert fresh.get("train_score").value() > 0
+
+    def test_dataset_cache_counters(self, fresh, tmp_path):
+        from deeplearning4j_tpu.datasets.cacheable import ensure_file
+
+        f = tmp_path / "data.bin"
+        f.write_bytes(b"x" * 8)
+        ensure_file("data.bin", root=str(tmp_path))
+        c = fresh.get("dataset_cache_requests_total")
+        assert c.value(outcome="hit") == 1
+        with pytest.raises(FileNotFoundError):
+            ensure_file("absent.bin", root=str(tmp_path))
+        assert c.value(outcome="miss") == 1
+
+    def test_distributed_round_metrics(self, fresh):
+        pytest.importorskip("deeplearning4j_tpu.parallel.distributed")
+        import jax
+        from jax.sharding import Mesh
+        from deeplearning4j_tpu.parallel.distributed import (
+            DistributedMultiLayer, ParameterAveragingTrainingMaster)
+
+        mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+        master = ParameterAveragingTrainingMaster(
+            mesh, batch_size_per_worker=8, averaging_frequency=2)
+        x, y = _xy(32)
+        DistributedMultiLayer(_mlp(), master).fit(x, y, epochs=1)
+        h = fresh.get("distributed_round_seconds")
+        assert h.count(master="parameter_averaging") == 2
+        assert fresh.get("distributed_rounds_total").value(
+            master="parameter_averaging") == 2
+
+
+# ----------------------------------------------------------------------
+# /metrics endpoint (ISSUE 1 satellite: live UIServer serves parseable
+# Prometheus text)
+# ----------------------------------------------------------------------
+
+class TestMetricsEndpoint:
+    def test_metrics_served_from_live_uiserver(self, fresh):
+        from deeplearning4j_tpu.ui import UIServer
+
+        x, y = _xy()
+        _mlp().fit(x, y, epochs=1, batch_size=16)
+        server = UIServer(port=0).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/metrics") as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/plain")
+                text = r.read().decode()
+        finally:
+            server.stop()
+        _check_prometheus(text)
+        assert "train_step_seconds_bucket" in text
+        assert "train_iterations_total 4.0" in text
+        # the scrape itself is counted
+        assert 'ui_requests_total{path="/metrics"}' in text
+
+
+# ----------------------------------------------------------------------
+# listener satellites
+# ----------------------------------------------------------------------
+
+class TestListenerHooks:
+    def test_on_fit_end_fires_on_completion_and_exception(self):
+        from deeplearning4j_tpu.nn.listeners import TrainingListener
+
+        class Recorder(TrainingListener):
+            def __init__(self, fail_at=None):
+                self.fit_ends = 0
+                self.fail_at = fail_at
+
+            def iteration_done(self, model, iteration, score, etl_time=0.0):
+                if self.fail_at is not None and iteration >= self.fail_at:
+                    raise RuntimeError("boom")
+
+            def on_fit_end(self, model):
+                self.fit_ends += 1
+
+        x, y = _xy(32)
+        ok = Recorder()
+        net = _mlp().add_listener(ok)
+        net.fit(x, y, epochs=2, batch_size=16)
+        assert ok.fit_ends == 1
+
+        bad = Recorder(fail_at=1)
+        net2 = _mlp().add_listener(bad)
+        with pytest.raises(RuntimeError):
+            net2.fit(x, y, epochs=1, batch_size=16)
+        assert bad.fit_ends == 1  # finally-block hook ran despite the raise
+
+    def test_raising_fit_end_hook_masks_nothing_and_skips_no_one(self):
+        from deeplearning4j_tpu.nn.listeners import TrainingListener
+
+        calls = []
+
+        class Bad(TrainingListener):
+            def on_fit_end(self, model):
+                calls.append("bad")
+                raise OSError("cleanup failed")
+
+        class Good(TrainingListener):
+            def on_fit_end(self, model):
+                calls.append("good")
+
+        class Boom(TrainingListener):
+            def iteration_done(self, model, iteration, score, etl_time=0.0):
+                raise RuntimeError("training error")
+
+        x, y = _xy(16)
+        net = _mlp().add_listener(Boom(), Bad(), Good())
+        with pytest.raises(RuntimeError, match="training error"):
+            net.fit(x, y, epochs=1)  # Bad's OSError must not mask this
+        assert calls == ["bad", "good"]  # later hooks still ran
+
+    def test_profiler_listener_multi_fit_window_opt_out(self, tmp_path):
+        from deeplearning4j_tpu.nn.listeners import ProfilerListener
+
+        lst = ProfilerListener(str(tmp_path), start_iteration=1,
+                               n_iterations=5, close_on_fit_end=False)
+        x, y = _xy(32)
+        net = _mlp().add_listener(lst)
+        net.fit(x, y, epochs=1, batch_size=16)  # 2 iterations: window open
+        assert lst._active and not lst.completed
+        net.fit(x, y, epochs=2, batch_size=16)  # window completes mid-run
+        assert lst.completed and not lst._active
+
+    def test_profiler_listener_window_closed_by_fit_end(self, tmp_path):
+        import jax
+        from deeplearning4j_tpu.nn.listeners import ProfilerListener
+
+        lst = ProfilerListener(str(tmp_path), start_iteration=1,
+                               n_iterations=10_000)
+        x, y = _xy(32)
+        net = _mlp().add_listener(lst)
+        net.fit(x, y, epochs=1, batch_size=16)  # window never completes
+        assert not lst._active  # fit end closed the trace
+        assert lst.completed
+        # a fresh trace can start — the session did not leak
+        jax.profiler.start_trace(str(tmp_path / "again"))
+        jax.profiler.stop_trace()
+
+    def test_graph_fit_on_fit_end(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.nn import updaters as U
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.listeners import TrainingListener
+
+        class Recorder(TrainingListener):
+            fit_ends = 0
+
+            def on_fit_end(self, model):
+                Recorder.fit_ends += 1
+
+        conf = (GraphBuilder(updater=U.Sgd(learning_rate=0.1))
+                .add_inputs("in")
+                .set_input_types(I.FeedForwardType(4))
+                .add_layer("d", L.DenseLayer(n_out=8, activation="tanh"), "in")
+                .add_layer("out", L.OutputLayer(n_out=2, loss="mcxent"), "d")
+                .set_outputs("out")
+                .build())
+        x, y = _xy(16)
+        ComputationGraph(conf).add_listener(Recorder()).fit(x, y, epochs=1)
+        assert Recorder.fit_ends == 1
+
+
+class TestPerformanceListenerInference:
+    def test_samples_per_sec_inferred_from_batch_shape(self):
+        from deeplearning4j_tpu.nn.listeners import PerformanceListener
+
+        lst = PerformanceListener(frequency=1, print_fn=lambda s: None)
+        x, y = _xy(48)
+        _mlp().add_listener(lst).fit(x, y, epochs=2, batch_size=16)
+        assert lst.records, "no performance records"
+        for rec in lst.records:
+            assert rec["samples_per_sec"] > 0
+        # consistency: samples/sec == batch_size * batches/sec
+        rec = lst.records[-1]
+        assert rec["samples_per_sec"] == pytest.approx(
+            16 * rec["batches_per_sec"])
+
+    def test_explicit_report_batch_size_still_wins(self):
+        from deeplearning4j_tpu.nn.listeners import PerformanceListener
+
+        lst = PerformanceListener(frequency=1, report_batch_size=100,
+                                  print_fn=lambda s: None)
+        x, y = _xy(32)
+        _mlp().add_listener(lst).fit(x, y, epochs=2, batch_size=16)
+        rec = lst.records[-1]
+        assert rec["samples_per_sec"] == pytest.approx(
+            100 * rec["batches_per_sec"])
+
+
+# ----------------------------------------------------------------------
+# CLI verb
+# ----------------------------------------------------------------------
+
+class TestCLITelemetry:
+    def test_local_snapshot_json(self, fresh, capsys):
+        from deeplearning4j_tpu.cli import main
+
+        fresh.counter("cli_smoke_total").inc(2)
+        assert main(["telemetry", "--format", "json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["cli_smoke_total"]["series"][0]["value"] == 2.0
+
+    def test_prom_format_and_chrome_trace(self, fresh, capsys, tmp_path):
+        from deeplearning4j_tpu.cli import main
+
+        with telemetry.span("cli.work"):
+            fresh.counter("cli_smoke_total").inc()
+        trace = tmp_path / "trace.json"
+        assert main(["telemetry", "--chrome-trace", str(trace)]) == 0
+        _check_prometheus(capsys.readouterr().out)
+        with open(trace) as f:
+            assert json.load(f)["traceEvents"][0]["name"] == "cli.work"
+
+    def test_url_plus_chrome_trace_rejected(self, tmp_path):
+        from deeplearning4j_tpu.cli import main
+
+        with pytest.raises(SystemExit, match="chrome-trace"):
+            main(["telemetry", "--url", "http://127.0.0.1:1/metrics",
+                  "--chrome-trace", str(tmp_path / "t.json")])
+
+    def test_scrape_url(self, fresh, capsys):
+        from deeplearning4j_tpu.cli import main
+        from deeplearning4j_tpu.ui import UIServer
+
+        fresh.gauge("scrape_me").set(4)
+        server = UIServer(port=0).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/metrics"
+            assert main(["telemetry", "--url", url]) == 0
+        finally:
+            server.stop()
+        assert "scrape_me 4.0" in capsys.readouterr().out
