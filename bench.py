@@ -770,6 +770,27 @@ def _run_config_subprocess(name, platform):
     return rec
 
 
+def _attach_observability(rec):
+    """Health/memory summaries on every bench record (ISSUE 2): a record
+    whose run leaked HBM or went NaN mid-measure must say so next to its
+    samples/sec, not in a separate tool. Guarded — bench must produce
+    numbers even if the telemetry tier is mid-refactor."""
+    try:
+        from deeplearning4j_tpu.telemetry import devices as _devices
+        from deeplearning4j_tpu.telemetry import health as _health
+        mem = _devices.memory_summary()
+        if mem.get("devices") or mem.get("live_array_bytes"):
+            rec["device_memory"] = mem
+        hs = _health.get_monitor().summary()
+        if hs["steps_checked"] or hs["anomalies"]:
+            rec["health"] = {k: hs[k] for k in
+                             ("policy", "steps_checked", "nonfinite_steps",
+                              "anomalies")}
+    except Exception:
+        pass
+    return rec
+
+
 def _run_config_inprocess(n, device):
     t0 = time.perf_counter()
     try:
@@ -777,6 +798,7 @@ def _run_config_inprocess(n, device):
         rec.update(config=n, device=device, preflight=_preflight(),
                    wall_s=round(time.perf_counter() - t0, 1))
         rec["canonical"] = _is_canonical(rec)
+        _attach_observability(rec)
         _emit(rec)
         return rec
     except Exception as e:

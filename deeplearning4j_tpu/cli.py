@@ -94,6 +94,17 @@ def _build_parser():
                          "the server's Prometheus text)")
     tl.add_argument("--chrome-trace",
                     help="also export the host-span Chrome trace JSON here")
+
+    fr = sub.add_parser(
+        "flightrec",
+        help="pretty-print a crash flight-recorder dump "
+             "(telemetry/flight.py JSON)")
+    fr.add_argument("path", help="dump file written on anomaly/crash/SIGTERM")
+    fr.add_argument("--last", type=int, default=10,
+                    help="show only the last N step records (default 10; "
+                         "0 = all)")
+    fr.add_argument("--json", action="store_true",
+                    help="raw JSON passthrough instead of the table")
     return p
 
 
@@ -155,6 +166,11 @@ def _cmd_train(args):
     from deeplearning4j_tpu.parallel.distributed import (
         DistributedMultiLayer, ParameterAveragingTrainingMaster,
         SharedTrainingMaster)
+
+    # CLI training is the preemptable long-running entry point: a SIGTERM
+    # (scheduler eviction) leaves a flight-recorder dump behind
+    from deeplearning4j_tpu.telemetry import flight as _flight
+    _flight.install_signal_handler()
 
     x, y = _load_xy(args)
     n_devices = len(jax.devices())
@@ -295,6 +311,56 @@ def _cmd_telemetry(args):
     return 0
 
 
+#: flight-record columns in display order; only those present in the dump
+#: are rendered (health fields appear when the watchdog annotated the ring)
+_FLIGHT_COLS = ("step", "score", "loss", "step_time_s", "etl_time_s",
+                "grad_norm", "loss_nonfinite", "grad_nonfinite",
+                "device_bytes_in_use", "live_array_bytes")
+
+
+def _cmd_flightrec(args):
+    """Postmortem reader: the last-N-steps table a human scans for 'where
+    did it go wrong' without hand-parsing the dump JSON."""
+    import json
+
+    with open(args.path) as f:
+        doc = json.load(f)
+    if args.json:
+        print(json.dumps(doc, indent=1, default=str))
+        return 0
+    recs = doc.get("records", [])
+    print(f"flight dump: reason={doc.get('reason')} "
+          f"dumped_at={doc.get('dumped_at')} pid={doc.get('pid')} "
+          f"records={len(recs)}")
+    if doc.get("error"):
+        print(f"error: {doc['error']}")
+    if doc.get("anomaly"):
+        print(f"anomaly: {doc['anomaly']}")
+    show = recs[-args.last:] if args.last else recs
+
+    def _fmt(v):
+        if isinstance(v, bool):
+            return "YES" if v else "-"
+        if isinstance(v, float):
+            return f"{v:.4g}"
+        return "-" if v is None else str(v)
+
+    cols = [c for c in _FLIGHT_COLS if any(c in r for r in show)]
+    if cols:
+        rows = [[_fmt(r.get(c)) for c in cols] for r in show]
+        widths = [max(len(c), *(len(row[i]) for row in rows))
+                  for i, c in enumerate(cols)]
+        print("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+        for row in rows:
+            print("  ".join(v.ljust(w) for v, w in zip(row, widths)))
+    flagged = [r for r in recs
+               if r.get("loss_nonfinite") or r.get("grad_nonfinite")]
+    if flagged:
+        print(f"{len(flagged)} record(s) flagged nonfinite; first at step "
+              f"{flagged[0].get('step')}")
+    return 0
+
+
 def main(argv=None):
     args = _build_parser().parse_args(argv)
     if args.command == "train":
@@ -307,6 +373,8 @@ def main(argv=None):
         return _cmd_eval(args)
     if args.command == "telemetry":
         return _cmd_telemetry(args)
+    if args.command == "flightrec":
+        return _cmd_flightrec(args)
     return 1
 
 

@@ -29,6 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import telemetry as _tm
+from deeplearning4j_tpu.telemetry import devices as _devices
+from deeplearning4j_tpu.telemetry import flight as _flight
+from deeplearning4j_tpu.telemetry import health as _health
 from deeplearning4j_tpu.nn import gradnorm as _gradnorm
 from deeplearning4j_tpu.nn import listeners as _listeners
 from deeplearning4j_tpu.nn import updaters as _updaters
@@ -530,6 +533,7 @@ class ComputationGraph:
         self.listeners = []
         self.score_value = None
         self._train_step = None
+        self._train_step_health = None
         self._rng = jax.random.PRNGKey(conf.seed)
 
     def init(self, rng=None, dtype=None):
@@ -894,12 +898,18 @@ class ComputationGraph:
                                             updates)
         return new_params, new_opt
 
-    def make_train_step(self, donate=True, jit=True):
+    def make_train_step(self, donate=True, jit=True, with_health=False):
         def train_step(params, state, opt_state, inputs, labels, step, rng, mask=None):
             loss, new_state, grads = self.compute_gradients(
                 params, state, inputs, labels, rng=rng, mask=mask)
+            if with_health:
+                # numerics-watchdog bundle, fused into the step (labels the
+                # per-vertex series by vertex name)
+                health = _health.health_stats(grads, params, loss)
             new_params, new_opt = self.apply_update(params, opt_state, grads,
                                                     step)
+            if with_health:
+                return new_params, new_state, new_opt, loss, health
             return new_params, new_state, new_opt, loss
 
         if not jit:
@@ -916,11 +926,23 @@ class ComputationGraph:
         tm = self._time_major(inputs)
         use_tbptt = (self.conf.backprop_type == "tbptt" and tm is not None
                      and tm.shape[1] > self.conf.tbptt_fwd_length)
-        if not use_tbptt and self._train_step is None:
-            self._train_step = self.make_train_step()
+        hm = _health.get_monitor()
+        use_health = hm.active and not use_tbptt
+        if use_health:
+            if self._train_step_health is None:
+                self._train_step_health = self.make_train_step(
+                    with_health=True)
+            step_fn = self._train_step_health
+        elif not use_tbptt:
+            if self._train_step is None:
+                self._train_step = self.make_train_step()
+            step_fn = self._train_step
+        else:
+            step_fn = None
         n = next(iter(inputs.values())).shape[0]
         bs = batch_size or n
         reg, step_h, etl_h, iters_c, score_g = _tm.train_metrics()
+        frec = _flight.get_recorder()
         try:
             with _tm.span("fit", net=type(self).__name__):
                 for _ in range(epochs):
@@ -951,24 +973,47 @@ class ComputationGraph:
                         # activation-visualizing listeners (MLN convention)
                         self.last_input = next(iter(bi.values()))
                         score = None
+                        hb = None
+                        step_i = self.iteration
                         rec = reg.enabled  # one read: a mid-iteration
                         # enable() must not see half-initialized locals
                         with _tm.span("fit.step", iteration=self.iteration):
                             self._rng, sub = jax.random.split(self._rng)
-                            (self.params, self.state, self.opt_state,
-                             loss) = self._train_step(
-                                self.params, self.state, self.opt_state, bi, bl,
-                                self.iteration, sub, bm)
+                            if use_health:
+                                (self.params, self.state, self.opt_state,
+                                 loss, hb) = step_fn(
+                                    self.params, self.state, self.opt_state,
+                                    bi, bl, self.iteration, sub, bm)
+                            else:
+                                (self.params, self.state, self.opt_state,
+                                 loss) = step_fn(
+                                    self.params, self.state, self.opt_state,
+                                    bi, bl, self.iteration, sub, bm)
                             self.score_value = loss  # device scalar
                             self.iteration += 1
                             if rec:
                                 score = float(loss)  # sync inside the span
-                        if rec:
-                            step_h.observe(time.perf_counter() - etl_start
-                                           - etl_time)
-                            etl_h.observe(etl_time)
-                            iters_c.inc()
-                            score_g.set(score)
+                        if rec or use_health:
+                            step_time = (time.perf_counter() - etl_start
+                                         - etl_time)
+                            fr = {"step": step_i, "step_time_s": step_time,
+                                  "etl_time_s": etl_time}
+                            if score is not None:
+                                fr["score"] = score
+                            if rec:
+                                step_h.observe(step_time)
+                                etl_h.observe(etl_time)
+                                iters_c.inc()
+                                score_g.set(score)
+                                mem = _devices.poll_memory()
+                                if mem:
+                                    fr.update(mem)
+                                _devices.note_jit_cache("fit.step", step_fn)
+                            frec.note(**fr)
+                        if hb is not None:
+                            # queues this bundle, resolves the previous one
+                            # (policy may raise NumericsError one step late)
+                            hm.on_step(hb, step=step_i)
                         if self.listeners:
                             if score is None:
                                 score = float(loss)
@@ -978,6 +1023,18 @@ class ComputationGraph:
                     for l in self.listeners:
                         l.on_epoch_end(self)
                     self.epoch += 1
+            if use_health:
+                # resolve the tail bundle; an anomaly on the last step still
+                # runs the policy (may raise) before fit returns
+                hm.flush()
+        except BaseException as e:
+            if use_health:
+                try:
+                    hm.flush(apply_policy=False)  # final health into the ring
+                except Exception:
+                    pass
+            _flight.crash_dump(e)
+            raise
         finally:
             _listeners.run_fit_end_hooks(self)
         return self

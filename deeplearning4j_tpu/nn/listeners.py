@@ -79,8 +79,38 @@ class PerformanceListener(TrainingListener):
         shape = getattr(x, "shape", None)
         return shape[0] if shape else None
 
+    @staticmethod
+    def _telemetry_fields():
+        """Memory/health gauges the instrumented fit loop just refreshed —
+        read back from the shared registry (no device sync, no recompute)
+        when telemetry is on; {} otherwise."""
+        try:
+            from deeplearning4j_tpu import telemetry
+        except Exception:
+            return {}
+        reg = telemetry.get_registry()
+        if not reg.enabled:
+            return {}
+        out = {}
+        # grad_norm only while the watchdog is actively refreshing it: a
+        # stale gauge from an earlier watchdog-on fit must not misreport
+        # this run
+        if telemetry.health.get_monitor().active:
+            g = reg.get("train_grad_norm")
+            if g is not None and g.labelsets():
+                out["grad_norm"] = g.value()
+        g = reg.get("device_bytes_in_use")
+        if g is not None:
+            vals = [g.value(**ls) for ls in g.labelsets()]
+            if vals:
+                out["device_mb_in_use"] = max(vals) / 2**20
+        g = reg.get("live_array_bytes")
+        if g is not None and g.labelsets():
+            out["live_array_mb"] = g.value() / 2**20
+        return out
+
     def iteration_done(self, model, iteration, score, etl_time=0.0):
-        now = time.perf_counter()
+        now = time.perf_counter()  # the ONLY clock read per iteration
         if self._last is not None:
             dt = now - self._last
             bs = self.batch_size or self._infer_batch_size(model)
@@ -88,12 +118,23 @@ class PerformanceListener(TrainingListener):
                    "batches_per_sec": 1.0 / dt if dt > 0 else 0.0}
             if bs:
                 rec["samples_per_sec"] = bs / dt if dt > 0 else 0.0
+            rec.update(self._telemetry_fields())
             self.records.append(rec)
             if iteration % self.frequency == 0:
-                self.print_fn(
-                    f"iteration {iteration}: {dt * 1e3:.2f} ms/iter"
-                    + (f", {rec.get('samples_per_sec', 0):.1f} samples/sec" if bs else "")
-                    + f", etl {etl_time * 1e3:.2f} ms")
+                # one consolidated line: throughput + ETL + the telemetry
+                # gauges, so a tailed log reads health without a second tool
+                parts = [f"iteration {iteration}: {dt * 1e3:.2f} ms/iter"]
+                if bs:
+                    parts.append(
+                        f"{rec.get('samples_per_sec', 0):.1f} samples/sec")
+                parts.append(f"etl {etl_time * 1e3:.2f} ms")
+                if "grad_norm" in rec:
+                    parts.append(f"grad_norm {rec['grad_norm']:.3g}")
+                if "device_mb_in_use" in rec:
+                    parts.append(f"hbm {rec['device_mb_in_use']:.1f} MB")
+                elif "live_array_mb" in rec:
+                    parts.append(f"live {rec['live_array_mb']:.2f} MB")
+                self.print_fn(", ".join(parts))
         self._last = now
 
 

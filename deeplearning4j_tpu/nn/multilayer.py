@@ -30,6 +30,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import telemetry as _tm
+from deeplearning4j_tpu.telemetry import devices as _devices
+from deeplearning4j_tpu.telemetry import flight as _flight
+from deeplearning4j_tpu.telemetry import health as _health
 from deeplearning4j_tpu.nn import gradnorm as _gradnorm
 from deeplearning4j_tpu.nn import listeners as _listeners
 from deeplearning4j_tpu.nn.conf import inputs as _inputs
@@ -59,6 +62,7 @@ class MultiLayerNetwork:
         self.epoch = 0
         self.listeners = []
         self._train_step = None
+        self._train_step_health = None
         self._rng = jax.random.PRNGKey(conf.seed)
 
     # ------------------------------------------------------------------
@@ -289,19 +293,26 @@ class MultiLayerNetwork:
                       for l, p in zip(self.conf.layers, new_params)]
         return new_params, new_opt
 
-    def make_train_step(self, donate=True, jit=True):
+    def make_train_step(self, donate=True, jit=True, with_health=False):
         """Build the jitted train step:
         (params, state, opt_state, x, y, step, rng, mask) ->
-        (params, state, opt_state, loss).
+        (params, state, opt_state, loss[, health]).
 
         Mirrors BaseOptimizer.gradientAndScore:171 -> updater :187 ->
         StochasticGradientDescent step :78, fused into one XLA computation.
+        ``with_health=True`` appends the numerics-watchdog scalar bundle
+        (telemetry/health.py) — a few extra fused reductions, fetched
+        asynchronously by the fit loop's HealthMonitor.
         """
         def train_step(params, state, opt_state, x, y, step, rng, mask=None):
             loss, new_state, grads = self.compute_gradients(
                 params, state, x, y, rng=rng, mask=mask)
+            if with_health:
+                health = _health.health_stats(grads, params, loss)
             new_params, new_opt = self.apply_update(params, opt_state, grads,
                                                     step)
+            if with_health:
+                return new_params, new_state, new_opt, loss, health
             return new_params, new_state, new_opt, loss
 
         if not jit:
@@ -319,9 +330,20 @@ class MultiLayerNetwork:
         at MultiLayerNetwork.java:1205)."""
         if self.params is None:
             self.init()
-        if self._train_step is None:
-            self._train_step = self.make_train_step()
+        hm = _health.get_monitor()
+        use_health = hm.active  # one read per fit: the watchdog variant of
+        # the step is picked (and compiled) at fit entry, not mid-epoch
+        if use_health:
+            if self._train_step_health is None:
+                self._train_step_health = self.make_train_step(
+                    with_health=True)
+            step_fn = self._train_step_health
+        else:
+            if self._train_step is None:
+                self._train_step = self.make_train_step()
+            step_fn = self._train_step
         reg, step_h, etl_h, iters_c, score_g = _tm.train_metrics()
+        frec = _flight.get_recorder()
         try:
             with _tm.span("fit", net=type(self).__name__):
                 for _ in range(epochs):
@@ -338,17 +360,27 @@ class MultiLayerNetwork:
                         self.last_input = x  # for activation-visualizing listeners
                         step_start = etl_start + etl_time
                         score = None
+                        hb = None
+                        step_i = self.iteration
                         rec = reg.enabled  # one read: a mid-iteration
                         # enable() must not see half-initialized locals
                         with _tm.span("fit.step", iteration=self.iteration):
                             if (self.conf.backprop_type == "tbptt" and x.ndim == 3
                                     and y.ndim == 3
                                     and x.shape[1] > self.conf.tbptt_fwd_length):
+                                # TBPTT runs its own chunked step; the
+                                # watchdog bundle covers the plain step only
                                 loss = self._fit_tbptt(x, y, m)
                             else:
                                 self._rng, step_rng = jax.random.split(self._rng)
-                                self.params, self.state, self.opt_state, loss = \
-                                    self._train_step(
+                                if use_health:
+                                    (self.params, self.state, self.opt_state,
+                                     loss, hb) = step_fn(
+                                        self.params, self.state, self.opt_state,
+                                        x, y, self.iteration, step_rng, m)
+                                else:
+                                    (self.params, self.state, self.opt_state,
+                                     loss) = step_fn(
                                         self.params, self.state, self.opt_state,
                                         x, y, self.iteration, step_rng, m)
                                 self.score_value = loss
@@ -358,11 +390,26 @@ class MultiLayerNetwork:
                                 # device work, not just the async dispatch;
                                 # disabled, no host round-trip is added
                                 score = float(loss)
-                        if rec:
-                            step_h.observe(time.perf_counter() - step_start)
-                            etl_h.observe(etl_time)
-                            iters_c.inc()
-                            score_g.set(score)
+                        if rec or use_health:
+                            step_time = time.perf_counter() - step_start
+                            fr = {"step": step_i, "step_time_s": step_time,
+                                  "etl_time_s": etl_time}
+                            if score is not None:
+                                fr["score"] = score
+                            if rec:
+                                step_h.observe(step_time)
+                                etl_h.observe(etl_time)
+                                iters_c.inc()
+                                score_g.set(score)
+                                mem = _devices.poll_memory()
+                                if mem:
+                                    fr.update(mem)
+                                _devices.note_jit_cache("fit.step", step_fn)
+                            frec.note(**fr)
+                        if hb is not None:
+                            # queues this bundle, resolves the previous one
+                            # (policy may raise NumericsError one step late)
+                            hm.on_step(hb, step=step_i)
                         if self.listeners:
                             if score is None:
                                 score = float(loss)
@@ -372,6 +419,18 @@ class MultiLayerNetwork:
                     for l in self.listeners:
                         l.on_epoch_end(self)
                     self.epoch += 1
+            if use_health:
+                # resolve the tail bundle; an anomaly on the last step still
+                # runs the policy (may raise) before fit returns
+                hm.flush()
+        except BaseException as e:
+            if use_health:
+                try:
+                    hm.flush(apply_policy=False)  # final health into the ring
+                except Exception:
+                    pass
+            _flight.crash_dump(e)
+            raise
         finally:
             _listeners.run_fit_end_hooks(self)
         return self

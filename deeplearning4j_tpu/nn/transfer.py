@@ -118,22 +118,24 @@ def _install_freeze(net):
         return
     orig_make = net.make_train_step
 
-    def make_train_step(donate=True, jit=True):
-        base = orig_make(donate=False, jit=False)
+    def make_train_step(donate=True, jit=True, with_health=False):
+        base = orig_make(donate=False, jit=False, with_health=with_health)
 
         def step(params, state, opt_state, x, y, it, rng, mask=None):
-            new_params, new_state, new_opt, loss = base(params, state, opt_state,
-                                                        x, y, it, rng, mask)
+            out = base(params, state, opt_state, x, y, it, rng, mask)
+            new_params, new_state, new_opt, loss = out[:4]
             # restore frozen params exactly (zero effective update)
             new_params = [params[i] if i in frozen else p
                           for i, p in enumerate(new_params)]
-            return new_params, new_state, new_opt, loss
+            # out[4:] carries the watchdog health bundle when requested
+            return (new_params, new_state, new_opt, loss) + tuple(out[4:])
 
         if not jit:
             return step
         return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
 
     net.make_train_step = make_train_step
+    net._train_step_health = None  # pre-freeze compiled variant is stale
 
 
 class TransferLearningHelper:
@@ -297,15 +299,16 @@ def _install_freeze_graph(net):
         return
     orig_make = net.make_train_step
 
-    def make_train_step(donate=True, jit=True):
-        base = orig_make(donate=False, jit=False)
+    def make_train_step(donate=True, jit=True, with_health=False):
+        base = orig_make(donate=False, jit=False, with_health=with_health)
 
         def step(params, state, opt_state, x, y, it, rng, mask=None):
-            new_params, new_state, new_opt, loss = base(
-                params, state, opt_state, x, y, it, rng, mask)
+            out = base(params, state, opt_state, x, y, it, rng, mask)
+            new_params, new_state, new_opt, loss = out[:4]
             new_params = {name: (params[name] if name in frozen else p)
                           for name, p in new_params.items()}
-            return new_params, new_state, new_opt, loss
+            # out[4:] carries the watchdog health bundle when requested
+            return (new_params, new_state, new_opt, loss) + tuple(out[4:])
 
         if not jit:
             return step
@@ -313,3 +316,4 @@ def _install_freeze_graph(net):
 
     net.make_train_step = make_train_step
     net._train_step = None
+    net._train_step_health = None

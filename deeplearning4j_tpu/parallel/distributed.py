@@ -48,6 +48,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu import telemetry as _tm
+from deeplearning4j_tpu.telemetry import health as _health
 from deeplearning4j_tpu.native import codec as _codec
 from deeplearning4j_tpu.native.queue import FancyBlockingQueue
 from deeplearning4j_tpu.parallel import mesh as _mesh
@@ -108,6 +109,39 @@ class TrainingMaster:
                 reg.counter("distributed_rounds_total",
                             "distributed rounds executed, labeled by master"))
 
+    @staticmethod
+    def _worker_health_rollup(wh, master, step):
+        """Fetch the stacked per-worker health leaves (ONE batched transfer)
+        and fold them into gauges + the numerics watchdog.
+
+        ``wh`` is a dict of [n_workers]-shaped arrays: ``nonfinite`` plus a
+        per-worker norm (``grad_norm`` for the per-step master,
+        ``param_norm`` for the local-SGD master — grads don't cross its scan
+        boundary). A worker whose replica diverged is visible HERE even
+        though the pmean would smear it across the fleet one exchange later.
+        """
+        vals = jax.device_get(wh)
+        reg = _tm.get_registry()
+        g_nf = reg.gauge("distributed_worker_nonfinite",
+                         "1 when this worker's last round saw NaN/Inf, "
+                         "labeled by master and worker")
+        norm_key = "grad_norm" if "grad_norm" in vals else "param_norm"
+        g_norm = reg.gauge(f"distributed_worker_{norm_key}",
+                           f"per-worker {norm_key.replace('_', ' ')} at the "
+                           "last exchange, labeled by master and worker")
+        flags = np.asarray(vals["nonfinite"]).reshape(-1)
+        norms = np.asarray(vals[norm_key]).reshape(-1)
+        for w in range(len(flags)):
+            g_nf.set(1.0 if flags[w] else 0.0, master=master, worker=str(w))
+            g_norm.set(float(norms[w]), master=master, worker=str(w))
+        bad = [int(w) for w in np.nonzero(flags)[0]]
+        if bad:
+            _health.get_monitor().note_anomaly(
+                "distributed_nonfinite", step=step, master=master,
+                workers=bad, n_workers=len(flags))
+        else:
+            _health.get_monitor().note_healthy()
+
 
 def _stack_worker_dim(tree, n):
     return tree_map(lambda a: jnp.broadcast_to(a[None], (n,) + a.shape), tree)
@@ -139,11 +173,12 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         self.averaging_frequency = int(averaging_frequency)
         self.average_updaters = bool(average_updaters)
         self._split_fn = None
+        self._split_fns = {}  # keyed by watchdog flag
         self._net = None
         self._stats = {"splits": 0, "worker_steps": 0}
 
     # -- jitted split executor ----------------------------------------
-    def _build(self, net):
+    def _build(self, net, with_health):
         base_step = net.make_train_step(jit=False)
         avg_upd = self.average_updaters
 
@@ -162,26 +197,45 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
 
             (p, s, o, _, _), losses = jax.lax.scan(
                 body, (params, state, opt, 0, rng), (xs, ys))
+            ex = lambda t: tree_map(lambda a: a[None], t)
+            if with_health:
+                # per-worker rollup BEFORE the average smears divergence
+                # across the fleet: which replica went NaN, and how big its
+                # params grew over the local steps
+                wh = ex({"nonfinite": jnp.any(~jnp.isfinite(losses)),
+                         "param_norm": jnp.sqrt(_health.tree_sq_sum(p))})
             p = jax.lax.pmean(p, "data")
             if avg_upd:
                 o = jax.lax.pmean(o, "data")
-            ex = lambda t: tree_map(lambda a: a[None], t)
-            return (ex(p), ex(s), ex(o),
-                    jax.lax.pmean(jnp.mean(losses), "data"))
+            out = (ex(p), ex(s), ex(o),
+                   jax.lax.pmean(jnp.mean(losses), "data"))
+            return out + (wh,) if with_health else out
 
+        out_specs = (P("data"), P("data"), P("data"), P())
+        if with_health:
+            out_specs = out_specs + (P("data"),)
         fn = _compat.shard_map(
             split_step, mesh=self.mesh,
             in_specs=(P("data"), P("data"), P("data"), P("data"), P("data"),
                       P(), P("data")),
-            out_specs=(P("data"), P("data"), P("data"), P()),
+            out_specs=out_specs,
             check_vma=False)
         return jax.jit(fn, donate_argnums=(0, 1, 2))
 
     def execute_training(self, net, data, labels=None, *, epochs=1):
         """Fit ``net`` (a MultiLayerNetwork) on host arrays (x, y)."""
-        if self._split_fn is None or self._net is not net:
-            self._split_fn = self._build(net)
+        # compiled variants cached per watchdog flag (like the trainers'
+        # _train_step/_train_step_health pair): toggling the watchdog
+        # between calls must not re-pay the shard_map compile
+        with_health = _health.get_monitor().active
+        if self._net is not net:
+            self._split_fns = {}
             self._net = net
+        self._split_fn = self._split_fns.get(with_health)
+        if self._split_fn is None:
+            self._split_fn = self._split_fns[with_health] = \
+                self._build(net, with_health)
+        self._built_with_health = with_health
         n, w, f, b = (len(data), self.n_workers, self.averaging_frequency,
                       self.batch_size_per_worker)
         split_examples = w * f * b
@@ -217,11 +271,12 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                         (w, f, b) + labels.shape[1:])
                     rng, *subs = jax.random.split(rng, w + 1)
                     rngs = _put(jnp.stack(subs), mesh, "data")
-                    params, state, opt, loss = self._split_fn(
+                    out = self._split_fn(
                         params, state, opt,
                         _put(jnp.asarray(xs), mesh, "data"),
                         _put(jnp.asarray(ys), mesh, "data"),
                         it0, rngs)
+                    params, state, opt, loss = out[:4]
                     if reg.enabled:
                         # block inside the span so the round time covers the
                         # collective, not just the async dispatch; disabled,
@@ -231,6 +286,9 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                     round_h.observe(time.perf_counter() - t_round,
                                     master="parameter_averaging")
                     rounds_c.inc(master="parameter_averaging")
+                if self._built_with_health:
+                    self._worker_health_rollup(out[4], "parameter_averaging",
+                                               it0)
                 it0 += f
                 self._stats["splits"] += 1
                 self._stats["worker_steps"] += w * f
@@ -284,16 +342,26 @@ class SharedTrainingMaster(TrainingMaster):
         self.min_threshold = float(min_threshold)
         self.threshold_step = float(threshold_step)
         self._step_fn = None
+        self._step_fns = {}  # keyed by watchdog flag
         self._net = None
         self._stats = {"steps": 0}
 
-    def _build(self, net):
+    def _build(self, net, with_health):
         compress = self.threshold is not None
         min_t, t_step = self.min_threshold, self.threshold_step
 
         def step(params, state, opt, resid, tau, x, y, it, rng):
             loss, new_state, grads = net.compute_gradients(
                 params, state, x, y, rng=rng)
+            if with_health:
+                # per-worker rollup BEFORE the psum mixes everyone's
+                # gradients: the worker whose batch produced the NaN is
+                # identifiable, not just "the fleet went NaN"
+                wh = tree_map(
+                    lambda a: a[None],
+                    {"nonfinite": (_health.any_nonfinite(grads)
+                                   | ~jnp.isfinite(loss)),
+                     "grad_norm": jnp.sqrt(_health.tree_sq_sum(grads))})
             if compress:
                 sq = lambda t: tree_map(lambda a: a[0], t)
                 resid = sq(resid)
@@ -320,21 +388,32 @@ class SharedTrainingMaster(TrainingMaster):
             new_state = tree_map(
                 lambda a: jax.lax.pmean(a, "data")
                 if jnp.issubdtype(a.dtype, jnp.inexact) else a, new_state)
-            return (new_params, new_state, new_opt, resid, tau,
-                    jax.lax.pmean(loss, "data"))
+            out = (new_params, new_state, new_opt, resid, tau,
+                   jax.lax.pmean(loss, "data"))
+            return out + (wh,) if with_health else out
 
+        out_specs = (P(), P(), P(), P("data"), P(), P())
+        if with_health:
+            out_specs = out_specs + (P("data"),)
         fn = _compat.shard_map(
             step, mesh=self.mesh,
             in_specs=(P(), P(), P(), P("data"), P(), P("data"), P("data"),
                       P(), P()),
-            out_specs=(P(), P(), P(), P("data"), P(), P()),
+            out_specs=out_specs,
             check_vma=False)
         return jax.jit(fn, donate_argnums=(0, 1, 2, 3))
 
     def execute_training(self, net, data, labels=None, *, epochs=1):
-        if self._step_fn is None or self._net is not net:
-            self._step_fn = self._build(net)
+        # compiled variants cached per watchdog flag (cf. the trainers)
+        with_health = _health.get_monitor().active
+        if self._net is not net:
+            self._step_fns = {}
             self._net = net
+        self._step_fn = self._step_fns.get(with_health)
+        if self._step_fn is None:
+            self._step_fn = self._step_fns[with_health] = \
+                self._build(net, with_health)
+        self._built_with_health = with_health
         mesh, w, b = self.mesh, self.n_workers, self.batch_size_per_worker
         n = len(data)
         step_examples = w * b
@@ -366,14 +445,17 @@ class SharedTrainingMaster(TrainingMaster):
                     y = jax.device_put(
                         jnp.asarray(labels[s0:s0 + step_examples]), data_sh)
                     rng, sub = jax.random.split(rng)
-                    params, state, opt, resid, tau, loss = self._step_fn(
+                    out = self._step_fn(
                         params, state, opt, resid, tau, x, y, it, sub)
+                    params, state, opt, resid, tau, loss = out[:6]
                     if reg.enabled:
                         jax.block_until_ready(loss)  # cover the all-reduce
                 if reg.enabled:
                     round_h.observe(time.perf_counter() - t_round,
                                     master="shared")
                     rounds_c.inc(master="shared")
+                if self._built_with_health:
+                    self._worker_health_rollup(out[6], "shared", it)
                 it += 1
                 self._stats["steps"] += 1
                 for l in listeners:  # per-step callback (forces a host sync)

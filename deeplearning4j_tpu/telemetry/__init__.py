@@ -13,6 +13,16 @@ PerformanceListener / BaseStatsListener / OpProfiler (SURVEY.md §5):
   (``get_tracer().export(path)``), forwarded to
   ``jax.profiler.TraceAnnotation`` so host spans line up with XLA device
   ops in xprof.
+* ``health`` — numerics watchdog: ``health.enable(policy="raise")`` folds
+  NaN/Inf flags + grad norms + update/weight ratios into the jitted train
+  step and applies the policy (record/warn/``NumericsError``).
+* ``devices`` — HBM gauges (``device_bytes_in_use``, ``live_array_bytes``)
+  and the ``recompiles_total`` jit-cache-miss counter (recompile storms).
+* ``flight`` — ring-buffer flight recorder of the last N step records;
+  auto-dumps JSON on watchdog anomaly, uncaught fit exception, or SIGTERM
+  (``flight.install_signal_handler()``); pretty-print with the
+  ``flightrec`` CLI verb.
+* ``reset()`` — drop all recorded state across the subsystem (tests).
 
 Off by default; switch on per process with ``DL4J_TPU_TELEMETRY=1`` or at
 runtime::
@@ -35,10 +45,13 @@ from deeplearning4j_tpu.telemetry.registry import (DEFAULT_BUCKETS, Counter,
                                                    MetricsRegistry,
                                                    get_registry, write_jsonl)
 from deeplearning4j_tpu.telemetry.tracing import Tracer, get_tracer, span
+from deeplearning4j_tpu.telemetry import devices, flight, health
+from deeplearning4j_tpu.telemetry.health import NumericsError
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
            "DEFAULT_BUCKETS", "get_registry", "get_tracer", "span",
-           "write_jsonl", "enable", "disable", "enabled"]
+           "write_jsonl", "enable", "disable", "enabled", "reset",
+           "health", "devices", "flight", "NumericsError"]
 
 
 def enable():
@@ -53,6 +66,19 @@ def disable():
 
 def enabled():
     return get_registry().enabled
+
+
+def reset():
+    """Drop every piece of recorded telemetry state — registry series,
+    tracer buffer, watchdog state (back to inactive), recompile baselines,
+    flight-recorder ring — without discarding instrument objects. The test
+    isolation entry point (ISSUE 2): one call instead of per-module
+    teardown. Does not change the registry's enabled flag."""
+    get_registry().reset()
+    get_tracer().clear()
+    health.get_monitor().reset()
+    devices.reset()
+    flight.get_recorder().clear()
 
 
 def train_metrics():

@@ -1,0 +1,176 @@
+"""Device memory + XLA recompilation observability.
+
+Two TPU-stack failure modes the metrics tier could not see:
+
+* **HBM creep** — live-array bytes and per-device ``memory_stats()`` grow
+  until an OOM kills the run hours in. ``poll_memory()`` samples both into
+  the shared registry each recorded iteration (guarded: CPU backends return
+  ``None`` from ``memory_stats()`` — the per-device walk latches off after
+  the first empty poll; the live-array census still works everywhere).
+* **Recompile storms** — the canonical TPU perf trap (Fischer & Saba 2018,
+  §4: every new shape signature re-enters XLA compilation, turning a
+  microseconds step into seconds). ``note_jit_cache(site, fn)`` tracks a
+  jitted callable's compile-cache size; growth beyond the first fill counts
+  into ``recompiles_total{site=...}`` — a rising series IS the storm, now
+  scrapeable from /metrics instead of diagnosed by staring at wall clocks.
+
+Everything here is registry-gated: with telemetry disabled these functions
+are never called by the instrumented loops, and calling them anyway records
+nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from deeplearning4j_tpu.telemetry import registry as _registry
+
+#: recompiles-per-site at which /health flips to "warn": a couple of
+#: recompiles are normal warm-up (ragged final batch, eval shapes); a storm
+#: is one per step
+RECOMPILE_STORM_THRESHOLD = 8
+
+_lock = threading.Lock()
+_cache_sizes = {}        # (site, id(fn)) -> last observed jit cache size
+_mem_unsupported = False  # latched: this backend has no memory_stats()
+
+
+def reset():
+    """Drop recompile baselines + the memory-support latch (test isolation;
+    part of telemetry.reset())."""
+    global _mem_unsupported
+    with _lock:
+        _cache_sizes.clear()
+        _mem_unsupported = False
+
+
+def _instruments():
+    reg = _registry.get_registry()
+    return (reg,
+            reg.gauge("device_bytes_in_use",
+                      "per-device HBM bytes in use (memory_stats), "
+                      "labeled by device"),
+            reg.gauge("device_bytes_limit",
+                      "per-device HBM capacity bytes, labeled by device"),
+            reg.gauge("live_array_bytes",
+                      "total bytes of live jax arrays in this process"),
+            reg.counter("compiles_total",
+                        "jit cache entries created, labeled by site "
+                        "(first-fill warm-up included)"),
+            reg.counter("recompiles_total",
+                        "jit cache misses beyond the first fill, labeled "
+                        "by site — a rising series is a recompile storm"))
+
+
+def poll_memory(include_live_arrays=True):
+    """Sample device memory into the shared registry gauges.
+
+    Returns a small dict (``live_array_bytes``, ``device_bytes_in_use``:
+    max across devices) for callers that want the numbers inline (the fit
+    loops put them on flight-recorder step records), or ``None`` when the
+    registry is disabled.
+    """
+    global _mem_unsupported
+    reg, g_use, g_lim, g_live, _, _ = _instruments()
+    if not reg.enabled:
+        return None
+    out = {}
+    if not _mem_unsupported:
+        max_use = None
+        saw_stats = False
+        for d in jax.devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            saw_stats = True
+            dev = f"{d.platform}:{d.id}"
+            use = stats.get("bytes_in_use")
+            if use is not None:
+                g_use.set(use, device=dev)
+                max_use = use if max_use is None else max(max_use, use)
+            limit = (stats.get("bytes_limit")
+                     or stats.get("bytes_reservable_limit"))
+            if limit:
+                g_lim.set(limit, device=dev)
+        if not saw_stats:
+            _mem_unsupported = True  # don't re-walk devices every step
+        if max_use is not None:
+            out["device_bytes_in_use"] = int(max_use)
+    if include_live_arrays:
+        try:
+            live = int(sum(a.nbytes for a in jax.live_arrays()))
+        except Exception:
+            live = None
+        if live is not None:
+            g_live.set(live)
+            out["live_array_bytes"] = live
+    return out
+
+
+def memory_summary():
+    """Registry-independent snapshot — ``{devices: {dev: {bytes_in_use,
+    bytes_limit}}, live_array_bytes}`` — for bench records and /health.
+    CPU backends yield an empty ``devices`` map, never an error."""
+    out = {"devices": {}, "live_array_bytes": 0}
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        out["devices"][f"{d.platform}:{d.id}"] = {
+            "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "bytes_limit": int(stats.get("bytes_limit", 0)
+                               or stats.get("bytes_reservable_limit", 0)
+                               or 0)}
+    try:
+        out["live_array_bytes"] = int(sum(a.nbytes
+                                          for a in jax.live_arrays()))
+    except Exception:
+        pass
+    return out
+
+
+def note_jit_cache(site, fn):
+    """Observe a jitted callable's compile-cache size after a call.
+
+    The first observation baselines the expected warm-up compile(s); any
+    growth after that is a cache miss at a site that should be steady-state
+    — counted into ``recompiles_total{site=...}``. Keyed by (site, fn) so
+    two networks sharing a site name each get their own baseline. Returns
+    the number of NEW recompiles seen (0 on baseline or unsupported fn).
+    """
+    try:
+        size = fn._cache_size()
+    except Exception:
+        return 0
+    key = (site, id(fn))
+    with _lock:
+        last = _cache_sizes.get(key)
+        _cache_sizes[key] = size
+    reg, *_, c_comp, c_rec = _instruments()
+    if last is None:
+        if size:
+            c_comp.inc(size, site=site)
+        return 0
+    new = size - last
+    if new <= 0:
+        return 0
+    c_comp.inc(new, site=site)
+    c_rec.inc(new, site=site)
+    return new
+
+
+def recompile_counts():
+    """{site: recompiles} from the shared registry (for /health)."""
+    reg = _registry.get_registry()
+    c = reg.get("recompiles_total")
+    if c is None:
+        return {}
+    return {ls.get("site", ""): c.value(**ls) for ls in c.labelsets()}

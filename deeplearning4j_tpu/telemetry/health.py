@@ -1,0 +1,298 @@
+"""Numerics watchdog: NaN/Inf detection, gradient-norm telemetry, policy.
+
+The failure modes that actually kill long TPU jobs are silent: a loss that
+went NaN at step 40k, a single layer whose gradients exploded, an
+update/weight ratio that collapsed to zero. The reference surfaces none of
+these (PerformanceListener reports throughput, not health); TensorFlow-scale
+systems treat run-health monitoring as first-class (Abadi et al., 2016, §5).
+
+Two pieces:
+
+* ``health_stats(grads, params, loss)`` — a jit-friendly pure function that
+  folds NaN/Inf flags, the global and per-layer gradient L2 norms, and
+  per-layer update-to-weight ratio proxies (``||grad|| / ||param||`` — the
+  updater's LR scaling is uniform, so divergence shows up identically) into
+  ONE fused bundle of device scalars. The fit loops return it from the
+  jitted train step, so the watchdog adds a handful of reductions to the XLA
+  computation and zero extra dispatches.
+* ``HealthMonitor`` — the host-side consumer. Bundles are fetched with a
+  one-step delay (``on_step`` queues step *i* and resolves step *i-1*), so
+  the host transfer overlaps the next step's device execution instead of
+  serializing with dispatch; ``flush()`` drains the tail. On anomaly the
+  configured policy runs: ``record`` (count + flight-record), ``warn``
+  (+ log), or ``raise`` (+ ``NumericsError``) — every policy also triggers
+  one flight-recorder dump (telemetry/flight.py) so the postmortem exists
+  whether or not the run was allowed to die.
+
+Disabled (the default), the fit loops never build the health variant of the
+train step and never call into this module's hot path — the cost is one
+attribute read per fit() call, no device->host sync.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.telemetry import registry as _registry
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+POLICIES = ("record", "warn", "raise")
+
+
+class NumericsError(FloatingPointError):
+    """Raised by the watchdog under ``policy='raise'``. Carries the step
+    index, the anomaly record, and the flight-recorder dump path (also the
+    marker telemetry/flight.py uses to avoid double-dumping on the way out
+    of the fit loop)."""
+
+    def __init__(self, msg, step=None, record=None, flight_dump=None):
+        super().__init__(msg)
+        self.step = step
+        self.record = record
+        self.flight_dump = flight_dump
+
+
+# ----------------------------------------------------------------------
+# jit-friendly bundle
+# ----------------------------------------------------------------------
+
+def _named_groups(tree):
+    """Top-level (name, subtree) pairs of a params/grads pytree: the
+    MultiLayerNetwork list-of-dicts becomes ('0', ...), ('1', ...); the
+    ComputationGraph dict-of-dicts keeps its vertex names."""
+    if isinstance(tree, dict):
+        return list(tree.items())
+    return [(str(i), g) for i, g in enumerate(tree)]
+
+
+def tree_sq_sum(tree):
+    """Sum of squares over every leaf (f32 accumulation), as a scalar."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+
+
+def any_nonfinite(tree):
+    """Device bool: does any leaf contain a NaN or Inf?"""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(False)
+    flag = jnp.any(~jnp.isfinite(leaves[0]))
+    for l in leaves[1:]:
+        flag = flag | jnp.any(~jnp.isfinite(l))
+    return flag
+
+
+def health_stats(grads, params, loss):
+    """One fused health bundle: a flat dict of device scalars.
+
+    Keys: ``loss``, ``loss_nonfinite``, ``grad_nonfinite``, ``grad_norm``,
+    and per top-level group ``layer/<name>/grad_norm`` +
+    ``layer/<name>/gw_ratio`` (grad-to-weight L2 ratio, the update/weight
+    proxy). Designed to be returned from the jitted train step and fetched
+    in ONE ``jax.device_get`` — all reductions fuse into the step's XLA
+    computation.
+    """
+    loss32 = jnp.asarray(loss, jnp.float32)
+    bundle = {"loss": loss32,
+              "loss_nonfinite": ~jnp.isfinite(loss32),
+              "grad_nonfinite": any_nonfinite(grads)}
+    gsq_total = jnp.float32(0.0)
+    for (name, g), (_, p) in zip(_named_groups(grads), _named_groups(params)):
+        gsq = tree_sq_sum(g)
+        gsq_total = gsq_total + gsq
+        gn = jnp.sqrt(gsq)
+        bundle[f"layer/{name}/grad_norm"] = gn
+        # empty-params groups have empty grads too, so 0/eps stays 0
+        bundle[f"layer/{name}/gw_ratio"] = gn / (jnp.sqrt(tree_sq_sum(p))
+                                                 + 1e-12)
+    bundle["grad_norm"] = jnp.sqrt(gsq_total)
+    return bundle
+
+
+# ----------------------------------------------------------------------
+# host-side monitor
+# ----------------------------------------------------------------------
+
+class HealthMonitor:
+    """Process-wide watchdog consuming health bundles off the fit loops."""
+
+    def __init__(self, max_anomalies=32):
+        self._lock = threading.RLock()
+        self.max_anomalies = int(max_anomalies)
+        self._defaults()
+
+    def _defaults(self):
+        self.active = False
+        self.policy = "record"
+        self.grad_norm_limit = None
+        self.anomalies = collections.deque(maxlen=self.max_anomalies)
+        self.nonfinite_steps = 0
+        self.steps_checked = 0
+        self.last = None           # last resolved record (for /health)
+        self._pending = None       # (bundle, meta) awaiting async fetch
+        self._dumped = False       # one flight dump per anomaly streak
+
+    def enable(self, policy="record", grad_norm_limit=None):
+        """Arm the watchdog. ``policy``: 'record' | 'warn' | 'raise'.
+        ``grad_norm_limit``: optional finite-but-exploding threshold on the
+        global gradient norm (NaN/Inf always count as anomalies)."""
+        if policy not in POLICIES:
+            raise ValueError(f"policy must be one of {POLICIES}, got "
+                             f"{policy!r}")
+        with self._lock:
+            self.active = True
+            self.policy = policy
+            self.grad_norm_limit = (None if grad_norm_limit is None
+                                    else float(grad_norm_limit))
+            self._dumped = False  # re-arming starts a fresh dump streak
+        return self
+
+    def disable(self):
+        with self._lock:
+            self.active = False
+        return self
+
+    def reset(self):
+        """Back to cold state (test isolation; part of telemetry.reset())."""
+        with self._lock:
+            self._defaults()
+        return self
+
+    def _instruments(self):
+        reg = _registry.get_registry()
+        return (reg,
+                reg.gauge("train_grad_norm",
+                          "global gradient L2 norm (numerics watchdog)"),
+                reg.gauge("train_layer_grad_norm",
+                          "per-layer gradient L2 norm, labeled by layer"),
+                reg.gauge("train_layer_gw_ratio",
+                          "per-layer grad-to-weight L2 ratio "
+                          "(update/weight proxy), labeled by layer"),
+                reg.counter("train_numerics_anomalies_total",
+                            "watchdog anomalies observed, labeled by kind"))
+
+    # -- pipelined consumption -----------------------------------------
+
+    def on_step(self, bundle, **meta):
+        """Queue this step's device bundle; resolve the PREVIOUS one.
+
+        The one-step pipeline keeps the watchdog off the dispatch critical
+        path: the host fetch of step i's scalars overlaps step i+1's device
+        execution instead of forcing a sync at dispatch. Policy actions for
+        step i therefore fire while step i+1 runs — one step late, never
+        lost (``flush()`` drains the tail).
+        """
+        with self._lock:
+            prev, self._pending = self._pending, (bundle, meta)
+        if prev is not None:
+            self._resolve(*prev)
+
+    def flush(self, apply_policy=True):
+        """Resolve any pending bundle (fit-loop tail / exception path).
+        ``apply_policy=False`` records without warning/raising — used when
+        an exception is already propagating and must not be masked."""
+        with self._lock:
+            prev, self._pending = self._pending, None
+        if prev is not None:
+            self._resolve(*prev, apply_policy=apply_policy)
+
+    def _resolve(self, bundle, meta, apply_policy=True):
+        vals = jax.device_get(bundle)  # ONE batched transfer
+        rec = {k: (bool(v) if k.endswith("nonfinite") else float(v))
+               for k, v in vals.items()}
+        step = meta.get("step")
+        reg, g_norm, g_layer, g_ratio, _ = self._instruments()
+        if reg.enabled:
+            g_norm.set(rec["grad_norm"])
+            for k, v in rec.items():
+                if k.startswith("layer/"):
+                    _, name, kind = k.split("/", 2)
+                    (g_layer if kind == "grad_norm" else g_ratio).set(
+                        v, layer=name)
+        flat = {k: v for k, v in rec.items() if not k.startswith("layer/")}
+        with self._lock:
+            self.steps_checked += 1
+            self.last = {"step": step, **flat}
+        # annotate the flight-recorder ring BEFORE any dump so the offending
+        # step's record carries its health fields in the postmortem
+        from deeplearning4j_tpu.telemetry import flight as _flight
+        _flight.get_recorder().annotate(step, **flat)
+        nonfinite = rec["loss_nonfinite"] or rec["grad_nonfinite"]
+        exploded = (self.grad_norm_limit is not None
+                    and rec["grad_norm"] > self.grad_norm_limit)
+        if nonfinite or exploded:
+            self.note_anomaly("nonfinite" if nonfinite else "grad_norm_limit",
+                              step=step, apply_policy=apply_policy, **flat)
+        else:
+            self.note_healthy()
+
+    def note_healthy(self):
+        """A healthy observation ends the current anomaly streak: the NEXT
+        anomaly is a new incident and earns its own flight dump."""
+        with self._lock:
+            self._dumped = False
+
+    def note_anomaly(self, kind, step=None, apply_policy=True, **fields):
+        """Record one anomaly and run the policy. Also the entry point for
+        non-bundle anomaly sources (the distributed masters' per-worker
+        rollup)."""
+        a = {"kind": kind, "step": step, **fields}
+        with self._lock:
+            self.nonfinite_steps += 1
+            self.anomalies.append(a)
+            first = not self._dumped
+            self._dumped = True
+        reg, *_, c_anom = self._instruments()
+        c_anom.inc(kind=kind)
+        from deeplearning4j_tpu.telemetry import flight as _flight
+        path = None
+        if first:
+            # one dump per anomaly streak: once the params are NaN every
+            # subsequent step is anomalous, and a dump per step would bury
+            # the postmortem under identical files
+            path = _flight.get_recorder().dump(reason=f"numerics:{kind}",
+                                               extra={"anomaly": a})
+        if not apply_policy:
+            return a
+        msg = (f"numerics watchdog: {kind} at step {step} "
+               f"(loss={fields.get('loss')}, "
+               f"grad_norm={fields.get('grad_norm')})")
+        if self.policy == "warn":
+            logger.warning("%s%s", msg,
+                           f" [flight dump: {path}]" if path else "")
+        elif self.policy == "raise":
+            raise NumericsError(msg, step=step, record=a, flight_dump=path)
+        return a
+
+    def summary(self):
+        """JSON-ready state for the /health endpoint and bench records."""
+        with self._lock:
+            return {"active": self.active, "policy": self.policy,
+                    "steps_checked": self.steps_checked,
+                    "nonfinite_steps": self.nonfinite_steps,
+                    "last": dict(self.last) if self.last else None,
+                    "anomalies": [dict(a) for a in self.anomalies]}
+
+
+_monitor = HealthMonitor()
+
+
+def get_monitor():
+    return _monitor
+
+
+def enable(policy="record", grad_norm_limit=None):
+    """Arm the process-wide numerics watchdog (next fit() picks it up)."""
+    return _monitor.enable(policy=policy, grad_norm_limit=grad_norm_limit)
+
+
+def disable():
+    return _monitor.disable()

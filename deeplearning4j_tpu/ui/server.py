@@ -6,6 +6,9 @@ tabs) + remote/RemoteReceiverModule.java. Here: a dependency-free stdlib
 HTTP server with a self-contained HTML page (inline SVG charts) —
 
     GET  /            dashboard page (live-updating score chart)
+    GET  /health                     -> run-health JSON (watchdog status,
+                                        anomalies, recompiles, memory,
+                                        flight-recorder state)
     GET  /train/sessions             -> session ids
     GET  /train/overview?session=s   -> score curve + timing (JSON)
     GET  /train/model?session=s      -> per-param norms over time (JSON)
@@ -104,6 +107,12 @@ class UIServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                if url.path == "/health":
+                    # run-health snapshot: watchdog + recompiles + memory +
+                    # flight-recorder state in one JSON (the "is this run
+                    # sick, and why" endpoint next to the raw /metrics)
+                    self._json(_health_payload())
+                    return
                 if url.path in ("/", "/train", "/train/overview.html"):
                     self._html(_PAGE)
                     return
@@ -181,9 +190,10 @@ class UIServer:
         return cls._instance
 
     _KNOWN_PATHS = frozenset((
-        "/", "/metrics", "/train", "/train/overview.html", "/train/sessions",
-        "/train/overview", "/train/model", "/train/model.html",
-        "/train/system", "/train/system.html", "/remote"))
+        "/", "/metrics", "/health", "/train", "/train/overview.html",
+        "/train/sessions", "/train/overview", "/train/model",
+        "/train/model.html", "/train/system", "/train/system.html",
+        "/remote"))
 
     def _count_request(self, path):
         try:
@@ -229,6 +239,34 @@ class UIServer:
             self._thread.join(timeout=5)
         if UIServer._instance is self:
             UIServer._instance = None
+
+
+def _health_payload():
+    """The /health JSON: overall status + last anomalies + the signals that
+    justify it. Status ladder: ``sick`` when the numerics watchdog has seen
+    anomalies, ``warn`` on a recompile storm (any site past
+    devices.RECOMPILE_STORM_THRESHOLD), else ``ok``."""
+    from deeplearning4j_tpu.telemetry import devices as _devices
+    from deeplearning4j_tpu.telemetry import flight as _flight
+    from deeplearning4j_tpu.telemetry import health as _tm_health
+
+    watchdog = _tm_health.get_monitor().summary()
+    recompiles = _devices.recompile_counts()
+    status = "ok"
+    if any(v >= _devices.RECOMPILE_STORM_THRESHOLD
+           for v in recompiles.values()):
+        status = "warn"
+    if watchdog["nonfinite_steps"] or watchdog["anomalies"]:
+        status = "sick"
+    rec = _flight.get_recorder()
+    ring = rec.snapshot()
+    return {"status": status,
+            "watchdog": watchdog,
+            "recompiles": recompiles,
+            "memory": _devices.memory_summary(),
+            "flight": {"records": len(ring),
+                       "last_step": ring[-1].get("step") if ring else None,
+                       "dumps": list(rec.dumps)}}
 
 
 def _param_series(recs):

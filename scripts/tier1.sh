@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Tier-1 verify — the exact ROADMAP.md command, including the env gotcha:
+# the sandbox presets PALLAS_AXON_POOL_IPS (axon TPU tunnel) via
+# sitecustomize, and with it set a plain `python` can hang at startup
+# dialing the tunnel. `env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu`
+# pins the suite to the CPU backend. Run from anywhere:
+#
+#   scripts/tier1.sh            # full fast tier (~4.5 min)
+#   scripts/tier1.sh tests/test_health.py   # extra pytest args pass through
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_t1.log
+timeout -k 10 870 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python -m pytest "${@:-tests/}" -q -m 'not slow' \
+  --continue-on-collection-errors \
+  -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

@@ -1,0 +1,518 @@
+"""Training-health watchdog, device/recompile telemetry, flight recorder
+(ISSUE 2 acceptance): NaN injected into a real jitted MultiLayerNetwork.fit
+triggers the configured policy and dumps a flight-recorder JSON containing
+the offending step's record; a shape change bumps the recompile counter;
+/health serves the run-health payload; and with everything disabled the
+instrumented step path records nothing and stays sync-free."""
+
+import json
+import os
+import signal
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.telemetry import devices, flight, health
+from deeplearning4j_tpu.telemetry.health import NumericsError, health_stats
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    """One-call telemetry state reset around every test (ISSUE 2)."""
+    telemetry.reset()
+    telemetry.disable()
+    yield
+    telemetry.reset()
+    telemetry.disable()
+
+
+@pytest.fixture
+def flight_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FLIGHT_DIR", str(tmp_path))
+    return tmp_path
+
+
+def _mlp(n_in=4, seed=0):
+    from deeplearning4j_tpu.nn import layers as L
+    from deeplearning4j_tpu.nn import updaters as U
+    from deeplearning4j_tpu.nn.conf import inputs as I
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = NeuralNetConfig(seed=seed, updater=U.Adam(learning_rate=0.01)).list(
+        L.DenseLayer(n_out=8, activation="tanh"),
+        L.OutputLayer(n_out=2, loss="mcxent"),
+        input_type=I.FeedForwardType(n_in))
+    return MultiLayerNetwork(conf)
+
+
+def _xy(n=64, n_in=4, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, n_in).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, n)]
+    return x, y
+
+
+def _nan_xy(n=64, batch=16):
+    """Clean step 0, NaN features in step 1's batch."""
+    x, y = _xy(n)
+    x[batch:2 * batch] = np.nan
+    return x, y
+
+
+# ----------------------------------------------------------------------
+# health_stats: the jit-friendly bundle
+# ----------------------------------------------------------------------
+
+class TestHealthStats:
+    def test_bundle_list_tree(self):
+        grads = [{"W": jnp.ones((2, 2))}, {}]
+        params = [{"W": jnp.full((2, 2), 2.0)}, {}]
+        b = health_stats(grads, params, jnp.float32(1.0))
+        assert float(b["grad_norm"]) == pytest.approx(2.0)
+        assert not bool(b["loss_nonfinite"])
+        assert not bool(b["grad_nonfinite"])
+        assert float(b["layer/0/grad_norm"]) == pytest.approx(2.0)
+        # ||g|| / ||p|| = 2 / 4
+        assert float(b["layer/0/gw_ratio"]) == pytest.approx(0.5)
+        # empty-params layer contributes zeros, not NaN from 0/0
+        assert float(b["layer/1/gw_ratio"]) == 0.0
+
+    def test_bundle_dict_tree_keeps_vertex_names(self):
+        grads = {"dense": {"W": jnp.ones(3)}, "out": {}}
+        params = {"dense": {"W": jnp.ones(3)}, "out": {}}
+        b = health_stats(grads, params, jnp.float32(0.5))
+        assert "layer/dense/grad_norm" in b
+        assert "layer/out/grad_norm" in b
+
+    def test_detects_nonfinite(self):
+        grads = [{"W": jnp.asarray([np.nan, 1.0], jnp.float32)}]
+        params = [{"W": jnp.ones(2)}]
+        b = health_stats(grads, params, jnp.float32(np.inf))
+        assert bool(b["grad_nonfinite"])
+        assert bool(b["loss_nonfinite"])
+
+
+# ----------------------------------------------------------------------
+# watchdog through a real jitted fit (ISSUE 2 acceptance)
+# ----------------------------------------------------------------------
+
+class TestWatchdogFit:
+    def test_policy_raise_and_flight_dump(self, flight_dir):
+        telemetry.enable()
+        health.enable(policy="raise")
+        x, y = _nan_xy()
+        with pytest.raises(NumericsError) as ei:
+            _mlp().fit(x, y, epochs=1, batch_size=16)
+        err = ei.value
+        assert err.step == 1  # the NaN batch
+        assert err.record["kind"] == "nonfinite"
+        assert err.flight_dump and os.path.exists(err.flight_dump)
+        doc = json.load(open(err.flight_dump))
+        assert doc["reason"] == "numerics:nonfinite"
+        offending = [r for r in doc["records"] if r.get("step") == 1]
+        assert offending, "dump is missing the offending step's record"
+        assert offending[0]["loss_nonfinite"] or offending[0]["grad_nonfinite"]
+        # the raise happened mid-fit: exactly one dump, not one per step
+        assert len(flight.get_recorder().dumps) == 1
+
+    def test_policy_record_counts_and_completes(self, flight_dir):
+        telemetry.enable()
+        health.enable(policy="record")
+        x, y = _nan_xy()
+        _mlp().fit(x, y, epochs=1, batch_size=16)  # must NOT raise
+        mon = health.get_monitor()
+        # step 1 goes NaN and poisons the params: steps 1..3 all anomalous
+        assert mon.nonfinite_steps >= 2
+        assert mon.steps_checked == 4
+        assert mon.summary()["anomalies"][0]["step"] == 1
+        reg = telemetry.get_registry()
+        assert reg.get("train_numerics_anomalies_total").value(
+            kind="nonfinite") >= 2
+        # one dump per anomaly streak, not per anomalous step
+        assert len(flight.get_recorder().dumps) == 1
+
+    def test_new_anomaly_streak_gets_new_dump(self, flight_dir):
+        # one dump per INCIDENT: a healthy run between two NaN runs ends
+        # the first streak, so the second incident earns its own dump
+        telemetry.enable()
+        health.enable(policy="record")
+        xb, yb = _nan_xy(n=32)
+        xg, yg = _xy(32)
+        _mlp().fit(xb, yb, epochs=1, batch_size=16)      # incident 1
+        _mlp(seed=1).fit(xg, yg, epochs=1, batch_size=16)  # healthy run
+        _mlp(seed=2).fit(xb, yb, epochs=1, batch_size=16)  # incident 2
+        assert len(flight.get_recorder().dumps) == 2
+
+    def test_policy_warn_logs(self, flight_dir, caplog):
+        telemetry.enable()
+        health.enable(policy="warn")
+        x, y = _nan_xy(n=48)
+        import logging
+        with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+            _mlp().fit(x, y, epochs=1, batch_size=16)
+        assert any("numerics watchdog" in r.message for r in caplog.records)
+
+    def test_healthy_fit_gauges_and_no_anomaly(self):
+        telemetry.enable()
+        health.enable(policy="raise")  # must not fire on a healthy run
+        x, y = _xy()
+        _mlp().fit(x, y, epochs=1, batch_size=16)
+        mon = health.get_monitor()
+        assert mon.nonfinite_steps == 0
+        assert mon.steps_checked == 4  # tail bundle flushed at fit end
+        reg = telemetry.get_registry()
+        assert reg.get("train_grad_norm").value() > 0
+        layers = {ls["layer"]
+                  for ls in reg.get("train_layer_grad_norm").labelsets()}
+        assert layers == {"0", "1"}
+        assert reg.get("train_layer_gw_ratio").value(layer="0") > 0
+
+    def test_grad_norm_limit_policy(self, flight_dir):
+        telemetry.enable()
+        health.enable(policy="raise", grad_norm_limit=1e-9)  # trips at once
+        x, y = _xy()
+        with pytest.raises(NumericsError) as ei:
+            _mlp().fit(x, y, epochs=1, batch_size=16)
+        assert ei.value.record["kind"] == "grad_norm_limit"
+
+    def test_watchdog_without_metrics_registry(self, flight_dir):
+        # watchdog alone (telemetry disabled): policy still fires, no series
+        health.enable(policy="raise")
+        x, y = _nan_xy()
+        with pytest.raises(NumericsError):
+            _mlp().fit(x, y, epochs=1, batch_size=16)
+        reg = telemetry.get_registry()
+        assert all(not m["series"] for m in reg.snapshot().values())
+
+    def test_graph_fit_watchdog(self, flight_dir):
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.nn import updaters as U
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+
+        telemetry.enable()
+        health.enable(policy="raise")
+        conf = (GraphBuilder(updater=U.Sgd(learning_rate=0.1))
+                .add_inputs("in")
+                .set_input_types(I.FeedForwardType(4))
+                .add_layer("d", L.DenseLayer(n_out=8, activation="tanh"),
+                           "in")
+                .add_layer("out", L.OutputLayer(n_out=2, loss="mcxent"), "d")
+                .set_outputs("out")
+                .build())
+        x, y = _nan_xy(n=48)
+        with pytest.raises(NumericsError) as ei:
+            ComputationGraph(conf).fit(x, y, epochs=1, batch_size=16)
+        assert ei.value.step == 1
+        # per-vertex series carry graph vertex names
+        layers = {ls["layer"] for ls in telemetry.get_registry().get(
+            "train_layer_grad_norm").labelsets()}
+        assert "d" in layers and "out" in layers
+
+
+# ----------------------------------------------------------------------
+# flight recorder
+# ----------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_bounded_and_annotate(self):
+        r = flight.FlightRecorder(capacity=3)
+        for i in range(5):
+            r.note(step=i, score=float(i))
+        recs = r.snapshot()
+        assert [x["step"] for x in recs] == [2, 3, 4]
+        r.annotate(3, grad_norm=1.5)
+        assert r.snapshot()[1]["grad_norm"] == 1.5
+        # annotating an evicted step re-creates the record
+        r.annotate(0, grad_norm=9.0)
+        assert r.snapshot()[-1] == pytest.approx(
+            {"step": 0, "grad_norm": 9.0, "t": r.snapshot()[-1]["t"]})
+
+    def test_dump_on_fit_crash(self, flight_dir):
+        from deeplearning4j_tpu.nn.listeners import TrainingListener
+
+        class Boom(TrainingListener):
+            def iteration_done(self, model, iteration, score, etl_time=0.0):
+                if iteration >= 2:
+                    raise RuntimeError("simulated failure")
+
+        telemetry.enable()
+        x, y = _xy()
+        with pytest.raises(RuntimeError) as ei:
+            _mlp().add_listener(Boom()).fit(x, y, epochs=1, batch_size=16)
+        path = getattr(ei.value, "flight_dump", None)
+        assert path and os.path.exists(path)
+        doc = json.load(open(path))
+        assert doc["reason"] == "exception:RuntimeError"
+        assert doc["error"] == "simulated failure"
+        assert [r["step"] for r in doc["records"]] == [0, 1]
+
+    def test_empty_ring_dumps_nothing(self, flight_dir):
+        assert flight.get_recorder().dump(reason="numerics:test") is None
+        assert list(flight_dir.iterdir()) == []
+
+    def test_sigterm_handler_dumps_and_chains(self, flight_dir):
+        telemetry.enable()
+        flight.get_recorder().note(step=0, score=1.0)
+        chained = []
+        prev = signal.signal(signal.SIGUSR1, lambda s, f: chained.append(s))
+        try:
+            assert flight.install_signal_handler(signal.SIGUSR1)
+            # idempotent: second install is a no-op
+            assert not flight.install_signal_handler(signal.SIGUSR1)
+            os.kill(os.getpid(), signal.SIGUSR1)
+            assert chained == [signal.SIGUSR1]  # previous handler still ran
+            dumps = flight.get_recorder().dumps
+            assert len(dumps) == 1
+            assert json.load(open(dumps[0]))["reason"] == "signal:SIGUSR1"
+        finally:
+            signal.signal(signal.SIGUSR1, prev)
+            flight._sig_installed.pop(signal.SIGUSR1, None)
+
+
+# ----------------------------------------------------------------------
+# device memory + recompiles
+# ----------------------------------------------------------------------
+
+class TestDevices:
+    def test_memory_summary_guarded_on_cpu(self):
+        s = devices.memory_summary()
+        # CPU backend has no memory_stats(): devices map empty, never a raise
+        assert isinstance(s["devices"], dict)
+        assert s["live_array_bytes"] >= 0
+
+    def test_poll_memory_disabled_returns_none(self):
+        assert devices.poll_memory() is None
+
+    def test_poll_memory_live_array_gauge(self):
+        telemetry.enable()
+        out = devices.poll_memory()
+        assert out is not None and "live_array_bytes" in out
+        assert telemetry.get_registry().get(
+            "live_array_bytes").value() == out["live_array_bytes"]
+
+    def test_recompile_counter_on_shape_change(self):
+        telemetry.enable()
+        x, y = _xy(48)
+        net = _mlp()
+        # batch 32 then a ragged 16-tail: two signatures -> one recompile
+        net.fit(x, y, epochs=1, batch_size=32)
+        reg = telemetry.get_registry()
+        assert reg.get("recompiles_total").value(site="fit.step") == 1
+        assert reg.get("compiles_total").value(site="fit.step") == 2
+        # steady-state epochs add no recompiles
+        net.fit(x, y, epochs=1, batch_size=32)
+        assert reg.get("recompiles_total").value(site="fit.step") == 1
+
+    def test_note_jit_cache_unsupported_fn(self):
+        telemetry.enable()
+        assert devices.note_jit_cache("x", lambda: None) == 0
+
+
+# ----------------------------------------------------------------------
+# /health endpoint
+# ----------------------------------------------------------------------
+
+class TestHealthEndpoint:
+    def _get(self, server):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/health") as r:
+            assert r.status == 200
+            return json.loads(r.read())
+
+    def test_ok_when_nothing_wrong(self):
+        from deeplearning4j_tpu.ui import UIServer
+        server = UIServer(port=0).start()
+        try:
+            p = self._get(server)
+        finally:
+            server.stop()
+        assert p["status"] == "ok"
+        assert p["watchdog"]["nonfinite_steps"] == 0
+        assert p["flight"]["records"] == 0
+        assert "memory" in p and "recompiles" in p
+
+    def test_sick_after_nan_run(self, flight_dir):
+        from deeplearning4j_tpu.ui import UIServer
+        telemetry.enable()
+        health.enable(policy="record")
+        x, y = _nan_xy()
+        _mlp().fit(x, y, epochs=1, batch_size=16)
+        server = UIServer(port=0).start()
+        try:
+            p = self._get(server)
+        finally:
+            server.stop()
+        assert p["status"] == "sick"
+        assert p["watchdog"]["nonfinite_steps"] >= 1
+        assert p["watchdog"]["anomalies"][0]["kind"] == "nonfinite"
+        assert p["flight"]["records"] == 4
+        assert p["flight"]["last_step"] == 3
+        assert len(p["flight"]["dumps"]) == 1
+
+
+# ----------------------------------------------------------------------
+# distributed per-worker rollup
+# ----------------------------------------------------------------------
+
+class TestDistributedRollup:
+    def test_parameter_averaging_worker_gauges(self):
+        import jax
+        from jax.sharding import Mesh
+        from deeplearning4j_tpu.parallel.distributed import (
+            DistributedMultiLayer, ParameterAveragingTrainingMaster)
+
+        telemetry.enable()
+        health.enable(policy="record")
+        mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+        master = ParameterAveragingTrainingMaster(
+            mesh, batch_size_per_worker=8, averaging_frequency=2)
+        x, y = _xy(32)
+        DistributedMultiLayer(_mlp(), master).fit(x, y, epochs=1)
+        reg = telemetry.get_registry()
+        assert reg.get("distributed_worker_param_norm").value(
+            master="parameter_averaging", worker="0") > 0
+        assert reg.get("distributed_worker_nonfinite").value(
+            master="parameter_averaging", worker="0") == 0
+        assert health.get_monitor().nonfinite_steps == 0
+
+    def test_shared_master_nan_rollup(self, flight_dir):
+        import jax
+        from jax.sharding import Mesh
+        from deeplearning4j_tpu.parallel.distributed import (
+            DistributedMultiLayer, SharedTrainingMaster)
+
+        telemetry.enable()
+        health.enable(policy="record")
+        mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+        master = SharedTrainingMaster(mesh, batch_size_per_worker=8,
+                                      threshold=None)
+        x, y = _xy(32)
+        x[8:16] = np.nan  # round 1's shard
+        DistributedMultiLayer(_mlp(), master).fit(x, y, epochs=1)
+        mon = health.get_monitor()
+        kinds = {a["kind"] for a in mon.anomalies}
+        assert kinds == {"distributed_nonfinite"}
+        assert mon.anomalies[0]["workers"] == [0]
+        reg = telemetry.get_registry()
+        assert reg.get("distributed_worker_grad_norm").labelsets() == [
+            {"master": "shared", "worker": "0"}]
+
+    def test_master_caches_both_watchdog_variants(self):
+        # toggling the watchdog between calls must not re-pay the
+        # shard_map compile: both variants stay cached side by side
+        import jax
+        from jax.sharding import Mesh
+        from deeplearning4j_tpu.parallel.distributed import (
+            ParameterAveragingTrainingMaster)
+
+        mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+        master = ParameterAveragingTrainingMaster(
+            mesh, batch_size_per_worker=8, averaging_frequency=2)
+        net = _mlp()
+        net.init()
+        x, y = _xy(16)
+        master.execute_training(net, x, y, epochs=1)
+        plain = master._split_fns[False]
+        health.enable(policy="record")
+        master.execute_training(net, x, y, epochs=1)
+        assert set(master._split_fns) == {False, True}
+        health.disable()
+        master.execute_training(net, x, y, epochs=1)
+        assert master._split_fn is plain  # first compile reused
+
+
+# ----------------------------------------------------------------------
+# disabled path (acceptance: no sync, no records, branch-cheap)
+# ----------------------------------------------------------------------
+
+class TestDisabledPath:
+    def test_disabled_fit_records_nothing(self):
+        x, y = _xy()
+        _mlp().fit(x, y, epochs=2, batch_size=16)
+        assert flight.get_recorder().snapshot() == []
+        assert health.get_monitor().steps_checked == 0
+        reg = telemetry.get_registry()
+        assert all(not m["series"] for m in reg.snapshot().values())
+
+    def test_disabled_gate_overhead_smoke(self):
+        # the per-iteration disabled-path additions are two attribute
+        # reads and a branch (tripwire in the test_telemetry.py mold:
+        # 30k iterations far under a second)
+        import time
+        mon = health.get_monitor()
+        frec = flight.get_recorder()
+        reg = telemetry.get_registry()
+        t0 = time.perf_counter()
+        for _ in range(30000):
+            if reg.enabled or mon.active:
+                frec.note(step=0)
+        assert time.perf_counter() - t0 < 1.0
+        assert frec.snapshot() == []
+
+
+# ----------------------------------------------------------------------
+# listener + CLI surfaces
+# ----------------------------------------------------------------------
+
+class TestSurfaces:
+    def test_performance_listener_consolidated_line(self):
+        from deeplearning4j_tpu.nn.listeners import PerformanceListener
+
+        telemetry.enable()
+        health.enable(policy="record")
+        lines = []
+        lst = PerformanceListener(frequency=1, print_fn=lines.append)
+        x, y = _xy(48)
+        _mlp().add_listener(lst).fit(x, y, epochs=2, batch_size=16)
+        assert any("grad_norm" in l for l in lines)
+        # one line carries throughput AND health (consolidated, not split)
+        health_lines = [l for l in lines if "grad_norm" in l]
+        assert all("ms/iter" in l for l in health_lines)
+        assert lst.records[-1]["grad_norm"] > 0
+        assert "live_array_mb" in lst.records[-1]
+
+    def test_performance_listener_plain_when_disabled(self):
+        from deeplearning4j_tpu.nn.listeners import PerformanceListener
+
+        lines = []
+        lst = PerformanceListener(frequency=1, print_fn=lines.append)
+        x, y = _xy(32)
+        _mlp().add_listener(lst).fit(x, y, epochs=2, batch_size=16)
+        assert lines and all("grad_norm" not in l for l in lines)
+        assert all("grad_norm" not in r for r in lst.records)
+
+    def test_flightrec_cli_table_and_json(self, flight_dir, capsys):
+        from deeplearning4j_tpu.cli import main
+
+        telemetry.enable()
+        r = flight.get_recorder()
+        for i in range(3):
+            r.note(step=i, score=1.0 / (i + 1), step_time_s=0.01)
+        r.annotate(2, loss_nonfinite=True, grad_norm=float("nan"))
+        path = r.dump(reason="numerics:nonfinite")
+        assert main(["flightrec", path]) == 0
+        out = capsys.readouterr().out
+        assert "reason=numerics:nonfinite" in out
+        assert "1 record(s) flagged nonfinite; first at step 2" in out
+        assert main(["flightrec", path, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["n_records"] == 3
+
+    def test_telemetry_reset_clears_everything(self):
+        telemetry.enable()
+        health.enable(policy="warn")
+        telemetry.get_registry().counter("x_total").inc()
+        flight.get_recorder().note(step=0)
+        health.get_monitor().note_anomaly("nonfinite", step=0,
+                                          apply_policy=False)
+        telemetry.reset()
+        assert telemetry.get_registry().get("x_total").value() == 0
+        assert flight.get_recorder().snapshot() == []
+        mon = health.get_monitor()
+        assert not mon.active and mon.nonfinite_steps == 0
+        assert devices.recompile_counts() == {}

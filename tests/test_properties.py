@@ -15,6 +15,12 @@ Bounded example counts keep the fast tier fast.
 import unicodedata
 
 import numpy as np
+import pytest
+
+# the tier-1 env has no hypothesis (and no pip): skip the module cleanly
+# instead of erroring at collection
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings, strategies as st
 
 MAX_EXAMPLES = 25

@@ -18,17 +18,24 @@ from deeplearning4j_tpu.telemetry import tracing as _tracing
 from deeplearning4j_tpu.telemetry.registry import MetricsRegistry, write_jsonl
 
 
+@pytest.fixture(autouse=True)
+def _isolate():
+    """Full telemetry-state isolation around EVERY test via the one-call
+    telemetry.reset() (registry series, tracer, watchdog, recompile
+    baselines, flight ring) — replaces the ad-hoc per-fixture teardown."""
+    telemetry.reset()
+    telemetry.disable()
+    yield
+    telemetry.reset()
+    telemetry.disable()
+
+
 @pytest.fixture
-def fresh():
-    """Enabled, empty default registry + tracer; disabled and cleared after."""
+def fresh(_isolate):
+    """Enabled, empty default registry (teardown handled by _isolate)."""
     reg = telemetry.get_registry()
-    reg.reset()
-    telemetry.get_tracer().clear()
     telemetry.enable()
     yield reg
-    telemetry.disable()
-    reg.reset()
-    telemetry.get_tracer().clear()
 
 
 def _mlp(n_in=4, seed=0):
@@ -304,6 +311,11 @@ class TestDisabled:
         _mlp().fit(x, y, epochs=2, batch_size=32)
         assert all(not m["series"] for m in reg.snapshot().values())
         assert telemetry.get_tracer().chrome_trace()["traceEvents"] == []
+        # ISSUE 2: the watchdog/flight/devices tier is equally silent —
+        # the disabled step path takes no extra clock reads, allocs or
+        # device->host syncs
+        assert telemetry.flight.get_recorder().snapshot() == []
+        assert telemetry.health.get_monitor().steps_checked == 0
 
 
 # ----------------------------------------------------------------------
