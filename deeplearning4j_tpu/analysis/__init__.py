@@ -1,0 +1,61 @@
+"""graftlint: JAX-aware static analysis for the TPU step path.
+
+The structural fact this repo inherits from the reference (SURVEY.md) —
+orchestration language above, compiled kernels below — has one classic
+failure mode: host code silently forcing device->host syncs or XLA
+recompiles inside the training/serving step path. DL4J's workspace
+validation mode existed for exactly this bug class; TVM and the XLA
+weight-update-sharding work (PAPERS.md) both check such invariants in the
+compiler rather than by convention. PRs 1-2 assert "no added syncs when
+disabled" at runtime in tests; this package makes the invariants
+*mechanically* enforceable repo-wide:
+
+* ``R1 host-sync``       — implicit device->host syncs (``float()`` /
+  ``.item()`` / ``np.asarray`` ...) in traced functions, or applied
+  per-iteration to step-fn results in fit/round loops.
+* ``R2 traced-branch``   — Python ``if``/``while`` on traced values inside
+  jitted bodies (TracerBoolConversionError at runtime; flagged statically).
+* ``R3 recompile``       — re-jitting inside loops, jit-of-fresh-lambda:
+  the recompile-storm hazards ``telemetry.devices`` can only count after
+  the fact.
+* ``R4 impure-jit``      — telemetry / clock / RNG / I/O calls inside
+  traced code (silently trace-time-only, or a hidden sync); device-side
+  stats must go through the fetched-one-step-late pattern
+  (``telemetry.health``, ``telemetry.scorepipe``).
+* ``R5 backend-guard``   — ``memory_stats()``-style backend-specific calls
+  outside a try/except guard (CPU backends return None or raise).
+* ``R6 thread-discipline`` — threads without an explicit ``daemon`` flag;
+  read-modify-write of shared attributes outside the owning lock in
+  lock-bearing classes.
+
+Pure stdlib (``ast`` + ``tokenize``) — importing this package never
+imports jax, so the linter runs anywhere (CI, pre-commit) without touching
+an accelerator backend.
+
+Usage::
+
+    python -m deeplearning4j_tpu lint                  # whole package
+    python -m deeplearning4j_tpu lint --rules R1 nn/   # one rule, one tree
+    scripts/lint.sh R1 deeplearning4j_tpu/nn           # same, from shell
+
+Suppress a deliberate finding on its line with a justification::
+
+    jax.block_until_ready(loss)  # graftlint: disable=R1 -- span must cover the collective
+
+Pre-existing findings live in ``graftlint.baseline.json`` (repo root);
+``--update-baseline`` rewrites it, ``--strict-baseline`` (CI) also fails
+on stale entries so the baseline only ever shrinks.
+"""
+
+from deeplearning4j_tpu.analysis.core import (Finding, LintError, LintModule,
+                                              all_rules, lint_paths,
+                                              lint_source)
+from deeplearning4j_tpu.analysis.baseline import (apply_baseline,
+                                                  default_baseline_path,
+                                                  load_baseline,
+                                                  save_baseline)
+from deeplearning4j_tpu.analysis import rules as _rules  # registers R1-R6
+
+__all__ = ["Finding", "LintError", "LintModule", "all_rules", "lint_paths",
+           "lint_source", "apply_baseline", "default_baseline_path",
+           "load_baseline", "save_baseline"]

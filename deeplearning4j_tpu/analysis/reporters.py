@@ -1,0 +1,49 @@
+"""graftlint reporters: human ``file:line:col`` lines and a JSON document.
+
+The human form is the compiler-error shape editors already parse; the
+JSON form is the machine artifact CI and the test-suite read (same
+"one schema for every machine-readable artifact" stance as
+``telemetry.registry.write_jsonl``).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def report_human(new, known, stale, stream=None, verbose=False):
+    """Print new findings (always), known/stale summaries (counts), and
+    return the one-line verdict string."""
+    stream = sys.stderr if stream is None else stream
+    for f in new:
+        print(f.human(), file=stream)
+    if verbose:
+        for f in known:
+            print(f"{f.human()}  [baselined]", file=stream)
+    bits = [f"{len(new)} new finding(s)"]
+    if known:
+        bits.append(f"{len(known)} baselined")
+    if stale:
+        bits.append(f"{len(stale)} stale baseline entr"
+                    f"{'y' if len(stale) == 1 else 'ies'}")
+    verdict = "graftlint: " + ", ".join(bits)
+    print(verdict, file=stream)
+    if stale:
+        for k in sorted(stale):
+            print(f"  stale: {k} (x{stale[k]})", file=stream)
+        print("  (fixed debt — remove with: python -m deeplearning4j_tpu "
+              "lint --update-baseline)", file=stream)
+    return verdict
+
+
+def report_json(new, known, stale, stream=None):
+    doc = {"new": [f.to_json() for f in new],
+           "baselined": [f.to_json() for f in known],
+           "stale_baseline": dict(sorted(stale.items())),
+           "counts": {"new": len(new), "baselined": len(known),
+                      "stale": len(stale)}}
+    stream = sys.stdout if stream is None else stream
+    json.dump(doc, stream, indent=1)
+    stream.write("\n")
+    return doc

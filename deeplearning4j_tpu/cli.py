@@ -95,6 +95,33 @@ def _build_parser():
     tl.add_argument("--chrome-trace",
                     help="also export the host-span Chrome trace JSON here")
 
+    ln = sub.add_parser(
+        "lint",
+        help="graftlint: JAX-aware static analysis (hidden host syncs, "
+             "jit purity, recompile hazards) — see analysis/")
+    ln.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: the "
+                         "deeplearning4j_tpu package)")
+    ln.add_argument("--rules",
+                    help="comma-separated rule subset (e.g. R1,R4); "
+                         "default all")
+    ln.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ln.add_argument("--format", choices=("human", "json"), default="human")
+    ln.add_argument("--baseline",
+                    help="baseline file (default: "
+                         "<repo>/graftlint.baseline.json)")
+    ln.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding")
+    ln.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "and exit 0")
+    ln.add_argument("--strict-baseline", action="store_true",
+                    help="CI mode: stale baseline entries (fixed debt "
+                         "still in the ledger) also fail")
+    ln.add_argument("--verbose", action="store_true",
+                    help="also print baselined findings")
+
     fr = sub.add_parser(
         "flightrec",
         help="pretty-print a crash flight-recorder dump "
@@ -311,6 +338,51 @@ def _cmd_telemetry(args):
     return 0
 
 
+def _cmd_lint(args):
+    """graftlint CLI: exit 0 when every finding is fixed/suppressed/
+    baselined, non-zero otherwise — the tier-1 gating contract."""
+    import os
+
+    from deeplearning4j_tpu import analysis
+    from deeplearning4j_tpu.analysis import reporters
+
+    if args.list_rules:
+        for name, rule in analysis.all_rules().items():
+            print(f"{name} [{rule.slug}]\n    {rule.description}")
+        return 0
+
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(pkg_dir)
+    paths = args.paths or [pkg_dir]
+    rules = args.rules.split(",") if args.rules else None
+    try:
+        findings = analysis.lint_paths(paths, rules=rules, root=root)
+    except analysis.LintError as e:
+        raise SystemExit(f"graftlint: {e}")
+
+    if args.no_baseline:
+        baseline = {}
+    else:
+        bpath = args.baseline or analysis.default_baseline_path()
+        if args.update_baseline:
+            analysis.save_baseline(bpath, findings)
+            print(f"graftlint: baseline rewritten with {len(findings)} "
+                  f"finding(s): {bpath}", file=sys.stderr)
+            return 0
+        baseline = analysis.load_baseline(bpath)
+    new, known, stale = analysis.apply_baseline(findings, baseline)
+
+    if args.format == "json":
+        reporters.report_json(new, known, stale)
+    else:
+        reporters.report_human(new, known, stale, verbose=args.verbose)
+    if new:
+        return 1
+    if stale and args.strict_baseline:
+        return 1
+    return 0
+
+
 #: flight-record columns in display order; only those present in the dump
 #: are rendered (health fields appear when the watchdog annotated the ring)
 _FLIGHT_COLS = ("step", "score", "loss", "step_time_s", "etl_time_s",
@@ -375,6 +447,8 @@ def main(argv=None):
         return _cmd_telemetry(args)
     if args.command == "flightrec":
         return _cmd_flightrec(args)
+    if args.command == "lint":
+        return _cmd_lint(args)
     return 1
 
 

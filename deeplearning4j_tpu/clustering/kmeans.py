@@ -64,7 +64,7 @@ class KMeans:
         prev_inertia = np.inf
         for it in range(self.max_iterations):
             centroids, assign, inertia = _lloyd_step(pts, centroids, self.k)
-            inertia = float(inertia)
+            inertia = float(inertia)  # graftlint: disable=R1 -- the tolerance test below IS the per-iteration host decision (Lloyd convergence), same as the convex solvers
             if abs(prev_inertia - inertia) < self.tol * max(abs(prev_inertia), 1.0):
                 break
             prev_inertia = inertia

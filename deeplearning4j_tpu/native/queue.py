@@ -60,7 +60,8 @@ class FancyBlockingQueue:
     def register_consumer(self) -> int:
         if self._native:
             cid = int(self._lib.dl4j_fbq_register(self._h))
-            self._n_consumers_cache += 1
+            with self._tok_lock:  # counter read by token refcounting
+                self._n_consumers_cache += 1
             return cid
         with self._lock:
             self._cursors.append(self._head_seq + len(self._buf))

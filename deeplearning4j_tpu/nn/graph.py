@@ -825,6 +825,7 @@ class ComputationGraph:
         carries = self._zero_carries(first.shape[0], jnp.asarray(first).dtype)
         total = 0.0
         n_chunks = 0
+        chunk_scores = []  # (iteration, device loss) for listener replay
         for t0 in range(0, T, L):
             ci = self._chunk_time(inputs, t0, t0 + L)
             cl = self._chunk_time(labels, t0, t0 + L)
@@ -837,8 +838,17 @@ class ComputationGraph:
             n_chunks += 1
             self.iteration += 1
             self.score_value = loss
-            for lst in self.listeners:
-                lst.iteration_done(self, self.iteration, float(loss))
+            if self.listeners:
+                chunk_scores.append((self.iteration, loss))
+        if chunk_scores:
+            # ONE batched fetch for every chunk's listener callback —
+            # per-chunk float(loss) would sync each TBPTT chunk
+            # (graftlint R1); the callbacks fire after the macro-batch,
+            # matching the device-accumulated score below
+            vals = jax.device_get([s for _, s in chunk_scores])
+            for (it, _), v in zip(chunk_scores, vals):
+                for lst in self.listeners:
+                    lst.iteration_done(self, it, float(v))
         self.score_value = float(total) / max(n_chunks, 1)
         return self.score_value
 
@@ -943,6 +953,13 @@ class ComputationGraph:
         bs = batch_size or n
         reg, step_h, etl_h, iters_c, score_g = _tm.train_metrics()
         frec = _flight.get_recorder()
+        # score path is PIPELINED one step late (graftlint R1): queue step
+        # i's device loss, fetch it while step i+1 runs — the MLN fit-loop
+        # pattern exactly; record schema + listener fan-out shared via
+        # StepRecordEmitter (see telemetry/scorepipe)
+        pipe = _tm.ScorePipeline()
+        emitter = _tm.scorepipe.StepRecordEmitter(self, step_h, etl_h,
+                                                  iters_c, score_g, frec)
         try:
             with _tm.span("fit", net=type(self).__name__):
                 for _ in range(epochs):
@@ -972,12 +989,14 @@ class ComputationGraph:
                         # for PerformanceListener batch-size inference +
                         # activation-visualizing listeners (MLN convention)
                         self.last_input = next(iter(bi.values()))
-                        score = None
                         hb = None
                         step_i = self.iteration
                         rec = reg.enabled  # one read: a mid-iteration
                         # enable() must not see half-initialized locals
-                        with _tm.span("fit.step", iteration=self.iteration):
+                        want_score = rec or bool(self.listeners)
+                        resolved = meta = None
+                        step_start = time.perf_counter()
+                        with _tm.span("fit.step", iteration=step_i):
                             self._rng, sub = jax.random.split(self._rng)
                             if use_health:
                                 (self.params, self.state, self.opt_state,
@@ -991,35 +1010,36 @@ class ComputationGraph:
                                     bi, bl, self.iteration, sub, bm)
                             self.score_value = loss  # device scalar
                             self.iteration += 1
-                            if rec:
-                                score = float(loss)  # sync inside the span
-                        if rec or use_health:
-                            step_time = (time.perf_counter() - etl_start
-                                         - etl_time)
-                            fr = {"step": step_i, "step_time_s": step_time,
-                                  "etl_time_s": etl_time}
-                            if score is not None:
-                                fr["score"] = score
-                            if rec:
-                                step_h.observe(step_time)
-                                etl_h.observe(etl_time)
-                                iters_c.inc()
-                                score_g.set(score)
-                                mem = _devices.poll_memory()
-                                if mem:
-                                    fr.update(mem)
-                                _devices.note_jit_cache("fit.step", step_fn)
-                            frec.note(**fr)
+                            if want_score:
+                                # resolve step i-1 inside the span: the
+                                # fetch overlaps the step just dispatched
+                                meta = {"step": step_i,
+                                        "iteration": self.iteration,
+                                        "etl_time_s": etl_time, "rec": rec,
+                                        "health": use_health,
+                                        "step_time_s": 0.0}
+                                resolved = pipe.push(loss, meta)
+                        if meta is not None:
+                            meta["step_time_s"] = (time.perf_counter()
+                                                   - step_start)
+                        if resolved is not None:
+                            emitter.emit(*resolved)
+                        elif use_health and not want_score:
+                            frec.note(step=step_i,
+                                      step_time_s=(time.perf_counter()
+                                                   - step_start),
+                                      etl_time_s=etl_time)
+                        if rec:
+                            _devices.note_jit_cache("fit.step", step_fn)
                         if hb is not None:
                             # queues this bundle, resolves the previous one
                             # (policy may raise NumericsError one step late)
                             hm.on_step(hb, step=step_i)
-                        if self.listeners:
-                            if score is None:
-                                score = float(loss)
-                            for l in self.listeners:
-                                l.iteration_done(self, self.iteration, score,
-                                                 etl_time)
+                    # drain the score pipeline at the epoch edge (one sync
+                    # per epoch) before the epoch-end callbacks fire
+                    tail = pipe.flush()
+                    if tail is not None:
+                        emitter.emit(*tail)
                     for l in self.listeners:
                         l.on_epoch_end(self)
                     self.epoch += 1

@@ -344,6 +344,16 @@ class MultiLayerNetwork:
             step_fn = self._train_step
         reg, step_h, etl_h, iters_c, score_g = _tm.train_metrics()
         frec = _flight.get_recorder()
+        # score path is PIPELINED: step i's loss is queued on dispatch and
+        # fetched while step i+1 runs on device — the same one-step-late
+        # pattern as HealthMonitor.on_step and the TBPTT on-device
+        # accumulation below. No per-iteration float(loss) sync remains
+        # in this loop (graftlint R1). Record schema + listener fan-out
+        # (and the documented one-step listener skew) live in the shared
+        # StepRecordEmitter.
+        pipe = _tm.ScorePipeline()
+        emitter = _tm.scorepipe.StepRecordEmitter(self, step_h, etl_h,
+                                                  iters_c, score_g, frec)
         try:
             with _tm.span("fit", net=type(self).__name__):
                 for _ in range(epochs):
@@ -358,13 +368,14 @@ class MultiLayerNetwork:
                             m = jnp.asarray(m) if m is not None else None
                         etl_time = time.perf_counter() - etl_start
                         self.last_input = x  # for activation-visualizing listeners
-                        step_start = etl_start + etl_time
-                        score = None
                         hb = None
                         step_i = self.iteration
                         rec = reg.enabled  # one read: a mid-iteration
                         # enable() must not see half-initialized locals
-                        with _tm.span("fit.step", iteration=self.iteration):
+                        want_score = rec or bool(self.listeners)
+                        resolved = meta = None
+                        step_start = time.perf_counter()
+                        with _tm.span("fit.step", iteration=step_i):
                             if (self.conf.backprop_type == "tbptt" and x.ndim == 3
                                     and y.ndim == 3
                                     and x.shape[1] > self.conf.tbptt_fwd_length):
@@ -385,37 +396,42 @@ class MultiLayerNetwork:
                                         x, y, self.iteration, step_rng, m)
                                 self.score_value = loss
                                 self.iteration += 1
-                            if rec:
-                                # sync INSIDE the span so step time covers the
-                                # device work, not just the async dispatch;
-                                # disabled, no host round-trip is added
-                                score = float(loss)
-                        if rec or use_health:
-                            step_time = time.perf_counter() - step_start
-                            fr = {"step": step_i, "step_time_s": step_time,
-                                  "etl_time_s": etl_time}
-                            if score is not None:
-                                fr["score"] = score
-                            if rec:
-                                step_h.observe(step_time)
-                                etl_h.observe(etl_time)
-                                iters_c.inc()
-                                score_g.set(score)
-                                mem = _devices.poll_memory()
-                                if mem:
-                                    fr.update(mem)
-                                _devices.note_jit_cache("fit.step", step_fn)
-                            frec.note(**fr)
+                            if want_score:
+                                # queue step i, resolve step i-1 INSIDE the
+                                # span: the blocking fetch overlaps the step
+                                # just dispatched, so the recorded window
+                                # converges to the device step time without
+                                # a same-step sync
+                                meta = {"step": step_i,
+                                        "iteration": self.iteration,
+                                        "etl_time_s": etl_time, "rec": rec,
+                                        "health": use_health,
+                                        "step_time_s": 0.0}
+                                resolved = pipe.push(loss, meta)
+                        if meta is not None:
+                            meta["step_time_s"] = (time.perf_counter()
+                                                   - step_start)
+                        if resolved is not None:
+                            emitter.emit(*resolved)
+                        elif use_health and not want_score:
+                            # watchdog-only run: flight-record the step
+                            # shape without fetching a score
+                            frec.note(step=step_i,
+                                      step_time_s=(time.perf_counter()
+                                                   - step_start),
+                                      etl_time_s=etl_time)
+                        if rec:
+                            _devices.note_jit_cache("fit.step", step_fn)
                         if hb is not None:
                             # queues this bundle, resolves the previous one
                             # (policy may raise NumericsError one step late)
                             hm.on_step(hb, step=step_i)
-                        if self.listeners:
-                            if score is None:
-                                score = float(loss)
-                            for l in self.listeners:
-                                l.iteration_done(self, self.iteration, score,
-                                                 etl_time)
+                    # drain the score pipeline at the epoch edge so the
+                    # last iteration's record/callback lands before
+                    # on_epoch_end (one sync per epoch, not per step)
+                    tail = pipe.flush()
+                    if tail is not None:
+                        emitter.emit(*tail)
                     for l in self.listeners:
                         l.on_epoch_end(self)
                     self.epoch += 1

@@ -146,7 +146,7 @@ class TransferLearningHelper:
     def __init__(self, net: MultiLayerNetwork, frozen_until: int):
         self.net = net
         self.frozen_until = frozen_until
-        self._prefix = jax.jit(
+        self._prefix = jax.jit(  # graftlint: disable=R3 -- built ONCE per helper in __init__ and cached on self; one helper = one featurizer compile
             lambda p, s, x: net.apply_fn(p, s, x, train=False,
                                          layer_limit=frozen_until + 1)[0])
 

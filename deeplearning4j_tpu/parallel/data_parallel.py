@@ -245,9 +245,15 @@ class ParallelTrainer:
             raise ValueError("batch_size/mask have no effect with an "
                              "iterator input: the iterator owns its own "
                              "batching and per-batch masks")
+        from deeplearning4j_tpu.telemetry.scorepipe import ScorePipeline
+
         data_size = self.mesh.shape["data"]
         self.examples_dropped = 0
         last = None
+        # listener scores resolve one step late: the fetch of step i's
+        # loss overlaps step i+1's device work (graftlint R1; the
+        # MultiLayerNetwork.fit pipelining convention exactly)
+        pipe = ScorePipeline()
         for epoch in range(epochs):
             steps = 0
             for bx, by, bm in iter_batches(x, y, batch_size, mask):
@@ -257,11 +263,16 @@ class ParallelTrainer:
                 last = self.step(bx, by, mask=bm)
                 steps += 1
                 if self.listeners:
-                    # post-increment 1-based index + one host sync, the
-                    # MultiLayerNetwork.fit firing convention exactly
-                    score = float(last)
-                    for li in self.listeners:
-                        li.iteration_done(self, self.iteration, score)
+                    resolved = pipe.push(last, self.iteration)
+                    if resolved is not None:
+                        for li in self.listeners:
+                            li.iteration_done(self, resolved[1], resolved[0])
+            # drain at the epoch edge so the last callback lands before
+            # on_epoch_end (one sync per epoch, not per step)
+            tail = pipe.flush()
+            if tail is not None:
+                for li in self.listeners:
+                    li.iteration_done(self, tail[1], tail[0])
             if steps == 0 and epoch == 0:
                 raise ValueError(
                     "no trainable batches: every batch's leading dim must "

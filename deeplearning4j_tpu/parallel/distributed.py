@@ -254,6 +254,10 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         loss = None
         listeners = list(getattr(net, "listeners", []))
         reg, round_h, rounds_c = self._round_metrics()
+        # listener scores resolve ONE ROUND LATE so the host fetch
+        # overlaps the next round's device work (graftlint R1; same
+        # pattern as the fit loops / HealthMonitor)
+        pipe = _tm.ScorePipeline()
         rem = n % split_examples
         for ep in range(epochs):
             # rotate the window each epoch so a ragged tail is not always the
@@ -281,7 +285,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                         # block inside the span so the round time covers the
                         # collective, not just the async dispatch; disabled,
                         # no extra sync is added to the round loop
-                        jax.block_until_ready(loss)
+                        jax.block_until_ready(loss)  # graftlint: disable=R1 -- deliberate, telemetry-gated: the round span must cover the collective, not just its dispatch
                 if reg.enabled:
                     round_h.observe(time.perf_counter() - t_round,
                                     master="parameter_averaging")
@@ -292,8 +296,15 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 it0 += f
                 self._stats["splits"] += 1
                 self._stats["worker_steps"] += w * f
-                for l in listeners:  # per-split callback (one host sync)
-                    l.iteration_done(net, it0, float(jax.device_get(loss)))
+                if listeners:  # per-split callback, fetched one round late
+                    resolved = pipe.push(loss, it0)
+                    if resolved is not None:
+                        for l in listeners:
+                            l.iteration_done(net, resolved[1], resolved[0])
+        tail = pipe.flush()
+        if tail is not None:
+            for l in listeners:
+                l.iteration_done(net, tail[1], tail[0])
         # replicas are identical post-average for params/opt; state (e.g. BN
         # running stats) stays per-worker in the reference too — fold by mean
         first = lambda t: tree_map(lambda a: np.asarray(jax.device_get(a[0])), t)
@@ -432,6 +443,7 @@ class SharedTrainingMaster(TrainingMaster):
         loss = None
         listeners = list(getattr(net, "listeners", []))
         reg, round_h, rounds_c = self._round_metrics()
+        pipe = _tm.ScorePipeline()  # listener scores: one step late
         rem = n % step_examples
         for ep in range(epochs):
             start = (ep * rem) % (rem + 1) if rem else 0
@@ -449,7 +461,7 @@ class SharedTrainingMaster(TrainingMaster):
                         params, state, opt, resid, tau, x, y, it, sub)
                     params, state, opt, resid, tau, loss = out[:6]
                     if reg.enabled:
-                        jax.block_until_ready(loss)  # cover the all-reduce
+                        jax.block_until_ready(loss)  # graftlint: disable=R1 -- deliberate, telemetry-gated: the round span must cover the all-reduce, not just its dispatch
                 if reg.enabled:
                     round_h.observe(time.perf_counter() - t_round,
                                     master="shared")
@@ -458,8 +470,15 @@ class SharedTrainingMaster(TrainingMaster):
                     self._worker_health_rollup(out[6], "shared", it)
                 it += 1
                 self._stats["steps"] += 1
-                for l in listeners:  # per-step callback (forces a host sync)
-                    l.iteration_done(net, it, float(jax.device_get(loss)))
+                if listeners:  # per-step callback, fetched one step late
+                    resolved = pipe.push(loss, it)
+                    if resolved is not None:
+                        for l in listeners:
+                            l.iteration_done(net, resolved[1], resolved[0])
+        tail = pipe.flush()
+        if tail is not None:
+            for l in listeners:
+                l.iteration_done(net, tail[1], tail[0])
         get = lambda t: tree_map(lambda a: np.asarray(jax.device_get(a)), t)
         net.params, net.state, net.opt_state = get(params), get(state), get(opt)
         net.iteration = it  # training position survives re-save/resume

@@ -69,7 +69,8 @@ class StreamingBroker:
     def start(self):
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
-        self._threads.append(t)
+        with self._lock:  # _threads is shared with the accept thread
+            self._threads.append(t)
         return self
 
     def _accept_loop(self):
@@ -82,8 +83,9 @@ class StreamingBroker:
             t.start()
             # prune finished connection threads so a long-lived broker does
             # not accumulate one entry per historical connection
-            self._threads = [th for th in self._threads if th.is_alive()]
-            self._threads.append(t)
+            with self._lock:  # start() appends from the caller thread
+                self._threads = [th for th in self._threads if th.is_alive()]
+                self._threads.append(t)
 
     def _serve(self, conn):
         keep_open = False

@@ -45,13 +45,15 @@ from deeplearning4j_tpu.telemetry.registry import (DEFAULT_BUCKETS, Counter,
                                                    MetricsRegistry,
                                                    get_registry, write_jsonl)
 from deeplearning4j_tpu.telemetry.tracing import Tracer, get_tracer, span
-from deeplearning4j_tpu.telemetry import devices, flight, health
+from deeplearning4j_tpu.telemetry import devices, flight, health, scorepipe
 from deeplearning4j_tpu.telemetry.health import NumericsError
+from deeplearning4j_tpu.telemetry.scorepipe import ScorePipeline
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
            "DEFAULT_BUCKETS", "get_registry", "get_tracer", "span",
            "write_jsonl", "enable", "disable", "enabled", "reset",
-           "health", "devices", "flight", "NumericsError"]
+           "health", "devices", "flight", "scorepipe", "ScorePipeline",
+           "NumericsError"]
 
 
 def enable():

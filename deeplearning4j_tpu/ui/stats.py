@@ -42,7 +42,7 @@ def _array_stats(tree, histogram_bins=0):
     import jax
     global _jitted_stats
     if _jitted_stats is None:
-        _jitted_stats = jax.jit(
+        _jitted_stats = jax.jit(  # graftlint: disable=R3 -- module-global cache above: built once per process, not per call
             lambda t: jax.tree_util.tree_map(_leaf_stats, t))
     stats = jax.device_get(_jitted_stats(tree))
     out = {}

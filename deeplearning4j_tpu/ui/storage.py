@@ -40,7 +40,8 @@ class InMemoryStatsStorage:
                        if isinstance(r, dict)})
 
     def register_listener(self, cb):
-        self._listeners.append(cb)
+        with self._lock:  # registration may race a publishing fit thread
+            self._listeners.append(cb)
 
 
 class FileStatsStorage(InMemoryStatsStorage):

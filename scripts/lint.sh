@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# graftlint convenience runner: one rule (or all) against one path.
+#
+#   scripts/lint.sh                      # all rules, whole package
+#   scripts/lint.sh R1                   # one rule, whole package
+#   scripts/lint.sh R1 deeplearning4j_tpu/nn   # one rule, one tree
+#   scripts/lint.sh all tests/test_x.py  # all rules, one file
+#
+# Runs WITHOUT the baseline (every finding prints) — the gating CI run
+# with the baseline applied lives in scripts/tier1.sh. Same env gotcha as
+# tier1.sh: unset the axon tunnel and pin the CPU backend so importing
+# the package never dials a TPU.
+set -o pipefail
+cd "$(dirname "$0")/.."
+RULE="${1:-}"
+PATH_ARG="${2:-deeplearning4j_tpu}"
+ARGS=(--no-baseline)
+if [ -n "$RULE" ] && [ "$RULE" != "all" ]; then
+  ARGS+=(--rules "$RULE")
+fi
+exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python -m deeplearning4j_tpu lint "${ARGS[@]}" "$PATH_ARG"

@@ -9,6 +9,17 @@
 #   scripts/tier1.sh tests/test_health.py   # extra pytest args pass through
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+# Stage 0: graftlint — the static-analysis gate (analysis/ package).
+# Fails on any non-baselined finding AND (--strict-baseline) on stale
+# baseline entries, so graftlint.baseline.json only ever shrinks.
+echo "== graftlint =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python -m deeplearning4j_tpu lint --strict-baseline || {
+    echo "tier1: graftlint gate FAILED (fix, suppress with justification,"
+    echo "tier1: or update graftlint.baseline.json)"; exit 1; }
+
+# Stage 1: the fast test tier (the exact ROADMAP.md command).
 rm -f /tmp/_t1.log
 timeout -k 10 870 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python -m pytest "${@:-tests/}" -q -m 'not slow' \
