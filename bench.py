@@ -589,6 +589,74 @@ def bench_transformer(batch=32, seq=512, d_model=512, n_layers=6,
     return rec
 
 
+def bench_fused(batch=128, n_batches=48, epochs=2):
+    """K-sweep of the fused multi-step dispatch engine (nn/fused.py): the
+    same tiny-MLP fit at ``steps_per_dispatch=K`` for each K in
+    ``BENCH_FUSED_KS`` (the ``--steps-per-dispatch 1,4`` flag), end-to-end
+    through the real fit loop — prefetch thread, shape bucketing and the
+    one-dispatch-late score pipeline included, so the curve measures the
+    dispatch amortization users actually get. The dataset is deliberately
+    ragged (n % batch != 0) so every leg exercises the bucketed tail.
+    CPU-smoke friendly: tier1.sh runs it under BENCH_PREFLIGHT=1."""
+    import jax
+    from deeplearning4j_tpu.nn import layers as L
+    from deeplearning4j_tpu.nn import updaters as U
+    from deeplearning4j_tpu.nn.conf import inputs as I
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    ks = [int(s) for s in
+          os.environ.get("BENCH_FUSED_KS", "1,4").split(",") if s.strip()]
+    if _preflight():
+        batch, n_batches, epochs = 32, 12, 2
+    rs = np.random.RandomState(0)
+    n = batch * n_batches - batch // 2  # ragged tail on purpose
+    x = rs.rand(n, 64).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rs.randint(0, 10, n)]
+    steps_per_epoch = -(-n // batch)
+
+    def make():
+        conf = NeuralNetConfig(seed=3, updater=U.Adam(learning_rate=1e-3)) \
+            .list(L.DenseLayer(n_out=128, activation="relu"),
+                  L.DenseLayer(n_out=128, activation="relu"),
+                  L.OutputLayer(n_out=10, loss="mcxent"),
+                  input_type=I.FeedForwardType(64))
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net
+
+    def barrier(net):
+        # fit keeps the loss pipeline one dispatch late: fetch a param
+        # leaf so the timed window covers ALL device work (the tunnel
+        # sync discipline of _train_bench)
+        jax.device_get(jax.tree_util.tree_leaves(net.params)[0])
+
+    sweep = []
+    for k in ks:
+        net = make()
+        net.fit(x, y, epochs=1, batch_size=batch, steps_per_dispatch=k)
+        barrier(net)  # compile + warm epoch excluded from the window
+        t0 = time.perf_counter()
+        net.fit(x, y, epochs=epochs, batch_size=batch,
+                steps_per_dispatch=k)
+        barrier(net)
+        dt = time.perf_counter() - t0
+        steps = epochs * steps_per_epoch
+        sweep.append({"k": k, "steps_per_sec": round(steps / dt, 1),
+                      "samples_per_sec": round(steps * batch / dt, 1),
+                      "wall_s": round(dt, 3)})
+    best = max(sweep, key=lambda r: r["steps_per_sec"])
+    base_leg = next((r for r in sweep if r["k"] == 1), sweep[0])
+    return {"metric": "fused_dispatch_ksweep_steps_per_sec",
+            "value": best["steps_per_sec"], "unit": "steps/sec",
+            # speedup of the best K over the K=1 leg of THIS run — the
+            # dispatch-amortization factor, not a cross-machine baseline
+            "vs_baseline": round(best["steps_per_sec"]
+                                 / max(base_leg["steps_per_sec"], 1e-9), 2),
+            "best_k": best["k"], "batch": batch, "n_examples": n,
+            "steps_per_epoch": steps_per_epoch, "ksweep": sweep}
+
+
 def bench_longcontext():
     """Long-sequence decoder LM: seq 4096 is past the measured flash-attention
     crossover, so this config exercises the fused kernel (the naive path's
@@ -601,9 +669,9 @@ def bench_longcontext():
 CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
            "lstm": bench_lstm, "word2vec": bench_word2vec,
            "parallel": bench_parallel, "transformer": bench_transformer,
-           "longcontext": bench_longcontext}
+           "longcontext": bench_longcontext, "fused": bench_fused}
 DEFAULT_ORDER = ["lenet", "resnet50", "lstm", "word2vec", "parallel",
-                 "transformer", "longcontext"]
+                 "transformer", "longcontext", "fused"]
 
 _MEASURED_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "BENCH_TPU_MEASURED.json")
@@ -658,6 +726,7 @@ _CANONICAL_SHAPES = {
     "transformer": {"batch": 32, "seq": 512, "d_model": 512, "n_layers": 6},
     "longcontext": {"batch": 4, "seq": 4096, "d_model": 512, "n_layers": 6},
     "parallel": {},
+    "fused": {"batch": 128},
 }
 
 
@@ -810,9 +879,28 @@ def _run_config_inprocess(n, device):
         return None
 
 
+def _parse_steps_flag(argv):
+    """``--steps-per-dispatch 1,4`` (or ``=1,4``): stash the K list in
+    BENCH_FUSED_KS (env so subprocess-per-config children inherit it) and
+    strip the flag from argv. Returns True when the flag was present —
+    with no explicit config name that selects the ``fused`` K-sweep."""
+    for i, a in enumerate(list(argv)):
+        if a == "--steps-per-dispatch" and i + 1 < len(argv):
+            os.environ["BENCH_FUSED_KS"] = argv[i + 1]
+            del argv[i:i + 2]
+            return True
+        if a.startswith("--steps-per-dispatch="):
+            os.environ["BENCH_FUSED_KS"] = a.split("=", 1)[1]
+            del argv[i:i + 1]
+            return True
+    return False
+
+
 def main():
+    ksweep_flag = _parse_steps_flag(sys.argv)
     name = (sys.argv[1] if len(sys.argv) > 1
-            else os.environ.get("BENCH_CONFIG", "all"))
+            else ("fused" if ksweep_flag
+                  else os.environ.get("BENCH_CONFIG", "all")))
     names = DEFAULT_ORDER if name == "all" else [name]
 
     assumed = os.environ.get("BENCH_ASSUME_PLATFORM")

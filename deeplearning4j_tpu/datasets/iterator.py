@@ -38,6 +38,66 @@ class DataSet:
         return self.features.shape[0]
 
 
+# ---------------------------------------------------------------------------
+# Shape bucketing: pad ragged minibatches up to the compiled batch shape with
+# a validity mask, so the tail of every epoch reuses the steady-state XLA
+# executable instead of compiling a fresh one (the recompile trap
+# telemetry.devices counts as ``recompiles_total``). The masked-mean loss
+# divides by the REAL example count (nn/losses._apply_mask_and_mean), so
+# padded results are exact, not approximate.
+# ---------------------------------------------------------------------------
+
+
+def _leading_dim(tree):
+    """Batch size of a (pytree of) array(s)."""
+    return jax.tree_util.tree_leaves(tree)[0].shape[0]
+
+
+def _pad_rows(tree, target):
+    """Zero-pad every leaf of ``tree`` to ``target`` rows along axis 0
+    (host-side: part of ETL batch assembly, before device placement)."""
+    def pad(a):
+        n = a.shape[0]
+        if n == target:
+            return a
+        if n > target:
+            raise ValueError(f"batch of {n} examples exceeds the bucketed "
+                             f"shape {target}")
+        a = np.asarray(a)
+        return np.concatenate(
+            [a, np.zeros((target - n,) + a.shape[1:], a.dtype)])
+    return jax.tree_util.tree_map(pad, tree)
+
+
+def validity_mask(labels, n_valid, target):
+    """[target] (or [target, T] for time-distributed labels) float mask:
+    1 for the first ``n_valid`` examples, 0 for bucketing padding."""
+    first = jax.tree_util.tree_leaves(labels)[0]
+    valid = (np.arange(target) < n_valid).astype(np.float32)
+    if first.ndim >= 3:  # [B, T, ...] labels score per timestep
+        return np.repeat(valid[:, None], first.shape[1], axis=1)
+    return valid
+
+
+def pad_batch(x, y, m, target):
+    """Bucket one ``(x, y, mask)`` minibatch to ``target`` examples.
+
+    Returns ``(x, y, mask, n_valid)`` where the mask is ALWAYS present —
+    all-ones when nothing was padded and no mask was given — so a padded
+    stream presents one jit signature for the whole epoch (a mask that
+    appears only on the tail batch would itself force a recompile).
+    ``x``/``y`` may be pytrees (the ComputationGraph dict form).
+    """
+    n = _leading_dim(x)
+    x = _pad_rows(x, target)
+    y_padded = _pad_rows(y, target)
+    if m is None:
+        m = validity_mask(y, n, target)
+    else:
+        m = _pad_rows(m, target)
+    return x, y_padded, m, n
+
+
 class DataSetIterator:
     """Iterator protocol: yields DataSet; reset() for a new epoch."""
 
@@ -57,8 +117,15 @@ class DataSetIterator:
 
 
 class ArrayDataSetIterator(DataSetIterator):
+    """``pad_last=True`` buckets the ragged final batch to the full
+    ``batch_size`` (zero rows + validity folded into the masks) and emits
+    masks on EVERY batch, so one jit signature covers the whole epoch —
+    the tail batch stops costing a fresh XLA compile (shape bucketing;
+    exact under the masked-mean losses)."""
+
     def __init__(self, features, labels, batch_size=32, *, features_mask=None,
-                 labels_mask=None, shuffle=False, seed=123, drop_last=False):
+                 labels_mask=None, shuffle=False, seed=123, drop_last=False,
+                 pad_last=False):
         self.features = np.asarray(features)
         self.labels = np.asarray(labels)
         self.features_mask = None if features_mask is None else np.asarray(features_mask)
@@ -67,6 +134,7 @@ class ArrayDataSetIterator(DataSetIterator):
         self.shuffle = shuffle
         self.rng = np.random.RandomState(seed)
         self.drop_last = drop_last
+        self.pad_last = pad_last
         self._order = np.arange(len(self.features))
         self._pos = 0
 
@@ -88,10 +156,19 @@ class ArrayDataSetIterator(DataSetIterator):
             raise StopIteration
         idx = self._order[self._pos:end]
         self._pos = end
-        return DataSet(
+        ds = DataSet(
             features=self.features[idx], labels=self.labels[idx],
             features_mask=None if self.features_mask is None else self.features_mask[idx],
             labels_mask=None if self.labels_mask is None else self.labels_mask[idx])
+        if not self.pad_last:
+            return ds
+        x, y, fm, n = pad_batch(ds.features, ds.labels, ds.features_mask,
+                                self._batch)
+        lm = ds.labels_mask
+        if lm is not None:
+            lm = _pad_rows(lm, self._batch)
+        return DataSet(features=x, labels=y, features_mask=fm,
+                       labels_mask=lm)
 
 
 _SENTINEL = object()
@@ -137,6 +214,7 @@ class AsyncDataSetIterator(DataSetIterator):
             self.callback.reset()
         self._queue = queue.Queue(maxsize=self.queue_size)
         self._error = None
+        self._stop = threading.Event()
         self._thread = threading.Thread(target=self._producer, daemon=True)
         self._thread.start()
 
@@ -147,29 +225,45 @@ class AsyncDataSetIterator(DataSetIterator):
             return ds
         put = (lambda a: jax.device_put(a, self.sharding)) if self.sharding \
             else jax.device_put
-        return DataSet(
-            features=put(ds.features), labels=put(ds.labels),
-            features_mask=None if ds.features_mask is None else put(ds.features_mask),
-            labels_mask=None if ds.labels_mask is None else put(ds.labels_mask))
+        opt = lambda a: None if a is None else put(a)
+        # dataclasses.replace keeps subclass payloads intact (SuperBatch's
+        # step_valid/n_steps ride the same queue for the fused-dispatch
+        # prefetch path); device_put recurses into dict-valued features
+        # (the ComputationGraph form)
+        return dataclasses.replace(
+            ds, features=opt(ds.features), labels=opt(ds.labels),
+            features_mask=opt(ds.features_mask),
+            labels_mask=opt(ds.labels_mask))
 
     def _producer(self):
+        # capture THIS generation's queue/stop: a producer that outlives
+        # _shutdown's join timeout (slow source, wedged device_put) must
+        # not inject a stale batch or premature sentinel into the fresh
+        # queue the next reset() installs
+        q, stop = self._queue, self._stop
         try:
-            while True:
+            while not stop.is_set():
                 with _tm.span("etl.prefetch"):
                     try:
                         ds = next(self.base)
                     except StopIteration:
                         break
                     item = self._put_device(ds)
-                self._queue.put(item)
+                q.put(item)
         except Exception as e:  # surfaced on the consumer side
-            self._error = e
+            if self._queue is q:  # our generation is still live
+                self._error = e
         finally:
-            self._queue.put(_SENTINEL)
+            q.put(_SENTINEL)
 
     def __next__(self):
         if self._queue is None:
             self.reset()
+        if self._error is not None:
+            # producer died: surface PROMPTLY (an epoch fed by a dead
+            # producer is broken — don't drain the surviving queued
+            # batches first and report the failure minutes later)
+            raise self._error
         if self._reg.enabled:
             t0 = time.perf_counter()
             item = self._queue.get()
@@ -185,9 +279,20 @@ class AsyncDataSetIterator(DataSetIterator):
             self._m_batches.inc()
         return item
 
+    def close(self):
+        """Stop and join the producer thread. The fit loops call this in
+        their ``finally`` when they own the iterator, so an exception
+        mid-epoch doesn't leave a dangling producer ``device_put``-ing
+        batches into a dead epoch; safe to call repeatedly, and the
+        iterator restarts cleanly on the next ``reset()``/``iter()``."""
+        self._shutdown()
+
     def _shutdown(self):
         if self._thread is not None and self._thread.is_alive():
-            # drain so the producer can exit
+            # flag first, then drain: a producer blocked in put() wakes,
+            # observes the stop flag and exits instead of producing the
+            # rest of the (possibly huge) epoch into the void
+            self._stop.set()
             try:
                 while self._queue.get_nowait() is not _SENTINEL:
                     pass
@@ -196,6 +301,95 @@ class AsyncDataSetIterator(DataSetIterator):
             self._thread.join(timeout=5)
         self._thread = None
         self._queue = None
+
+
+@dataclasses.dataclass
+class SuperBatch(DataSet):
+    """K stacked minibatches for ONE fused ``lax.scan`` dispatch
+    (nn/fused.py): ``features``/``labels`` are ``[K, B, ...]`` (pytrees
+    stack leaf-wise), ``labels_mask`` is the ``[K, B(, T)]`` per-example
+    validity x user mask. ``step_valid`` is the K-tail bucketing vector —
+    1.0 for real minibatches, 0.0 for the zeroed no-op steps padding a
+    ragged tail to the compiled K — and ``n_steps`` counts the real ones.
+    """
+
+    step_valid: object = None
+    n_steps: int = 0
+
+
+class SuperBatchIterator(DataSetIterator):
+    """Stack K minibatches into super-batches for fused multi-step
+    dispatch: each yield feeds one ``lax.scan`` over K train steps
+    (nn/fused.py). Shape bucketing keeps every super-batch of a fit on
+    ONE compiled signature: ragged minibatches pad to the bucketed batch
+    shape (validity folded into ``labels_mask`` — exact under the
+    masked-mean losses) and a ragged K-tail pads with zeroed steps whose
+    updates the scan discards via ``step_valid``.
+
+    ``source`` is a DataSetIterator, or a zero-arg callable returning a
+    fresh ``(x, y, mask)`` iterable per epoch (the fit loops pass their
+    batch-generator factory); ``reset()`` re-enters either.
+    Host-side only — wrap in :class:`AsyncDataSetIterator` to overlap the
+    stacking + ``device_put`` with the running dispatch (double
+    buffering). Stacking is np-based batch assembly: a source yielding
+    DEVICE arrays pays a device->host fetch per leaf (off the dispatch
+    critical path, on the producer thread, but still bus traffic) —
+    feed host arrays for peak prefetch throughput.
+    """
+
+    def __init__(self, source, k, *, batch_size=None):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.source = source
+        self.k = int(k)
+        self._nominal = batch_size
+        self._target = None  # bucketed batch shape, fixed at first batch
+        self._it = None
+
+    @property
+    def batch_size(self):
+        if self._nominal:
+            return self._nominal
+        return getattr(self.source, "batch_size", None)
+
+    def reset(self):
+        if isinstance(self.source, DataSetIterator) or not callable(self.source):
+            self._it = iter(iter_batches(self.source))
+        else:
+            self._it = iter(self.source())
+
+    def __next__(self):
+        if self._it is None:
+            self.reset()
+        got = []
+        for _ in range(self.k):
+            try:
+                got.append(next(self._it))
+            except StopIteration:
+                break
+        if not got:
+            raise StopIteration
+        if self._target is None:
+            nominal = self.batch_size
+            self._target = int(max(_leading_dim(got[0][0]), nominal or 0))
+        padded = [pad_batch(x, y, m, self._target) for x, y, m in got]
+        n = len(padded)
+        xs = [p[0] for p in padded]
+        ys = [p[1] for p in padded]
+        ms = [np.asarray(p[2]) for p in padded]
+        if n < self.k:  # ragged K-tail: zeroed no-op steps
+            zx = jax.tree_util.tree_map(np.zeros_like, xs[0])
+            zy = jax.tree_util.tree_map(np.zeros_like, ys[0])
+            zm = np.zeros_like(ms[0])
+            xs += [zx] * (self.k - n)
+            ys += [zy] * (self.k - n)
+            ms += [zm] * (self.k - n)
+        stack = lambda parts: jax.tree_util.tree_map(
+            lambda *leaves: np.stack(leaves), *parts)
+        return SuperBatch(
+            features=stack(xs), labels=stack(ys), labels_mask=np.stack(ms),
+            step_valid=(np.arange(self.k) < n).astype(np.float32),
+            n_steps=n)
 
 
 class MultipleEpochsIterator(DataSetIterator):
@@ -387,12 +581,25 @@ class ShardedDataSetIterator(DataSetIterator):
         return self.source.batch_size
 
 
-def iter_batches(data, labels=None, batch_size=None, mask=None):
+def iter_batches(data, labels=None, batch_size=None, mask=None, pad_to=None):
     """Unified minibatch source shared by the training facades
     (MultiLayerNetwork.fit, ParallelTrainer.fit): yields (x, y, mask)
     from a DataSetIterator-style iterable (DataSet objects, dicts,
     2/3-tuples), an (x, y) pair, or feature+label arrays sliced by
-    ``batch_size``."""
+    ``batch_size``.
+
+    ``pad_to``: bucket every yielded batch to that many examples (``True``
+    = the first batch's size), zero-padding ragged tails and ALWAYS
+    yielding a mask so one jit signature covers the whole epoch — exact
+    under the masked-mean losses (shape bucketing, nn/fused.py)."""
+    if pad_to is not None and pad_to is not False:
+        target = None if pad_to is True else int(pad_to)
+        for x, y, m in iter_batches(data, labels, batch_size, mask):
+            if target is None:
+                target = _leading_dim(x)
+            x, y, m, _ = pad_batch(x, y, m, target)
+            yield x, y, m
+        return
     import jax.numpy as jnp
     import numpy as np
 

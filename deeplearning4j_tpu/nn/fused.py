@@ -1,0 +1,263 @@
+"""Fused multi-step training: K train steps per dispatch via ``lax.scan``.
+
+The per-step tax of the Python-over-XLA split — one jit dispatch, one
+host->device batch copy, one listener round-trip per minibatch — caps the
+step rate of fast models well below what the device sustains (SURVEY.md
+§7; the prefetch-overlap cure is the tf.data pattern, arxiv 1605.08695).
+This module amortizes that tax K-fold:
+
+* ``make_train_steps(net, k)`` wraps the net's single train step in a
+  ``jax.lax.scan`` over a stacked super-batch ``[K, B, ...]``: params,
+  state, opt_state, the iteration counter and the RNG chain are carried
+  ON DEVICE across the K steps, so K steps cost ONE dispatch.
+* Ragged shapes never recompile (shape bucketing):
+  ``datasets.iterator.SuperBatchIterator`` pads ragged minibatches to the
+  bucketed batch shape — validity folded into the loss mask, exact
+  because the masked mean divides by the real example count — and pads a
+  ragged K-tail with zeroed no-op steps whose updates the scan discards
+  via ``step_valid`` (a zero-mask batch still carries regularization
+  gradients and updater-state decay, so masking the loss alone would NOT
+  be a no-op; the carry must be ``where()``-kept).
+* The input pipeline overlaps compute: super-batch stacking +
+  ``device_put`` run on ``AsyncDataSetIterator``'s producer thread
+  (double-buffered, ``queue_size=2``) while the current fused dispatch
+  executes, and the consumed super-batch's buffers are donated back to
+  XLA so its HBM is free for the next prefetch.
+* Scores and health bundles come back as STACKED ``[K]`` arrays fetched
+  one DISPATCH late through the existing ``ScorePipeline`` /
+  ``HealthMonitor`` — the same pipelining discipline as the K=1 loop,
+  now one fetch per K steps. Listener skew grows accordingly: callbacks
+  for the K steps of dispatch *i* fire while dispatch *i+1* runs (see
+  PROFILE.md / the StepRecordEmitter note).
+
+Caveat (documented, not hidden): bucketing padding is exact for the loss
+and gradients, but batch-statistics layers (BatchNorm in train mode) see
+the zero rows in their batch moments on the padded tail step. Datasets
+divisible by the batch size — or ``drop_last`` — sidestep this, exactly
+as they did for the reference's ragged-batch handling.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import telemetry as _tm
+from deeplearning4j_tpu.telemetry import devices as _devices
+from deeplearning4j_tpu.telemetry import flight as _flight
+from deeplearning4j_tpu.telemetry import health as _health
+from deeplearning4j_tpu.nn import listeners as _listeners
+
+__all__ = ["make_train_steps", "fit_fused"]
+
+
+def _silence_unusable_donation(fn):
+    """Donated super-batch buffers rarely match an output shape, so XLA
+    cannot reuse them and jax warns once per compile; the donation is
+    still wanted — it releases the consumed super-batch's device memory
+    for the prefetcher's next ``device_put``. Filter exactly that
+    warning, keeping ``_cache_size`` visible for recompile telemetry."""
+    @functools.wraps(fn)
+    def call(*args, **kwargs):
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            return fn(*args, **kwargs)
+    if hasattr(fn, "_cache_size"):
+        call._cache_size = fn._cache_size
+    return call
+
+
+def make_train_steps(net, k, donate=True, jit=True, with_health=False,
+                     donate_batch=True):
+    """Build the fused K-step engine over ``net``'s single train step:
+
+    ``(params, state, opt_state, xs, ys, step0, rng, masks, step_valid)
+    -> (params, state, opt_state, losses[K][, health{key: [K]}])``
+
+    ``xs``/``ys``/``masks`` are stacked ``[K, B, ...]`` super-batches
+    (pytrees stack leaf-wise — the ComputationGraph dict form works
+    unchanged); ``step_valid`` is the K-tail bucketing vector. The scan
+    carries params/state/opt_state, the iteration counter and the RNG
+    chain on device, splitting a fresh subkey per step, so the K steps
+    run back-to-back inside ONE XLA computation — one dispatch, no
+    host round-trips between steps. Works for any net exposing the
+    ``make_train_step`` contract (MultiLayerNetwork, ComputationGraph).
+    """
+    base = net.make_train_step(donate=False, jit=False,
+                               with_health=with_health)
+
+    def steps_fn(params, state, opt_state, xs, ys, step0, rng, masks,
+                 step_valid):
+        def body(carry, inp):
+            params, state, opt_state, step, rng = carry
+            x, y, m, sv = inp
+            rng, sub = jax.random.split(rng)
+            out = base(params, state, opt_state, x, y, step, sub, m)
+            if with_health:
+                new_p, new_s, new_o, loss, hb = out
+            else:
+                (new_p, new_s, new_o, loss), hb = out, ()
+            # K-tail no-op: a zero-mask padded step still has
+            # regularization gradients and updater-state decay, so the
+            # carry must be where()-kept, not just loss-masked
+            keep = functools.partial(
+                jax.tree_util.tree_map,
+                lambda new, old: jnp.where(sv > 0, new, old))
+            carry = (keep(new_p, params), keep(new_s, state),
+                     keep(new_o, opt_state),
+                     step + (sv > 0).astype(jnp.int32), rng)
+            return carry, (loss, hb)
+
+        carry0 = (params, state, opt_state, jnp.asarray(step0, jnp.int32),
+                  rng)
+        (params, state, opt_state, _, _), (losses, health) = jax.lax.scan(
+            body, carry0, (xs, ys, masks, step_valid))
+        if with_health:
+            return params, state, opt_state, losses, health
+        return params, state, opt_state, losses
+
+    if not jit:
+        return steps_fn
+    donate_argnums = (0, 1, 2) if donate else ()
+    if donate and donate_batch:
+        donate_argnums += (3, 4, 7)  # the consumed super-batch
+    fused = jax.jit(steps_fn, donate_argnums=donate_argnums)
+    return _silence_unusable_donation(fused) if donate_argnums else fused
+
+
+def _steps_fn_for(net, k, with_health):
+    """Per-net cache of compiled fused engines, keyed (k, with_health)."""
+    cache = getattr(net, "_train_steps_fused", None)
+    if cache is None:
+        cache = net._train_steps_fused = {}
+    key = (int(k), bool(with_health))
+    fn = cache.get(key)
+    if fn is None:
+        fn = cache[key] = make_train_steps(net, k, with_health=with_health)
+    return fn
+
+
+def fit_fused(net, batch_factory, *, epochs, k, batch_size=None,
+              prefetch=True):
+    """The fused-dispatch fit loop shared by MultiLayerNetwork and
+    ComputationGraph (both expose the same trainer-state contract:
+    params/state/opt_state/iteration/epoch/listeners/_rng/score_value).
+
+    ``batch_factory`` is a zero-arg callable returning a fresh
+    ``(x, y, mask)`` iterable per epoch (the net's batch generator). The
+    stream is bucketed + stacked by ``SuperBatchIterator`` and, with
+    ``prefetch``, assembled and ``device_put`` on an
+    ``AsyncDataSetIterator`` producer thread while the current dispatch
+    runs (double buffering) — the thread is joined in ``finally`` so a
+    fit exception never leaves a dangling producer.
+    """
+    from deeplearning4j_tpu.datasets.iterator import (AsyncDataSetIterator,
+                                                      SuperBatchIterator)
+
+    hm = _health.get_monitor()
+    use_health = hm.active
+    steps_fn = _steps_fn_for(net, k, use_health)
+    reg, step_h, etl_h, iters_c, score_g = _tm.train_metrics()
+    frec = _flight.get_recorder()
+    # scores resolve one DISPATCH late: the K stacked losses of dispatch i
+    # are fetched (one transfer) while dispatch i+1 runs — the K=1 loops'
+    # pipelining discipline, amortized (see telemetry/scorepipe)
+    pipe = _tm.ScorePipeline()
+    emitter = _tm.scorepipe.StepRecordEmitter(net, step_h, etl_h, iters_c,
+                                              score_g, frec)
+    sbit = SuperBatchIterator(batch_factory, k, batch_size=batch_size)
+    src = AsyncDataSetIterator(sbit, queue_size=2) if prefetch else sbit
+    try:
+        with _tm.span("fit", net=type(net).__name__, fused_k=k):
+            for _ in range(epochs):
+                for l in net.listeners:
+                    l.on_epoch_start(net)
+                for sb in src:
+                    etl_start = time.perf_counter()
+                    with _tm.span("fit.etl"):
+                        # prefetched super-batches are already on device;
+                        # asarray is then a no-op per leaf
+                        xs = jax.tree_util.tree_map(jnp.asarray, sb.features)
+                        ys = jax.tree_util.tree_map(jnp.asarray, sb.labels)
+                        ms = jnp.asarray(sb.labels_mask)
+                        sv = jnp.asarray(sb.step_valid)
+                    etl_time = time.perf_counter() - etl_start
+                    if net.listeners:
+                        # listener convention only — the [0] slice is a
+                        # device op, so don't dispatch it for nobody
+                        first = (next(iter(xs.values()))
+                                 if isinstance(xs, dict) else xs)
+                        net.last_input = first[0]
+                    n_real = sb.n_steps
+                    hb = None
+                    step0 = net.iteration
+                    rec = reg.enabled  # one read per dispatch
+                    want_score = rec or bool(net.listeners)
+                    resolved = meta = None
+                    step_start = time.perf_counter()
+                    with _tm.span("fit.step", iteration=step0,
+                                  fused_k=n_real):
+                        net._rng, step_rng = jax.random.split(net._rng)
+                        if use_health:
+                            (net.params, net.state, net.opt_state, losses,
+                             hb) = steps_fn(net.params, net.state,
+                                            net.opt_state, xs, ys, step0,
+                                            step_rng, ms, sv)
+                        else:
+                            (net.params, net.state, net.opt_state,
+                             losses) = steps_fn(net.params, net.state,
+                                                net.opt_state, xs, ys,
+                                                step0, step_rng, ms, sv)
+                        # last REAL step's loss; device scalar, no sync
+                        net.score_value = losses[n_real - 1]
+                        net.iteration += n_real
+                        if want_score:
+                            meta = {"step": step0,
+                                    "iteration": net.iteration,
+                                    "k": n_real,
+                                    "etl_time_s": etl_time, "rec": rec,
+                                    "health": use_health,
+                                    "step_time_s": 0.0}
+                            resolved = pipe.push(losses, meta)
+                    if meta is not None:
+                        meta["step_time_s"] = (time.perf_counter()
+                                               - step_start)
+                    if resolved is not None:
+                        emitter.emit(*resolved)
+                    elif use_health and not want_score:
+                        frec.note(step=step0, fused_k=n_real,
+                                  step_time_s=(time.perf_counter()
+                                               - step_start),
+                                  etl_time_s=etl_time)
+                    if rec:
+                        _devices.note_jit_cache("fit.step", steps_fn)
+                    if hb is not None:
+                        # stacked bundle: K records per resolve, padded
+                        # K-tail entries dropped via the k meta
+                        hm.on_step(hb, step=step0, k=n_real)
+                tail = pipe.flush()
+                if tail is not None:
+                    emitter.emit(*tail)
+                for l in net.listeners:
+                    l.on_epoch_end(net)
+                net.epoch += 1
+        if use_health:
+            hm.flush()
+    except BaseException as e:
+        if use_health:
+            try:
+                hm.flush(apply_policy=False)
+            except Exception:
+                pass
+        _flight.crash_dump(e)
+        raise
+    finally:
+        if hasattr(src, "close"):
+            src.close()
+        _listeners.run_fit_end_hooks(net)
+    return net

@@ -41,6 +41,22 @@ from deeplearning4j_tpu.utils import dtypes as _dtypes
 from deeplearning4j_tpu.utils import serde
 
 
+def _loss_mask_for(mask, label):
+    """The batch mask as an output's label mask ONLY when its layout
+    matches that output's per-example loss: [B] pairs with pooled
+    (<=2-d) labels, [B, T] with time-distributed (>=3-d) labels. A
+    mixed-layout graph (one temporal feature mask, pooled heads) keeps
+    the head unmasked rather than mis-broadcasting — pass explicit
+    ``label_masks`` to override."""
+    if mask is None:
+        return None
+    if mask.ndim == 1 and label.ndim <= 2:
+        return mask
+    if mask.ndim == 2 and label.ndim >= 3:
+        return mask
+    return None
+
+
 # --------------------------------------------------------------------------
 # Graph vertices
 # --------------------------------------------------------------------------
@@ -97,7 +113,10 @@ class LayerVertex(GraphVertex):
         if fam is _inputs.FeedForwardType and x.ndim > 2:
             x = x.reshape((x.shape[0], -1))
         kwargs = {}
-        if mask is not None and "mask" in inspect.signature(type(self.layer).apply).parameters:
+        # 1-d masks are example-validity (shape bucketing), not [B, T]
+        # timestep masks — mask-aware layers only get the latter
+        if mask is not None and mask.ndim >= 2 \
+                and "mask" in inspect.signature(type(self.layer).apply).parameters:
             kwargs["mask"] = mask
         return self.layer.apply(params, state, x, train=train, rng=rng, **kwargs)
 
@@ -683,7 +702,12 @@ class ComputationGraph:
                 if (layer.input_family is _inputs.FeedForwardType
                         and x.ndim > 2):
                     x = x.reshape((x.shape[0], -1))
+                # the MLN/reference convention: the batch mask doubles as
+                # the label mask unless per-output label_masks are given
+                # (MaskedReductionUtil zeroes padded steps from the score)
                 lm = (label_masks or {}).get(name)
+                if lm is None:
+                    lm = _loss_mask_for(mask, labels[name])
                 l_i, preds, st = layer.loss_from_features(
                     params[name], state[name], x, labels[name], lm,
                     train=train and name not in frozen)
@@ -713,6 +737,8 @@ class ComputationGraph:
                     if not hasattr(l_layer, "compute_loss"):
                         raise ValueError(f"Output vertex {name!r} has no loss")
                     lm = (label_masks or {}).get(name)
+                    if lm is None:  # MLN convention, shape-guarded
+                        lm = _loss_mask_for(mask, labels[name])
                     loss = loss + l_layer.compute_loss(acts[name],
                                                        labels[name], lm)
         if carries is not None:
@@ -926,7 +952,39 @@ class ComputationGraph:
             return train_step
         return jax.jit(train_step, donate_argnums=(0, 1, 2) if donate else ())
 
-    def fit(self, inputs, labels, *, epochs=1, batch_size=None, mask=None):
+    def make_train_steps(self, k, donate=True, jit=True, with_health=False):
+        """Fused K-step engine over the graph's train step: one
+        ``lax.scan`` dispatch per K minibatches (nn/fused.py; dict-keyed
+        inputs/labels stack leaf-wise; ``fit(steps_per_dispatch=K)``
+        drives it)."""
+        from deeplearning4j_tpu.nn import fused as _fused
+        return _fused.make_train_steps(self, k, donate=donate, jit=jit,
+                                       with_health=with_health)
+
+    def _fit_batches(self, inputs, labels, batch_size, mask, pad_to=None):
+        """Per-epoch (inputs, labels, mask) minibatch generator over the
+        dict-keyed arrays; ``pad_to`` buckets every batch to the nominal
+        batch size with the validity folded into the mask (exact under
+        the masked-mean losses — shape bucketing, nn/fused.py)."""
+        from deeplearning4j_tpu.datasets.iterator import pad_batch
+
+        n = next(iter(inputs.values())).shape[0]
+        bs = batch_size or n
+        for i in range(0, n, bs):
+            bi = {k: v[i:i + bs] for k, v in inputs.items()}
+            bl = {k: v[i:i + bs] for k, v in labels.items()}
+            bm = mask[i:i + bs] if mask is not None else None
+            if pad_to:
+                bi, bl, bm, _ = pad_batch(bi, bl, bm, bs)
+            yield bi, bl, bm
+
+    def fit(self, inputs, labels, *, epochs=1, batch_size=None, mask=None,
+            steps_per_dispatch=1, pad_ragged=None):
+        """Train over dict-keyed (or single-array) inputs/labels.
+        ``steps_per_dispatch=K`` runs K steps per device dispatch through
+        the fused ``lax.scan`` engine with prefetch + shape bucketing;
+        ``pad_ragged=True`` buckets the K=1 loop's ragged tail batch
+        (see MultiLayerNetwork.fit for both contracts)."""
         if self.params is None:
             self.init()
         if not isinstance(inputs, dict):
@@ -936,6 +994,33 @@ class ComputationGraph:
         tm = self._time_major(inputs)
         use_tbptt = (self.conf.backprop_type == "tbptt" and tm is not None
                      and tm.shape[1] > self.conf.tbptt_fwd_length)
+        k = int(steps_per_dispatch)
+        if k > 1 or pad_ragged:
+            # shape bucketing builds ONE validity mask; a graph mixing
+            # pooled ([B, C]) and time-distributed ([B, T, C]) outputs
+            # would leave the mismatched head silently unmasked — refuse
+            # rather than break the exactness contract
+            layouts = {("pooled" if v.ndim <= 2 else ("temporal",
+                                                      v.shape[1]))
+                       for v in labels.values()}
+            if len(layouts) > 1:
+                raise ValueError(
+                    "shape bucketing (steps_per_dispatch > 1 / "
+                    "pad_ragged) needs a single label layout; this graph "
+                    "mixes pooled / differently-lengthed time-distributed "
+                    "outputs — pad the dataset to the batch size yourself "
+                    "or train with steps_per_dispatch=1")
+        if k > 1:
+            if use_tbptt:
+                raise ValueError(
+                    "steps_per_dispatch > 1 does not compose with TBPTT "
+                    "(the chunk loop is its own on-device scan); use the "
+                    "default single-step path")
+            from deeplearning4j_tpu.nn import fused as _fused
+            return _fused.fit_fused(
+                self,
+                lambda: self._fit_batches(inputs, labels, batch_size, mask),
+                epochs=epochs, k=k, batch_size=batch_size)
         hm = _health.get_monitor()
         use_health = hm.active and not use_tbptt
         if use_health:
@@ -949,8 +1034,6 @@ class ComputationGraph:
             step_fn = self._train_step
         else:
             step_fn = None
-        n = next(iter(inputs.values())).shape[0]
-        bs = batch_size or n
         reg, step_h, etl_h, iters_c, score_g = _tm.train_metrics()
         frec = _flight.get_recorder()
         # score path is PIPELINED one step late (graftlint R1): queue step
@@ -965,10 +1048,9 @@ class ComputationGraph:
                 for _ in range(epochs):
                     for l in self.listeners:
                         l.on_epoch_start(self)
-                    for i in range(0, n, bs):
-                        bi = {k: v[i:i + bs] for k, v in inputs.items()}
-                        bl = {k: v[i:i + bs] for k, v in labels.items()}
-                        bm = mask[i:i + bs] if mask is not None else None
+                    for bi, bl, bm in self._fit_batches(
+                            inputs, labels, batch_size, mask,
+                            pad_to=bool(pad_ragged)):
                         if use_tbptt:   # TBPTT per minibatch, as MLN
                             t_tb = time.perf_counter()
                             with _tm.span("fit.step", tbptt=True):

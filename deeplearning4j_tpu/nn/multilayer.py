@@ -105,7 +105,11 @@ class MultiLayerNetwork:
                 from deeplearning4j_tpu.nn.layers.base import dropout_mask
                 x = dropout_mask(sub, x, layer.dropout)
             kwargs = {}
-            if self._mask_aware[i] and mask is not None:
+            if self._mask_aware[i] and mask is not None \
+                    and mask.ndim >= 2:
+                # a 1-d mask is an example-validity mask (shape
+                # bucketing): it has no timestep info to forward into
+                # mask-aware layers, which require [batch, time]
                 kwargs["mask"] = mask
             if rng is not None:
                 rng, sub = jax.random.split(rng)
@@ -320,16 +324,63 @@ class MultiLayerNetwork:
         donate_argnums = (0, 1, 2) if donate else ()
         return jax.jit(train_step, donate_argnums=donate_argnums)
 
+    def make_train_steps(self, k, donate=True, jit=True, with_health=False):
+        """Fused K-step engine: ONE dispatch runs K train steps under
+        ``jax.lax.scan`` over a stacked ``[K, B, ...]`` super-batch, the
+        iteration counter and RNG chain carried on device (nn/fused.py;
+        ``fit(steps_per_dispatch=K)`` drives it)."""
+        from deeplearning4j_tpu.nn import fused as _fused
+        return _fused.make_train_steps(self, k, donate=donate, jit=jit,
+                                       with_health=with_health)
+
     # ------------------------------------------------------------------
     # convenience (stateful) API
     # ------------------------------------------------------------------
 
-    def fit(self, data, labels=None, *, epochs=1, batch_size=None, mask=None):
+    def fit(self, data, labels=None, *, epochs=1, batch_size=None, mask=None,
+            steps_per_dispatch=1, pad_ragged=None):
         """Train. ``data`` is either (features, labels) arrays or an iterator
         yielding dicts/tuples per minibatch (reference: fit(DataSetIterator)
-        at MultiLayerNetwork.java:1205)."""
+        at MultiLayerNetwork.java:1205).
+
+        ``steps_per_dispatch=K`` (default 1 = this loop, unchanged) runs K
+        steps per device dispatch through the fused ``lax.scan`` engine
+        (nn/fused.py): super-batches of K minibatches are stacked +
+        ``device_put`` on a prefetch thread while the current dispatch
+        runs, ragged batch/K-tail shapes are bucketed with validity masks
+        (exact; ``recompiles_total`` stays flat), and scores/health come
+        back one dispatch late as stacked arrays.
+
+        ``pad_ragged=True`` applies the same shape bucketing to the K=1
+        loop: every batch padded to one compiled shape with the validity
+        folded into the loss mask, so the ragged tail batch of each epoch
+        stops costing a fresh XLA compile."""
         if self.params is None:
             self.init()
+        k = int(steps_per_dispatch)
+        if k > 1:
+            if self.conf.backprop_type == "tbptt":
+                # reject only when TBPTT could actually engage (the K=1
+                # loop gates it per batch: 3-d input with T > fwd_length,
+                # the ComputationGraph.fit convention); feature arrays
+                # short enough — or non-temporal — train fused fine
+                pair = labels is None and isinstance(data, (tuple, list))
+                feats = data[0] if pair else data
+                labs = data[1] if pair else labels
+                safe = (hasattr(feats, "shape") and
+                        (feats.ndim != 3
+                         or feats.shape[1] <= self.conf.tbptt_fwd_length
+                         or (hasattr(labs, "shape") and labs.ndim != 3)))
+                if not safe:
+                    raise ValueError(
+                        "steps_per_dispatch > 1 does not compose with "
+                        "TBPTT (the chunk loop is its own on-device "
+                        "scan); use the default single-step path")
+            from deeplearning4j_tpu.nn import fused as _fused
+            return _fused.fit_fused(
+                self,
+                lambda: self._batches(data, labels, batch_size, mask),
+                epochs=epochs, k=k, batch_size=batch_size)
         hm = _health.get_monitor()
         use_health = hm.active  # one read per fit: the watchdog variant of
         # the step is picked (and compiled) at fit entry, not mid-epoch
@@ -359,7 +410,9 @@ class MultiLayerNetwork:
                 for _ in range(epochs):
                     for l in self.listeners:
                         l.on_epoch_start(self)
-                    batches = self._batches(data, labels, batch_size, mask)
+                    batches = self._batches(data, labels, batch_size, mask,
+                                            pad_to=True if pad_ragged
+                                            else None)
                     for batch in batches:
                         x, y, m = batch
                         etl_start = time.perf_counter()
@@ -451,9 +504,10 @@ class MultiLayerNetwork:
             _listeners.run_fit_end_hooks(self)
         return self
 
-    def _batches(self, data, labels, batch_size, mask):
+    def _batches(self, data, labels, batch_size, mask, pad_to=None):
         from deeplearning4j_tpu.datasets.iterator import iter_batches
-        yield from iter_batches(data, labels, batch_size, mask)
+        yield from iter_batches(data, labels, batch_size, mask,
+                                pad_to=pad_to)
 
     def output(self, x, train=False, mask=None):
         """Inference forward pass (reference: MultiLayerNetwork.output:1993)."""

@@ -223,7 +223,8 @@ class ParallelTrainer:
         self.iteration += 1
         return loss
 
-    def fit(self, x, y=None, *, epochs=1, batch_size=None, mask=None):
+    def fit(self, x, y=None, *, epochs=1, batch_size=None, mask=None,
+            steps_per_dispatch=1):
         """Train on arrays, an (x, y) pair, OR any DataSetIterator (the
         reference's signature entry point,
         ParallelWrapper.fit(DataSetIterator) at ParallelWrapper.java:58 —
@@ -233,7 +234,14 @@ class ParallelTrainer:
         Batches whose leading dim is not divisible by the mesh 'data'
         axis are SKIPPED (the data sharding cannot place them) and
         counted in ``self.examples_dropped`` — the array path has always
-        dropped the ragged tail the same way."""
+        dropped the ragged tail the same way.
+
+        ``steps_per_dispatch=K`` runs K steps per dispatch through the
+        fused ``lax.scan`` engine (nn/fused.py) over super-batches
+        sharded ``[K, B/data, ...]``: ragged batches pad to the bucketed
+        shape (validity in the loss mask, exact) instead of being
+        dropped, and the super-batch assembly + sharded ``device_put``
+        overlap the running dispatch on the prefetch thread."""
         import warnings
 
         from deeplearning4j_tpu.datasets.iterator import iter_batches
@@ -245,6 +253,10 @@ class ParallelTrainer:
             raise ValueError("batch_size/mask have no effect with an "
                              "iterator input: the iterator owns its own "
                              "batching and per-batch masks")
+        if int(steps_per_dispatch) > 1:
+            return self._fit_fused(x, y, epochs=epochs,
+                                   batch_size=batch_size, mask=mask,
+                                   k=int(steps_per_dispatch))
         from deeplearning4j_tpu.telemetry.scorepipe import ScorePipeline
 
         data_size = self.mesh.shape["data"]
@@ -291,6 +303,122 @@ class ParallelTrainer:
                           f"{self.examples_dropped} examples in ragged "
                           f"batches not divisible by data={data_size}")
         return last
+
+    def _build_steps_fused(self, k, donate):
+        """Sharded fused K-step engine: the raw scan from nn/fused.py
+        jitted with the trainer's param/opt shardings, super-batches
+        sharded [K, B/data, ...] and the RNG chain carried through the
+        dispatch (the _build_step conventions, amortized K-fold)."""
+        from deeplearning4j_tpu.nn import fused as _fused
+
+        base = _fused.make_train_steps(self.net, k, jit=False)
+        repl = NamedSharding(self.mesh, P())
+        sb_sh = _mesh.superbatch_sharded(self.mesh)
+        state_sh = jax.tree_util.tree_map(lambda _: repl, self.state)
+        opt_sh = self._opt_shardings
+
+        # in: params, state, opt, xs, ys, step0, rng, masks, step_valid
+        in_sh = (self.param_shardings, state_sh, opt_sh, sb_sh, sb_sh,
+                 None, repl, sb_sh, repl)
+        out_sh = (self.param_shardings, state_sh, opt_sh, repl, repl)
+
+        def steps(params, state, opt_state, xs, ys, step0, rng, masks, sv):
+            rng_next, sub = jax.random.split(rng)
+            out = base(params, state, opt_state, xs, ys, step0, sub, masks,
+                       sv)
+            return out + (rng_next,)
+
+        return jax.jit(steps, in_shardings=in_sh, out_shardings=out_sh,
+                       donate_argnums=(0, 1, 2, 6) if donate else ())
+
+    def _fit_fused(self, x, y, *, epochs, batch_size, mask, k):
+        """fit() at steps_per_dispatch=K: one sharded dispatch per K
+        minibatches; scores resolve one dispatch late as stacked arrays
+        (the ScorePipeline discipline, amortized)."""
+        from deeplearning4j_tpu.datasets.iterator import (
+            AsyncDataSetIterator, SuperBatchIterator, iter_batches)
+        from deeplearning4j_tpu.telemetry.scorepipe import ScorePipeline
+
+        if self.params is None:
+            self.init()
+        fns = getattr(self, "_steps_fns_fused", None)
+        if fns is None:
+            fns = self._steps_fns_fused = {}
+        if k not in fns:
+            fns[k] = self._build_steps_fused(k, self.donate)
+        fused_fn = fns[k]
+        data_size = self.mesh.shape["data"]
+        # validate BEFORE the prefetch thread: its sharded device_put hits
+        # the non-divisible dim first and would surface as a raw sharding
+        # error instead of this message
+        feats = x[0] if (y is None and isinstance(x, (tuple, list))) else x
+        nominal = batch_size if batch_size is not None else (
+            feats.shape[0] if hasattr(feats, "shape") else None)
+        if nominal is not None and nominal % data_size:
+            raise ValueError(
+                f"bucketed batch size {nominal} not divisible by the "
+                f"data-axis size {data_size}")
+        self.examples_dropped = 0  # bucketing pads; nothing is dropped
+        sbit = SuperBatchIterator(lambda: iter_batches(x, y, batch_size,
+                                                       mask), k,
+                                  batch_size=batch_size)
+        # prefetch thread assembles + device_puts the next super-batch
+        # ALREADY SHARDED while the current dispatch runs
+        src = AsyncDataSetIterator(sbit, queue_size=2,
+                                   sharding=_mesh.superbatch_sharded(
+                                       self.mesh))
+        pipe = ScorePipeline()
+        last = None
+        try:
+            for epoch in range(epochs):
+                steps = 0
+                for sb in src:
+                    feats = (next(iter(sb.features.values()))
+                             if isinstance(sb.features, dict)
+                             else sb.features)
+                    if feats.shape[1] % data_size:
+                        raise ValueError(
+                            f"bucketed batch size {feats.shape[1]} not "
+                            f"divisible by the data-axis size {data_size}")
+                    (self.params, self.state, self.opt_state, losses,
+                     self._rng) = fused_fn(
+                        self.params, self.state, self.opt_state,
+                        sb.features, sb.labels, self.iteration, self._rng,
+                        sb.labels_mask, jnp.asarray(sb.step_valid))
+                    n = sb.n_steps
+                    self.iteration += n
+                    self.score_value = last = losses[n - 1]
+                    steps += n
+                    if self.listeners:
+                        resolved = pipe.push(
+                            losses, {"iteration": self.iteration, "k": n})
+                        if resolved is not None:
+                            self._fan_listener_scores(*resolved)
+                tail = pipe.flush()
+                if tail is not None:
+                    self._fan_listener_scores(*tail)
+                if steps == 0 and epoch == 0:
+                    raise ValueError("no trainable batches")
+                if steps == 0 and epoch > 0:
+                    raise ValueError(
+                        f"input exhausted before epoch {epoch + 1}: pass "
+                        "a resettable DataSetIterator (or arrays) for "
+                        "epochs>1")
+                for li in self.listeners:
+                    li.on_epoch_end(self)
+                self.epoch += 1
+        finally:
+            src.close()
+        return last
+
+    def _fan_listener_scores(self, scores, meta):
+        """K per-step listener callbacks from one resolved fused
+        dispatch (padded K-tail entries already dropped via meta['k'])."""
+        k = meta["k"]
+        it0 = meta["iteration"] - k
+        for j, s in enumerate(scores[:k]):
+            for li in self.listeners:
+                li.iteration_done(self, it0 + j + 1, s)
 
     def score(self, x, y, mask=None):
         """Validation loss on the mesh — the DataSetLossCalculator contract,

@@ -62,6 +62,13 @@ def data_sharded(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("data"))
 
 
+def superbatch_sharded(mesh: Mesh) -> NamedSharding:
+    """Sharding for stacked ``[K, B, ...]`` super-batches (nn/fused.py):
+    the scan axis K stays whole on every device, the batch axis shards
+    over 'data' — each replica scans its own slice of all K steps."""
+    return NamedSharding(mesh, P(None, "data"))
+
+
 def shard_batch(mesh: Mesh, batch):
     """Place a host batch sharded over the data axis."""
     return jax.tree_util.tree_map(

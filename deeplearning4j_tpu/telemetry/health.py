@@ -205,10 +205,26 @@ class HealthMonitor:
             self._resolve(*prev, apply_policy=apply_policy)
 
     def _resolve(self, bundle, meta, apply_policy=True):
-        vals = jax.device_get(bundle)  # ONE batched transfer
+        vals = jax.device_get(bundle)  # ONE batched transfer (K steps
+        # when the bundle came from a fused multi-step dispatch)
+        first = next(iter(vals.values()), None)
+        if getattr(first, "ndim", 0):
+            # stacked [K] bundle (nn/fused.py): fan into per-step
+            # records; entries beyond meta['k'] are padded K-tail no-op
+            # steps and are dropped
+            k = min(int(meta.get("k") or first.shape[0]), first.shape[0])
+            step0 = meta.get("step")
+            for j in range(k):
+                rec = {key: (bool(v[j]) if key.endswith("nonfinite")
+                             else float(v[j])) for key, v in vals.items()}
+                self._consume(rec, None if step0 is None else step0 + j,
+                              apply_policy)
+            return
         rec = {k: (bool(v) if k.endswith("nonfinite") else float(v))
                for k, v in vals.items()}
-        step = meta.get("step")
+        self._consume(rec, meta.get("step"), apply_policy)
+
+    def _consume(self, rec, step, apply_policy=True):
         reg, g_norm, g_layer, g_ratio, _ = self._instruments()
         if reg.enabled:
             g_norm.set(rec["grad_norm"])

@@ -62,6 +62,11 @@ class ScorePipeline:
     @staticmethod
     def _resolve(item):
         loss, meta = item
+        if getattr(loss, "ndim", 0):
+            # stacked [K] losses from a fused multi-step dispatch
+            # (nn/fused.py): ONE batched transfer for the K scores — the
+            # per-dispatch analog of the scalar fetch below
+            return [float(v) for v in loss.tolist()], meta
         return float(loss), meta
 
 
@@ -100,6 +105,9 @@ class StepRecordEmitter:
         # lazy: keeps this module import-light (no jax) for host tooling
         from deeplearning4j_tpu.telemetry import devices as _devices
 
+        if isinstance(score, (list, tuple)):
+            self._emit_fused(score, meta, _devices)
+            return
         fr = {"step": meta["step"], "step_time_s": meta["step_time_s"],
               "etl_time_s": meta["etl_time_s"], "score": score}
         if meta["rec"]:
@@ -115,3 +123,32 @@ class StepRecordEmitter:
         for lst in self.net.listeners:
             lst.iteration_done(self.net, meta["iteration"], score,
                                meta["etl_time_s"])
+
+    def _emit_fused(self, scores, meta, _devices):
+        """Fan one fused K-step dispatch into K per-step records: the
+        stacked scores arrived in ONE fetch; padded K-tail entries
+        (beyond ``meta['k']``) are dropped. Per-step times are the
+        dispatch window split evenly — the scan exposes no per-step
+        boundary. Listener skew: all K ``iteration_done`` callbacks fire
+        one DISPATCH late (the K=1 one-step-late note, amortized)."""
+        k = max(int(meta.get("k", 1)), 1)
+        scores = scores[:k]
+        step_t = meta["step_time_s"] / k
+        etl_t = meta["etl_time_s"] / k
+        step0 = meta["step"]
+        it0 = meta["iteration"] - len(scores)
+        mem = _devices.poll_memory() if meta["rec"] else None
+        for j, s in enumerate(scores):
+            fr = {"step": step0 + j, "step_time_s": step_t,
+                  "etl_time_s": etl_t, "score": s, "fused_k": k}
+            if meta["rec"]:
+                self.step_hist.observe(step_t)
+                self.etl_hist.observe(etl_t)
+                self.iters.inc()
+                self.score_gauge.set(s)
+                if mem:
+                    fr.update(mem)
+            if meta["rec"] or meta["health"]:
+                self.recorder.note(**fr)
+            for lst in self.net.listeners:
+                lst.iteration_done(self.net, it0 + j + 1, s, etl_t)

@@ -27,4 +27,15 @@ timeout -k 10 870 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+
+# Stage 2: fused-dispatch bench smoke (nn/fused.py) — the K-sweep on a
+# tiny MLP at CPU preflight shapes, streaming BENCH JSON into
+# BENCH_smoke.json so every tier-1 run refreshes the dispatch-amortization
+# trajectory record next to the test signal.
+echo "== fused bench smoke =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
+  timeout -k 10 300 python bench.py fused --steps-per-dispatch 1,4 \
+  | tee BENCH_smoke.json || {
+    echo "tier1: fused bench smoke FAILED"; exit 1; }
+
 exit $rc

@@ -1,0 +1,556 @@
+"""Fused multi-step dispatch (nn/fused.py): K-step lax.scan parity with
+sequential stepping, dispatch counting, shape-bucketing recompile
+flatness, super-batch stacking/padding, and async-prefetch error
+discipline (ISSUE 5)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.datasets.iterator import (ArrayDataSetIterator,
+                                                  AsyncDataSetIterator,
+                                                  DataSet, DataSetIterator,
+                                                  SuperBatch,
+                                                  SuperBatchIterator,
+                                                  iter_batches, pad_batch)
+from deeplearning4j_tpu.nn import fused as fused_mod
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+from deeplearning4j_tpu.nn.listeners import CollectScoresListener
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.telemetry import health
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_isolation():
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _mlp(seed=5):
+    conf = NeuralNetConfig(seed=seed, updater=U.Adam(learning_rate=0.05)).list(
+        L.DenseLayer(n_out=16, activation="tanh"),
+        L.OutputLayer(n_out=3, loss="mcxent"),
+        input_type=I.FeedForwardType(4))
+    return MultiLayerNetwork(conf)
+
+
+def _graph(seed=9):
+    conf = (GraphBuilder(seed=seed, updater=U.Adam(learning_rate=0.03))
+            .add_inputs("in")
+            .set_input_types(I.FeedForwardType(4))
+            .add_layer("d", L.DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("out", L.OutputLayer(n_out=2, loss="mcxent"), "d")
+            .set_outputs("out")
+            .build())
+    g = ComputationGraph(conf)
+    g.init()
+    return g
+
+
+def _data(n=40, n_classes=3, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, 4).astype(np.float32)
+    y = np.eye(n_classes, dtype=np.float32)[rs.randint(0, n_classes, n)]
+    return x, y
+
+
+def _tree_allclose(a, b, atol=1e-6):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for p, q in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(p), np.asarray(q), atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# engine parity: K fused steps == K sequential steps
+# ---------------------------------------------------------------------------
+
+
+class TestMakeTrainSteps:
+    def test_matches_sequential_steps(self):
+        net = _mlp()
+        net.init()
+        x, y = _data(32)
+        xs, ys = x.reshape(4, 8, 4), y.reshape(4, 8, 3)
+        step = net.make_train_step(donate=False)
+        p, s, o = net.params, net.state, net.opt_state
+        rng = jax.random.PRNGKey(0)
+        seq_losses = []
+        for j in range(4):
+            p, s, o, loss = step(p, s, o, xs[j], ys[j], j, rng, None)
+            seq_losses.append(float(loss))
+        fused = net.make_train_steps(4, donate=False)
+        fp, fs, fo, fl = fused(net.params, net.state, net.opt_state, xs, ys,
+                               0, rng, np.ones((4, 8), np.float32),
+                               np.ones(4, np.float32))
+        _tree_allclose(p, fp)
+        _tree_allclose(o, fo)
+        np.testing.assert_allclose(np.asarray(fl), seq_losses, atol=1e-6)
+
+    def test_step_valid_zero_is_noop(self):
+        net = _mlp()
+        net.init()
+        x, y = _data(16)
+        xs, ys = x.reshape(2, 8, 4), y.reshape(2, 8, 3)
+        fused = net.make_train_steps(2, donate=False)
+        rng = jax.random.PRNGKey(0)
+        ones = np.ones((2, 8), np.float32)
+        # both steps valid vs only the first: the second must not touch
+        # params/opt_state (zero-mask alone would still apply reg decay)
+        p2, _, o2, _ = fused(net.params, net.state, net.opt_state, xs, ys,
+                             0, rng, ones, np.asarray([1.0, 1.0], np.float32))
+        p1, _, o1, l1 = fused(net.params, net.state, net.opt_state, xs, ys,
+                              0, rng, ones, np.asarray([1.0, 0.0], np.float32))
+        one = net.make_train_step(donate=False)
+        sp, ss, so, sl = one(net.params, net.state, net.opt_state, xs[0],
+                             ys[0], 0, rng, None)
+        _tree_allclose(p1, sp)
+        _tree_allclose(o1, so)
+        with pytest.raises(AssertionError):
+            _tree_allclose(p2, sp)
+
+    def test_with_health_bundle_stacked(self):
+        net = _mlp()
+        net.init()
+        x, y = _data(24)
+        xs, ys = x.reshape(3, 8, 4), y.reshape(3, 8, 3)
+        fused = net.make_train_steps(3, donate=False, with_health=True)
+        fp, fs, fo, fl, hb = fused(net.params, net.state, net.opt_state, xs,
+                                   ys, 0, jax.random.PRNGKey(0),
+                                   np.ones((3, 8), np.float32),
+                                   np.ones(3, np.float32))
+        assert hb["grad_norm"].shape == (3,)
+        np.testing.assert_allclose(np.asarray(hb["loss"]), np.asarray(fl),
+                                   atol=1e-6)
+        assert not bool(np.asarray(hb["loss_nonfinite"]).any())
+
+
+# ---------------------------------------------------------------------------
+# fit(steps_per_dispatch=K) end-to-end parity
+# ---------------------------------------------------------------------------
+
+
+class TestFitFused:
+    @pytest.mark.parametrize("k", [2, 3, 5])
+    def test_parity_ragged_dataset(self, k):
+        # 40 % 16 != 0: ragged tail batch AND ragged K-tail both in play
+        x, y = _data(40)
+        a = _mlp()
+        a.fit(x, y, epochs=2, batch_size=16)
+        b = _mlp()
+        b.fit(x, y, epochs=2, batch_size=16, steps_per_dispatch=k)
+        assert a.iteration == b.iteration == 6
+        _tree_allclose(a.params, b.params)
+        _tree_allclose(a.opt_state, b.opt_state)
+
+    def test_parity_with_user_mask(self):
+        x, y = _data(40)
+        mask = (np.random.RandomState(3).rand(40) > 0.2).astype(np.float32)
+        a = _mlp()
+        a.fit(x, y, epochs=2, batch_size=16, mask=mask)
+        b = _mlp()
+        b.fit(x, y, epochs=2, batch_size=16, mask=mask, steps_per_dispatch=4)
+        _tree_allclose(a.params, b.params)
+
+    def test_parity_with_health_and_listeners(self):
+        health.enable(policy="record")
+        try:
+            x, y = _data(40)
+            a = _mlp()
+            ca = CollectScoresListener()
+            a.add_listener(ca)
+            a.fit(x, y, epochs=2, batch_size=16)
+            b = _mlp()
+            cb = CollectScoresListener()
+            b.add_listener(cb)
+            b.fit(x, y, epochs=2, batch_size=16, steps_per_dispatch=3)
+            _tree_allclose(a.params, b.params)
+            assert cb.iterations == ca.iterations  # all K fan out, in order
+            np.testing.assert_allclose(cb.scores, ca.scores, atol=1e-6)
+            assert health.get_monitor().summary()["steps_checked"] >= 6
+        finally:
+            health.get_monitor().reset()
+
+    def test_score_value_is_last_real_step(self):
+        x, y = _data(40)
+        a = _mlp()
+        a.fit(x, y, epochs=1, batch_size=16)
+        b = _mlp()
+        b.fit(x, y, epochs=1, batch_size=16, steps_per_dispatch=2)
+        np.testing.assert_allclose(float(a.score_value),
+                                   float(b.score_value), atol=1e-6)
+
+    def test_graph_parity(self):
+        x, y = _data(40, n_classes=2)
+        a = _graph()
+        a.fit(x, y, epochs=2, batch_size=16)
+        b = _graph()
+        b.fit(x, y, epochs=2, batch_size=16, steps_per_dispatch=4)
+        _tree_allclose(a.params, b.params)
+
+    def test_pooled_rnn_parity(self):
+        """Temporal features + pooled [B, C] labels: the synthesized
+        validity mask is 1-d (example validity), which must reach the
+        loss but must NOT be forwarded into the mask-aware LSTM (it has
+        no timestep info; rnn layers require [B, T])."""
+        def rnn_net():
+            conf = NeuralNetConfig(seed=2,
+                                   updater=U.Sgd(learning_rate=0.1)).list(
+                L.GravesLSTM(n_out=8),
+                L.LastTimeStep(),
+                L.OutputLayer(n_out=2, loss="mcxent"),
+                input_type=I.RecurrentType(4))
+            return MultiLayerNetwork(conf)
+
+        rs = np.random.RandomState(1)
+        x = rs.rand(20, 6, 4).astype(np.float32)  # 20 % 8 != 0
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 20)]
+        a = rnn_net()
+        a.fit(x, y, epochs=2, batch_size=8)
+        b = rnn_net()
+        b.fit(x, y, epochs=2, batch_size=8, steps_per_dispatch=2)
+        _tree_allclose(a.params, b.params, atol=1e-5)
+
+    def test_sequence_labels_parity(self):
+        """Time-distributed [B, T, C] labels: the synthesized validity
+        mask is [B, T] and serves both the rnn feature mask and the
+        masked-mean loss exactly."""
+        def seq_net():
+            conf = NeuralNetConfig(seed=4,
+                                   updater=U.Sgd(learning_rate=0.1)).list(
+                L.GravesLSTM(n_out=8),
+                L.RnnOutputLayer(n_out=2, loss="mcxent"),
+                input_type=I.RecurrentType(4))
+            return MultiLayerNetwork(conf)
+
+        rs = np.random.RandomState(1)
+        x = rs.rand(20, 6, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, (20, 6))]
+        a = seq_net()
+        a.fit(x, y, epochs=2, batch_size=8)
+        b = seq_net()
+        b.fit(x, y, epochs=2, batch_size=8, steps_per_dispatch=2)
+        _tree_allclose(a.params, b.params, atol=1e-5)
+
+    def test_graph_temporal_mask_pooled_head_keeps_loss_unmasked(self):
+        """A [B, T] feature mask must not be mis-broadcast into a pooled
+        head's [B] per-example loss (it is only adopted as a label mask
+        when the layouts match)."""
+        from deeplearning4j_tpu.nn.graph import LastTimeStepVertex
+
+        conf = (GraphBuilder(seed=3, updater=U.Sgd(learning_rate=0.1))
+                .add_inputs("in")
+                .set_input_types(I.RecurrentType(4))
+                .add_layer("lstm", L.GravesLSTM(n_out=8), "in")
+                .add_vertex("last", LastTimeStepVertex(), "lstm")
+                .add_layer("out", L.OutputLayer(n_out=2, loss="mcxent"),
+                           "last")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf)
+        g.init()
+        rs = np.random.RandomState(0)
+        x = rs.rand(6, 5, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 6)]
+        m = np.ones((6, 5), np.float32)
+        loss_masked = g.score(x, {"out": y}, mask=jnp.asarray(m))
+        loss_plain = g.score(x, {"out": y})
+        assert np.isfinite(loss_masked)
+        np.testing.assert_allclose(loss_masked, loss_plain, atol=1e-6)
+
+    def test_tbptt_rejected_only_when_it_would_engage(self):
+        def tb_net():
+            conf = NeuralNetConfig(seed=2,
+                                   updater=U.Sgd(learning_rate=0.1)).list(
+                L.GravesLSTM(n_out=8),
+                L.RnnOutputLayer(n_out=2, loss="mcxent"),
+                input_type=I.RecurrentType(4),
+                backprop_type="tbptt", tbptt_fwd_length=10)
+            return MultiLayerNetwork(conf)
+
+        x = np.zeros((2, 40, 4), np.float32)
+        y = np.zeros((2, 40, 2), np.float32)
+        with pytest.raises(ValueError, match="TBPTT"):
+            tb_net().fit(x, y, steps_per_dispatch=2)
+        # sequences within the fwd window never enter the chunk loop
+        # (the per-batch K=1 gate) and train fused fine
+        rs = np.random.RandomState(0)
+        xs = rs.rand(4, 6, 4).astype(np.float32)
+        ys = np.eye(2, dtype=np.float32)[rs.randint(0, 2, (4, 6))]
+        net = tb_net()
+        net.fit(xs, ys, epochs=1, batch_size=2, steps_per_dispatch=2)
+        assert net.iteration == 2
+
+    def test_graph_mixed_label_layouts_rejected_under_bucketing(self):
+        from deeplearning4j_tpu.nn.graph import LastTimeStepVertex
+
+        conf = (GraphBuilder(seed=3, updater=U.Sgd(learning_rate=0.1))
+                .add_inputs("in")
+                .set_input_types(I.RecurrentType(4))
+                .add_layer("lstm", L.GravesLSTM(n_out=8), "in")
+                .add_layer("seq", L.RnnOutputLayer(n_out=2, loss="mcxent"),
+                           "lstm")
+                .add_vertex("last", LastTimeStepVertex(), "lstm")
+                .add_layer("pooled", L.OutputLayer(n_out=2, loss="mcxent"),
+                           "last")
+                .set_outputs("seq", "pooled")
+                .build())
+        g = ComputationGraph(conf)
+        rs = np.random.RandomState(0)
+        x = rs.rand(6, 5, 4).astype(np.float32)
+        labels = {"seq": np.eye(2, dtype=np.float32)[
+                      rs.randint(0, 2, (6, 5))],
+                  "pooled": np.eye(2, dtype=np.float32)[
+                      rs.randint(0, 2, 6)]}
+        with pytest.raises(ValueError, match="label layout"):
+            g.fit({"in": x}, labels, batch_size=4, steps_per_dispatch=2)
+        with pytest.raises(ValueError, match="label layout"):
+            g.fit({"in": x}, labels, batch_size=4, pad_ragged=True)
+
+    def test_dispatch_count_one_per_k_steps(self):
+        """K steps = ONE compiled-fn call (the tentpole claim), counted by
+        monkeypatching the cached fused engine."""
+        x, y = _data(37)  # 5 minibatches of 8 -> 2 dispatches at K=4
+        net = _mlp()
+        net.init()
+        k = 4
+        real = net.make_train_steps(k)
+        calls = []
+
+        def counting(*args, **kwargs):
+            calls.append(1)
+            return real(*args, **kwargs)
+
+        net._train_steps_fused = {(k, False): counting}
+        net.fit(x, y, epochs=1, batch_size=8, steps_per_dispatch=k)
+        assert net.iteration == 5
+        assert len(calls) == 2  # ceil(5 steps / 4 per dispatch)
+
+    def test_k1_loop_unchanged_no_dispatch_through_fused(self):
+        x, y = _data(24)
+        net = _mlp()
+        net.init()
+        net._train_steps_fused = {}  # fused cache must stay untouched
+        net.fit(x, y, epochs=1, batch_size=8)
+        assert net._train_steps_fused == {}
+
+
+# ---------------------------------------------------------------------------
+# shape bucketing: recompiles_total stays flat
+# ---------------------------------------------------------------------------
+
+
+class TestRecompileFlat:
+    def _recompiles(self):
+        c = telemetry.get_registry().get("recompiles_total")
+        return 0 if c is None else c.value(site="fit.step")
+
+    def test_fused_nondivisible_epochs_flat(self):
+        telemetry.enable()
+        x, y = _data(40)  # 40 % 16 != 0
+        net = _mlp()
+        net.fit(x, y, epochs=3, batch_size=16, steps_per_dispatch=2)
+        assert self._recompiles() == 0
+
+    def test_k1_pad_ragged_flat(self):
+        telemetry.enable()
+        x, y = _data(40)
+        net = _mlp()
+        net.fit(x, y, epochs=3, batch_size=16, pad_ragged=True)
+        assert self._recompiles() == 0
+        # and the padded loop is numerically identical to the plain one
+        ref = _mlp()
+        ref.fit(x, y, epochs=3, batch_size=16)
+        _tree_allclose(net.params, ref.params)
+
+
+# ---------------------------------------------------------------------------
+# super-batch stacking / padding units
+# ---------------------------------------------------------------------------
+
+
+class TestSuperBatchIterator:
+    def test_stacks_pads_and_k_tails(self):
+        x, y = _data(37)
+        it = SuperBatchIterator(
+            ArrayDataSetIterator(x, y, batch_size=8), 3)
+        sbs = list(it)
+        assert [sb.n_steps for sb in sbs] == [3, 2]
+        for sb in sbs:
+            assert sb.features.shape == (3, 8, 4)
+            assert sb.labels.shape == (3, 8, 3)
+            assert sb.labels_mask.shape == (3, 8)
+        np.testing.assert_array_equal(sbs[0].step_valid, [1, 1, 1])
+        np.testing.assert_array_equal(sbs[1].step_valid, [1, 1, 0])
+        # batches: 8,8,8 | 8,5(+3 pad), zero-step
+        np.testing.assert_array_equal(sbs[1].labels_mask.sum(axis=1),
+                                      [8, 5, 0])
+        # zeroed K-tail step carries zero features
+        assert float(np.abs(sbs[1].features[2]).sum()) == 0.0
+
+    def test_reset_via_iter_protocol(self):
+        x, y = _data(32)
+        it = SuperBatchIterator(ArrayDataSetIterator(x, y, batch_size=8), 2)
+        assert len(list(it)) == 2
+        assert len(list(it)) == 2  # fresh epoch on re-iteration
+
+    def test_callable_source_and_dict_pytrees(self):
+        x, y = _data(20, n_classes=2)
+        src = lambda: iter_batches({"in": x}.get("in"), y, 8)
+        it = SuperBatchIterator(src, 2, batch_size=8)
+        sbs = list(it)
+        assert [sb.n_steps for sb in sbs] == [2, 1]
+        # dict-keyed (ComputationGraph) batches stack leaf-wise
+        cg_src = lambda: ((({"a": bx}), {"o": by}, bm)
+                          for bx, by, bm in iter_batches(x, y, 8))
+        sbs = list(SuperBatchIterator(cg_src, 2, batch_size=8))
+        assert sbs[0].features["a"].shape == (2, 8, 4)
+        assert sbs[-1].labels["o"].shape == (2, 8, 2)
+
+    def test_pad_batch_timeseries_mask(self):
+        x = np.zeros((3, 7, 4), np.float32)
+        y = np.zeros((3, 7, 2), np.float32)
+        px, py, m, n = pad_batch(x, y, None, 5)
+        assert px.shape == (5, 7, 4) and py.shape == (5, 7, 2)
+        assert m.shape == (5, 7)  # [B, T] validity for 3-d labels
+        assert n == 3
+        np.testing.assert_array_equal(m.sum(axis=1), [7, 7, 7, 0, 0])
+
+    def test_array_iterator_pad_last(self):
+        x, y = _data(20)
+        it = ArrayDataSetIterator(x, y, batch_size=8, pad_last=True)
+        batches = list(it)
+        assert all(b.features.shape == (8, 4) for b in batches)
+        # masks on EVERY batch (one jit signature), validity on the tail
+        assert [int(b.features_mask.sum()) for b in batches] == [8, 8, 4]
+
+
+# ---------------------------------------------------------------------------
+# async prefetch discipline
+# ---------------------------------------------------------------------------
+
+
+class _BoomIterator(DataSetIterator):
+    def __init__(self, good=2):
+        self.good = good
+        self._i = 0
+
+    @property
+    def batch_size(self):
+        return 4
+
+    def reset(self):
+        self._i = 0
+
+    def __next__(self):
+        self._i += 1
+        if self._i > self.good:
+            raise RuntimeError("producer boom")
+        return DataSet(features=np.zeros((4, 2), np.float32),
+                       labels=np.zeros((4, 1), np.float32))
+
+
+class TestAsyncPrefetch:
+    def test_producer_error_propagates_promptly(self):
+        it = AsyncDataSetIterator(_BoomIterator(good=2), queue_size=4,
+                                  device_put=False)
+        it.reset()
+        # let the producer run to completion: 2 good batches queued, then
+        # the error — the consumer must surface it without draining first
+        deadline = time.time() + 5
+        while it._error is None and time.time() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(RuntimeError, match="producer boom"):
+            next(it)
+        it.close()
+        assert it._thread is None
+
+    def test_error_raised_at_sentinel_when_consumed_first(self):
+        it = AsyncDataSetIterator(_BoomIterator(good=2), queue_size=1,
+                                  device_put=False)
+        with pytest.raises(RuntimeError, match="producer boom"):
+            for _ in range(10):
+                next(it)
+        it.close()
+
+    def test_close_joins_producer_midstream(self):
+        it = AsyncDataSetIterator(_BoomIterator(good=10 ** 6), queue_size=2,
+                                  device_put=False)
+        next(it)
+        thread = it._thread
+        it.close()
+        assert it._thread is None
+        assert not thread.is_alive()
+        # restarts cleanly after close
+        assert next(it) is not None
+        it.close()
+
+    def test_superbatch_rides_async_queue_intact(self):
+        x, y = _data(20)
+        sbit = SuperBatchIterator(ArrayDataSetIterator(x, y, batch_size=8), 2)
+        async_it = AsyncDataSetIterator(sbit, queue_size=2)
+        sbs = list(async_it)
+        assert [sb.n_steps for sb in sbs] == [2, 1]
+        assert all(isinstance(sb, SuperBatch) for sb in sbs)
+        assert isinstance(sbs[0].features, jax.Array)  # device_put happened
+        async_it.close()
+
+    def test_fit_closes_prefetcher_on_listener_exception(self):
+        class Bomb(CollectScoresListener):
+            def iteration_done(self, model, iteration, score, etl_time=0.0):
+                raise RuntimeError("listener bomb")
+
+        x, y = _data(40)
+        net = _mlp()
+        net.add_listener(Bomb())
+        before = threading.active_count()
+        with pytest.raises(RuntimeError, match="listener bomb"):
+            net.fit(x, y, epochs=2, batch_size=8, steps_per_dispatch=2)
+        deadline = time.time() + 5
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.01)
+        assert threading.active_count() <= before  # producer joined
+
+
+# ---------------------------------------------------------------------------
+# parallel trainer
+# ---------------------------------------------------------------------------
+
+
+class TestParallelFused:
+    def test_parity_with_single_step_trainer(self):
+        from deeplearning4j_tpu.parallel import (MeshSpec, ParallelTrainer,
+                                                 make_mesh)
+
+        mesh = make_mesh(MeshSpec(data=2, model=1),
+                         devices=jax.devices()[:2])
+        x, y = _data(64)
+        a = ParallelTrainer(_mlp(), mesh).init()
+        a.fit(x, y, epochs=2, batch_size=16)
+        b = ParallelTrainer(_mlp(), mesh).init()
+        b.fit(x, y, epochs=2, batch_size=16, steps_per_dispatch=2)
+        assert a.iteration == b.iteration == 8
+        _tree_allclose(a.params, b.params)
+        assert b.examples_dropped == 0
+
+    def test_nondivisible_batch_rejected_before_prefetch(self):
+        from deeplearning4j_tpu.parallel import (MeshSpec, ParallelTrainer,
+                                                 make_mesh)
+
+        mesh = make_mesh(MeshSpec(data=2, model=1),
+                         devices=jax.devices()[:2])
+        x, y = _data(30)
+        t = ParallelTrainer(_mlp(), mesh).init()
+        with pytest.raises(ValueError, match="not divisible"):
+            t.fit(x, y, batch_size=15, steps_per_dispatch=2)
