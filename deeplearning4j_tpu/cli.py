@@ -9,6 +9,7 @@ and PlayUIServer's CLI. Invoke as::
         --averaging-frequency 5 --model-output-path out.zip
     python -m deeplearning4j_tpu train --zoo lenet --data x.npy --labels y.npy
     python -m deeplearning4j_tpu ui --port 9000
+    python -m deeplearning4j_tpu serve --model-path ckpt.zip --max-batch 32
     python -m deeplearning4j_tpu bench lenet
 
 "workers" in the reference = replica threads on N GPUs; here the worker
@@ -60,6 +61,39 @@ def _build_parser():
 
     u = sub.add_parser("ui", help="standalone training dashboard server")
     u.add_argument("--port", type=int, default=9000)
+
+    sv = sub.add_parser(
+        "serve",
+        help="production inference server: continuous batching over "
+             "AOT-warmed shape buckets, bounded admission queue with "
+             "load shedding, /serving status on the dashboard port")
+    svsrc = sv.add_mutually_exclusive_group(required=True)
+    svsrc.add_argument("--model-path", help="checkpoint zip to serve")
+    svsrc.add_argument("--zoo", help="zoo model name (fresh init)")
+    sv.add_argument("--name", default="default",
+                    help="model name in the registry (default: 'default')")
+    sv.add_argument("--max-batch", type=int, default=32,
+                    help="largest serving batch (= largest bucket)")
+    sv.add_argument("--buckets",
+                    help="comma-separated batch buckets to AOT-warm "
+                         "(default: powers of two up to --max-batch)")
+    sv.add_argument("--input-shape",
+                    help="per-example feature shape, e.g. 28,28,1 "
+                         "(default: derived from the model's input type)")
+    sv.add_argument("--max-queue", type=int, default=256,
+                    help="admission queue bound; a full queue sheds "
+                         "requests with ServingOverloaded")
+    sv.add_argument("--deadline-ms", type=float,
+                    help="default request deadline; requests stale in the "
+                         "queue past this are shed, not served")
+    sv.add_argument("--batch-window-ms", type=float, default=2.0,
+                    help="max extra wait to fill a batch once at least "
+                         "one request is in hand (ONE shared deadline)")
+    sv.add_argument("--port", type=int, default=9000,
+                    help="dashboard/status port (/serving, /metrics)")
+    sv.add_argument("--smoke", type=int, metavar="N",
+                    help="serve N synthetic requests, print the stats, "
+                         "and exit (CI smoke mode)")
 
     e = sub.add_parser("eval", help="evaluate a checkpoint on a dataset")
     esrc = e.add_mutually_exclusive_group(required=True)
@@ -238,6 +272,99 @@ def _cmd_train(args):
         save_model(net, args.model_output_path)
         print(f"saved: {args.model_output_path}")
     if ui_server is not None:
+        ui_server.stop()
+    return 0
+
+
+def _serve_input_spec(args, net):
+    """Per-example input shape for AOT warmup: --input-shape wins, else the
+    model conf's input type (FeedForwardType(6) -> (6,))."""
+    if args.input_shape:
+        return tuple(int(d) for d in args.input_shape.split(",") if d.strip())
+    input_type = getattr(net.conf, "input_type", None)
+    if input_type is None:
+        raise SystemExit(
+            "--input-shape is required: the model conf carries no input "
+            "type to derive the warmup shape from")
+    return tuple(input_type.shape(1)[1:])
+
+
+def _cmd_serve(args):
+    """The production serving entry point (ROADMAP 'serving heavy
+    traffic'): AOT-warm every registered bucket so no request pays a
+    compile, then serve with continuous batching + admission control."""
+    import time
+
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.serving import get_model_registry
+    from deeplearning4j_tpu.ui import UIServer
+
+    telemetry.enable()  # SLO gauges/counters are the point of a server
+    net = _load_model(args)
+    input_spec = _serve_input_spec(args, net)
+    buckets = None
+    if args.buckets:
+        buckets = [int(b) for b in args.buckets.split(",") if b.strip()]
+    registry = get_model_registry()
+    engine = registry.register(
+        args.name, net, input_spec=input_spec,
+        max_batch_size=args.max_batch, buckets=buckets,
+        max_queue=args.max_queue,
+        default_deadline_s=(None if args.deadline_ms is None
+                            else args.deadline_ms / 1e3),
+        batch_window_s=args.batch_window_ms / 1e3)
+    st = engine.stats()
+    print(f"model {args.name!r}: AOT-warmed buckets {st['buckets']} "
+          f"in {st['warmup_s']:.2f}s (input {input_spec})")
+    ui_server = UIServer(port=args.port).start()
+    print(f"serving status: http://127.0.0.1:{ui_server.port}/serving "
+          f"(metrics on /metrics)")
+
+    try:
+        if args.smoke:
+            import json
+
+            import numpy as np
+            from deeplearning4j_tpu.serving import ServingOverloaded
+            rs = np.random.RandomState(0)
+            xs = rs.rand(args.smoke, *input_spec).astype(np.float32)
+            futs, shed = [], 0
+            for i in range(args.smoke):
+                # a smoke burst bigger than --max-queue legitimately sheds
+                # (that's the admission control working): back off briefly
+                # and keep going rather than crash the smoke
+                for _ in range(1000):
+                    try:
+                        futs.append(engine.submit(xs[i]))
+                        break
+                    except ServingOverloaded:
+                        time.sleep(0.001)
+                else:
+                    raise SystemExit("smoke: admission queue never drained")
+            for f in futs:
+                try:
+                    f.get(timeout=30)
+                except ServingOverloaded:
+                    shed += 1  # stale-in-queue deadline shed (--deadline-ms)
+            if shed:
+                print(f"smoke: {shed} request(s) shed by deadline")
+            print(json.dumps(registry.status()["models"][args.name],
+                             indent=1))
+            return 0
+        # SIGTERM (docker stop / systemd) must route through the same
+        # clean-stop path as Ctrl-C: killing the interpreter with the
+        # serving worker mid-XLA-call aborts the process hard
+        import signal
+
+        def _term(signum, frame):
+            raise KeyboardInterrupt
+        signal.signal(signal.SIGTERM, _term)
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        registry.stop()
         ui_server.stop()
     return 0
 
@@ -439,6 +566,8 @@ def main(argv=None):
         return _cmd_train(args)
     if args.command == "ui":
         return _cmd_ui(args)
+    if args.command == "serve":
+        return _cmd_serve(args)
     if args.command == "bench":
         return _cmd_bench(args)
     if args.command == "eval":

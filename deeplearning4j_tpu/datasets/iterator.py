@@ -14,6 +14,7 @@ becomes plain device_put, since XLA owns device memory.
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
 import queue
 import threading
@@ -96,6 +97,65 @@ def pad_batch(x, y, m, target):
     else:
         m = _pad_rows(m, target)
     return x, y_padded, m, n
+
+
+class BucketRegistry:
+    """The registered batch-size buckets a process compiles for.
+
+    Shape bucketing (``pad_batch``) removes ragged-shape recompiles only if
+    every padded size maps onto a FINITE, pre-declared set of batch shapes —
+    otherwise each new request size mints a new XLA executable and
+    ``recompiles_total`` climbs anyway. This registry is that declaration:
+    ``bucket_for(n)`` returns the smallest registered bucket >= n (``None``
+    past the largest — callers chunk by ``max``), so the serving tier can
+    AOT-compile exactly ``len(sizes())`` forwards at startup and ragged
+    traffic reuses them forever (the whole-program AOT stance of the
+    Julia-to-TPU paper: declare the shapes, compile once, never again).
+    """
+
+    def __init__(self, sizes):
+        cleaned = sorted({int(s) for s in sizes})
+        if not cleaned or cleaned[0] < 1:
+            raise ValueError(f"bucket sizes must be positive, got {sizes!r}")
+        self._sizes = cleaned
+
+    @classmethod
+    def powers_of_two(cls, max_batch, min_batch=1):
+        """1, 2, 4, ... up to (and always including) ``max_batch``."""
+        sizes, b = [], int(min_batch)
+        while b < max_batch:
+            sizes.append(b)
+            b *= 2
+        sizes.append(int(max_batch))
+        return cls(sizes)
+
+    def sizes(self):
+        return list(self._sizes)
+
+    @property
+    def max(self):
+        return self._sizes[-1]
+
+    def bucket_for(self, n):
+        """Smallest registered bucket >= n, or None when n exceeds max."""
+        if n > self._sizes[-1]:
+            return None
+        return self._sizes[bisect.bisect_left(self._sizes, n)]
+
+    def round_up_to_multiple(self, m):
+        """A new registry with every bucket rounded up to a multiple of
+        ``m`` (mesh serving: the padded batch must split over the data
+        axis), duplicates collapsed."""
+        return BucketRegistry(-(-s // m) * m for s in self._sizes)
+
+    def __iter__(self):
+        return iter(self._sizes)
+
+    def __len__(self):
+        return len(self._sizes)
+
+    def __repr__(self):
+        return f"BucketRegistry({self._sizes})"
 
 
 class DataSetIterator:

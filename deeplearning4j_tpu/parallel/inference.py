@@ -9,6 +9,14 @@ TPU-native: one jitted forward compiled at a fixed max batch size; incoming
 requests are queued, padded into the static batch shape (XLA needs static
 shapes), executed, and results sliced back out. Multi-device serving = shard
 the padded batch over the mesh data axis.
+
+Rebased on the serving tier (deeplearning4j_tpu/serving/engine.py): the
+compiled padded forward is a single-bucket :class:`BucketedForward` (the
+same core the production :class:`~deeplearning4j_tpu.serving.ServingEngine`
+AOT-warms across many buckets), and request futures are
+:class:`InferenceFuture` (``done()`` + chained errors). This class remains
+the simple fixed-batch facade; for continuous batching, admission control
+and SLO gauges use the serving package.
 """
 
 from __future__ import annotations
@@ -17,16 +25,16 @@ import queue
 import threading
 import time
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import telemetry as _tm
-from deeplearning4j_tpu.parallel import mesh as _mesh
+from deeplearning4j_tpu.datasets.iterator import BucketRegistry
+from deeplearning4j_tpu.serving.engine import (BucketedForward,
+                                               InferenceFuture,
+                                               ServingShutdown)
 
-#: fill-ratio buckets: eighths of the padded batch — "how much of each
-#: compiled max_batch forward was real work vs padding"
-_FILL_BUCKETS = tuple(i / 8.0 for i in range(1, 9))
+#: back-compat alias: request holders predate the serving tier's name
+_Result = InferenceFuture
 
 
 class ParallelInference:
@@ -42,25 +50,15 @@ class ParallelInference:
         self.mesh = mesh
         self.timeout_s = timeout_s
         self.inference_mode = inference_mode
-        if mesh is not None:
-            # padded batch must split evenly over the data axis
-            nd = mesh.shape["data"]
-            self.max_batch = -(-max_batch_size // nd) * nd
-            self._place = lambda x: jax.device_put(x, _mesh.data_sharded(mesh))
-        else:
-            self.max_batch = max_batch_size
-            self._place = lambda x: x
+        self._nominal_batch = max_batch_size
         self._serving = self._compile(net)
+        self.max_batch = self._serving[1].buckets.max  # mesh rounds up
         self._queue: queue.Queue = queue.Queue()
         self._thread = None
         self._stop = threading.Event()
         reg = self._reg = _tm.get_registry()
         self._m_depth = reg.gauge(
             "serving_queue_depth", "pending requests in the serving queue")
-        self._m_fill = reg.histogram(
-            "serving_batch_fill_ratio",
-            "fraction of each padded device batch holding real examples",
-            buckets=_FILL_BUCKETS)
         self._m_latency = reg.histogram(
             "serving_request_latency_seconds",
             "request latency by mode (direct / batched / sequential)")
@@ -71,21 +69,17 @@ class ParallelInference:
             "failed or in flight")
 
     def _compile(self, net):
-        """(net, fwd, fwd_one): the served model and its jitted forwards —
-        kept in ONE tuple so hot-swaps are atomic (a batch never mixes one
-        model's params with another's state or apply_fn)."""
-        def raw(p, s, x):
-            return net.apply_fn(p, s, x, train=False)[0]
-        if self.mesh is not None:
-            repl = _mesh.replicated(self.mesh)
-            data_sh = _mesh.data_sharded(self.mesh)
-            fwd = jax.jit(raw, in_shardings=(repl, repl, data_sh),
-                          out_shardings=data_sh)
-        else:
-            fwd = jax.jit(raw)
-        # sequential mode serves one example per call: a batch-1 jit, not a
-        # padded max_batch forward with max_batch-1 wasted rows
-        fwd_one = jax.jit(raw)
+        """(net, fwd, fwd_one): the served model, its bucketed padded
+        forward, and the batch-1 sequential forward — kept in ONE tuple so
+        hot-swaps are atomic (a batch never mixes one model's params with
+        another's state or apply_fn)."""
+        fwd = BucketedForward(net, BucketRegistry([self._nominal_batch]),
+                              mesh=self.mesh, site="parallel_inference",
+                              dtype=None)
+        # sequential mode serves one example per call: a batch-1 forward,
+        # not a padded max_batch forward with max_batch-1 wasted rows
+        fwd_one = BucketedForward(net, BucketRegistry([1]),
+                                  site="parallel_inference_seq", dtype=None)
         return (net, fwd, fwd_one)
 
     # ---- synchronous API ----
@@ -103,29 +97,15 @@ class ParallelInference:
         return out
 
     def _forward_padded(self, x):
-        """The padded chunk loop shared by output() and the batched worker;
-        observes per-chunk batch-fill so padding waste is a visible series."""
-        net, fwd, _ = self._serving  # one atomic snapshot per call
-        n = x.shape[0]
-        outs = []
-        for i in range(0, n, self.max_batch):
-            chunk = x[i:i + self.max_batch]
-            real = chunk.shape[0]
-            pad = self.max_batch - real
-            if pad:
-                chunk = np.concatenate([chunk, np.zeros((pad,) + chunk.shape[1:], chunk.dtype)])
-            with _tm.span("serving.forward", fill=real / self.max_batch):
-                y = fwd(net.params, net.state, self._place(jnp.asarray(chunk)))
-                y = np.asarray(y)[:real]
-            if self._reg.enabled:
-                self._m_fill.observe(real / self.max_batch)
-            outs.append(y)
-        return np.concatenate(outs)
+        """The padded chunk loop shared by output() and the batched worker
+        (serving/engine.py BucketedForward: per-chunk batch-fill telemetry,
+        one atomic model snapshot per call)."""
+        _net, fwd, _ = self._serving
+        return fwd(x)
 
     def _output_one(self, x):
-        net, _, fwd_one = self._serving
-        return np.asarray(fwd_one(net.params, net.state,
-                                  jnp.asarray(x)[None]))[0]
+        _net, _, fwd_one = self._serving
+        return fwd_one(np.asarray(x)[None])[0]
 
     @property
     def net(self):
@@ -146,16 +126,40 @@ class ParallelInference:
         return self
 
     def stop(self):
+        """Stop the worker, then FAIL every request it never picked up —
+        pending holders must not hang until their own ``get(timeout=)``.
+        ``submit()`` after stop raises immediately."""
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+            self._thread = None
+        self._fail_pending()
+
+    def _fail_pending(self):
+        """Drain the queue, failing every request the worker never picked
+        up (stop(), and submit()'s stop-race guard)."""
+        err = ServingShutdown(
+            "ParallelInference stopped before serving this request")
+        while True:
+            try:
+                _x, holder, _t = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not holder.done():
+                holder._set_error(err)
 
     def submit(self, x):
         """Submit one example; returns a Future-like holder."""
-        holder = _Result()
+        if self._stop.is_set():
+            raise ServingShutdown("ParallelInference is stopped")
+        holder = InferenceFuture()
         enabled = self._reg.enabled
         self._queue.put((np.asarray(x), holder,
                          time.perf_counter() if enabled else 0.0))
+        if self._stop.is_set():
+            # raced stop(): its drain may already have passed this slot —
+            # fail pending holders instead of leaving them to hang
+            self._fail_pending()
         if enabled:
             self._m_requests.inc(mode="queued")
             self._m_depth.set(self._queue.qsize())
@@ -169,21 +173,38 @@ class ParallelInference:
                 self._m_latency.observe(time.perf_counter() - t_submit,
                                         mode=mode)
 
-    def _worker(self):
-        while not self._stop.is_set():
-            batch = []
-            try:
-                batch.append(self._queue.get(timeout=0.1))
-            except queue.Empty:
-                continue
-            # BATCHED mode opportunistically drains up to max_batch
-            # requests; SEQUENTIAL serves them one at a time
-            while (self.inference_mode == "batched"
-                   and len(batch) < self.max_batch):
+    def _drain_batch(self, first):
+        """BATCHED-mode coalescing: take everything already queued with
+        ``get_nowait()`` (no waiting), then — only if the batch still has
+        room — wait for stragglers under ONE shared ``timeout_s`` deadline.
+        Previously each empty slot waited ``timeout_s`` afresh, so a
+        trickle of arrivals could hold the batch open for up to
+        ``timeout_s * (max_batch - 1)``; now the worst case is one
+        ``timeout_s`` total."""
+        batch = [first]
+        try:
+            while len(batch) < self.max_batch:
+                batch.append(self._queue.get_nowait())
+        except queue.Empty:
+            deadline = time.perf_counter() + self.timeout_s
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
                 try:
-                    batch.append(self._queue.get(timeout=self.timeout_s))
+                    batch.append(self._queue.get(timeout=remaining))
                 except queue.Empty:
                     break
+        return batch
+
+    def _worker(self):
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = (self._drain_batch(first)
+                     if self.inference_mode == "batched" else [first])
             if self._reg.enabled:
                 self._m_depth.set(self._queue.qsize())
             # a failing forward (bad input shape, mid-swap architecture
@@ -202,27 +223,5 @@ class ParallelInference:
                     self._finish(holder, y, t_sub, "batched")
             except Exception as e:  # noqa: BLE001 — propagate to waiters
                 for _, holder, _t in batch:
-                    if not holder._event.is_set():  # don't poison requests
-                        holder._set_error(e)       # already served (seq mode)
-
-
-class _Result:
-    def __init__(self):
-        self._event = threading.Event()
-        self._value = None
-        self._error = None
-
-    def _set(self, v):
-        self._value = v
-        self._event.set()
-
-    def _set_error(self, e):
-        self._error = e
-        self._event.set()
-
-    def get(self, timeout=None):
-        if not self._event.wait(timeout):
-            raise TimeoutError("inference result not ready")
-        if self._error is not None:
-            raise self._error
-        return self._value
+                    if not holder.done():       # don't poison requests
+                        holder._set_error(e)    # already served (seq mode)

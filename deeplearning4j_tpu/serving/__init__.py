@@ -1,0 +1,40 @@
+"""Production serving tier: continuous batching + AOT-warmed inference.
+
+Grown from ``parallel/inference.py``'s ParallelInference into a real
+serving path for heavy traffic (ROADMAP north star; the serving half of the
+TensorFlow system paper, PAPERS.md arxiv 1605.08695):
+
+* :class:`ServingEngine` — continuous (dynamic) batching over registered
+  shape buckets with AOT warmup (``jax.jit(...).lower().compile()`` per
+  bucket at startup), a bounded admission queue with deadline-aware
+  load shedding (:class:`ServingOverloaded`), and per-model p50/p99 SLO
+  gauges.
+* :class:`ModelRegistry` — several named models served side by side with
+  atomic ``update_model`` hot swaps; the process-default instance backs
+  the UIServer's ``/serving`` endpoint and the ``serve`` CLI verb.
+* :class:`BucketedForward` / :class:`InferenceFuture` — the compiled-
+  forward core and the request future, shared with ParallelInference
+  (which is rebased on them).
+
+Quickstart::
+
+    from deeplearning4j_tpu.serving import get_model_registry
+    reg = get_model_registry()
+    engine = reg.register("lenet", net, input_spec=(28, 28, 1),
+                          max_batch_size=32, max_queue=256)
+    fut = engine.submit(example)          # continuous batching
+    y = fut.get(timeout=1.0)
+    reg.update_model("lenet", retrained)  # atomic hot swap
+"""
+
+from deeplearning4j_tpu.serving.engine import (BucketedForward,
+                                               InferenceFuture,
+                                               ServingEngine,
+                                               ServingOverloaded,
+                                               ServingShutdown)
+from deeplearning4j_tpu.serving.registry import (ModelRegistry,
+                                                 get_model_registry, reset)
+
+__all__ = ["BucketedForward", "InferenceFuture", "ModelRegistry",
+           "ServingEngine", "ServingOverloaded", "ServingShutdown",
+           "get_model_registry", "reset"]

@@ -1,0 +1,685 @@
+"""Production inference engine: continuous batching over AOT-warmed buckets.
+
+The serving half of the TensorFlow system paper (PAPERS.md, arxiv
+1605.08695) as this framework's request path, grown from
+``parallel/inference.py``'s ParallelInference:
+
+* **Continuous (dynamic) batching** — a single worker drains whatever is
+  queued the moment the accelerator frees (no per-slot waits), pads the
+  ragged request batch to the nearest registered bucket
+  (datasets/iterator.py ``BucketRegistry`` + ``pad_batch`` row padding) and
+  runs ONE compiled forward, so arbitrary traffic shapes keep
+  ``recompiles_total`` flat.
+* **AOT warmup** — at startup every registered bucket (and its per-mesh
+  shardings) is lowered and compiled via ``jax.jit(...).lower().compile()``
+  (the whole-program AOT stance of the Julia-to-TPU paper, arxiv
+  1810.09868), so time-to-first-request is the same histogram bucket as
+  steady state: no user request ever pays a compile.
+* **SLO + admission control** — per-model p50/p99 latency gauges, a bounded
+  admission queue, deadline-aware shedding: a full queue rejects at
+  ``submit()`` with :class:`ServingOverloaded`, and requests whose deadline
+  passed while queued are shed before wasting a forward on them — the
+  "load shedding beats queueing collapse" discipline of serving heavy
+  traffic.
+
+Hot swap: the compiled state lives in ONE immutable :class:`BucketedForward`
+(params + apply_fn + executables); ``update_model`` builds and warms a fresh
+one off to the side, then atomically rebinds — a batch can never mix one
+model's params with another's apply_fn, and no queued request is dropped.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import telemetry as _tm
+from deeplearning4j_tpu.datasets.iterator import BucketRegistry
+
+#: fill-ratio buckets: eighths of the padded bucket (shared with
+#: ParallelInference — "how much of each compiled forward was real work")
+FILL_BUCKETS = tuple(i / 8.0 for i in range(1, 9))
+
+
+class ServingOverloaded(RuntimeError):
+    """Request shed by admission control: the bounded queue is full, or the
+    request's deadline passed before a worker picked it up."""
+
+
+class ServingShutdown(RuntimeError):
+    """Request failed because the engine stopped before serving it."""
+
+
+class InferenceFuture:
+    """Future-like holder for one submitted request (the reference's
+    observable-completion contract, hardened): ``done()`` polls, ``get()``
+    blocks, and a failed request raises a FRESH exception chained from the
+    original (``raise ... from e``) — re-raising one shared instance across
+    waiter threads would mutate its traceback concurrently."""
+
+    __slots__ = ("_event", "_value", "_error", "latency_s")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+        #: submit-to-result seconds, stamped by the serving worker when the
+        #: request completes (None until then / on the direct path)
+        self.latency_s = None
+
+    def done(self):
+        """True once a result or error is set (never blocks)."""
+        return self._event.is_set()
+
+    def _set(self, v):
+        self._value = v
+        self._event.set()
+
+    def _set_error(self, e):
+        self._error = e
+        self._event.set()
+
+    def get(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("inference result not ready")
+        err = self._error
+        if err is not None:
+            try:
+                fresh = type(err)(*err.args)
+            except Exception:
+                fresh = RuntimeError(f"{type(err).__name__}: {err}")
+            raise fresh from err
+        return self._value
+
+
+def _example_structs(input_spec, batch, dtype):
+    """Pytree of ``jax.ShapeDtypeStruct`` for a ``batch``-sized input.
+
+    ``input_spec`` is a per-example shape tuple, or a dict of them (the
+    ComputationGraph multi-input form).
+    """
+    def struct(shape):
+        return jax.ShapeDtypeStruct((batch,) + tuple(int(d) for d in shape),
+                                    dtype)
+    if isinstance(input_spec, dict):
+        return {k: struct(v) for k, v in input_spec.items()}
+    return struct(input_spec)
+
+
+def _as_input(x):
+    """Host-normalize one request input: a dict is the ComputationGraph
+    multi-input pytree (each value coerced per key); anything else —
+    ndarray, list, tuple, scalar row — is ONE array. Feeding lists through
+    tree_map directly would explode them into per-scalar leaves."""
+    if isinstance(x, dict):
+        return {k: np.asarray(v) for k, v in x.items()}
+    return np.asarray(x)
+
+
+def _pad_rows_np(tree, target):
+    """Zero-pad every leaf to ``target`` rows along axis 0 (host-side)."""
+    def pad(a):
+        a = np.asarray(a)
+        n = a.shape[0]
+        if n == target:
+            return a
+        return np.concatenate(
+            [a, np.zeros((target - n,) + a.shape[1:], a.dtype)])
+    return jax.tree_util.tree_map(pad, tree)
+
+
+class BucketedForward:
+    """One model's compiled, bucketed forward — IMMUTABLE once built, so a
+    hot swap is a single reference rebind and a running batch keeps a
+    consistent (params, state, apply_fn, executables) snapshot.
+
+    ``warmup(input_spec)`` AOT-compiles every registered bucket; a request
+    size with no compiled bucket falls back to a lazy compile, counted into
+    ``recompiles_total{site=}`` and the engine's ``aot`` stats — a rising
+    ``lazy_compiles`` means the registered buckets don't cover live traffic.
+    """
+
+    def __init__(self, net, buckets: BucketRegistry, mesh=None,
+                 site="serving", dtype=np.float32):
+        self.net = net
+        self.mesh = mesh
+        self.site = site
+        # dtype=None: serve requests in whatever dtype they arrive
+        # (ParallelInference back-compat); a FIXED dtype is what lets the
+        # serving engine promise one jit signature per bucket
+        self.dtype = None if dtype is None else np.dtype(dtype)
+        if mesh is not None:
+            # imported here, not at module top: parallel/__init__ pulls in
+            # ParallelInference, which is itself rebased on this module
+            from deeplearning4j_tpu.parallel import mesh as _mesh
+            nd = mesh.shape["data"]
+            buckets = buckets.round_up_to_multiple(nd)
+            self._repl = _mesh.replicated(mesh)
+            data_sh = _mesh.data_sharded(mesh)
+            self._place = lambda x: jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, data_sh), x)
+
+            def raw(p, s, x):
+                return net.apply_fn(p, s, x, train=False)[0]
+            self._jit = jax.jit(raw, in_shardings=(self._repl, self._repl,
+                                                   data_sh),
+                                out_shardings=data_sh)
+        else:
+            self._repl = None
+            self._place = lambda x: jax.tree_util.tree_map(jnp.asarray, x)
+
+            def raw(p, s, x):
+                return net.apply_fn(p, s, x, train=False)[0]
+            self._jit = jax.jit(raw)
+        # params/state are read LIVE from the net on every call (a net
+        # trained in place between requests serves its current weights —
+        # and never a donated stale buffer); the mesh replication below is
+        # cached by tree identity so steady-state serving pays zero
+        # placement dispatches
+        self._placed = None       # (params_repl, state_repl)
+        self._placed_src = None   # (net.params, net.state) they came from
+        self.buckets = buckets
+        self._compiled = {}  # input signature -> AOT executable (False=jit)
+        self._warmed = False  # has an AOT warmup declared coverage?
+        self._lock = threading.Lock()
+        self._aot = {"warmed": 0, "lazy_compiles": 0, "hits": 0,
+                     "jit_serves": 0}
+        reg = self._reg = _tm.get_registry()
+        self._m_fill = reg.histogram(
+            "serving_batch_fill_ratio",
+            "fraction of each padded device batch holding real examples",
+            buckets=FILL_BUCKETS)
+        self._m_aot = reg.counter(
+            "serving_aot_cache_total",
+            "compiled-bucket lookups (site=, result=hit/miss); misses pay "
+            "a lazy compile and also count into recompiles_total")
+        self._c_comp = reg.counter(
+            "compiles_total",
+            "jit cache entries created, labeled by site "
+            "(first-fill warm-up included)")
+        self._c_rec = reg.counter(
+            "recompiles_total",
+            "jit cache misses beyond the first fill, labeled "
+            "by site — a rising series is a recompile storm")
+
+    def warmup(self, input_spec):
+        """Lower + compile the forward for every registered bucket (and the
+        mesh shardings baked into the jit). Returns the wall seconds spent —
+        the startup cost that buys a compile-free request path."""
+        t0 = time.perf_counter()
+        dtype = self.dtype if self.dtype is not None else np.dtype("float32")
+        for b in self.buckets:
+            self._ensure_compiled(_example_structs(input_spec, b, dtype),
+                                  warm=True)
+        self._warmed = True
+        return time.perf_counter() - t0
+
+    @staticmethod
+    def _signature(x_struct):
+        """Cache key: the full (shape, dtype) signature — two dtypes (or a
+        malformed request shape) must not collide on one executable."""
+        return tuple((tuple(l.shape), str(l.dtype))
+                     for l in jax.tree_util.tree_leaves(x_struct))
+
+    def _ensure_compiled(self, x_struct, warm=False):
+        """The AOT executable for this input signature (compiling on miss)."""
+        key = self._signature(x_struct)
+        with self._lock:
+            ex = self._compiled.get(key)
+            if ex is not None:
+                if not warm:
+                    if ex is False:
+                        # a jit-fallback entry is NOT an AOT hit: counting
+                        # it as one would let "lazy_compiles: 0" read as a
+                        # healthy AOT path on a server with no working
+                        # executables at all
+                        self._aot["jit_serves"] += 1
+                    else:
+                        self._aot["hits"] += 1
+                        self._m_aot.inc(result="hit", site=self.site)
+                return ex
+            # compile under the lock: two threads racing the same bucket
+            # would otherwise both pay (and double-count) the compile
+            try:
+                ex = self._jit.lower(self.net.params, self.net.state,
+                                     x_struct).compile()
+            except Exception:
+                if warm:
+                    # startup/update_model warmup must fail FAST: a spec
+                    # the model rejects, reported as "warmed", would serve
+                    # nothing but errors (or silent lazy compiles)
+                    raise
+                ex = False  # odd request signature: serve via the jit
+                            # path, which surfaces any real shape error
+            self._compiled[key] = ex
+            if warm:
+                self._aot["warmed"] += 1
+            else:
+                self._aot["lazy_compiles"] += 1
+                self._m_aot.inc(result="miss", site=self.site)
+                if self._warmed:
+                    # a compile the warmup sweep claimed to cover but
+                    # didn't IS a recompile (a shape outside the
+                    # registered buckets); cold lazy compiles on an
+                    # unwarmed forward are just first-fill
+                    self._c_rec.inc(site=self.site)
+            self._c_comp.inc(site=self.site)
+            return ex
+
+    def aot_stats(self):
+        with self._lock:
+            return dict(self._aot)
+
+    def _resolve(self):
+        """The (params, state) to serve THIS call: always the net's live
+        trees. With a mesh they are replicated on first use and the
+        placement is reused until the net rebinds them (post-fit trees are
+        new objects, so the identity check catches every update)."""
+        net = self.net
+        params, state = net.params, net.state
+        if self._repl is None:
+            return params, state
+        with self._lock:
+            if self._placed_src is not None \
+                    and self._placed_src[0] is params \
+                    and self._placed_src[1] is state:
+                return self._placed
+            placed = (jax.device_put(params, self._repl),
+                      jax.device_put(state, self._repl))
+            self._placed_src = (params, state)
+            self._placed = placed
+            return placed
+
+    def _run(self, x_padded):
+        """One compiled forward at the padded signature; jit fallback when
+        AOT lowering was unavailable or rejects the call convention."""
+        x_struct = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), x_padded)
+        ex = self._ensure_compiled(x_struct)
+        params, state = self._resolve()
+        x_dev = self._place(x_padded)
+        if ex is not False:
+            try:
+                return ex(params, state, x_dev)
+            except TypeError:
+                pass  # AOT arg-passing quirk on this jax version
+        return self._jit(params, state, x_dev)
+
+    def __call__(self, x):
+        """Padded, bucketed forward of a host batch (any leading size):
+        chunks by the largest bucket, pads each chunk up to its nearest
+        registered bucket, slices real rows back out."""
+        x = _as_input(x)
+        first = jax.tree_util.tree_leaves(x)[0]
+        n = first.shape[0]
+        outs = []
+        step = self.buckets.max
+        for i in range(0, n, step):
+            chunk = jax.tree_util.tree_map(
+                lambda a: np.asarray(a[i:i + step], dtype=self.dtype), x)
+            real = jax.tree_util.tree_leaves(chunk)[0].shape[0]
+            bucket = self.buckets.bucket_for(real)
+            padded = _pad_rows_np(chunk, bucket)
+            with _tm.span("serving.forward", fill=real / bucket,
+                          bucket=bucket):
+                y = self._run(padded)
+                y = jax.tree_util.tree_map(
+                    lambda a: np.asarray(a)[:real], y)
+            if self._reg.enabled:
+                self._m_fill.observe(real / bucket, site=self.site)
+            outs.append(y)
+        if len(outs) == 1:
+            return outs[0]
+        return jax.tree_util.tree_map(
+            lambda *parts: np.concatenate(parts), *outs)
+
+
+class ServingEngine:
+    """Continuous-batching inference server for ONE named model.
+
+    ``submit()`` is the async request path (bounded admission queue,
+    deadline-aware shedding); ``output()`` is the synchronous direct path
+    (same compiled buckets, no queue). ``update_model()`` hot-swaps the
+    served model atomically. ``stats()`` is the /serving status payload.
+    """
+
+    def __init__(self, net, *, name="default", input_spec=None,
+                 buckets=None, max_batch_size=32, mesh=None, max_queue=256,
+                 default_deadline_s=None, batch_window_s=0.0,
+                 dtype=np.float32, warmup=None):
+        self.name = name
+        self.mesh = mesh
+        self.batch_window_s = batch_window_s
+        self.default_deadline_s = default_deadline_s
+        self._input_spec = input_spec
+        self._dtype = np.dtype(dtype)
+        if buckets is None:
+            buckets = BucketRegistry.powers_of_two(max_batch_size)
+        elif not isinstance(buckets, BucketRegistry):
+            buckets = BucketRegistry(buckets)
+        self._fwd = BucketedForward(net, buckets, mesh,
+                                    site=f"serving:{name}", dtype=dtype)
+        self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
+        self.max_queue = max_queue
+        self._stop = threading.Event()
+        self._thread = None
+        self._lock = threading.Lock()
+        self._counts = {"submitted": 0, "served": 0, "shed_queue_full": 0,
+                        "shed_deadline": 0, "errors": 0, "swaps": 0}
+        self._recent_latencies = []   # bounded ring; /serving works even
+        self._warmup_s = None         # with telemetry disabled
+        reg = self._reg = _tm.get_registry()
+        self._m_depth = reg.gauge(
+            "serving_admission_queue_depth",
+            "pending requests in the bounded admission queue, per model")
+        self._m_latency = reg.histogram(
+            "serving_model_latency_seconds",
+            "submit-to-result request latency, per model")
+        self._m_p50 = reg.gauge(
+            "serving_latency_p50_seconds",
+            "rolling p50 request latency per model (SLO gauge)")
+        self._m_p99 = reg.gauge(
+            "serving_latency_p99_seconds",
+            "rolling p99 request latency per model (SLO gauge)")
+        self._m_requests = reg.counter(
+            "serving_model_requests_total",
+            "requests by model and outcome "
+            "(submitted/served/shed_queue_full/shed_deadline/error)")
+        self._m_shed = reg.counter(
+            "serving_shed_total",
+            "load-shed requests per model and reason "
+            "(queue_full / deadline / shutdown)")
+        self._m_warm = reg.gauge(
+            "serving_warmup_seconds",
+            "wall seconds the AOT bucket warmup took at startup, per model")
+        if warmup is None:
+            warmup = input_spec is not None
+        if warmup:
+            self.warmup()
+
+    # ---- lifecycle ----
+
+    def warmup(self):
+        """AOT-compile every registered bucket now, so no request pays a
+        compile. Requires ``input_spec`` (per-example shape, or a dict of
+        them for multi-input graphs)."""
+        if self._input_spec is None:
+            raise ValueError(
+                "warmup needs input_spec (per-example feature shape)")
+        self._warmup_s = self._fwd.warmup(self._input_spec)
+        self._m_warm.set(self._warmup_s, model=self.name)
+        return self._warmup_s
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Stop the worker and FAIL every request it never picked up with
+        :class:`ServingShutdown` — a stopped engine must not leave waiters
+        blocked until their own get() timeout. ``submit()`` after stop
+        raises immediately."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._fail_pending()
+
+    def _fail_pending(self):
+        """Drain the queue, failing every pending request with
+        :class:`ServingShutdown` (stop(), and submit()'s race guard)."""
+        err = ServingShutdown(
+            f"serving engine {self.name!r} stopped before serving this "
+            f"request")
+        while True:
+            try:
+                _, fut, _t, _dl = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not fut.done():
+                fut._set_error(err)
+                self._count("errors")
+                if self._reg.enabled:
+                    self._m_shed.inc(model=self.name, reason="shutdown")
+
+    @property
+    def running(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def net(self):
+        return self._fwd.net
+
+    @property
+    def buckets(self):
+        return self._fwd.buckets
+
+    def update_model(self, net, warm=None):
+        """Hot-swap the served model. The replacement BucketedForward is
+        built and (by default, when the engine knows its input spec) AOT-
+        warmed OFF the serving path, then atomically rebound — in-flight
+        batches finish on the old snapshot, later batches use the new one,
+        and no queued request is dropped or errored by the swap."""
+        fresh = BucketedForward(net, self._fwd.buckets, self.mesh,
+                                site=f"serving:{self.name}",
+                                dtype=self._dtype)
+        if warm is None:
+            warm = self._input_spec is not None
+        if warm:
+            if self._input_spec is None:
+                raise ValueError(
+                    "update_model(warm=True) needs input_spec")
+            fresh.warmup(self._input_spec)
+        self._fwd = fresh
+        self._count("swaps")
+
+    # ---- request paths ----
+
+    def output(self, x):
+        """Synchronous direct inference (no queue): pads/buckets internally,
+        same compiled executables as the batched path. Counted into
+        ``stats()``/the SLO ring like any served traffic — a server driven
+        synchronously must not read as idle on /serving."""
+        enabled = self._reg.enabled
+        t0 = time.perf_counter()
+        with _tm.span("serving.output", model=self.name):
+            out = self._fwd(x)  # asarray/bucketing happens per chunk
+        dt = time.perf_counter() - t0
+        n = jax.tree_util.tree_leaves(out)[0].shape[0]
+        self._count("served", n)
+        self._note_latencies([dt])  # one observation per call
+        if enabled:
+            self._m_requests.inc(n, model=self.name, outcome="served_direct")
+        return out
+
+    def submit(self, x, deadline_s=None):
+        """Queue ONE example; returns an :class:`InferenceFuture`.
+
+        Admission control: a full queue sheds the request here
+        (``ServingOverloaded``, counted per model) rather than letting the
+        backlog grow without bound; ``deadline_s`` (or the engine default)
+        sheds it later if it goes stale while queued.
+        """
+        if self._stop.is_set():
+            raise ServingShutdown(
+                f"serving engine {self.name!r} is stopped")
+        fut = InferenceFuture()
+        now = time.perf_counter()
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        deadline = None if deadline_s is None else now + deadline_s
+        self._count("submitted")
+        if self._reg.enabled:
+            self._m_requests.inc(model=self.name, outcome="submitted")
+        try:
+            # _as_input, not plain asarray: x may be the dict multi-input
+            # form (ComputationGraph) the warmup spec and output() support
+            self._queue.put_nowait((_as_input(x), fut, now, deadline))
+        except queue.Full:
+            self._count("shed_queue_full")
+            if self._reg.enabled:
+                self._m_shed.inc(model=self.name, reason="queue_full")
+                self._m_requests.inc(model=self.name,
+                                     outcome="shed_queue_full")
+            raise ServingOverloaded(
+                f"model {self.name!r}: admission queue full "
+                f"({self.max_queue} pending)") from None
+        if self._stop.is_set():
+            # raced stop(): its drain may already have run, leaving this
+            # request in a queue nobody reads — fail it (and any other
+            # stragglers) rather than hang the waiter forever
+            self._fail_pending()
+        if self._reg.enabled:
+            self._m_depth.set(self._queue.qsize(), model=self.name)
+        return fut
+
+    # ---- worker ----
+
+    def _drain(self):
+        """Continuous-batching drain: block briefly for the FIRST request,
+        then take everything already queued with ``get_nowait()`` (no
+        per-slot waits), then — only if the batch still has room and a
+        batch window is configured — wait under ONE shared deadline for
+        stragglers. The worst-case added latency is ``batch_window_s``
+        total, not per empty slot."""
+        cap = self._fwd.buckets.max
+        try:
+            batch = [self._queue.get(timeout=0.05)]
+        except queue.Empty:
+            return []
+        try:
+            while len(batch) < cap:
+                batch.append(self._queue.get_nowait())
+        except queue.Empty:
+            if self.batch_window_s > 0:
+                deadline = time.perf_counter() + self.batch_window_s
+                while len(batch) < cap:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    try:
+                        batch.append(self._queue.get(timeout=remaining))
+                    except queue.Empty:
+                        break
+        return batch
+
+    def _worker(self):
+        while not self._stop.is_set():
+            batch = self._drain()
+            if not batch:
+                continue
+            now = time.perf_counter()
+            live = []
+            for item in batch:
+                _x, fut, t_sub, deadline = item
+                if deadline is not None and now > deadline:
+                    # stale request: shed it instead of spending a forward
+                    # on an answer nobody is waiting for (deadline-aware
+                    # load shedding)
+                    fut._set_error(ServingOverloaded(
+                        f"model {self.name!r}: deadline exceeded while "
+                        f"queued ({1e3 * (now - t_sub):.1f} ms)"))
+                    self._count("shed_deadline")
+                    if self._reg.enabled:
+                        self._m_shed.inc(model=self.name, reason="deadline")
+                        self._m_requests.inc(model=self.name,
+                                             outcome="shed_deadline")
+                    continue
+                live.append(item)
+            if self._reg.enabled:
+                self._m_depth.set(self._queue.qsize(), model=self.name)
+            if not live:
+                continue
+            # a failing forward (bad input shape, mid-swap architecture
+            # mismatch) must fail THESE requests, not kill the serving loop
+            try:
+                with _tm.span("serving.batch", model=self.name,
+                              size=len(live)):
+                    xs = jax.tree_util.tree_map(  # stacks dict inputs too
+                        lambda *leaves: np.stack(leaves),
+                        *[b[0] for b in live])
+                    ys = self._fwd(xs)  # one atomic model snapshot
+                done = time.perf_counter()
+                lats = []
+                for (_, fut, t_sub, _dl), y in zip(
+                        live, _rows(ys, len(live))):
+                    fut.latency_s = done - t_sub
+                    fut._set(y)
+                    lats.append(done - t_sub)
+                self._count("served", len(live))
+                self._note_latencies(lats, outcome="served")
+            except Exception as e:  # noqa: BLE001 — propagate to waiters
+                for _, fut, _t, _dl in live:
+                    if not fut.done():
+                        fut._set_error(e)
+                self._count("errors", len(live))
+                if self._reg.enabled:
+                    self._m_requests.inc(len(live), model=self.name,
+                                         outcome="error")
+
+    def _count(self, key, n=1):
+        with self._lock:
+            self._counts[key] += n
+
+    def _note_latencies(self, lats, outcome=None):
+        """Record request latencies into the rolling SLO ring and refresh
+        the p50/p99 gauges; with ``outcome`` each also counts into the
+        per-model requests counter (the direct path counts its examples
+        separately, so it passes None)."""
+        with self._lock:
+            self._recent_latencies.extend(lats)
+            del self._recent_latencies[:-512]
+            recent = list(self._recent_latencies)
+        if self._reg.enabled:
+            for dt in lats:
+                self._m_latency.observe(dt, model=self.name)
+                if outcome is not None:
+                    self._m_requests.inc(model=self.name, outcome=outcome)
+            self._m_p50.set(float(np.percentile(recent, 50)),
+                            model=self.name)
+            self._m_p99.set(float(np.percentile(recent, 99)),
+                            model=self.name)
+
+    # ---- status ----
+
+    def latency_percentiles(self):
+        """(p50_s, p99_s) over the recent-latency ring, or (None, None)."""
+        with self._lock:
+            recent = list(self._recent_latencies)
+        if not recent:
+            return None, None
+        return (float(np.percentile(recent, 50)),
+                float(np.percentile(recent, 99)))
+
+    def stats(self):
+        """The /serving status payload for this model."""
+        with self._lock:
+            counts = dict(self._counts)
+        p50, p99 = self.latency_percentiles()
+        return {
+            "model": self.name,
+            "running": self.running,
+            "buckets": self._fwd.buckets.sizes(),
+            "mesh": None if self.mesh is None else dict(self.mesh.shape),
+            "max_queue": self.max_queue,
+            "queue_depth": self._queue.qsize(),
+            "requests": counts,
+            "aot": self._fwd.aot_stats(),
+            "warmup_s": self._warmup_s,
+            "latency_ms": {
+                "p50": None if p50 is None else round(1e3 * p50, 3),
+                "p99": None if p99 is None else round(1e3 * p99, 3)},
+        }
+
+
+def _rows(ys, n):
+    """Iterate the first ``n`` per-example rows of a (pytree of) stacked
+    output(s)."""
+    for i in range(n):
+        yield jax.tree_util.tree_map(lambda a: a[i], ys)

@@ -1,0 +1,112 @@
+"""Multi-model serving registry: several named models behind one process.
+
+Reference analog: TensorFlow Serving's model manager (the serving half of
+the system paper, PAPERS.md arxiv 1605.08695) — named models, each with its
+own continuous-batching engine, atomic ``update_model`` hot swaps, and one
+status surface (`/serving` on the UIServer, the ``serve`` CLI verb).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from deeplearning4j_tpu.serving.engine import ServingEngine
+
+
+class ModelRegistry:
+    """Named :class:`ServingEngine` instances with atomic hot swap."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._engines = {}
+
+    def register(self, name, net, *, start=True, **engine_kw):
+        """Build (and by default start) a serving engine for ``net`` under
+        ``name``. Engine kwargs (``input_spec``, ``buckets``, ``mesh``,
+        ``max_queue``, ``default_deadline_s``, ...) pass through; with an
+        ``input_spec`` the engine AOT-warms every bucket before this
+        returns, so the model is compile-free from its first request."""
+        def duplicate():
+            return ValueError(f"model {name!r} already registered; use "
+                              f"update_model for a hot swap")
+        with self._lock:
+            # check BEFORE building: the constructor AOT-warms every bucket
+            # (seconds of compile) and registers per-model gauges — work
+            # that must not run, let alone clobber the live engine's
+            # metrics, for a name that will be rejected
+            if name in self._engines:
+                raise duplicate()
+        engine = ServingEngine(net, name=name, **engine_kw)
+        with self._lock:
+            if name in self._engines:  # raced a concurrent register
+                raise duplicate()
+            self._engines[name] = engine
+        if start:
+            engine.start()
+        return engine
+
+    def engine(self, name) -> ServingEngine:
+        with self._lock:
+            try:
+                return self._engines[name]
+            except KeyError:
+                raise KeyError(
+                    f"no model {name!r} registered; known: "
+                    f"{sorted(self._engines)}") from None
+
+    def update_model(self, name, net, warm=None):
+        """Atomic hot swap of one named model (in-flight batches finish on
+        the old snapshot; no queued request is dropped)."""
+        self.engine(name).update_model(net, warm=warm)
+
+    def unregister(self, name):
+        with self._lock:
+            engine = self._engines.pop(name)
+        engine.stop()
+
+    def names(self):
+        with self._lock:
+            return sorted(self._engines)
+
+    def submit(self, name, x, deadline_s=None):
+        return self.engine(name).submit(x, deadline_s=deadline_s)
+
+    def output(self, name, x):
+        return self.engine(name).output(x)
+
+    def status(self):
+        """The /serving payload: per-model engine stats."""
+        with self._lock:
+            engines = list(self._engines.values())
+        return {"models": {e.name: e.stats() for e in engines}}
+
+    def stop(self):
+        with self._lock:
+            engines = list(self._engines.values())
+            self._engines.clear()
+        for e in engines:
+            e.stop()
+
+
+_default = None
+_default_lock = threading.Lock()
+
+
+def get_model_registry() -> ModelRegistry:
+    """The process-wide default registry — what the UIServer's /serving
+    endpoint and the ``serve`` CLI verb read."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = ModelRegistry()
+    return _default
+
+
+def reset():
+    """Stop every engine in the default registry and drop it (tests)."""
+    global _default
+    with _default_lock:
+        reg, _default = _default, None
+    if reg is not None:
+        reg.stop()

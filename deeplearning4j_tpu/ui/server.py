@@ -9,6 +9,9 @@ HTTP server with a self-contained HTML page (inline SVG charts) —
     GET  /health                     -> run-health JSON (watchdog status,
                                         anomalies, recompiles, memory,
                                         flight-recorder state)
+    GET  /serving                    -> serving-tier status JSON (per-model
+                                        queue depth, p50/p99, shed counts,
+                                        AOT bucket coverage)
     GET  /train/sessions             -> session ids
     GET  /train/overview?session=s   -> score curve + timing (JSON)
     GET  /train/model?session=s      -> per-param norms over time (JSON)
@@ -113,6 +116,13 @@ class UIServer:
                     # sick, and why" endpoint next to the raw /metrics)
                     self._json(_health_payload())
                     return
+                if url.path == "/serving":
+                    # serving-tier status: per-model queue depth, SLO
+                    # percentiles, shed counts, AOT bucket coverage — the
+                    # process-default ModelRegistry (serving/registry.py)
+                    from deeplearning4j_tpu.serving import registry as _sreg
+                    self._json(_sreg.get_model_registry().status())
+                    return
                 if url.path in ("/", "/train", "/train/overview.html"):
                     self._html(_PAGE)
                     return
@@ -190,7 +200,8 @@ class UIServer:
         return cls._instance
 
     _KNOWN_PATHS = frozenset((
-        "/", "/metrics", "/health", "/train", "/train/overview.html",
+        "/", "/metrics", "/health", "/serving", "/train",
+        "/train/overview.html",
         "/train/sessions", "/train/overview", "/train/model",
         "/train/model.html", "/train/system", "/train/system.html",
         "/remote"))

@@ -38,4 +38,14 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
   | tee BENCH_smoke.json || {
     echo "tier1: fused bench smoke FAILED"; exit 1; }
 
+# Stage 3: serving bench smoke (deeplearning4j_tpu/serving) — the
+# latency-vs-offered-load sweep at small CPU loads, appended into
+# BENCH_smoke.json so every tier-1 run also refreshes the serving tier's
+# p50/p99/shed curve next to the dispatch-amortization record.
+echo "== serving bench smoke =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
+  timeout -k 10 300 python bench.py serving \
+  | tee -a BENCH_smoke.json || {
+    echo "tier1: serving bench smoke FAILED"; exit 1; }
+
 exit $rc

@@ -1,0 +1,562 @@
+"""Serving-tier tests (deeplearning4j_tpu/serving): continuous batching,
+AOT warmup over registered buckets (ISSUE 6 acceptance: recompiles_total
+delta 0 in steady state and first-request latency in the same histogram
+bucket as steady state), admission control + load shedding, multi-model
+hot swap under concurrent load, and the ParallelInference rebase
+satellites (single-deadline drain, prompt stop, chained future errors)."""
+
+import bisect
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu import serving as serving_pkg
+from deeplearning4j_tpu.datasets.iterator import BucketRegistry
+from deeplearning4j_tpu.nn import layers as L, updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (InferenceFuture, ModelRegistry,
+                                        ServingEngine, ServingOverloaded,
+                                        ServingShutdown,
+                                        get_model_registry)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    """Telemetry + default-model-registry isolation around every test."""
+    telemetry.reset()
+    telemetry.disable()
+    serving_pkg.reset()
+    yield
+    serving_pkg.reset()
+    telemetry.reset()
+    telemetry.disable()
+
+
+@pytest.fixture
+def fresh(_isolate):
+    reg = telemetry.get_registry()
+    telemetry.enable()
+    yield reg
+
+
+def _mlp(n_in=5, n_out=3, hidden=8, seed=4):
+    net = MultiLayerNetwork(
+        NeuralNetConfig(seed=seed, updater=U.Sgd(learning_rate=0.1)).list(
+            L.DenseLayer(n_out=hidden, activation="tanh"),
+            L.OutputLayer(n_out=n_out, loss="mcxent"),
+            input_type=I.FeedForwardType(n_in)))
+    net.init()
+    return net
+
+
+def _x(n, n_in=5, seed=0):
+    return np.random.RandomState(seed).rand(n, n_in).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# BucketRegistry
+# ---------------------------------------------------------------------------
+
+class TestBucketRegistry:
+    def test_bucket_for_and_max(self):
+        b = BucketRegistry([8, 2, 4, 2])
+        assert b.sizes() == [2, 4, 8]
+        assert b.max == 8
+        assert b.bucket_for(1) == 2
+        assert b.bucket_for(2) == 2
+        assert b.bucket_for(3) == 4
+        assert b.bucket_for(8) == 8
+        assert b.bucket_for(9) is None  # caller chunks by max
+
+    def test_powers_of_two_includes_max(self):
+        assert BucketRegistry.powers_of_two(32).sizes() == [1, 2, 4, 8, 16,
+                                                           32]
+        assert BucketRegistry.powers_of_two(24).sizes() == [1, 2, 4, 8, 16,
+                                                            24]
+
+    def test_round_up_to_multiple(self):
+        b = BucketRegistry([1, 2, 4, 8]).round_up_to_multiple(4)
+        assert b.sizes() == [4, 8]
+
+    def test_rejects_empty_and_nonpositive(self):
+        with pytest.raises(ValueError):
+            BucketRegistry([])
+        with pytest.raises(ValueError):
+            BucketRegistry([0, 4])
+
+
+# ---------------------------------------------------------------------------
+# ServingEngine
+# ---------------------------------------------------------------------------
+
+class TestServingEngine:
+    def test_direct_output_matches_net(self):
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,), buckets=(2, 4, 8))
+        x = _x(13)
+        np.testing.assert_allclose(engine.output(x),
+                                   np.asarray(net.output(x)), rtol=1e-5)
+
+    def test_continuous_batching_matches_direct(self):
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,),
+                               buckets=(1, 2, 4, 8)).start()
+        try:
+            x = _x(21)
+            futs = [engine.submit(x[i]) for i in range(21)]
+            res = np.stack([f.get(timeout=30) for f in futs])
+        finally:
+            engine.stop()
+        np.testing.assert_allclose(res, np.asarray(net.output(x)),
+                                   rtol=1e-5)
+        st = engine.stats()
+        assert st["requests"]["served"] == 21
+        assert st["requests"]["shed_queue_full"] == 0
+        assert st["aot"]["lazy_compiles"] == 0  # every size hit a bucket
+
+    def test_aot_warmup_recompiles_flat_and_first_request_warm(self, fresh):
+        """ISSUE 6 acceptance: after the startup warmup over the registered
+        buckets, a steady-state run over RAGGED request sizes keeps the
+        recompiles_total delta at 0, and the first request lands in (about)
+        the same latency histogram bucket as steady state — it never pays
+        a compile."""
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,), buckets=(1, 2, 4, 8),
+                               max_batch_size=8)
+        assert engine.stats()["aot"]["warmed"] == 4
+        rec = fresh.counter("recompiles_total")
+        before = sum(rec.value(**ls) for ls in rec.labelsets()) if \
+            rec.labelsets() else 0.0
+
+        t0 = time.perf_counter()
+        engine.output(_x(3, seed=1))  # time-to-first-request
+        first = time.perf_counter() - t0
+
+        lat = []
+        rs = np.random.RandomState(2)
+        for _ in range(40):  # ragged steady-state traffic
+            n = int(rs.randint(1, 9))
+            t0 = time.perf_counter()
+            engine.output(_x(n, seed=int(rs.randint(1 << 16))))
+            lat.append(time.perf_counter() - t0)
+
+        after = sum(rec.value(**ls) for ls in rec.labelsets())
+        assert after - before == 0, "ragged serving traffic recompiled"
+        assert engine.stats()["aot"]["lazy_compiles"] == 0
+        # same-histogram-bucket check on the registry's latency bounds
+        # (log-spaced): a cold compile would be orders of magnitude off,
+        # so allow the neighbouring bucket for scheduler jitter
+        med = float(np.median(lat))
+        b_first = bisect.bisect_left(telemetry.DEFAULT_BUCKETS, first)
+        b_med = bisect.bisect_left(telemetry.DEFAULT_BUCKETS, med)
+        assert b_first <= b_med + 2, (first, med)
+
+    def test_queue_full_sheds_at_submit(self, fresh):
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,), buckets=(4,),
+                               max_queue=2)  # worker NOT started
+        x = _x(3)
+        engine.submit(x[0])
+        engine.submit(x[1])
+        with pytest.raises(ServingOverloaded):
+            engine.submit(x[2])
+        st = engine.stats()
+        assert st["requests"]["shed_queue_full"] == 1
+        shed = fresh.get("serving_shed_total")
+        assert shed.value(model="default", reason="queue_full") == 1
+
+    def test_deadline_shed_while_queued(self, fresh):
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,), buckets=(4,))
+        fut = engine.submit(_x(1)[0], deadline_s=0.01)
+        time.sleep(0.08)  # goes stale before the worker starts
+        engine.start()
+        try:
+            with pytest.raises(ServingOverloaded):
+                fut.get(timeout=10)
+        finally:
+            engine.stop()
+        assert engine.stats()["requests"]["shed_deadline"] == 1
+        assert fresh.get("serving_shed_total").value(
+            model="default", reason="deadline") == 1
+
+    def test_stop_fails_pending_and_submit_after_stop_raises(self):
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,), buckets=(4,))
+        futs = [engine.submit(x) for x in _x(3)]  # never started
+        engine.stop()
+        for f in futs:
+            t0 = time.perf_counter()
+            with pytest.raises(ServingShutdown):
+                f.get(timeout=5)
+            assert time.perf_counter() - t0 < 1.0  # prompt, not a timeout
+        with pytest.raises(ServingShutdown):
+            engine.submit(_x(1)[0])
+
+    def test_slo_gauges_update(self, fresh):
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,), buckets=(1, 2, 4),
+                               name="slo").start()
+        try:
+            futs = [engine.submit(x) for x in _x(6)]
+            for f in futs:
+                f.get(timeout=30)
+        finally:
+            engine.stop()
+        p50 = fresh.get("serving_latency_p50_seconds").value(model="slo")
+        p99 = fresh.get("serving_latency_p99_seconds").value(model="slo")
+        assert 0 < p50 <= p99
+        st = engine.stats()
+        assert st["latency_ms"]["p50"] <= st["latency_ms"]["p99"]
+
+    def test_oversize_request_chunks_by_largest_bucket(self):
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,), buckets=(2, 4))
+        x = _x(11)  # > max bucket: 4+4+3 chunks
+        np.testing.assert_allclose(engine.output(x),
+                                   np.asarray(net.output(x)), rtol=1e-5)
+
+    def test_list_inputs_accepted_on_both_paths(self):
+        """Plain Python lists coerce to one array per request (the old
+        ParallelInference contract) — they must not explode into
+        per-scalar pytree leaves and fail the whole co-batched drain."""
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,),
+                               buckets=(1, 2, 4)).start()
+        try:
+            x = _x(3)
+            ref = np.asarray(net.output(x))
+            np.testing.assert_allclose(engine.output(x.tolist()), ref,
+                                       rtol=1e-5)
+            got = engine.submit(x[0].tolist()).get(timeout=30)
+        finally:
+            engine.stop()
+        np.testing.assert_allclose(got, ref[0], rtol=1e-5)
+        assert engine.stats()["requests"]["errors"] == 0
+
+    def test_warmup_fails_fast_on_bad_input_spec(self):
+        """A spec the model rejects must fail AT REGISTRATION, not report
+        'warmed' and then error (or lazily compile) on live traffic."""
+        net = _mlp(n_in=5)
+        with pytest.raises(Exception):
+            ServingEngine(net, input_spec=(99,), buckets=(2,))  # wrong dim
+
+    def test_direct_output_counts_into_stats_and_slo_ring(self):
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,), buckets=(4,))
+        engine.output(_x(7))
+        st = engine.stats()
+        assert st["requests"]["served"] == 7
+        assert st["latency_ms"]["p50"] is not None
+
+    def test_dict_input_graph_through_submit_and_output(self):
+        """The ComputationGraph dict input/output form works on BOTH
+        request paths (warmup spec, direct output, async submit)."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+        b = GraphBuilder(updater=U.Sgd(learning_rate=0.1), seed=5)
+        b.add_inputs("in")
+        b.set_input_types(I.FeedForwardType(4))
+        b.add_layer("h", L.DenseLayer(n_out=6, activation="tanh"), "in")
+        b.add_layer("out", L.OutputLayer(n_out=2, loss="mcxent"), "h")
+        b.set_outputs("out")
+        net = ComputationGraph(b.build())
+        net.init()
+        engine = ServingEngine(net, input_spec={"in": (4,)},
+                               buckets=(1, 2, 4)).start()
+        try:
+            x = _x(5, n_in=4)
+            direct = engine.output({"in": x})
+            # CG.output unwraps single-output graphs; apply_fn (what the
+            # engine serves) keeps the dict form
+            ref = np.asarray(net.output({"in": x}))
+            np.testing.assert_allclose(direct["out"], ref, rtol=1e-5)
+            futs = [engine.submit({"in": x[i]}) for i in range(5)]
+            got = np.stack([f.get(timeout=30)["out"] for f in futs])
+        finally:
+            engine.stop()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+        assert engine.stats()["requests"]["errors"] == 0
+
+    def test_serves_live_weights_after_in_place_training(self):
+        """Training the served net in place must be reflected on the next
+        request (and must not crash on the donated old param buffers):
+        params/state are read live per call, not snapshotted at engine
+        construction."""
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,), buckets=(4,))
+        x = _x(4)
+        before = engine.output(x)
+        xs, ys = _x(32, seed=7), np.eye(3, dtype=np.float32)[
+            np.random.RandomState(8).randint(0, 3, 32)]
+        net.fit(xs, ys, epochs=20)  # donates the old param buffers
+        after = engine.output(x)    # pre-fix: 'buffer deleted or donated'
+        np.testing.assert_allclose(after, np.asarray(net.output(x)),
+                                   rtol=1e-5)
+        assert np.abs(after - before).max() > 1e-6
+
+    def test_mesh_sharded_engine_matches_plain(self, eight_devices):
+        from deeplearning4j_tpu.parallel import MeshSpec, make_mesh
+        net = _mlp()
+        mesh = make_mesh(MeshSpec(data=8, model=1))
+        engine = ServingEngine(net, input_spec=(5,), buckets=(8, 16),
+                               mesh=mesh)
+        assert all(b % 8 == 0 for b in engine.buckets)  # rounded up
+        x = _x(13)
+        np.testing.assert_allclose(engine.output(x),
+                                   np.asarray(net.output(x)),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# hot swap under concurrent load (satellite)
+# ---------------------------------------------------------------------------
+
+class TestHotSwap:
+    def test_update_model_mid_stream_never_mixes_and_drops_nothing(self):
+        """update_model during a continuous request stream: every request
+        is answered (none dropped or errored by the swap) and every answer
+        equals one of the two models' reference outputs — a mixed
+        params/apply_fn would match neither."""
+        net1 = _mlp(seed=4)
+        # deliberately DIFFERENT architecture: a swap that mixes net1's
+        # params with net2's apply_fn cannot produce a valid output
+        net2 = _mlp(seed=11, hidden=16)
+        x1 = _x(1)[0]
+        ref1 = np.asarray(net1.output(x1[None]))[0]
+        ref2 = np.asarray(net2.output(x1[None]))[0]
+        assert np.abs(ref1 - ref2).max() > 1e-6
+
+        engine = ServingEngine(net1, input_spec=(5,), buckets=(1, 2, 4),
+                               max_queue=1024).start()
+        futs = []
+        stop_feeding = threading.Event()
+
+        def feeder():
+            while not stop_feeding.is_set():
+                futs.append(engine.submit(x1))
+                time.sleep(0.0005)
+
+        t = threading.Thread(target=feeder, daemon=True)
+        t.start()
+        try:
+            nets = [net2, net1]
+            for i in range(6):  # swap back and forth mid-stream
+                time.sleep(0.02)
+                engine.update_model(nets[i % 2])
+            time.sleep(0.02)
+        finally:
+            stop_feeding.set()
+            t.join(timeout=5)
+        results = [f.get(timeout=30) for f in futs]  # nothing dropped
+        engine.stop()
+        assert len(results) > 20
+        for r in results:
+            ok1 = np.allclose(r, ref1, rtol=1e-4, atol=1e-6)
+            ok2 = np.allclose(r, ref2, rtol=1e-4, atol=1e-6)
+            assert ok1 or ok2, "output matches neither served model"
+        assert engine.stats()["requests"]["swaps"] == 6
+        assert engine.stats()["requests"]["errors"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ModelRegistry + /serving endpoint
+# ---------------------------------------------------------------------------
+
+class TestModelRegistry:
+    def test_register_serve_update_unregister(self):
+        reg = ModelRegistry()
+        net = _mlp()
+        reg.register("a", net, input_spec=(5,), buckets=(2, 4))
+        x = _x(3)
+        np.testing.assert_allclose(reg.output("a", x),
+                                   np.asarray(net.output(x)), rtol=1e-5)
+        fut = reg.submit("a", x[0])
+        fut.get(timeout=30)
+        with pytest.raises(ValueError):
+            reg.register("a", net)  # duplicate name
+        net2 = _mlp(seed=9)
+        reg.update_model("a", net2)
+        assert reg.engine("a").net is net2
+        assert reg.names() == ["a"]
+        reg.unregister("a")
+        assert reg.names() == []
+        with pytest.raises(KeyError):
+            reg.engine("a")
+
+    def test_status_payload_shape(self):
+        reg = ModelRegistry()
+        reg.register("m1", _mlp(), input_spec=(5,), buckets=(2,),
+                     start=False)
+        st = reg.status()
+        assert set(st["models"]) == {"m1"}
+        m = st["models"]["m1"]
+        assert m["buckets"] == [2]
+        assert {"queue_depth", "requests", "aot", "latency_ms"} <= set(m)
+        reg.stop()
+
+    def test_ui_serving_endpoint(self):
+        from deeplearning4j_tpu.ui import UIServer
+        get_model_registry().register("ui-model", _mlp(),
+                                      input_spec=(5,), buckets=(2,))
+        server = UIServer(port=0).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/serving",
+                    timeout=10) as r:
+                doc = json.loads(r.read())
+        finally:
+            server.stop()
+        assert "ui-model" in doc["models"]
+        assert doc["models"]["ui-model"]["running"] is True
+
+
+# ---------------------------------------------------------------------------
+# ParallelInference rebase satellites
+# ---------------------------------------------------------------------------
+
+class TestParallelInferenceSatellites:
+    def test_batched_drain_single_shared_deadline(self):
+        """A trickle of arrivals must NOT hold the batch open indefinitely:
+        the post-drain straggler wait is ONE shared timeout_s deadline, so
+        the first request completes ~timeout_s after pickup even while new
+        requests keep arriving every < timeout_s (the old per-slot wait
+        would hold it for up to timeout_s * (max_batch - 1))."""
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+        net = _mlp()
+        pi = ParallelInference(net, max_batch_size=16,
+                               timeout_s=0.25).start()
+        stop = threading.Event()
+
+        def trickle():
+            for i in range(12):
+                if stop.is_set():
+                    return
+                pi.submit(_x(1, seed=i)[0])
+                time.sleep(0.18)  # < timeout_s: old code kept waiting
+
+        t = threading.Thread(target=trickle, daemon=True)
+        t0 = time.perf_counter()
+        t.start()
+        try:
+            first = pi.submit(_x(1)[0])
+            first.get(timeout=10)
+            elapsed = time.perf_counter() - t0
+            # one shared deadline: ~0.25s + forward; the old drain would
+            # have taken ~12 * 0.18s ≈ 2.2s to close this batch
+            assert elapsed < 1.5, f"batch held open {elapsed:.2f}s"
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            pi.stop()
+
+    def test_stop_fails_queued_requests_promptly(self):
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+        net = _mlp()
+        pi = ParallelInference(net, max_batch_size=4)  # never started
+        holders = [pi.submit(x) for x in _x(3)]
+        pi.stop()
+        for h in holders:
+            t0 = time.perf_counter()
+            with pytest.raises(ServingShutdown):
+                h.get(timeout=5)
+            assert time.perf_counter() - t0 < 1.0
+        with pytest.raises(ServingShutdown):
+            pi.submit(_x(1)[0])
+
+    def test_future_done_and_chained_errors(self):
+        fut = InferenceFuture()
+        assert not fut.done()
+        fut._set(42)
+        assert fut.done()
+        assert fut.get(timeout=1) == 42
+
+        err = ValueError("boom")
+        f2 = InferenceFuture()
+        f2._set_error(err)
+        raised = []
+        errs = []
+
+        def waiter():
+            try:
+                f2.get(timeout=5)
+            except ValueError as e:
+                raised.append(e)
+            except Exception as e:  # pragma: no cover - diagnostic
+                errs.append(e)
+
+        threads = [threading.Thread(target=waiter, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errs
+        assert len(raised) == 4
+        for e in raised:
+            assert e is not err          # fresh instance per waiter...
+            assert e.__cause__ is err    # ...chained from the original
+        # distinct instances: no shared traceback mutation across waiters
+        assert len({id(e) for e in raised}) == 4
+
+
+# ---------------------------------------------------------------------------
+# CLI + bench
+# ---------------------------------------------------------------------------
+
+class TestServeCli:
+    def test_serve_smoke(self, tmp_path, capsys):
+        from deeplearning4j_tpu.cli import main
+        from deeplearning4j_tpu.utils.serialization import save_model
+        net = _mlp(n_in=6)
+        mp = str(tmp_path / "model.zip")
+        save_model(net, mp)
+        rc = main(["serve", "--model-path", mp, "--max-batch", "4",
+                   "--buckets", "1,4", "--port", "0", "--smoke", "6"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "AOT-warmed buckets [1, 4]" in out
+        # the smoke tail prints the engine stats JSON
+        tail = out[out.index("{"):]
+        st = json.loads(tail)
+        assert st["requests"]["served"] == 6
+        assert st["aot"]["warmed"] == 2
+        assert st["aot"]["lazy_compiles"] == 0
+
+
+def _import_bench():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_serving_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_serving_record_shape(monkeypatch):
+    """`bench.py serving` must emit one record with the latency-vs-offered-
+    load curve: p50/p99 per point and shed counts on the past-saturation
+    points (ISSUE 6 acceptance)."""
+    monkeypatch.setenv("BENCH_PREFLIGHT", "1")
+    bench = _import_bench()
+    rec = bench.bench_serving()
+    assert rec["metric"] == "serving_offered_load_sweep"
+    assert rec["value"] > 0
+    assert rec["aot"]["lazy_compiles"] == 0
+    curve = rec["curve"]
+    assert [p["load_ratio"] for p in curve] == [0.3, 0.7, 1.5, 3.0]
+    for p in curve:
+        assert {"offered_rps", "served", "shed"} <= set(p)
+        if p["served"]:
+            assert 0 < p["p50_ms"] <= p["p99_ms"]
+    # the record is JSON-serializable through the shared writer
+    json.dumps(rec)
