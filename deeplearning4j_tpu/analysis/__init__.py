@@ -48,14 +48,17 @@ on stale entries so the baseline only ever shrinks.
 """
 
 from deeplearning4j_tpu.analysis.core import (Finding, LintError, LintModule,
-                                              all_rules, lint_paths,
-                                              lint_source)
+                                              ProjectRule, all_rules,
+                                              lint_modules, lint_paths,
+                                              lint_source, parse_paths)
 from deeplearning4j_tpu.analysis.baseline import (apply_baseline,
                                                   default_baseline_path,
                                                   load_baseline,
                                                   save_baseline)
 from deeplearning4j_tpu.analysis import rules as _rules  # registers R1-R6
+from deeplearning4j_tpu.analysis import flow_rules as _flow  # R7-R9
 
-__all__ = ["Finding", "LintError", "LintModule", "all_rules", "lint_paths",
-           "lint_source", "apply_baseline", "default_baseline_path",
-           "load_baseline", "save_baseline"]
+__all__ = ["Finding", "LintError", "LintModule", "ProjectRule", "all_rules",
+           "lint_modules", "lint_paths", "lint_source", "parse_paths",
+           "apply_baseline", "default_baseline_path", "load_baseline",
+           "save_baseline"]

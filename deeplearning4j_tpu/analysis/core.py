@@ -38,6 +38,10 @@ class Finding:
     #: any line of a multi-line statement are honored; not part of the
     #: finding's identity/ordering
     end_line: int = dataclasses.field(default=0, compare=False)
+    #: first physical line of the flagged STATEMENT including decorators —
+    #: a suppression comment on a decorator line covers the decorated
+    #: def/class's findings (0 = same as ``line``)
+    sup_start: int = dataclasses.field(default=0, compare=False)
 
     def key(self):
         """Baseline identity: rule + file + the offending line's text.
@@ -167,7 +171,30 @@ class LintModule:
                        col=getattr(node, "col_offset", 0) + 1, rule=rule,
                        slug=slug, message=message,
                        snippet=self.snippet(node),
-                       end_line=getattr(node, "end_lineno", line) or line)
+                       end_line=getattr(node, "end_lineno", line) or line,
+                       sup_start=self._stmt_start(node))
+
+    def _stmt_start(self, node):
+        """First physical line of the decorated statement ``node`` anchors
+        to: for a decorated def/class (or a node inside its decorator
+        list) the earliest decorator line — so ``# graftlint: disable``
+        on a decorator line suppresses the whole decorated statement's
+        findings."""
+        line = getattr(node, "lineno", 0)
+        decorated = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        if isinstance(node, decorated) and node.decorator_list:
+            return min(d.lineno for d in node.decorator_list)
+        # a finding anchored ON (or inside) a decorator expression: widen
+        # to the decorated statement (decorators + def line)
+        for a in self.ancestors(node):
+            if isinstance(a, decorated) and a.decorator_list:
+                for dec in a.decorator_list:
+                    if any(n is node for n in ast.walk(dec)):
+                        return min(d.lineno for d in a.decorator_list)
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                break
+        return line
 
     # -- AST navigation -------------------------------------------------
 
@@ -223,6 +250,20 @@ class Rule:
         raise NotImplementedError
 
 
+class ProjectRule(Rule):
+    """A rule that needs the WHOLE module set at once (interprocedural
+    dataflow: cross-module call graph, lock graph, donation summaries).
+    Subclasses implement ``check_project(modules) -> iterable[Finding]``;
+    the runner calls it exactly once per lint run. ``check`` is provided
+    for single-module use (tests, editors linting one buffer)."""
+
+    def check(self, module: LintModule):
+        return self.check_project([module])
+
+    def check_project(self, modules):
+        raise NotImplementedError
+
+
 _REGISTRY: dict[str, Rule] = {}
 
 
@@ -274,6 +315,29 @@ def _expand(paths):
     return files
 
 
+def lint_modules(mods, rules=None):
+    """Run the selected rules over already-parsed modules: per-module rules
+    file by file, project rules ONCE over the whole set, suppression
+    filtering applied per finding against its own module."""
+    selected = _select(rules)
+    bypath = {m.path: m for m in mods}
+    found = []
+    for rule in selected:
+        if isinstance(rule, ProjectRule):
+            found.extend(rule.check_project(list(mods)))
+        else:
+            for mod in mods:
+                found.extend(rule.check(mod))
+    keep = []
+    for f in found:
+        mod = bypath.get(f.path)
+        if mod is not None and mod.suppressed(
+                f.rule, _FakeNode(f.sup_start or f.line, f.end_line)):
+            continue
+        keep.append(f)
+    return sorted(set(keep))
+
+
 def lint_source(source, path="<string>", rules=None):
     """Lint one source string. Returns (findings, parse_error|None)."""
     try:
@@ -282,31 +346,25 @@ def lint_source(source, path="<string>", rules=None):
         return [], Finding(path=path, line=e.lineno or 0, col=(e.offset or 0),
                            rule="E0", slug="parse-error",
                            message=f"file does not parse: {e.msg}")
-    found = []
-    for rule in _select(rules):
-        for f in rule.check(mod):
-            if not mod.suppressed(f.rule, _FakeNode(f.line, f.end_line)):
-                found.append(f)
-    return sorted(set(found)), None
+    return lint_modules([mod], rules=rules), None
 
 
 class _FakeNode:
-    """Line-range node stand-in so suppression filtering in lint_source can
-    reuse LintModule.suppressed for already-built findings."""
+    """Line-range node stand-in so suppression filtering in lint_modules
+    can reuse LintModule.suppressed for already-built findings."""
 
     def __init__(self, line, end_line=0):
         self.lineno = line
         self.end_lineno = max(end_line, line)
 
 
-def lint_paths(paths, rules=None, root=None):
-    """Lint files/trees. Paths in findings are made relative to ``root``
-    (posix separators) so baseline keys are machine-independent.
-
-    Returns a sorted list of Findings; unparseable files surface as
-    ``E0[parse-error]`` findings rather than aborting the run."""
+def parse_paths(paths, root=None):
+    """(modules, parse-error findings) for files/trees. Paths are made
+    relative to ``root`` (posix separators) so baseline keys are
+    machine-independent; unparseable files surface as ``E0[parse-error]``
+    findings rather than aborting the run."""
     root = Path(root) if root is not None else None
-    out = []
+    mods, errors = [], []
     for f in _expand(paths):
         rel = f
         if root is not None:
@@ -316,8 +374,18 @@ def lint_paths(paths, rules=None, root=None):
                 rel = f
         rel = str(PurePosixPath(rel))
         text = Path(f).read_text(encoding="utf-8", errors="replace")
-        findings, parse_err = lint_source(text, path=rel, rules=rules)
-        out.extend(findings)
-        if parse_err is not None:
-            out.append(parse_err)
-    return sorted(set(out))
+        try:
+            mods.append(LintModule(text, path=rel))
+        except SyntaxError as e:
+            errors.append(Finding(
+                path=rel, line=e.lineno or 0, col=(e.offset or 0),
+                rule="E0", slug="parse-error",
+                message=f"file does not parse: {e.msg}"))
+    return mods, errors
+
+
+def lint_paths(paths, rules=None, root=None):
+    """Lint files/trees (all files parse FIRST, so project rules see the
+    whole module set, then rules run)."""
+    mods, errors = parse_paths(paths, root=root)
+    return sorted(set(lint_modules(mods, rules=rules) + errors))

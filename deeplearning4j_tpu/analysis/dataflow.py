@@ -1,0 +1,1072 @@
+"""Interprocedural facts for graftlint's dataflow rules (R7-R9).
+
+The intraprocedural rules R1-R6 see one function at a time; the bug class
+that motivated this pass (ISSUE 7: the PR 6 serving crash — a params
+snapshot read after the fit loop donated those buffers) lives in the
+seams BETWEEN functions. :class:`ProjectFacts` computes, once per lint
+run over the whole module set:
+
+* a **module-level call graph** — names resolved lexically inside a
+  module and through the import-alias table across modules, the same
+  "key on how this repo actually builds things" stance as
+  ``rules.ModuleFacts``;
+* **donation facts** — which callables are donating (``jax.jit(...,
+  donate_argnums=...)`` directly, via ``functools.partial``, as a
+  decorator, or returned from a *maker* like ``make_train_step``), which
+  bindings (locals, module globals, ``self.x`` attrs, parameters fed a
+  donating callable) carry them, and per-function summaries of which
+  PARAMETERS a call donates — so a caller that reads a value it passed
+  into a donating seam gets flagged even when the jit site is two
+  modules away;
+* **mapped-context facts** — which functions run under ``shard_map`` /
+  ``pmap`` (directly or as transitive callees), the axis names bound
+  there, and the project's mesh axis-name universe (every
+  ``Mesh(axis_names=...)`` literal);
+* the **static lock graph** — per-class lock attributes, lock-ordered
+  acquisition edges (nested ``with`` blocks and calls whose summaries
+  acquire), blocking-call summaries (queue get/put without timeout,
+  ``join()``/``wait()``), and the cycles in that graph.
+
+Everything is heuristic-by-design (static analysis over Python), tuned
+to this repo's idioms; pure stdlib — importing this module never
+imports jax.
+"""
+
+from __future__ import annotations
+
+import ast
+
+# ----------------------------------------------------------------------
+# small AST helpers
+# ----------------------------------------------------------------------
+
+
+def reaches(graph, start, goal):
+    """True when ``goal`` is reachable from ``start`` in the
+    ``{node: iterable-of-successors}`` graph (start == goal counts).
+    THE cycle primitive for the three lock-graph consumers — static R9
+    (``lock_cycles``), graftsan's online inversion check, and the
+    ``lint --san-report`` merge — so cycle semantics stay in one place."""
+    seen, stack = {start}, [start]
+    while stack:
+        cur = stack.pop()
+        if cur == goal:
+            return True
+        for nxt in graph.get(cur, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    return False
+
+
+def chain_of(node):
+    """``a.b.c`` string for a pure Name/Attribute chain, else None (the
+    base-identity key R7 tracks donated buffers by)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _int_tuple_union(expr):
+    """Union of all int-tuple/int literals inside ``expr`` — how
+    ``donate_argnums=(0, 1, 2) if donate else ()`` and friends resolve
+    conservatively."""
+    out = set()
+    if expr is None:
+        return out
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Constant) and isinstance(n.value, int) \
+                and not isinstance(n.value, bool):
+            out.add(n.value)
+    return out
+
+
+def _kw(call, *names):
+    for k in call.keywords:
+        if k.arg in names:
+            return k.value
+    return None
+
+
+def _params_of(fn):
+    a = fn.args
+    return [p.arg for p in (a.posonlyargs + a.args)]
+
+
+class _FnInfo:
+    """One function def with its resolution context."""
+
+    __slots__ = ("node", "mod", "name", "cls", "encl", "params",
+                 "is_method")
+
+    def __init__(self, node, mod, cls, encl):
+        self.node = node
+        self.mod = mod
+        self.name = node.name
+        self.cls = cls          # enclosing ClassDef or None
+        self.encl = encl        # enclosing function node or None
+        self.params = _params_of(node)
+        self.is_method = cls is not None and encl is None
+
+
+# ----------------------------------------------------------------------
+# project facts
+# ----------------------------------------------------------------------
+
+#: collectives and the position of their axis-name argument
+COLLECTIVES = {"psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+               "all_gather": 1, "all_to_all": 1, "ppermute": 1,
+               "pshuffle": 1, "psum_scatter": 1, "axis_index": 0}
+
+_LOCK_CTORS = {"threading.Lock": "Lock", "threading.RLock": "RLock",
+               "threading.Condition": "Condition"}
+_QUEUE_CTOR_SUFFIXES = ("queue.Queue", "queue.LifoQueue",
+                        "queue.PriorityQueue", "queue.SimpleQueue",
+                        "FancyBlockingQueue")
+_THREAD_CTOR = "threading.Thread"
+_EVENT_CTOR = "threading.Event"
+
+
+def _mod_dotted(mod):
+    p = mod.path
+    if p.endswith(".py"):
+        p = p[:-3]
+    return p.replace("/", ".").lstrip(".")
+
+
+class ProjectFacts:
+    def __init__(self, mods):
+        self.mods = list(mods)
+        self.dotted_of = {m: _mod_dotted(m) for m in self.mods}
+        # ---- function index -------------------------------------------
+        self.fns = {}            # node -> _FnInfo
+        self.global_fns = {}     # "mod.dotted.name" -> _FnInfo
+        self.class_methods = {}  # (mod, ClassDef) -> {name: _FnInfo}
+        self.classes = {}        # "mod.dotted.ClassName" -> (mod, ClassDef)
+        self._by_mod_name = {}   # (mod, name) -> [_FnInfo]
+        for mod in self.mods:
+            self._index_module(mod)
+        # ---- donation facts -------------------------------------------
+        self.donating_defs = {}    # _FnInfo -> set[int] (decorator form)
+        self.maker_returns = {}    # _FnInfo -> set[int]
+        self.module_bindings = {}  # "mod.name" -> set[int]
+        self.class_attr = {}       # (ClassDef, attr) -> set[int]
+        self.param_bindings = {}   # (fn_node, param_name) -> set[int]
+        self.fn_donates = {}       # _FnInfo -> {param_name: True}
+        self._donation_pass()
+        # ---- mapped contexts / axes -----------------------------------
+        self.axis_universe = set()
+        self.mapped = {}           # fn_node -> set[str] | None (unknown)
+        self._mapping_pass()
+        # ---- locks ----------------------------------------------------
+        self.locks = {}            # lock_id -> {kind, path, line}
+        self.fn_acquires = {}      # fn_node -> set[lock_id] (transitive)
+        self.fn_blocks = {}        # fn_node -> list[(desc, node)]
+        self.lock_edges = []       # (src_id, dst_id, mod, node, via)
+        self._lock_pass()
+
+    # ------------------------------------------------------------------
+    # indexing + resolution
+    # ------------------------------------------------------------------
+
+    def _index_module(self, mod):
+        dotted = self.dotted_of[mod]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                self.classes[f"{dotted}.{node.name}"] = (mod, node)
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            cls = None
+            encl = mod.enclosing_function(node)
+            for a in mod.ancestors(node):
+                if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    break
+                if isinstance(a, ast.ClassDef):
+                    cls = a
+                    break
+            info = _FnInfo(node, mod, cls, encl)
+            self.fns[node] = info
+            self._by_mod_name.setdefault((mod, node.name), []).append(info)
+            if cls is None and encl is None:
+                self.global_fns[f"{dotted}.{node.name}"] = info
+            if cls is not None and encl is None:
+                self.class_methods.setdefault((mod, cls), {})[node.name] = \
+                    info
+
+    def enclosing_info(self, mod, node):
+        fn = mod.enclosing_function(node)
+        return self.fns.get(fn) if fn is not None else None
+
+    def _class_of_site(self, mod, node):
+        for a in mod.ancestors(node):
+            if isinstance(a, ast.ClassDef):
+                return a
+        return None
+
+    def resolve_call(self, mod, call):
+        """_FnInfo the call's target may refer to, or None. Resolution is
+        lexical for bare names, ``self.x``/``cls.x`` for methods within
+        the site's class, ``super().x`` for the base class, and the
+        import-alias table for cross-module ``pkg.mod.fn``."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            info = self._resolve_name(mod, call, f.id)
+            if info is not None:
+                return info
+            dotted = mod.aliases.get(f.id)
+            if dotted:
+                return self.global_fns.get(dotted)
+            return None
+        if isinstance(f, ast.Attribute):
+            # self.x / cls.x
+            if isinstance(f.value, ast.Name) and f.value.id in ("self",
+                                                               "cls"):
+                cls = self._class_of_site(mod, call)
+                if cls is not None:
+                    hit = self.class_methods.get((mod, cls), {}).get(f.attr)
+                    if hit is not None:
+                        return hit
+                    return self._base_method(mod, cls, f.attr)
+                return None
+            # super().x
+            if (isinstance(f.value, ast.Call)
+                    and isinstance(f.value.func, ast.Name)
+                    and f.value.func.id == "super"):
+                cls = self._class_of_site(mod, call)
+                if cls is not None:
+                    return self._base_method(mod, cls, f.attr)
+                return None
+            dotted = mod.dotted(f)
+            if dotted:
+                return self.global_fns.get(dotted)
+        return None
+
+    def _base_method(self, mod, cls, name):
+        for b in cls.bases:
+            base = None
+            if isinstance(b, ast.Name):
+                dotted = mod.aliases.get(b.id, b.id)
+                base = (self.classes.get(f"{self.dotted_of[mod]}.{b.id}")
+                        or self.classes.get(dotted))
+            elif isinstance(b, ast.Attribute):
+                dotted = mod.dotted(b)
+                base = self.classes.get(dotted) if dotted else None
+            if base is not None:
+                bmod, bcls = base
+                hit = self.class_methods.get((bmod, bcls), {}).get(name)
+                if hit is not None:
+                    return hit
+        return None
+
+    def _resolve_name(self, mod, site, name):
+        """Lexically-visible same-module def for a bare name."""
+        candidates = self._by_mod_name.get((mod, name))
+        if not candidates:
+            return None
+        scope = mod.enclosing_function(site)
+        chain = []
+        while scope is not None:
+            chain.append(scope)
+            info = self.fns.get(scope)
+            scope = info.encl if info else None
+        chain.append(None)
+        for s in chain:
+            for info in candidates:
+                if info.encl is s and (s is not None or info.cls is None):
+                    return info
+        return None
+
+    # ------------------------------------------------------------------
+    # donation facts
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _is_jit(dotted):
+        return dotted is not None and (
+            dotted in ("jax.jit", "pjit") or dotted.endswith(".jit")
+            or dotted.endswith(".pjit"))
+
+    def _jit_donation(self, mod, expr, scope_fn):
+        """Donated positions if ``expr`` builds a donating jitted
+        callable: ``jax.jit(f, donate_argnums=...)`` or
+        ``functools.partial(jax.jit, donate_argnums=...)(f)`` /
+        the same partial used bare (decorator form)."""
+        if not isinstance(expr, ast.Call):
+            return None
+        f = expr.func
+        if self._is_jit(mod.dotted(f)):
+            kwv = _kw(expr, "donate_argnums", "donate_argnames")
+            if kwv is None:
+                return None
+            return self._positions(mod, kwv, scope_fn) or None
+        # functools.partial(jax.jit, donate_argnums=...)  [maybe called]
+        part = expr
+        if isinstance(f, ast.Call):           # partial(...)(fn) form
+            part = f
+        pf = part.func if isinstance(part, ast.Call) else None
+        if pf is not None and (mod.dotted(pf) or "").endswith("partial"):
+            if part.args and self._is_jit(mod.dotted(part.args[0])):
+                kwv = _kw(part, "donate_argnums", "donate_argnames")
+                if kwv is not None:
+                    return self._positions(mod, kwv, scope_fn) or None
+        return None
+
+    def _positions(self, mod, expr, scope_fn):
+        """Literal donate positions in ``expr``, resolving a bare Name
+        through its assignments within ``scope_fn``."""
+        if isinstance(expr, ast.Name) and scope_fn is not None:
+            out = set()
+            for n in ast.walk(scope_fn):
+                if isinstance(n, ast.Assign):
+                    if any(isinstance(t, ast.Name) and t.id == expr.id
+                           for t in n.targets):
+                        out |= _int_tuple_union(n.value)
+                elif isinstance(n, ast.AugAssign):
+                    if isinstance(n.target, ast.Name) \
+                            and n.target.id == expr.id:
+                        out |= _int_tuple_union(n.value)
+            return out
+        return _int_tuple_union(expr)
+
+    def _donation_pass(self):
+        # 1) decorator-donating defs
+        for info in self.fns.values():
+            for dec in info.node.decorator_list:
+                pos = self._jit_donation(info.mod, dec, info.encl)
+                if pos:
+                    self.donating_defs[info] = \
+                        self.donating_defs.get(info, set()) | pos
+        # 2) maker fixpoint: functions whose RETURN value is a donating
+        #    callable — contains a donating jit build (anywhere in the
+        #    subtree, nested helpers included) or a call to another maker
+        for _ in range(4):
+            changed = False
+            for info in self.fns.values():
+                if info in self.maker_returns:
+                    continue
+                if not any(isinstance(n, ast.Return) and n.value is not None
+                           for n in ast.walk(info.node)):
+                    continue
+                pos = set()
+                for n in ast.walk(info.node):
+                    got = self._jit_donation(info.mod, n, info.node)
+                    if got:
+                        pos |= got
+                    elif isinstance(n, ast.Call):
+                        tgt = self.resolve_call(info.mod, n)
+                        if tgt is not None and tgt in self.maker_returns:
+                            pos |= self.maker_returns[tgt]
+                if pos:
+                    self.maker_returns[info] = pos
+                    changed = True
+            if not changed:
+                break
+        # 3) bindings: module globals, class attrs, function locals are
+        #    resolved lazily (see binding_donation); here only the module
+        #    level + class-attr maps that need a whole-module walk
+        for mod in self.mods:
+            dotted = self.dotted_of[mod]
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign):
+                    pos = self._rhs_donation(mod, node.value, None)
+                    if pos:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                self.module_bindings[f"{dotted}.{t.id}"] = \
+                                    pos
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                cls = self._class_of_site(mod, node)
+                if cls is None:
+                    continue
+                fn = mod.enclosing_function(node)
+                pos = self._rhs_donation(mod, node.value,
+                                         fn if fn is not None else None)
+                if not pos:
+                    continue
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id in ("self", "cls")):
+                        key = (cls, t.attr)
+                        self.class_attr[key] = \
+                            self.class_attr.get(key, set()) | pos
+        # 4) donating callables passed as ARGUMENTS -> parameter bindings
+        for mod in self.mods:
+            for call in (n for n in ast.walk(mod.tree)
+                         if isinstance(n, ast.Call)):
+                tgt = self.resolve_call(mod, call)
+                if tgt is None:
+                    continue
+                off = 1 if (tgt.is_method and isinstance(
+                    call.func, ast.Attribute)) else 0
+                for i, arg in enumerate(call.args):
+                    pos = self.binding_donation(mod, call, arg)
+                    if not pos:
+                        continue
+                    pi = i + off
+                    if pi < len(tgt.params):
+                        key = (tgt.node, tgt.params[pi])
+                        self.param_bindings[key] = \
+                            self.param_bindings.get(key, set()) | pos
+        # 5) per-function "calling me donates these params" summaries
+        for _ in range(4):
+            changed = False
+            for info in self.fns.values():
+                mine = self.fn_donates.setdefault(info, set())
+                for call in (n for n in ast.walk(info.node)
+                             if isinstance(n, ast.Call)):
+                    if info.mod.enclosing_function(call) is not info.node:
+                        continue
+                    donated = self.donated_arg_positions(info.mod, call)
+                    if not donated:
+                        continue
+                    for pi in donated:
+                        if pi < len(call.args):
+                            base = chain_of(call.args[pi])
+                            if base in info.params and base not in mine:
+                                mine.add(base)
+                                changed = True
+            if not changed:
+                break
+
+    def _rhs_donation(self, mod, expr, scope_fn, _seen=None):
+        """Donated positions carried by an assignment RHS: a donating jit
+        build, a call to a maker, or an alias of a donating binding."""
+        pos = self._jit_donation(mod, expr, scope_fn)
+        if pos:
+            return pos
+        if isinstance(expr, ast.Call):
+            tgt = self.resolve_call(mod, expr)
+            if tgt is not None:
+                return self.maker_returns.get(tgt)
+            return None
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            return self.binding_donation(mod, expr, expr, _seen=_seen)
+        return None
+
+    def binding_donation(self, mod, site, expr, _seen=None):
+        """Donated positions of the callable ``expr`` evaluates to at
+        ``site``, through every binding layer: function locals,
+        parameters fed a donating callable, enclosing-class ``self.x``
+        attrs, module globals (ours and imported), decorator-donating
+        defs. ``_seen`` breaks cyclic alias chains (t = a; a = b; b = t
+        would otherwise recurse forever)."""
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) \
+                    and expr.value.id in ("self", "cls"):
+                cls = self._class_of_site(mod, site)
+                if cls is not None:
+                    return self.class_attr.get((cls, expr.attr))
+                return None
+            dotted = mod.dotted(expr)
+            if dotted:
+                hit = self.module_bindings.get(dotted)
+                if hit:
+                    return hit
+                info = self.global_fns.get(dotted)
+                if info is not None:
+                    return self.donating_defs.get(info)
+            return None
+        if not isinstance(expr, ast.Name):
+            return None
+        name = expr.id
+        fn = mod.enclosing_function(site)
+        if _seen is None:
+            _seen = set()
+        scope = fn
+        while scope is not None:
+            key = (id(mod), id(scope), name)
+            if key in _seen:
+                return None
+            _seen.add(key)
+            info = self.fns.get(scope)
+            if info is not None and name in info.params:
+                return self.param_bindings.get((scope, name))
+            for n in ast.walk(scope):
+                if isinstance(n, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == name
+                        for t in n.targets):
+                    got = self._rhs_donation(mod, n.value, scope,
+                                             _seen=_seen)
+                    if got:
+                        return got
+            scope = info.encl if info is not None else None
+        dotted = mod.aliases.get(name)
+        key = f"{self.dotted_of[mod]}.{name}"
+        hit = self.module_bindings.get(key) \
+            or (self.module_bindings.get(dotted) if dotted else None)
+        if hit:
+            return hit
+        info = self.global_fns.get(key) \
+            or (self.global_fns.get(dotted) if dotted else None)
+        if info is not None:
+            return self.donating_defs.get(info)
+        return None
+
+    def donated_arg_positions(self, mod, call):
+        """Positional-arg indices this call donates, or empty set: the
+        callee is a donating binding, a decorator-donating def, or a
+        project function whose summary donates some of its params."""
+        pos = self.binding_donation(mod, call, call.func)
+        if pos:
+            return {p for p in pos if p < len(call.args)}
+        tgt = self.resolve_call(mod, call)
+        if tgt is None:
+            return set()
+        direct = self.donating_defs.get(tgt)
+        if direct:
+            return {p for p in direct if p < len(call.args)}
+        donated_params = self.fn_donates.get(tgt) or set()
+        if not donated_params:
+            return set()
+        off = 1 if (tgt.is_method
+                    and isinstance(call.func, ast.Attribute)) else 0
+        out = set()
+        for pname in donated_params:
+            try:
+                pi = tgt.params.index(pname) - off
+            except ValueError:
+                continue
+            if 0 <= pi < len(call.args):
+                out.add(pi)
+        return out
+
+    # ------------------------------------------------------------------
+    # mapped contexts (R8)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _is_shard_map(dotted, name):
+        return (dotted is not None and dotted.endswith("shard_map")) \
+            or name == "shard_map"
+
+    def _site_axes(self, mod, call):
+        """Axis names bound at a shard_map/pmap site: P()/PartitionSpec
+        string literals in the spec kwargs, plus Mesh axis_names when the
+        mesh expr resolves; None when nothing resolves (axes unknown)."""
+        axes = set()
+        for kwname in ("in_specs", "out_specs"):
+            v = _kw(call, kwname)
+            if v is not None:
+                axes |= self._spec_axes(mod, v)
+        mesh_axes = self._mesh_axes(mod, _kw(call, "mesh"))
+        if mesh_axes:
+            axes |= mesh_axes
+        return axes or None
+
+    @staticmethod
+    def _spec_axes(mod, expr):
+        out = set()
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                d = mod.dotted(n.func) or ""
+                if d.endswith(("PartitionSpec", ".P")) or (
+                        isinstance(n.func, ast.Name) and n.func.id == "P"):
+                    for a in ast.walk(n):
+                        if isinstance(a, ast.Constant) \
+                                and isinstance(a.value, str):
+                            out.add(a.value)
+        return out
+
+    def _mesh_axes(self, mod, expr):
+        if expr is None:
+            return None
+        if isinstance(expr, ast.Call):
+            d = mod.dotted(expr.func) or ""
+            if d.endswith("Mesh"):
+                kwv = _kw(expr, "axis_names")
+                if kwv is not None:
+                    axes = {n.value for n in ast.walk(kwv)
+                            if isinstance(n, ast.Constant)
+                            and isinstance(n.value, str)}
+                    return axes or None
+            if d.endswith("make_mesh"):
+                return set(self.axis_universe) or None
+        if isinstance(expr, ast.Name):
+            scope = mod.enclosing_function(expr)
+            nodes = [scope] if scope is not None else []
+            nodes.append(mod.tree)
+            for s in nodes:
+                if s is None:
+                    continue
+                for n in ast.walk(s):
+                    if isinstance(n, ast.Assign) and any(
+                            isinstance(t, ast.Name) and t.id == expr.id
+                            for t in n.targets):
+                        got = self._mesh_axes(mod, n.value)
+                        if got:
+                            return got
+        return None
+
+    def _mapping_pass(self):
+        # universe first (mesh-axes resolution may fall back to it)
+        for mod in self.mods:
+            for call in (n for n in ast.walk(mod.tree)
+                         if isinstance(n, ast.Call)):
+                d = mod.dotted(call.func) or ""
+                if d.endswith("Mesh"):
+                    kwv = _kw(call, "axis_names")
+                    for n in ast.walk(kwv) if kwv is not None else ():
+                        if isinstance(n, ast.Constant) \
+                                and isinstance(n.value, str):
+                            self.axis_universe.add(n.value)
+                if d.endswith(".pmap") or d == "pmap":
+                    kwv = _kw(call, "axis_name")
+                    if isinstance(kwv, ast.Constant) \
+                            and isinstance(kwv.value, str):
+                        self.axis_universe.add(kwv.value)
+        # mapped roots
+        roots = {}
+
+        def add_root(fn_node, ax):
+            old = roots.get(fn_node)
+            roots[fn_node] = (old or set()) | (ax or set()) \
+                if (old or ax) else None
+
+        for mod in self.mods:
+            for info in self.fns.values():
+                if info.mod is not mod:
+                    continue
+                for dec in info.node.decorator_list:
+                    site = self._shard_site(mod, dec)
+                    if site is not None:
+                        roots[info.node] = self._site_axes(mod, site)
+            for call in (n for n in ast.walk(mod.tree)
+                         if isinstance(n, ast.Call)):
+                site = self._shard_site(mod, call)
+                if site is None or site is not call:
+                    continue
+                args = call.args
+                if not args:
+                    continue
+                ax = self._site_axes(mod, call)
+                tgt = None
+                factory = None
+                if isinstance(args[0], ast.Name):
+                    tgt = self._resolve_name(mod, call, args[0].id)
+                    if tgt is None:
+                        # name bound from a factory call in this scope:
+                        # shard_map maps the function(s) the factory
+                        # returns (run = gpipe_schedule(...); shard_map(run))
+                        factory = self._binding_call_target(
+                            mod, call, args[0].id)
+                elif isinstance(args[0], ast.Call):
+                    factory = self.resolve_call(mod, args[0])
+                if tgt is not None:
+                    add_root(tgt.node, ax)
+                if factory is not None:
+                    for ret in self._returned_defs(factory):
+                        add_root(ret, ax)
+        # pmap'd fns
+        for mod in self.mods:
+            for call in (n for n in ast.walk(mod.tree)
+                         if isinstance(n, ast.Call)):
+                d = mod.dotted(call.func) or ""
+                if not (d.endswith(".pmap") or d == "pmap"):
+                    continue
+                if not call.args or not isinstance(call.args[0], ast.Name):
+                    continue
+                tgt = self._resolve_name(mod, call, call.args[0].id)
+                if tgt is None:
+                    continue
+                kwv = _kw(call, "axis_name")
+                ax = {kwv.value} if (isinstance(kwv, ast.Constant) and
+                                     isinstance(kwv.value, str)) else None
+                roots[tgt.node] = (roots.get(tgt.node) or set()) | ax \
+                    if ax else roots.get(tgt.node, None)
+        # escaped callables: a def referenced as a VALUE (passed as an
+        # argument, returned, stored) may be invoked from a mapped
+        # context we cannot see — treat as mapped with unknown axes, so
+        # "outside mapped context" never fires on it (the axis-universe
+        # check still does)
+        for mod in self.mods:
+            for node in ast.walk(mod.tree):
+                exprs = []
+                if isinstance(node, ast.Call):
+                    exprs = list(node.args) + [k.value
+                                               for k in node.keywords]
+                elif isinstance(node, (ast.Return, ast.Assign)) \
+                        and node.value is not None:
+                    exprs = [node.value]
+                for e in exprs:
+                    for n in ast.walk(e):
+                        if not (isinstance(n, ast.Name)
+                                and isinstance(n.ctx, ast.Load)):
+                            continue
+                        parent = getattr(n, "_gl_parent", None)
+                        if isinstance(parent, ast.Call) \
+                                and parent.func is n:
+                            continue  # being invoked, not escaping
+                        tgt = self._resolve_name(mod, node, n.id)
+                        if tgt is not None:
+                            roots.setdefault(tgt.node, None)
+        # transitive closure: nested defs + resolvable callees inherit
+        self.mapped = dict(roots)
+        changed = True
+        while changed:
+            changed = False
+            for info in self.fns.values():
+                if info.node in self.mapped and info.encl is None \
+                        and info.cls is not None:
+                    pass
+                if info.encl is not None and info.encl in self.mapped \
+                        and info.node not in self.mapped:
+                    self.mapped[info.node] = self.mapped[info.encl]
+                    changed = True
+            for fn in list(self.mapped):
+                info = self.fns.get(fn)
+                if info is None:
+                    continue
+                for call in (n for n in ast.walk(fn)
+                             if isinstance(n, ast.Call)):
+                    tgt = self.resolve_call(info.mod, call)
+                    if tgt is None:
+                        continue
+                    if tgt.node not in self.mapped:
+                        self.mapped[tgt.node] = self.mapped[fn]
+                        changed = True
+                    elif (self.mapped[tgt.node] is not None
+                          and self.mapped[fn] is not None
+                          and not (self.mapped[fn]
+                                   <= self.mapped[tgt.node])):
+                        self.mapped[tgt.node] = (self.mapped[tgt.node]
+                                                 | self.mapped[fn])
+                        changed = True
+
+    def _binding_call_target(self, mod, site, name):
+        """The project function F when ``name`` is bound ``name = F(...)``
+        in the scope enclosing ``site`` (factory-made callables)."""
+        scope = mod.enclosing_function(site)
+        nodes = [scope] if scope is not None else [mod.tree]
+        for s in nodes:
+            for n in ast.walk(s):
+                if isinstance(n, ast.Assign) \
+                        and isinstance(n.value, ast.Call) \
+                        and any(isinstance(t, ast.Name) and t.id == name
+                                for t in n.targets):
+                    tgt = self.resolve_call(mod, n.value)
+                    if tgt is not None:
+                        return tgt
+        return None
+
+    def _returned_defs(self, info):
+        """Local defs of ``info`` that escape through its returns (what a
+        shard_map over a factory result actually maps)."""
+        out = []
+        locals_ = {i.name: i.node for i in self.fns.values()
+                   if i.encl is info.node}
+        for n in ast.walk(info.node):
+            if isinstance(n, ast.Return) and n.value is not None:
+                for m in ast.walk(n.value):
+                    if isinstance(m, ast.Name) and m.id in locals_:
+                        out.append(locals_[m.id])
+        return out
+
+    def _shard_site(self, mod, expr):
+        """The shard_map(...) Call carrying specs for ``expr`` (a call or
+        decorator), or None. Handles the ``functools.partial(shard_map,
+        mesh=..., in_specs=...)`` decorator form."""
+        if not isinstance(expr, ast.Call):
+            return None
+        d = mod.dotted(expr.func) or ""
+        name = expr.func.id if isinstance(expr.func, ast.Name) else ""
+        if self._is_shard_map(d, name):
+            return expr
+        if d.endswith("partial") and expr.args:
+            a0 = expr.args[0]
+            d0 = mod.dotted(a0) or ""
+            n0 = a0.id if isinstance(a0, ast.Name) else ""
+            if self._is_shard_map(d0, n0):
+                return expr
+        return None
+
+    def is_mapped(self, mod, node):
+        """(mapped?, axes|None) for the function enclosing ``node``."""
+        fn = mod.enclosing_function(node)
+        while fn is not None:
+            if fn in self.mapped:
+                return True, self.mapped[fn]
+            info = self.fns.get(fn)
+            fn = info.encl if info is not None else None
+        return False, None
+
+    # ------------------------------------------------------------------
+    # lock facts (R9)
+    # ------------------------------------------------------------------
+
+    def _lock_pass(self):
+        # discover lock/queue/thread/event attrs per class + module locks
+        self._cls_locks = {}    # (mod, ClassDef) -> {attr: (kind, line)}
+        self._cls_queues = {}   # (mod, ClassDef) -> set[attr]
+        self._cls_threads = {}
+        self._cls_events = {}
+        self._mod_locks = {}    # (mod, name) -> (kind, line)
+        for mod in self.mods:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Assign) \
+                        or not isinstance(node.value, ast.Call):
+                    continue
+                d = mod.dotted(node.value.func) or ""
+                kind = _LOCK_CTORS.get(d)
+                is_q = d.endswith(_QUEUE_CTOR_SUFFIXES)
+                is_t = d == _THREAD_CTOR or d.endswith(".Thread")
+                is_e = d == _EVENT_CTOR or d.endswith(".Event")
+                if not (kind or is_q or is_t or is_e):
+                    continue
+                cls = self._class_of_site(mod, node)
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self" and cls is not None):
+                        if kind:
+                            self._cls_locks.setdefault(
+                                (mod, cls), {})[t.attr] = (kind,
+                                                           node.lineno)
+                        elif is_q:
+                            self._cls_queues.setdefault(
+                                (mod, cls), set()).add(t.attr)
+                        elif is_t:
+                            self._cls_threads.setdefault(
+                                (mod, cls), set()).add(t.attr)
+                        elif is_e:
+                            self._cls_events.setdefault(
+                                (mod, cls), set()).add(t.attr)
+                    elif isinstance(t, ast.Name) and cls is None \
+                            and mod.enclosing_function(node) is None:
+                        if kind:
+                            self._mod_locks[(mod, t.id)] = (kind,
+                                                            node.lineno)
+        for (mod, cls), attrs in self._cls_locks.items():
+            for attr, (kind, line) in attrs.items():
+                self.locks[self._lock_id(mod, cls, attr)] = {
+                    "kind": kind, "path": mod.path, "line": line}
+        for (mod, name), (kind, line) in self._mod_locks.items():
+            self.locks[f"{self.dotted_of[mod]}.{name}"] = {
+                "kind": kind, "path": mod.path, "line": line}
+        # per-function direct acquires / blocking ops, then transitive
+        direct_acq = {}
+        direct_blk = {}
+        for info in self.fns.values():
+            acq, blk = set(), []
+            for node in ast.walk(info.node):
+                if info.mod.enclosing_function(node) is not info.node:
+                    continue
+                lid = self._with_lock_id(info, node)
+                if lid:
+                    acq.add(lid)
+                b = self._blocking(info, node, held=None)
+                if b:
+                    blk.append((b, node))
+            direct_acq[info.node] = acq
+            direct_blk[info.node] = blk
+        self.fn_acquires = {fn: set(a) for fn, a in direct_acq.items()}
+        self.fn_blocks = {fn: list(b) for fn, b in direct_blk.items()}
+        changed = True
+        iters = 0
+        while changed and iters < 8:
+            changed = False
+            iters += 1
+            for info in self.fns.values():
+                for call in (n for n in ast.walk(info.node)
+                             if isinstance(n, ast.Call)):
+                    tgt = self.resolve_call(info.mod, call)
+                    if tgt is None or tgt.node is info.node:
+                        continue
+                    add = self.fn_acquires.get(tgt.node, set()) \
+                        - self.fn_acquires[info.node]
+                    if add:
+                        self.fn_acquires[info.node] |= add
+                        changed = True
+                    if self.fn_blocks.get(tgt.node) \
+                            and not any(n is call for _, n
+                                        in self.fn_blocks[info.node]):
+                        desc = self.fn_blocks[tgt.node][0][0]
+                        self.fn_blocks[info.node].append(
+                            (f"{desc} (via {tgt.name}())", call))
+                        changed = True
+        # edges + blocking-under-lock sites
+        self.blocking_under_lock = []   # (lock_id, desc, mod, node)
+        for info in self.fns.values():
+            self._walk_lock_regions(info)
+
+    @staticmethod
+    def _lock_id(mod, cls, attr):
+        return f"{_mod_dotted(mod)}.{cls.name}.{attr}"
+
+    def _attr_owner(self, mod, cls, attr, table):
+        """(owner_mod, owner_cls) defining ``attr`` in ``table`` for the
+        class or (transitively) its statically-resolvable bases — so a
+        subclass's ``with self._lock`` maps to the INHERITED lock's
+        identity, not a phantom second lock."""
+        seen = set()
+        stack = [(mod, cls)]
+        while stack:
+            m, c = stack.pop()
+            if (id(m), id(c)) in seen:
+                continue
+            seen.add((id(m), id(c)))
+            entry = table.get((m, c))
+            if entry is not None and attr in entry:
+                return m, c
+            for b in c.bases:
+                base = None
+                if isinstance(b, ast.Name):
+                    dotted = m.aliases.get(b.id, b.id)
+                    base = (self.classes.get(
+                        f"{self.dotted_of[m]}.{b.id}")
+                        or self.classes.get(dotted))
+                elif isinstance(b, ast.Attribute):
+                    dotted = m.dotted(b)
+                    base = self.classes.get(dotted) if dotted else None
+                if base is not None:
+                    stack.append(base)
+        return None
+
+    def _with_lock_id(self, info, node):
+        """lock_id if ``node`` is a With whose first item acquires a
+        known lock of the enclosing class (own or inherited) / module."""
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            return None
+        for item in node.items:
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Call):
+                ctx = ctx.func
+            if (isinstance(ctx, ast.Attribute)
+                    and isinstance(ctx.value, ast.Name)
+                    and ctx.value.id == "self" and info.cls is not None):
+                owner = self._attr_owner(info.mod, info.cls, ctx.attr,
+                                         self._cls_locks)
+                if owner is not None:
+                    return self._lock_id(owner[0], owner[1], ctx.attr)
+            if isinstance(ctx, ast.Name):
+                if (info.mod, ctx.id) in self._mod_locks:
+                    return f"{self.dotted_of[info.mod]}.{ctx.id}"
+        return None
+
+    def _blocking(self, info, node, held):
+        """Description if ``node`` is a potentially-unbounded blocking
+        call: queue get/put with no timeout, thread join() with no
+        timeout, event wait() with no timeout. The condvar idiom —
+        waiting on the very lock you hold — is exempt."""
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            return None
+        recv, meth = node.func.value, node.func.attr
+        if not (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self" and info.cls is not None):
+            return None
+        has_timeout = _kw(node, "timeout") is not None or len(node.args) >= 2
+
+        def owns(table):
+            return self._attr_owner(info.mod, info.cls, recv.attr,
+                                    table) is not None
+
+        if meth in ("get", "put") and owns(self._cls_queues):
+            # get(False)/get(block=False)/put(x, False) never block at all
+            block_arg = _kw(node, "block")
+            if block_arg is None:
+                pos = 0 if meth == "get" else 1
+                if pos < len(node.args):
+                    block_arg = node.args[pos]
+            if isinstance(block_arg, ast.Constant) \
+                    and block_arg.value is False:
+                return None
+            if meth == "put" and len(node.args) >= 2:
+                has_timeout = True
+            if not has_timeout:
+                return f"blocking self.{recv.attr}.{meth}() with no timeout"
+        if meth == "join" and owns(self._cls_threads):
+            if not (node.args or _kw(node, "timeout") is not None):
+                return f"self.{recv.attr}.join() with no timeout"
+        if meth == "wait":
+            if owns(self._cls_events):
+                if not (node.args or _kw(node, "timeout") is not None):
+                    return f"self.{recv.attr}.wait() with no timeout"
+            owner = self._attr_owner(info.mod, info.cls, recv.attr,
+                                     self._cls_locks)
+            if owner is not None and held is not None:
+                lid = self._lock_id(owner[0], owner[1], recv.attr)
+                if lid != held \
+                        and not (node.args
+                                 or _kw(node, "timeout") is not None):
+                    return (f"self.{recv.attr}.wait() with no timeout "
+                            f"(not the held lock)")
+        return None
+
+    def _walk_lock_regions(self, info):
+        """Record ordered edges + blocking ops for every with-lock region
+        of one function."""
+        mod = info.mod
+
+        def walk(node, held):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                lid = self._with_lock_id(info, child)
+                if lid:
+                    if held:
+                        self.lock_edges.append(
+                            (held[-1], lid, mod, child, "nested with"))
+                    walk(child, held + [lid])
+                    continue
+                if held and isinstance(child, ast.Call):
+                    b = self._blocking(info, child, held=held[-1])
+                    if b:
+                        self.blocking_under_lock.append(
+                            (held[-1], b, mod, child))
+                    tgt = self.resolve_call(mod, child)
+                    if tgt is not None and tgt.node is not info.node:
+                        for acquired in sorted(
+                                self.fn_acquires.get(tgt.node, ())):
+                            self.lock_edges.append(
+                                (held[-1], acquired, mod, child,
+                                 f"call to {tgt.name}()"))
+                        for desc, _n in self.fn_blocks.get(tgt.node, ()):
+                            self.blocking_under_lock.append(
+                                (held[-1],
+                                 f"{desc} inside {tgt.name}()",
+                                 mod, child))
+                walk(child, held)
+
+        walk(info.node, [])
+
+    def lock_cycles(self):
+        """Simple cycles in the lock-order graph as ordered lock-id
+        tuples (deterministic), including self-cycles on non-reentrant
+        Lock kinds."""
+        graph = {}
+        for src, dst, *_ in self.lock_edges:
+            graph.setdefault(src, set()).add(dst)
+        cycles = set()
+        for src, dst, *_ in self.lock_edges:
+            if src == dst:
+                if self.locks.get(src, {}).get("kind") == "Lock":
+                    cycles.add((src,))
+                continue
+            if reaches(graph, dst, src):
+                cycles.add(tuple(sorted((src, dst))))
+        return sorted(cycles)
+
+
+def project_facts(mods):
+    """Cached ProjectFacts for this exact module list (all dataflow rules
+    share one build per lint run)."""
+    key = tuple(id(m) for m in mods)
+    if not mods:                  # every file failed to parse
+        return ProjectFacts(mods)
+    holder = mods[0]
+    cached = getattr(holder, "_gl_pfacts", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    facts = ProjectFacts(mods)
+    holder._gl_pfacts = (key, facts)
+    return facts

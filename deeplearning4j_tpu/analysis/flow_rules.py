@@ -1,0 +1,460 @@
+"""graftlint dataflow rules R7-R9: interprocedural hazards.
+
+These are the rules the PR 6 serving review paid for the hard way — a
+construction-time params snapshot read after the fit loop donated those
+buffers crashed in review, and R1-R6's one-function-at-a-time view could
+not see it. All three run as :class:`~.core.ProjectRule`s over the whole
+module set, sharing one :class:`~.dataflow.ProjectFacts` build:
+
+* ``R7 use-after-donate``   — a value passed at a ``donate_argnums``
+  position (resolved through makers, class attrs and module bindings,
+  cross-module) and then read on any later path: the exact PR 6 crash,
+  the stale-alias variant (a snapshot taken BEFORE the donating call
+  outlives the rebind), and the fused-scan loop hazard (a super-batch
+  donated but never refreshed before the next iteration).
+* ``R8 sharding-discipline`` — ``psum``/``pmean``/... with a literal
+  axis name in code no ``shard_map``/``pmap`` ever reaches; axis names
+  that don't exist in the enclosing mapped context or anywhere in the
+  project's ``Mesh(axis_names=...)`` universe (the typo'd-axis class of
+  bug XLA reports as an inscrutable lowering error at run time).
+* ``R9 lock-order``         — cycles in the static lock-acquisition
+  graph (including a non-reentrant ``threading.Lock`` re-acquired via a
+  callee: instant self-deadlock) and potentially-unbounded blocking ops
+  (queue ``get``/``put`` with no timeout, bare ``join()``/``wait()``)
+  while holding a lock.
+
+Pure stdlib, heuristic by design — same stance as rules.py.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from deeplearning4j_tpu.analysis.core import LintModule, ProjectRule, register
+from deeplearning4j_tpu.analysis.dataflow import (COLLECTIVES, chain_of,
+                                                  project_facts)
+
+
+# ----------------------------------------------------------------------
+# R7: use-after-donate
+# ----------------------------------------------------------------------
+
+@register
+class UseAfterDonateRule(ProjectRule):
+    name = "R7"
+    slug = "use-after-donate"
+    description = (
+        "a value passed at a donate_argnums position is read after the "
+        "donating call (its buffer now belongs to XLA): read of the name, "
+        "of a pre-call alias/snapshot of it, or reuse on the next loop "
+        "iteration without rebinding — rebind from the call's results, "
+        "or copy before donating (the PR 6 serving-snapshot crash class)")
+
+    def check_project(self, mods):
+        facts = project_facts(mods)
+        for mod in mods:
+            for info in (i for i in facts.fns.values() if i.mod is mod):
+                yield from self._check_fn(facts, mod, info)
+
+    # -- per-function dataflow ----------------------------------------
+
+    def _check_fn(self, facts, mod: LintModule, info):
+        fn = info.node
+        own = [n for n in ast.walk(fn)
+               if mod.enclosing_function(n) is fn]
+        calls = []
+        for n in own:
+            if isinstance(n, ast.Call):
+                donated = facts.donated_arg_positions(mod, n)
+                if donated:
+                    calls.append((n, donated))
+        if not calls:
+            return
+        assigns = self._assignments(own)      # [(end_line, {chains})]
+        aliases = self._aliases(own)          # [(line, alias, base)]
+        reads = self._reads(fn, mod)          # [(line, chain, node)]
+        for call, donated in calls:
+            stmt = self._stmt_of(mod, call)
+            s_line = stmt.lineno
+            s_end = getattr(stmt, "end_lineno", s_line) or s_line
+            targets = self._stmt_targets(stmt)
+            bases = {}
+            for pos in sorted(donated):
+                base = chain_of(call.args[pos])
+                if base is None or base in ("self", "cls"):
+                    continue
+                rebound = any(base == t or base.startswith(t + ".")
+                              for t in targets)
+                # the base itself (if not rebound from the results) plus
+                # every pre-call alias still pointing at the old buffer
+                if not rebound:
+                    bases.setdefault(base, base)
+                for line, alias, root in aliases:
+                    if line < s_line and root == base \
+                            and not any(alias == t for t in targets):
+                        bases.setdefault(alias, base)
+            if not bases:
+                continue
+            call_arms = self._arm_path(mod, stmt)
+            for name, origin in sorted(bases.items()):
+                hit = self._first_read_after(mod, call_arms, name, s_end,
+                                             reads, assigns)
+                if hit is not None:
+                    line, node = hit
+                    via = "" if name == origin else \
+                        f" (alias of donated {origin!r} taken before the call)"
+                    yield mod.finding(
+                        self.name, self.slug, node,
+                        f"{name!r} was donated to the jitted call at line "
+                        f"{s_line} and read here{via}; its buffer now "
+                        "belongs to XLA — rebind from the call's results "
+                        "or copy before donating")
+                elif name == origin:
+                    loop = self._enclosing_loop(mod, call, fn)
+                    if loop is not None and not self._assigned_in(
+                            name, loop, assigns, exclude=stmt):
+                        yield mod.finding(
+                            self.name, self.slug, call,
+                            f"{name!r} is donated here inside a loop and "
+                            "never rebound: the next iteration passes an "
+                            "already-donated buffer — rebind it from the "
+                            "call's results")
+
+    @staticmethod
+    def _stmt_of(mod, node):
+        stmt = node
+        for a in mod.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.Module)):
+                break
+            if isinstance(a, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                              ast.Expr, ast.Return, ast.If, ast.For,
+                              ast.While, ast.With)):
+                stmt = a
+                if isinstance(a, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                  ast.Expr, ast.Return)):
+                    break
+        return stmt
+
+    @staticmethod
+    def _stmt_targets(stmt):
+        out = set()
+
+        def collect(t):
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    collect(e)
+            elif isinstance(t, ast.Starred):
+                collect(t.value)
+            else:
+                c = chain_of(t)
+                if c:
+                    out.add(c)
+
+        if isinstance(stmt, (ast.Assign,)):
+            for t in stmt.targets:
+                collect(t)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            collect(stmt.target)
+        return out
+
+    def _assignments(self, own_nodes):
+        out = []
+        for n in own_nodes:
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                end = getattr(n, "end_lineno", n.lineno) or n.lineno
+                out.append((n.lineno, end, self._stmt_targets(n)))
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                tgt = set()
+                c = chain_of(n.target)
+                if c:
+                    tgt.add(c)
+                if isinstance(n.target, ast.Tuple):
+                    for e in n.target.elts:
+                        c = chain_of(e)
+                        if c:
+                            tgt.add(c)
+                if tgt:
+                    out.append((n.lineno, n.lineno, tgt))
+            elif isinstance(n, (ast.With, ast.AsyncWith)):
+                tgt = set()
+                for item in n.items:
+                    if item.optional_vars is not None:
+                        c = chain_of(item.optional_vars)
+                        if c:
+                            tgt.add(c)
+                if tgt:
+                    out.append((n.lineno, n.lineno, tgt))
+        return out
+
+    @staticmethod
+    def _aliases(own_nodes):
+        """(line, alias_name, base_chain) for plain snapshot assignments
+        ``alias = base`` / tuple-to-tuple forms."""
+        out = []
+        for n in own_nodes:
+            if not isinstance(n, ast.Assign) or len(n.targets) != 1:
+                continue
+            t, v = n.targets[0], n.value
+            pairs = []
+            if isinstance(t, ast.Tuple) and isinstance(v, ast.Tuple) \
+                    and len(t.elts) == len(v.elts):
+                pairs = list(zip(t.elts, v.elts))
+            else:
+                pairs = [(t, v)]
+            for tt, vv in pairs:
+                if isinstance(tt, ast.Name):
+                    base = chain_of(vv)
+                    if base and "." in base:  # snapshots of attrs only
+                        out.append((n.lineno, tt.id, base))
+        return out
+
+    @staticmethod
+    def _reads(fn, mod):
+        out = []
+        for n in ast.walk(fn):
+            if mod.enclosing_function(n) is not fn:
+                continue
+            if isinstance(n, (ast.Name, ast.Attribute)) \
+                    and isinstance(getattr(n, "ctx", None), ast.Load):
+                c = chain_of(n)
+                if c:
+                    out.append((n.lineno, c, n))
+        return out
+
+    @staticmethod
+    def _arm_path(mod, node):
+        """{if_node: 'body'|'orelse'} for every If the node sits under —
+        reads in the OTHER arm are not on any path after the call."""
+        arms = {}
+        prev = node
+        for anc in mod.ancestors(node):
+            if isinstance(anc, ast.If):
+                if any(prev is s for s in anc.body):
+                    arms[anc] = "body"
+                elif any(prev is s for s in anc.orelse):
+                    arms[anc] = "orelse"
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break
+            prev = anc
+        return arms
+
+    def _first_read_after(self, mod, call_arms, name, after_line, reads,
+                          assigns):
+        best = None
+        for line, chain, node in reads:
+            if line <= after_line or chain != name:
+                continue
+            arms = self._arm_path(mod, node)
+            if any(arms.get(k) is not None and arms[k] != v
+                   for k, v in call_arms.items()):
+                continue  # mutually-exclusive branch: not a path
+            if best is None or line < best[0]:
+                best = (line, node)
+        if best is None:
+            return None
+        # an intervening rebind of the name (or a prefix of it) clears it
+        for a_start, a_end, targets in assigns:
+            if after_line < a_end < best[0] and any(
+                    name == t or name.startswith(t + ".")
+                    for t in targets):
+                return None
+        return best
+
+    @staticmethod
+    def _enclosing_loop(mod, node, fn):
+        for a in mod.ancestors(node):
+            if a is fn:
+                return None
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return None
+            if isinstance(a, (ast.For, ast.While, ast.AsyncFor)):
+                return a
+        return None
+
+    @staticmethod
+    def _assigned_in(name, loop, assigns, exclude):
+        start = loop.lineno
+        end = getattr(loop, "end_lineno", start) or start
+        ex_line = exclude.lineno
+        for a_start, a_end, targets in assigns:
+            if a_start == ex_line:
+                continue
+            if start <= a_start <= end and any(
+                    name == t or name.startswith(t + ".")
+                    for t in targets):
+                return True
+        return False
+
+
+# ----------------------------------------------------------------------
+# R8: sharding / collective discipline
+# ----------------------------------------------------------------------
+
+@register
+class ShardingDisciplineRule(ProjectRule):
+    name = "R8"
+    slug = "sharding-discipline"
+    description = (
+        "collective (psum/pmean/all_gather/...) with a literal axis name "
+        "in code no shard_map/pmap reaches, or an axis name absent from "
+        "the mapped context / every Mesh(axis_names=...) in the project; "
+        "also shard_map/NamedSharding PartitionSpec axes that don't exist "
+        "on the mesh — XLA reports these as lowering errors at run time")
+
+    def check_project(self, mods):
+        facts = project_facts(mods)
+        for mod in mods:
+            yield from self._collectives(facts, mod)
+            yield from self._spec_sites(facts, mod)
+
+    def _collectives(self, facts, mod: LintModule):
+        for call in (n for n in ast.walk(mod.tree)
+                     if isinstance(n, ast.Call)):
+            dotted = mod.dotted(call.func) or ""
+            short = dotted.rsplit(".", 1)[-1]
+            # bare imported names resolve through the alias table to the
+            # full jax.lax.* path; a truly unresolved bare name is not ours
+            if short not in COLLECTIVES \
+                    or not dotted.startswith("jax.lax."):
+                continue
+            axis = self._axis_value(mod, call, COLLECTIVES[short])
+            if axis is None:
+                continue  # dynamic / parameter-fed: the caller decides
+            mapped, axes = facts.is_mapped(mod, call)
+            if not mapped:
+                yield mod.finding(
+                    self.name, self.slug, call,
+                    f"jax.lax.{short}(..., {axis!r}) but no "
+                    "shard_map/pmap reaches this function: the collective "
+                    "will fail with an unbound axis name at run time")
+            elif axes and axis not in axes:
+                yield mod.finding(
+                    self.name, self.slug, call,
+                    f"jax.lax.{short} axis {axis!r} is not bound by the "
+                    f"enclosing mapped context (axes: "
+                    f"{', '.join(sorted(axes))})")
+            elif facts.axis_universe and axis not in facts.axis_universe:
+                yield mod.finding(
+                    self.name, self.slug, call,
+                    f"jax.lax.{short} axis {axis!r} matches no "
+                    "Mesh(axis_names=...) declared anywhere in the "
+                    f"project (known: "
+                    f"{', '.join(sorted(facts.axis_universe))})")
+
+    @staticmethod
+    def _axis_value(mod, call, pos):
+        expr = None
+        for k in call.keywords:
+            if k.arg in ("axis_name", "axis"):
+                expr = k.value
+                break
+        if expr is None and pos < len(call.args):
+            expr = call.args[pos]
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        return None
+
+    def _spec_sites(self, facts, mod: LintModule):
+        """shard_map sites + NamedSharding(mesh, P(...)) axis checks."""
+        universe = facts.axis_universe
+        seen = set()
+        for info in (i for i in facts.fns.values() if i.mod is mod):
+            for dec in info.node.decorator_list:
+                site = facts._shard_site(mod, dec)
+                if site is not None:
+                    yield from self._check_site(facts, mod, site,
+                                                anchor=info.node)
+                    seen.add(id(site))
+        for call in (n for n in ast.walk(mod.tree)
+                     if isinstance(n, ast.Call)):
+            site = facts._shard_site(mod, call)
+            if site is call and id(site) not in seen:
+                yield from self._check_site(facts, mod, site, anchor=call)
+            dotted = mod.dotted(call.func) or ""
+            if dotted.endswith("NamedSharding") and universe:
+                spec_axes = facts._spec_axes(mod, call)
+                mesh_axes = facts._mesh_axes(
+                    mod, call.args[0] if call.args else None) or universe
+                for ax in sorted(spec_axes - mesh_axes):
+                    yield mod.finding(
+                        self.name, self.slug, call,
+                        f"NamedSharding PartitionSpec axis {ax!r} does "
+                        f"not exist on the mesh (known axes: "
+                        f"{', '.join(sorted(mesh_axes))})")
+
+    def _check_site(self, facts, mod, site, anchor):
+        mesh_axes = facts._mesh_axes(mod, None)
+        for k in site.keywords:
+            if k.arg == "mesh":
+                mesh_axes = facts._mesh_axes(mod, k.value)
+        allowed = mesh_axes or facts.axis_universe
+        if not allowed:
+            return
+        spec_axes = set()
+        for k in site.keywords:
+            if k.arg in ("in_specs", "out_specs"):
+                spec_axes |= facts._spec_axes(mod, k.value)
+        for ax in sorted(spec_axes - allowed):
+            yield mod.finding(
+                self.name, self.slug, anchor,
+                f"shard_map spec axis {ax!r} does not exist on the mesh "
+                f"(known axes: {', '.join(sorted(allowed))})")
+
+
+# ----------------------------------------------------------------------
+# R9: lock-order discipline
+# ----------------------------------------------------------------------
+
+@register
+class LockOrderRule(ProjectRule):
+    name = "R9"
+    slug = "lock-order"
+    description = (
+        "static lock-graph hazards across the threaded subsystems: "
+        "lock-acquisition cycles (A->B here, B->A elsewhere — a deadlock "
+        "waiting for the right interleaving; includes a non-reentrant "
+        "Lock re-acquired via a callee) and potentially-unbounded "
+        "blocking calls (queue get/put with no timeout, bare "
+        "join()/wait()) made while holding a lock")
+
+    def check_project(self, mods):
+        facts = project_facts(mods)
+        cycles = facts.lock_cycles()
+        cyc_members = {}
+        for cyc in cycles:
+            for lid in cyc:
+                cyc_members.setdefault(lid, cyc)
+        reported = set()
+        for src, dst, mod, node, via in facts.lock_edges:
+            cyc = None
+            if src == dst and (src,) in set(cycles):
+                cyc = (src,)
+            elif src in cyc_members and dst in cyc_members.get(src, ()):
+                cyc = cyc_members[src]
+            if cyc is None:
+                continue
+            key = (cyc, mod.path, node.lineno)
+            if key in reported:
+                continue
+            reported.add(key)
+            if len(cyc) == 1:
+                yield mod.finding(
+                    self.name, self.slug, node,
+                    f"non-reentrant lock {src} re-acquired while already "
+                    f"held ({via}): self-deadlock")
+            else:
+                yield mod.finding(
+                    self.name, self.slug, node,
+                    f"lock-order cycle {' -> '.join(cyc + (cyc[0],))}: "
+                    f"{src} is held while acquiring {dst} here ({via}), "
+                    "and the opposite order exists elsewhere — a "
+                    "deadlock waiting for the right thread interleaving")
+        for lock_id, desc, mod, node in facts.blocking_under_lock:
+            yield mod.finding(
+                self.name, self.slug, node,
+                f"{desc} while holding {lock_id}: every other thread "
+                "needing that lock stalls behind an unbounded wait — "
+                "drop the lock first, or bound the wait with a timeout")

@@ -155,6 +155,18 @@ def _build_parser():
                          "still in the ledger) also fail")
     ln.add_argument("--verbose", action="store_true",
                     help="also print baselined findings")
+    ln.add_argument("--diff", metavar="REF",
+                    help="pre-commit mode: analyse everything (project "
+                         "rules need the whole tree) but only REPORT "
+                         "findings whose statement touches a line changed "
+                         "vs this git ref (e.g. HEAD, origin/main)")
+    ln.add_argument("--san-report", metavar="JSON",
+                    help="merge a graftsan runtime report (Sanitizer.dump "
+                         "/ GRAFTSAN_REPORT) with the static R9 lock "
+                         "graph: maps observed acquisition orders onto "
+                         "static lock identities and fails on cycles in "
+                         "the MERGED graph — orders only runtime saw "
+                         "compose with orders only the code declares")
 
     fr = sub.add_parser(
         "flightrec",
@@ -482,10 +494,30 @@ def _cmd_lint(args):
     root = os.path.dirname(pkg_dir)
     paths = args.paths or [pkg_dir]
     rules = args.rules.split(",") if args.rules else None
+
+    if args.san_report:
+        return _lint_san_report(args, paths, root)
+    if args.diff and args.update_baseline:
+        raise SystemExit("graftlint: --diff filters findings to changed "
+                         "lines; rewriting the baseline from that subset "
+                         "would drop real debt — run --update-baseline "
+                         "without --diff")
+
     try:
         findings = analysis.lint_paths(paths, rules=rules, root=root)
     except analysis.LintError as e:
         raise SystemExit(f"graftlint: {e}")
+
+    if args.diff:
+        changed = _git_changed_lines(args.diff, root)
+        # a finding's statement spans sup_start (decorators included —
+        # editing only a decorator line must still surface the finding
+        # it causes on the def) through end_line
+        findings = [f for f in findings
+                    if any(ln in changed.get(f.path, ())
+                           for ln in range(min(f.sup_start or f.line,
+                                               f.line),
+                                           max(f.end_line, f.line) + 1))]
 
     if args.no_baseline:
         baseline = {}
@@ -498,6 +530,10 @@ def _cmd_lint(args):
             return 0
         baseline = analysis.load_baseline(bpath)
     new, known, stale = analysis.apply_baseline(findings, baseline)
+    if args.diff:
+        # off-diff baselined debt is invisible here, so "stale" is
+        # meaningless — the full (non-diff) CI run owns that check
+        stale = []
 
     if args.format == "json":
         reporters.report_json(new, known, stale)
@@ -508,6 +544,129 @@ def _cmd_lint(args):
     if stale and args.strict_baseline:
         return 1
     return 0
+
+
+def _git_changed_lines(ref, root):
+    """{repo-relative posix path: set of NEW-side line numbers} changed vs
+    ``ref`` (committed AND working-tree changes — pre-commit wants both).
+    Hunk headers only (-U0): pure deletions contribute no lines."""
+    import re
+    import subprocess
+    from pathlib import Path
+
+    try:
+        out = subprocess.run(
+            ["git", "-C", root, "diff", "--unified=0", ref, "--", "*.py"],
+            capture_output=True, text=True, check=True).stdout
+    except (OSError, subprocess.CalledProcessError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        raise SystemExit(f"graftlint: git diff {ref} failed: "
+                         f"{detail.strip()}")
+    changed, cur = {}, None
+    hunk = re.compile(r"^@@ -\d+(?:,\d+)? \+(\d+)(?:,(\d+))? @@")
+    for line in out.splitlines():
+        if line.startswith("+++ b/"):
+            cur = line[6:]
+        elif line.startswith("+++"):
+            cur = None                      # /dev/null: file deleted
+        elif cur is not None and line.startswith("@@"):
+            m = hunk.match(line)
+            if m:
+                start = int(m.group(1))
+                count = int(m.group(2)) if m.group(2) is not None else 1
+                if count:
+                    changed.setdefault(cur, set()).update(
+                        range(start, start + count))
+    # untracked files never appear in `git diff` hunks but ARE pending
+    # changes — every line of them counts
+    untracked = subprocess.run(
+        ["git", "-C", root, "ls-files", "--others", "--exclude-standard",
+         "--", "*.py"],
+        capture_output=True, text=True).stdout
+    for path in untracked.splitlines():
+        if not path:
+            continue
+        try:
+            with open(Path(root) / path, encoding="utf-8",
+                      errors="replace") as fh:
+                n = sum(1 for _ in fh)
+        except OSError:
+            continue
+        changed.setdefault(path, set()).update(range(1, n + 1))
+    return changed
+
+
+def _lint_san_report(args, paths, root):
+    """lint --san-report: one lock graph from both prongs. Static R9
+    edges come in lock-id space; observed graftsan edges come keyed by
+    allocation site (file:line) and map onto the SAME identity via the
+    lock registry — so an order only runtime saw composes with an order
+    only the code declares, and the merged cycle is reported even though
+    neither prong alone had it."""
+    import json
+    from pathlib import Path, PurePosixPath
+
+    from deeplearning4j_tpu import analysis
+    from deeplearning4j_tpu.analysis.dataflow import project_facts
+
+    with open(args.san_report, encoding="utf-8") as fh:
+        report = json.load(fh)
+    mods, parse_errors = analysis.parse_paths(paths, root=root)
+    static = analysis.lint_modules(mods, rules=["R9"])
+    facts = project_facts(mods)
+
+    site_to_id = {f"{info['path']}:{info['line']}": lid
+                  for lid, info in facts.locks.items()}
+
+    def norm(site):
+        fname, _, line = site.rpartition(":")
+        try:
+            rel = Path(fname).resolve().relative_to(Path(root).resolve())
+        except ValueError:
+            rel = Path(fname)
+        return f"{PurePosixPath(rel)}:{line}"
+
+    def ident(site):
+        n = norm(site)
+        return site_to_id.get(n, n)        # unmapped sites keep file:line
+
+    merged = {}
+    for src, dst, _mod, _node, _via in facts.lock_edges:
+        if src != dst:          # self-edges are static R9's own call
+            merged.setdefault(src, set()).add(dst)  # (RLock re-entry legal)
+    observed = []
+    for e in report.get("lock_order_edges", ()):
+        a, b = ident(e["from"]), ident(e["to"])
+        observed.append((a, b, e.get("count", 1)))
+        if a != b:
+            merged.setdefault(a, set()).add(b)
+
+    from deeplearning4j_tpu.analysis.dataflow import reaches
+    cycles = set()
+    for a in sorted(merged):
+        for b in sorted(merged[a]):
+            if reaches(merged, b, a):
+                cycles.add(tuple(sorted((a, b))))
+
+    runtime_findings = report.get("findings", ())
+    print(f"graftsan report: {len(observed)} observed lock-order edge(s), "
+          f"{len(runtime_findings)} runtime finding(s)")
+    for a, b, count in observed:
+        print(f"  observed {a} -> {b} (x{count})")
+    for f in runtime_findings:
+        tail = f" [{f['site']}]" if f.get("site") else ""
+        print(f"RUNTIME {f['kind']}: {f['message']}{tail}")
+    for f in static:
+        print(f"STATIC {f.human()}")
+    for f in parse_errors:
+        print(f"STATIC {f.human()}")
+    for cyc in sorted(cycles):
+        print("MERGED lock-order cycle: "
+              + " -> ".join(cyc + (cyc[0],)))
+    bad = bool(runtime_findings or static or parse_errors or cycles)
+    if not bad:
+        print("graftsan: static + observed lock graphs merge clean")
+    return 1 if bad else 0
 
 
 #: flight-record columns in display order; only those present in the dump

@@ -62,7 +62,9 @@ class InferenceFuture:
     original (``raise ... from e``) — re-raising one shared instance across
     waiter threads would mutate its traceback concurrently."""
 
-    __slots__ = ("_event", "_value", "_error", "latency_s")
+    # __weakref__ so graftsan (analysis/sanitizer.py) can track instances
+    # without keeping them alive
+    __slots__ = ("_event", "_value", "_error", "latency_s", "__weakref__")
 
     def __init__(self):
         self._event = threading.Event()

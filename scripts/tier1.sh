@@ -10,14 +10,34 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 
-# Stage 0: graftlint — the static-analysis gate (analysis/ package).
-# Fails on any non-baselined finding AND (--strict-baseline) on stale
-# baseline entries, so graftlint.baseline.json only ever shrinks.
+# Stage 0: graftlint — the static-analysis gate (analysis/ package),
+# running the FULL rule set R1-R9 (the interprocedural dataflow rules
+# R7-R9 register alongside R1-R6; nothing to opt into). Fails on any
+# non-baselined finding AND (--strict-baseline) on stale baseline
+# entries, so graftlint.baseline.json only ever shrinks.
 echo "== graftlint =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python -m deeplearning4j_tpu lint --strict-baseline || {
     echo "tier1: graftlint gate FAILED (fix, suppress with justification,"
     echo "tier1: or update graftlint.baseline.json)"; exit 1; }
+
+# Stage 0b: graftsan — the runtime concurrency sanitizer over the
+# threaded/donating test modules (analysis/sanitizer.py via the
+# GRAFTSAN=1 conftest fixture): observed lock inversions, leaked
+# non-daemon threads, never-resolved futures and unlocked cross-thread
+# RMW fail the stage; the observed-order report feeds `lint
+# --san-report` for the static-x-runtime lock-graph merge.
+echo "== graftsan (runtime concurrency sanitizer) =="
+timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  GRAFTSAN=1 GRAFTSAN_REPORT=/tmp/graftsan_tier1.json \
+  python -m pytest tests/test_serving.py tests/test_fused.py \
+  tests/test_streaming.py tests/test_parallel.py tests/test_native.py \
+  tests/test_ui.py tests/test_sanitizer.py -q -m 'not slow' \
+  -p no:cacheprovider -p no:xdist -p no:randomly || {
+    echo "tier1: graftsan stage FAILED"; exit 1; }
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python -m deeplearning4j_tpu lint --san-report /tmp/graftsan_tier1.json \
+  || { echo "tier1: lint --san-report merge FAILED"; exit 1; }
 
 # Stage 1: the fast test tier (the exact ROADMAP.md command).
 rm -f /tmp/_t1.log

@@ -99,3 +99,44 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if item.name.split("[")[0] in _HEAVY_TESTS:
             item.add_marker(pytest.mark.slow)
+
+
+# ---------------------------------------------------------------------------
+# graftsan (analysis/sanitizer.py): GRAFTSAN=1 wraps every test in the
+# runtime concurrency sanitizer — lock acquisitions made by product code
+# are recorded (inversions reported the moment the opposite order shows
+# up, no deadlock needed), non-daemon threads leaked past the test and
+# InferenceFutures never resolved fail the test. tier1.sh's sanitizer
+# stage runs the threaded modules this way; GRAFTSAN_REPORT=<path> also
+# dumps the merged observed-order report for `lint --san-report`.
+# ---------------------------------------------------------------------------
+
+_GRAFTSAN = os.environ.get("GRAFTSAN") == "1"
+_GRAFTSAN_TOTAL = {}
+
+if _GRAFTSAN:
+    from deeplearning4j_tpu.analysis import sanitizer as _sanitizer
+
+    @pytest.fixture(autouse=True)
+    def _graftsan():
+        san = _sanitizer.Sanitizer()
+        san.install()
+        try:
+            yield san
+        finally:
+            san.uninstall()
+            findings = san.check()
+            _sanitizer.merge_report(_GRAFTSAN_TOTAL,
+                                    san.report(findings=findings))
+            if findings:
+                pytest.fail("graftsan findings:\n"
+                            + "\n".join(f.human() for f in findings),
+                            pytrace=False)
+
+    def pytest_sessionfinish(session, exitstatus):
+        path = os.environ.get("GRAFTSAN_REPORT")
+        if path:
+            import json
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(_GRAFTSAN_TOTAL, fh, indent=1)
+                fh.write("\n")

@@ -658,6 +658,22 @@ class TestRepoIsClean:
                     "telemetry/health.py"):
             assert not any(mod in k for k in baseline), mod
 
+    def test_dataflow_rules_clean_at_head_with_empty_baseline(self):
+        # ISSUE 7 acceptance: R7/R8/R9 surface nothing at HEAD (findings
+        # were FIXED, not baselined) and the ledger holds zero entries
+        findings = lint_paths([PKG], root=REPO, rules=["R7", "R8", "R9"])
+        assert findings == [], "\n".join(f.human() for f in findings)
+        baseline = load_baseline(REPO / "graftlint.baseline.json")
+        assert baseline == {}
+
+    def test_serving_engine_reads_params_live_not_snapshotted(self):
+        # the PR 6 incident fix stays fixed: no R7 finding and no
+        # suppression in the serving engine
+        findings = lint_paths([PKG / "serving" / "engine.py"], root=REPO)
+        assert [f for f in findings if f.rule == "R7"] == []
+        src = (PKG / "serving" / "engine.py").read_text()
+        assert "graftlint: disable=R7" not in src
+
     def test_analysis_package_needs_no_jax(self):
         # the linter must run in environments without an accelerator
         # stack: its modules import only stdlib
@@ -729,3 +745,571 @@ class TestScorePipeline:
         iterations = [it for it, _ in lst.scores]
         assert iterations == sorted(iterations)
         assert all(np.isfinite(s) for _, s in lst.scores)
+
+
+# ----------------------------------------------------------------------
+# R7: use-after-donate (ISSUE 7 — the PR 6 serving-snapshot crash class)
+# ----------------------------------------------------------------------
+
+MAKER = """
+    import jax
+
+    def make_step():
+        def step(params, x):
+            return params
+        return jax.jit(step, donate_argnums=(0,))
+"""
+
+
+class TestR7UseAfterDonate:
+    # the PR 6 incident shape: a params snapshot taken at engine
+    # construction (BEFORE the donating fit) read again at serve time —
+    # the buffer belongs to XLA by then
+    BAD_SNAPSHOT = MAKER + """
+    class Server:
+        def fit_then_serve(self, x):
+            snap = self.net.params        # construction-time snapshot
+            step = make_step()
+            self.net.params = step(self.net.params, x)
+            return snap                   # stale alias: PR 6 crash
+    """
+
+    GOOD_LIVE_READ = MAKER + """
+    class Server:
+        def fit_then_serve(self, x):
+            step = make_step()
+            self.net.params = step(self.net.params, x)
+            return self.net.params        # live read: rebound from results
+    """
+
+    def test_pr6_snapshot_fixture_fires(self):
+        fs = [f for f in rules_fired(self.BAD_SNAPSHOT) if f.rule == "R7"]
+        assert len(fs) == 1
+        assert "alias" in fs[0].message
+        assert "snap" in fs[0].message
+
+    def test_pr6_fixed_idiom_silent(self):
+        assert "R7" not in rule_set(self.GOOD_LIVE_READ)
+
+    BAD_LOOP = MAKER + """
+    def fit(net, batches):
+        step = make_step()
+        params = net.params
+        for x in batches:
+            step(params, x)               # donated, never rebound
+    """
+
+    GOOD_LOOP = MAKER + """
+    def fit(net, batches):
+        step = make_step()
+        params = net.params
+        for x in batches:
+            params = step(params, x)      # rebound each iteration
+        return params
+    """
+
+    def test_fused_scan_loop_hazard_fires(self):
+        fs = [f for f in rules_fired(self.BAD_LOOP) if f.rule == "R7"]
+        assert len(fs) == 1
+        assert "next iteration" in fs[0].message
+
+    def test_rebinding_loop_silent(self):
+        assert "R7" not in rule_set(self.GOOD_LOOP)
+
+    def test_direct_read_after_donating_call_fires(self):
+        src = MAKER + """
+    def score(net, x):
+        step = make_step()
+        params = net.params
+        out = step(params, x)
+        return params.mean()              # read of the donated binding
+    """
+        fs = [f for f in rules_fired(src) if f.rule == "R7"]
+        assert len(fs) == 1
+        assert "donated" in fs[0].message
+
+    def test_interprocedural_summary_fires_in_caller(self):
+        # train_k donates its params PARAMETER; the caller's read after
+        # calling train_k is the finding — the seam R1-R6 cannot see
+        src = MAKER + """
+    def train_k(params, x):
+        step = make_step()
+        return step(params, x)
+
+    def fit(net, x):
+        params = net.params
+        out = train_k(params, x)
+        return params.block_until_ready()
+    """
+        fs = [f for f in rules_fired(src) if f.rule == "R7"]
+        assert [f.line for f in fs] and all(f.rule == "R7" for f in fs)
+
+    def test_cross_module_maker_fires(self):
+        # the donating jit lives two modules away from the reading loop
+        mod_a = textwrap.dedent(MAKER)
+        mod_b = textwrap.dedent("""
+            from pkg.a import make_step
+
+            def fit(net, batches):
+                step = make_step()
+                params = net.params
+                for x in batches:
+                    step(params, x)
+        """)
+        mods = [analysis.LintModule(mod_a, path="pkg/a.py"),
+                analysis.LintModule(mod_b, path="pkg/b.py")]
+        fs = [f for f in analysis.lint_modules(mods, rules=["R7"])]
+        assert len(fs) == 1 and fs[0].path == "pkg/b.py"
+
+    def test_branch_arms_are_not_a_path(self):
+        # the read in the OTHER arm of the same If is not reachable
+        # after the donating call — must stay silent
+        src = MAKER + """
+    def fit(net, x, donate):
+        step = make_step()
+        params = net.params
+        if donate:
+            step(params, x)
+        else:
+            return params.mean()
+    """
+        assert "R7" not in rule_set(src)
+
+
+# ----------------------------------------------------------------------
+# R8: sharding / collective discipline
+# ----------------------------------------------------------------------
+
+class TestR8ShardingDiscipline:
+    def test_unmapped_collective_fires(self):
+        src = """
+            import jax
+
+            def rollup(x):
+                return jax.lax.psum(x, "data")
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R8"]
+        assert len(fs) == 1
+        assert "no shard_map/pmap" in fs[0].message
+
+    GOOD_MAPPED = """
+        import jax
+        from functools import partial
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(None, axis_names=("data",))
+
+        @partial(shard_map, mesh=mesh, in_specs=(P("data"),),
+                 out_specs=P("data"))
+        def rollup(x):
+            return jax.lax.psum(x, "data")
+    """
+
+    def test_mapped_matching_axis_silent(self):
+        assert "R8" not in rule_set(self.GOOD_MAPPED)
+
+    def test_axis_not_bound_by_context_fires(self):
+        src = self.GOOD_MAPPED.replace('jax.lax.psum(x, "data")',
+                                       'jax.lax.psum(x, "model")')
+        fs = [f for f in rules_fired(src) if f.rule == "R8"]
+        assert len(fs) == 1
+        assert "not bound" in fs[0].message
+
+    def test_spec_axis_absent_from_mesh_fires(self):
+        src = self.GOOD_MAPPED.replace('in_specs=(P("data"),)',
+                                       'in_specs=(P("model"),)')
+        fs = [f for f in rules_fired(src) if f.rule == "R8"]
+        assert any("spec axis 'model'" in f.message for f in fs)
+
+    def test_escaped_callable_checked_against_universe_only(self):
+        # grad_sync escapes as a value: SOME mapped context may call it,
+        # so "outside mapped context" must not fire — but an axis name
+        # no Mesh in the project declares is still a finding
+        src = """
+            import jax
+            from jax.sharding import Mesh
+
+            mesh = Mesh(None, axis_names=("data",))
+
+            def grad_sync(g):
+                return jax.lax.pmean(g, "dat")
+
+            def run(fn):
+                return fn
+
+            handle = run(grad_sync)
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R8"]
+        assert len(fs) == 1
+        assert "matches no" in fs[0].message
+        assert "R8" not in rule_set(src.replace('"dat"', '"data"'))
+
+    def test_named_sharding_axis_checked(self):
+        src = """
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh = Mesh(None, axis_names=("data",))
+            sh = NamedSharding(mesh, P("model"))
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R8"]
+        assert len(fs) == 1
+        assert "NamedSharding" in fs[0].message
+
+    def test_dynamic_axis_name_silent(self):
+        # parameter-fed axis: the caller decides; nothing to check
+        src = """
+            import jax
+
+            def rollup(x, axis_name):
+                return jax.lax.psum(x, axis_name)
+        """
+        assert "R8" not in rule_set(src)
+
+
+# ----------------------------------------------------------------------
+# R9: lock-order discipline
+# ----------------------------------------------------------------------
+
+class TestR9LockOrder:
+    BAD_CYCLE = """
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self.l1 = threading.Lock()
+                self.l2 = threading.Lock()
+
+            def fwd(self):
+                with self.l1:
+                    with self.l2:
+                        pass
+
+            def rev(self):
+                with self.l2:
+                    with self.l1:
+                        pass
+    """
+
+    def test_ab_ba_cycle_fires(self):
+        fs = [f for f in rules_fired(self.BAD_CYCLE) if f.rule == "R9"]
+        assert len(fs) == 2          # one per conflicting site
+        assert all("cycle" in f.message for f in fs)
+
+    def test_consistent_order_silent(self):
+        src = self.BAD_CYCLE.replace(
+            "with self.l2:\n                    with self.l1:",
+            "with self.l1:\n                    with self.l2:")
+        assert "R9" not in rule_set(src)
+
+    def test_self_deadlock_via_callee_fires(self):
+        src = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self.helper()
+
+                def helper(self):
+                    with self._lock:
+                        pass
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R9"]
+        assert len(fs) == 1
+        assert "self-deadlock" in fs[0].message
+        # RLock is reentrant: the same shape is legal
+        assert "R9" not in rule_set(src.replace("threading.Lock()",
+                                                "threading.RLock()"))
+
+    def test_blocking_queue_get_under_lock_fires(self):
+        src = """
+            import queue
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+
+                def drain(self):
+                    with self._lock:
+                        return self._q.get()
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R9"]
+        assert len(fs) == 1
+        assert "get" in fs[0].message and "holding" in fs[0].message
+        assert "R9" not in rule_set(src.replace(
+            "self._q.get()", "self._q.get(timeout=1.0)"))
+
+    def test_blocking_join_via_callee_under_lock_fires(self):
+        src = """
+            import threading
+
+            class Runner:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=print, daemon=True)
+
+                def _stop_worker(self):
+                    self._t.join()
+
+                def close(self):
+                    with self._lock:
+                        self._stop_worker()
+        """
+        fs = [f for f in rules_fired(src, rules=["R9"])]
+        assert any("join" in f.message and "_stop_worker" in f.message
+                   for f in fs)
+
+
+# ----------------------------------------------------------------------
+# decorator-line suppressions (ISSUE 7 satellite)
+# ----------------------------------------------------------------------
+
+class TestDecoratorSuppression:
+    BAD = """
+        import jax
+        from functools import partial
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(None, axis_names=("data",))
+
+        @partial(shard_map, mesh=mesh, in_specs=(P("model"),),
+                 out_specs=P("model"))
+        def fwd(x):
+            return x
+    """
+
+    def test_finding_anchored_on_decorated_def_fires(self):
+        assert "R8" in rule_set(self.BAD)
+
+    def test_suppression_on_decorator_line_covers_the_def(self):
+        # pre-fix, the disable comment on the decorator line was invisible
+        # to findings anchored on the decorated def (its lineno is the
+        # `def` line, after the decorators)
+        src = self.BAD.replace(
+            "@partial(shard_map",
+            "@partial(  # graftlint: disable=R8 -- staged mesh migration\n"
+            "            shard_map")
+        assert "R8" not in rule_set(src)
+
+    def test_suppression_is_still_rule_specific(self):
+        src = self.BAD.replace(
+            "@partial(shard_map",
+            "@partial(  # graftlint: disable=R1 -- wrong rule named\n"
+            "            shard_map")
+        assert "R8" in rule_set(src)
+
+
+# ----------------------------------------------------------------------
+# lint --diff (ISSUE 7 satellite: pre-commit runs are instant)
+# ----------------------------------------------------------------------
+
+class TestLintDiff:
+    def test_changed_lines_parser(self, tmp_path):
+        import subprocess
+
+        from deeplearning4j_tpu.cli import _git_changed_lines
+
+        repo = tmp_path / "r"
+        repo.mkdir()
+
+        def git(*args):
+            subprocess.run(["git", "-C", str(repo), *args], check=True,
+                           capture_output=True,
+                           env={"PATH": "/usr/bin:/bin",
+                                "GIT_AUTHOR_NAME": "t",
+                                "GIT_AUTHOR_EMAIL": "t@t",
+                                "GIT_COMMITTER_NAME": "t",
+                                "GIT_COMMITTER_EMAIL": "t@t",
+                                "HOME": str(tmp_path)})
+
+        git("init", "-q")
+        f = repo / "m.py"
+        f.write_text("a = 1\nb = 2\nc = 3\n")
+        git("add", "m.py")
+        git("commit", "-qm", "seed")
+        f.write_text("a = 1\nb = 20\nc = 3\nd = 4\ne = 5\n")
+        changed = _git_changed_lines("HEAD", str(repo))
+        assert changed == {"m.py": {2, 4, 5}}
+
+    def test_untracked_files_count_every_line(self, tmp_path):
+        # `git diff REF` omits untracked files entirely; pre-commit must
+        # still see a brand-new module's findings
+        import subprocess
+
+        from deeplearning4j_tpu.cli import _git_changed_lines
+
+        repo = tmp_path / "r2"
+        repo.mkdir()
+        env = {"PATH": "/usr/bin:/bin", "GIT_AUTHOR_NAME": "t",
+               "GIT_AUTHOR_EMAIL": "t@t", "GIT_COMMITTER_NAME": "t",
+               "GIT_COMMITTER_EMAIL": "t@t", "HOME": str(tmp_path)}
+        subprocess.run(["git", "-C", str(repo), "init", "-q"], check=True,
+                       capture_output=True, env=env)
+        (repo / "seed.py").write_text("x = 1\n")
+        subprocess.run(["git", "-C", str(repo), "add", "seed.py"],
+                       check=True, capture_output=True, env=env)
+        subprocess.run(["git", "-C", str(repo), "commit", "-qm", "s"],
+                       check=True, capture_output=True, env=env)
+        (repo / "fresh.py").write_text("a = 1\nb = 2\n")
+        changed = _git_changed_lines("HEAD", str(repo))
+        assert changed == {"fresh.py": {1, 2}}
+
+    def test_diff_mode_filters_untouched_findings(self, tmp_path, capsys):
+        # a bad file OUTSIDE the repo diff: without --diff it fails the
+        # gate, with --diff vs HEAD every finding is off-diff -> clean
+        p = tmp_path / "bad.py"
+        p.write_text(TestLintCli.BAD)
+        assert main(["lint", str(p), "--no-baseline"]) == 1
+        capsys.readouterr()
+        assert main(["lint", str(p), "--no-baseline", "--diff", "HEAD"]) == 0
+
+    def test_diff_bad_ref_is_usage_error(self, tmp_path):
+        p = tmp_path / "ok.py"
+        p.write_text("x = 1\n")
+        with pytest.raises(SystemExit):
+            main(["lint", str(p), "--diff", "not-a-ref-xyz"])
+
+
+# ----------------------------------------------------------------------
+# lint --san-report (static R9 x observed graftsan orders)
+# ----------------------------------------------------------------------
+
+class TestSanReportMerge:
+    SRC = textwrap.dedent("""
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self.l1 = threading.Lock()
+                self.l2 = threading.Lock()
+
+            def fwd(self):
+                with self.l1:
+                    with self.l2:
+                        pass
+    """)
+
+    def _report(self, tmp_path, edges, findings=()):
+        doc = {"version": 1, "locks": {}, "findings": list(findings),
+               "lock_order_edges": [
+                   {"from": a, "to": b, "count": 1} for a, b in edges]}
+        rp = tmp_path / "gsan.json"
+        rp.write_text(json.dumps(doc))
+        return rp
+
+    def test_observed_reverse_order_completes_static_cycle(self, tmp_path,
+                                                           capsys):
+        # static sees only l1->l2; runtime observed l2->l1 (keyed by the
+        # locks' ALLOCATION sites). Neither prong alone has a cycle; the
+        # merged graph does.
+        p = tmp_path / "pair.py"
+        p.write_text(self.SRC)
+        l1 = f"{p}:6"       # self.l1 = threading.Lock()
+        l2 = f"{p}:7"
+        rp = self._report(tmp_path, [(l2, l1)])
+        rc = main(["lint", str(p), "--san-report", str(rp)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "MERGED lock-order cycle" in out
+
+    def test_consistent_observed_order_clean(self, tmp_path, capsys):
+        p = tmp_path / "pair.py"
+        p.write_text(self.SRC)
+        l1, l2 = f"{p}:6", f"{p}:7"
+        rp = self._report(tmp_path, [(l1, l2)])
+        rc = main(["lint", str(p), "--san-report", str(rp)])
+        assert rc == 0
+        assert "merge clean" in capsys.readouterr().out
+
+    def test_runtime_findings_fail_the_merge(self, tmp_path, capsys):
+        p = tmp_path / "pair.py"
+        p.write_text(self.SRC)
+        rp = self._report(tmp_path, [], findings=[
+            {"kind": "leaked-thread", "message": "thread 'w' leaked",
+             "site": ""}])
+        rc = main(["lint", str(p), "--san-report", str(rp)])
+        assert rc == 1
+        assert "RUNTIME leaked-thread" in capsys.readouterr().out
+
+
+# ----------------------------------------------------------------------
+# hardening regressions (PR 7 review)
+# ----------------------------------------------------------------------
+
+class TestDataflowHardening:
+    def test_cyclic_alias_chain_does_not_recurse(self):
+        # t = a; a = b; b = t on locals fed to a resolvable call once
+        # recursed binding_donation forever (RecursionError killed the
+        # whole lint run on legal swap code)
+        src = """
+            import jax
+
+            def helper(fn):
+                return fn
+
+            def swap(x):
+                t = a
+                a = b
+                b = t
+                helper(a)
+                return x
+        """
+        findings, err = lint_source(textwrap.dedent(src))
+        assert err is None
+        assert all(f.rule != "E0" for f in findings)
+
+    def test_nonblocking_queue_get_under_lock_silent(self):
+        # get(False) / get(block=False) never block: the get_nowait-style
+        # drain pattern must not trip R9 (reproduced false positive)
+        src = """
+            import queue
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+
+                def drain_pos(self):
+                    with self._lock:
+                        return self._q.get(False)
+
+                def drain_kw(self):
+                    with self._lock:
+                        return self._q.get(block=False)
+
+                def offer(self, item):
+                    with self._lock:
+                        self._q.put(item, False)
+        """
+        assert "R9" not in rule_set(src)
+
+    def test_diff_mode_sees_decorator_only_edits(self, tmp_path,
+                                                 monkeypatch):
+        # an R8 finding anchored on the def line must survive --diff when
+        # only its DECORATOR line changed (sup_start covers the range)
+        from deeplearning4j_tpu import cli as cli_mod
+
+        p = tmp_path / "dec.py"
+        p.write_text(textwrap.dedent(TestDecoratorSuppression.BAD))
+        findings = lint_paths([p])
+        r8 = [f for f in findings if f.rule == "R8"]
+        assert r8 and r8[0].sup_start < r8[0].line
+        dec_line = r8[0].sup_start     # the @partial(...) line
+
+        monkeypatch.setattr(
+            cli_mod, "_git_changed_lines",
+            lambda ref, root: {str(p): {dec_line}})
+        assert main(["lint", str(p), "--no-baseline", "--diff", "HEAD"]) == 1
+        # an edit elsewhere in the file: finding filtered out
+        monkeypatch.setattr(
+            cli_mod, "_git_changed_lines",
+            lambda ref, root: {str(p): {1}})
+        assert main(["lint", str(p), "--no-baseline", "--diff", "HEAD"]) == 0

@@ -1,0 +1,331 @@
+"""graftsan tests (ISSUE 7): the runtime concurrency sanitizer detects
+lock inversions (without needing a real deadlock), leaked non-daemon
+threads, never-resolved InferenceFutures, and cross-thread RMW outside
+any tracked lock — and stays silent on the disciplined twins.
+
+Each test builds its own Sanitizer; the ambient GRAFTSAN=1 autouse
+fixture (tests/conftest.py) is suspended first because only one
+sanitizer may own the ``threading`` patch at a time.
+"""
+
+import threading
+
+import pytest
+
+from deeplearning4j_tpu.analysis.sanitizer import (Sanitizer, _LockProxy,
+                                                   merge_report)
+
+#: scope that wraps locks allocated from THIS test module
+HERE = (__name__, "tests.test_sanitizer", "deeplearning4j_tpu")
+
+
+@pytest.fixture(autouse=True)
+def _suspend_ambient_graftsan():
+    # under GRAFTSAN=1 the conftest fixture installed a session sanitizer;
+    # these tests need the patch slot for their own instances
+    active = Sanitizer._active
+    if active is not None:
+        active.uninstall()
+    yield
+
+
+# ----------------------------------------------------------------------
+# lifecycle
+# ----------------------------------------------------------------------
+
+class TestLifecycle:
+    def test_install_patches_and_uninstall_restores(self):
+        orig_lock, orig_rlock = threading.Lock, threading.RLock
+        san = Sanitizer(scope_prefixes=HERE)
+        san.install()
+        try:
+            assert threading.Lock is not orig_lock
+            assert isinstance(threading.Lock(), _LockProxy)
+        finally:
+            san.uninstall()
+        assert threading.Lock is orig_lock
+        assert threading.RLock is orig_rlock
+
+    def test_second_install_refused(self):
+        with Sanitizer(scope_prefixes=HERE):
+            with pytest.raises(RuntimeError):
+                Sanitizer(scope_prefixes=HERE).install()
+
+    def test_out_of_scope_allocations_stay_real(self):
+        with Sanitizer(scope_prefixes=("some.other.package",)):
+            lock = threading.Lock()
+        assert not isinstance(lock, _LockProxy)
+
+    def test_proxy_survives_uninstall(self):
+        # an object built during a sanitized test may outlive it; its
+        # proxy locks must keep working (recording simply stops)
+        with Sanitizer(scope_prefixes=HERE) as san:
+            lock = threading.Lock()
+        assert isinstance(lock, _LockProxy)
+        with lock:
+            assert lock.locked()
+        assert not lock.locked()
+        assert san.check() == []
+
+
+# ----------------------------------------------------------------------
+# lock-inversion
+# ----------------------------------------------------------------------
+
+class TestLockInversion:
+    def _pair(self):
+        class Pair:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+        return Pair()
+
+    def test_opposite_orders_report_without_deadlocking(self):
+        with Sanitizer(scope_prefixes=HERE) as san:
+            p = self._pair()
+            with p.a:
+                with p.b:
+                    pass
+            done = threading.Event()
+
+            def rev():
+                with p.b:
+                    with p.a:
+                        pass
+                done.set()
+
+            t = threading.Thread(target=rev, daemon=True)
+            t.start()
+            assert done.wait(5.0)
+            t.join(5.0)
+            finds = [f for f in san.check() if f.kind == "lock-inversion"]
+            assert len(finds) == 1
+            assert "opposite" in finds[0].message
+
+    def test_consistent_order_clean(self):
+        with Sanitizer(scope_prefixes=HERE) as san:
+            p = self._pair()
+            for _ in range(3):
+                with p.a:
+                    with p.b:
+                        pass
+            assert san.check() == []
+
+    def test_rlock_reentry_is_not_an_edge(self):
+        with Sanitizer(scope_prefixes=HERE) as san:
+            lock = threading.RLock()
+            with lock:
+                with lock:
+                    pass
+            assert san.check() == []
+            assert san.report()["lock_order_edges"] == []
+
+    def test_cross_thread_release_clears_the_acquirer_stack(self):
+        # threading.Lock permits release from another thread (handoff
+        # pattern); the acquirer's held stack must not keep a phantom
+        # entry that turns later acquisitions into bogus edges
+        with Sanitizer(scope_prefixes=HERE) as san:
+            p = self._pair()
+            acquired = threading.Event()
+            released = threading.Event()
+
+            def acquirer():
+                p.a.acquire()
+                acquired.set()
+                assert released.wait(5.0)
+                with p.b:        # a is NOT held anymore: no edge
+                    pass
+
+            t = threading.Thread(target=acquirer, daemon=True)
+            t.start()
+            assert acquired.wait(5.0)
+            p.a.release()        # handoff release from the main thread
+            released.set()
+            t.join(5.0)
+            assert san.report()["lock_order_edges"] == []
+            assert san.check() == []
+
+    def test_report_keys_edges_by_allocation_site(self):
+        with Sanitizer(scope_prefixes=HERE) as san:
+            p = self._pair()
+            with p.a:
+                with p.b:
+                    pass
+            edges = san.report()["lock_order_edges"]
+            assert len(edges) == 1
+            assert edges[0]["count"] == 1
+            assert "test_sanitizer.py" in edges[0]["from"]
+
+
+# ----------------------------------------------------------------------
+# leaked threads
+# ----------------------------------------------------------------------
+
+class TestLeakedThreads:
+    def test_leaked_non_daemon_thread_reported(self):
+        with Sanitizer(scope_prefixes=HERE) as san:
+            ev = threading.Event()
+            t = threading.Thread(target=ev.wait, name="leaky-worker")
+            t.start()
+            finds = [f for f in san.check() if f.kind == "leaked-thread"]
+            assert len(finds) == 1
+            assert "leaky-worker" in finds[0].message
+            ev.set()
+            t.join(5.0)
+
+    def test_joined_and_daemon_threads_clean(self):
+        with Sanitizer(scope_prefixes=HERE) as san:
+            t = threading.Thread(target=lambda: None)
+            t.start()
+            t.join(5.0)
+            ev = threading.Event()
+            d = threading.Thread(target=ev.wait, daemon=True)
+            d.start()
+            assert [f for f in san.check()
+                    if f.kind == "leaked-thread"] == []
+            ev.set()
+            d.join(5.0)
+
+    def test_preexisting_threads_exempt(self):
+        ev = threading.Event()
+        before = threading.Thread(target=ev.wait, name="ambient")
+        before.start()
+        try:
+            with Sanitizer(scope_prefixes=HERE) as san:
+                assert [f for f in san.check()
+                        if f.kind == "leaked-thread"] == []
+        finally:
+            ev.set()
+            before.join(5.0)
+
+
+# ----------------------------------------------------------------------
+# cross-thread RMW
+# ----------------------------------------------------------------------
+
+class _Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+
+class TestUnlockedRmw:
+    def _run_writers(self, fn, n=2):
+        # SEQUENTIAL short-lived threads on purpose: CPython reuses
+        # thread idents the moment a thread exits, the regression that
+        # originally masked this detector
+        for _ in range(n):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            t.join(5.0)
+
+    def test_unlocked_cross_thread_writes_fire(self):
+        with Sanitizer(scope_prefixes=HERE) as san:
+            c = _Counter()
+            assert san.watch_rmw(c, "count")
+            self._run_writers(lambda: setattr(c, "count", c.count + 1))
+            finds = [f for f in san.check() if f.kind == "unlocked-rmw"]
+            assert len(finds) == 1
+            assert "_Counter.count" in finds[0].message
+
+    def test_locked_cross_thread_writes_clean(self):
+        with Sanitizer(scope_prefixes=HERE) as san:
+            c = _Counter()   # allocates a tracked proxy lock
+            assert san.watch_rmw(c, "count")
+
+            def bump():
+                with c._lock:
+                    c.count = c.count + 1
+
+            self._run_writers(bump)
+            assert c.count == 2
+            assert [f for f in san.check() if f.kind == "unlocked-rmw"] == []
+
+    def test_single_thread_writes_clean(self):
+        with Sanitizer(scope_prefixes=HERE) as san:
+            c = _Counter()
+            assert san.watch_rmw(c, "count")
+            for _ in range(5):
+                c.count += 1
+            assert san.check() == []
+
+    def test_unwatched_attrs_not_intercepted(self):
+        with Sanitizer(scope_prefixes=HERE) as san:
+            c = _Counter()
+            assert san.watch_rmw(c, "count")
+            self._run_writers(lambda: setattr(c, "other", 1))
+            assert san.check() == []
+
+
+# ----------------------------------------------------------------------
+# never-resolved futures (serving/engine.py InferenceFuture)
+# ----------------------------------------------------------------------
+
+class TestUnresolvedFutures:
+    def test_unresolved_future_reported_resolved_clean(self):
+        from deeplearning4j_tpu.serving.engine import InferenceFuture
+
+        with Sanitizer(scope_prefixes=HERE) as san:
+            kept = InferenceFuture()
+            ok = InferenceFuture()
+            ok._set(1)
+            failed = InferenceFuture()
+            failed._set_error(RuntimeError("x"))
+            finds = [f for f in san.check()
+                     if f.kind == "unresolved-future"]
+            assert len(finds) == 1       # only the never-resolved one
+            assert "test_sanitizer.py" in finds[0].site
+            kept._set(2)
+            assert [f for f in san.check()
+                    if f.kind == "unresolved-future"] == []
+
+    def test_dropped_future_not_reported(self):
+        # a future the program no longer references cannot block anyone
+        from deeplearning4j_tpu.serving.engine import InferenceFuture
+
+        with Sanitizer(scope_prefixes=HERE) as san:
+            InferenceFuture()
+            assert [f for f in san.check()
+                    if f.kind == "unresolved-future"] == []
+
+
+# ----------------------------------------------------------------------
+# report / merge (the lint --san-report input)
+# ----------------------------------------------------------------------
+
+class TestReportAndMerge:
+    def test_dump_roundtrip(self, tmp_path):
+        import json
+
+        with Sanitizer(scope_prefixes=HERE) as san:
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+            path = san.dump(tmp_path / "san.json")
+        doc = json.loads((tmp_path / "san.json").read_text())
+        assert path == tmp_path / "san.json"
+        assert doc["version"] == 1
+        assert len(doc["lock_order_edges"]) == 1
+        assert doc["findings"] == []
+        assert set(doc["locks"].values()) == {"Lock"}
+
+    def test_merge_accumulates_counts_and_findings(self):
+        with Sanitizer(scope_prefixes=HERE) as san:
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+            rep = san.report()
+        total = {}
+        merge_report(total, rep)
+        merge_report(total, rep)
+        assert total["lock_order_edges"][0]["count"] == 2
+        merge_report(total, {"lock_order_edges": [
+            {"from": "x.py:1", "to": "y.py:2", "count": 3}],
+            "findings": [{"kind": "leaked-thread", "message": "m",
+                          "site": ""}]})
+        assert len(total["lock_order_edges"]) == 2
+        assert len(total["findings"]) == 1
