@@ -556,6 +556,15 @@ class ImpureJitRule(Rule):
         if dotted:
             if dotted.rsplit(".", 1)[-1] in _PURE_TELEMETRY:
                 return None
+            if (".telemetry.tracectx" in dotted
+                    or dotted.startswith("tracectx.")):
+                # trace contexts are telemetry-gated HOST bookkeeping —
+                # fine in listener/host paths (R4 never looks there), but
+                # inside traced code the contextvar read fires at trace
+                # time only: attach()/handoff() around the jit call, never
+                # inside it
+                return (f"trace-context call {dotted} (host-side; "
+                        "attach/handoff around the jit boundary)")
             if dotted.startswith("deeplearning4j_tpu.telemetry"):
                 return f"telemetry call {dotted}"
             if dotted.startswith(_IMPURE_DOTTED_PREFIXES):

@@ -168,6 +168,28 @@ def _build_parser():
                          "the MERGED graph — orders only runtime saw "
                          "compose with orders only the code declares")
 
+    tc = sub.add_parser(
+        "traces",
+        help="inspect the slow-trace flight ring (telemetry/tracectx.py): "
+             "list the slowest complete causal traces per root span and "
+             "pretty-print one as an indented timeline")
+    tc.add_argument("--url",
+                    help="scrape a running server's /traces endpoint "
+                         "(e.g. http://127.0.0.1:9000/traces) instead of "
+                         "the local ring")
+    tc.add_argument("--file",
+                    help="read traces from a JSON file: a /traces "
+                         "payload, a raw ring snapshot, or a "
+                         "flight-recorder dump (its 'traces' key)")
+    tc.add_argument("--name",
+                    help="only this root-span name (e.g. serving.request)")
+    tc.add_argument("--trace-id",
+                    help="print the timeline of this trace id (the id a "
+                         "/metrics exemplar or BENCH worst_trace_id "
+                         "points at)")
+    tc.add_argument("--json", action="store_true",
+                    help="raw JSON passthrough instead of the timeline")
+
     fr = sub.add_parser(
         "flightrec",
         help="pretty-print a crash flight-recorder dump "
@@ -669,11 +691,119 @@ def _lint_san_report(args, paths, root):
     return 1 if bad else 0
 
 
+def _load_trace_rings(args):
+    """{root name: [trace docs]} from --file / --url / the local ring.
+    Accepts the three shapes traces travel in: a /traces payload
+    ({"traces": {...}}), a raw ring snapshot ({name: [...]}), or a
+    flight-recorder dump carrying a "traces" key."""
+    import json
+
+    if args.file:
+        with open(args.file) as f:
+            doc = json.load(f)
+        rings = doc.get("traces", doc) if isinstance(doc, dict) else {}
+        return {k: v for k, v in rings.items() if isinstance(v, list)}
+    if args.url:
+        import urllib.request
+        with urllib.request.urlopen(args.url, timeout=10) as r:
+            doc = json.loads(r.read().decode())
+        return doc.get("traces", doc)
+    from deeplearning4j_tpu import telemetry
+    rings = telemetry.tracectx.get_ring().snapshot()
+    if not rings:
+        print("note: local slow-trace ring is empty (each process has its "
+              "own); run traced work in THIS process, scrape a live "
+              "server with --url http://host:port/traces, or read a "
+              "flight dump with --file", file=sys.stderr)
+    return rings
+
+
+def _print_trace_timeline(doc):
+    """One trace as an indented timeline: spans sorted by start time,
+    indented by causal depth — the 'where did the p99 request spend its
+    time' view, readable without a trace viewer."""
+    dur = doc.get("duration_s")
+    head = f"trace {doc.get('trace_id')} {doc.get('name')}"
+    if dur is not None:
+        head += f" {1e3 * dur:.3f} ms"
+    if doc.get("status") not in (None, "ok"):
+        head += f" [{doc['status']}]"
+    print(head)
+    spans = [s for s in doc.get("spans", []) if isinstance(s, dict)]
+    depth = {}
+    by_id = {s.get("span_id"): s for s in spans}
+
+    def depth_of(s):
+        d, seen = 0, set()
+        while s is not None and s.get("parent_id") is not None \
+                and s.get("span_id") not in seen:
+            seen.add(s.get("span_id"))
+            s = by_id.get(s.get("parent_id"))
+            d += 1
+        return d
+
+    for s in spans:
+        depth[s.get("span_id")] = depth_of(s)
+    for s in sorted(spans, key=lambda s: (s.get("t0_s", 0.0),
+                                          depth[s.get("span_id")])):
+        pad = "  " * depth[s.get("span_id")]
+        d = s.get("dur_s")
+        dtxt = "?" if d is None else f"{1e3 * d:.3f} ms"
+        line = (f"  {1e3 * s.get('t0_s', 0.0):>10.3f}  {pad}"
+                f"{s.get('name')}  {dtxt}  [{s.get('thread', '?')}]")
+        if s.get("args"):
+            line += "  " + " ".join(f"{k}={v}"
+                                    for k, v in sorted(s["args"].items()))
+        print(line)
+
+
+def _cmd_traces(args):
+    """The gauge->exemplar->timeline landing: `traces --trace-id <id>`
+    renders the causal story a p99 exemplar points at."""
+    import json
+
+    rings = _load_trace_rings(args)
+    if args.name:
+        rings = {args.name: rings.get(args.name, [])}
+    if args.trace_id:
+        for docs in rings.values():
+            for doc in docs:
+                if doc.get("trace_id") == args.trace_id:
+                    if args.json:
+                        print(json.dumps(doc, indent=1, default=str))
+                    else:
+                        _print_trace_timeline(doc)
+                    return 0
+        print(f"traces: no trace {args.trace_id!r} in the ring",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(rings, indent=1, default=str))
+        return 0
+    slowest = None
+    for name in sorted(rings):
+        docs = rings[name]
+        if not docs:
+            continue
+        durs = [d.get("duration_s") or 0.0 for d in docs]
+        print(f"{name}: {len(docs)} trace(s), slowest "
+              f"{1e3 * max(durs):.3f} ms, fastest kept "
+              f"{1e3 * min(durs):.3f} ms")
+        for d in docs:
+            if slowest is None or (d.get("duration_s") or 0.0) > \
+                    (slowest.get("duration_s") or 0.0):
+                slowest = d
+    if slowest is not None:
+        print()
+        _print_trace_timeline(slowest)
+    return 0
+
+
 #: flight-record columns in display order; only those present in the dump
 #: are rendered (health fields appear when the watchdog annotated the ring)
 _FLIGHT_COLS = ("step", "score", "loss", "step_time_s", "etl_time_s",
                 "grad_norm", "loss_nonfinite", "grad_nonfinite",
-                "device_bytes_in_use", "live_array_bytes")
+                "trace_id", "device_bytes_in_use", "live_array_bytes")
 
 
 def _cmd_flightrec(args):
@@ -735,6 +865,8 @@ def main(argv=None):
         return _cmd_telemetry(args)
     if args.command == "flightrec":
         return _cmd_flightrec(args)
+    if args.command == "traces":
+        return _cmd_traces(args)
     if args.command == "lint":
         return _cmd_lint(args)
     return 1

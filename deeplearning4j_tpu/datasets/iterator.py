@@ -239,7 +239,7 @@ class AsyncDataSetIterator(DataSetIterator):
     AsyncDataSetIterator.java — queue-based double buffering)."""
 
     def __init__(self, base: DataSetIterator, queue_size=2, device_put=True,
-                 sharding=None, callback=None):
+                 sharding=None, callback=None, trace_root=None):
         self.base = base
         self.queue_size = queue_size
         self.device_put = device_put
@@ -249,6 +249,14 @@ class AsyncDataSetIterator(DataSetIterator):
                 "callback and sharding are mutually exclusive: the callback "
                 "owns device placement (e.g. InterleavedDataSetCallback)")
         self.callback = callback  # DataSetCallback, e.g. Interleaved round-robin
+        #: causal-tracing opt-in (telemetry.tracectx): with a root name set
+        #: and tracing on, the producer starts one trace per batch — its
+        #: assembly/device_put spans record on the producer thread — and
+        #: hands it off on the item (``item._trace_ctx``) for the consumer
+        #: to attach and finish (nn/fused.py passes "train.dispatch").
+        #: None (default): no traces, whatever the tracing toggle says —
+        #: a consumer that never finishes handoffs would leak open traces.
+        self.trace_root = trace_root
         self._queue = None
         self._thread = None
         self._error = None
@@ -290,10 +298,11 @@ class AsyncDataSetIterator(DataSetIterator):
         # step_valid/n_steps ride the same queue for the fused-dispatch
         # prefetch path); device_put recurses into dict-valued features
         # (the ComputationGraph form)
-        return dataclasses.replace(
-            ds, features=opt(ds.features), labels=opt(ds.labels),
-            features_mask=opt(ds.features_mask),
-            labels_mask=opt(ds.labels_mask))
+        with _tm.span("etl.device_put"):
+            return dataclasses.replace(
+                ds, features=opt(ds.features), labels=opt(ds.labels),
+                features_mask=opt(ds.features_mask),
+                labels_mask=opt(ds.labels_mask))
 
     def _producer(self):
         # capture THIS generation's queue/stop: a producer that outlives
@@ -301,19 +310,47 @@ class AsyncDataSetIterator(DataSetIterator):
         # not inject a stale batch or premature sentinel into the fresh
         # queue the next reset() installs
         q, stop = self._queue, self._stop
+        tctx = None
         try:
             while not stop.is_set():
-                with _tm.span("etl.prefetch"):
-                    try:
-                        ds = next(self.base)
-                    except StopIteration:
-                        break
-                    item = self._put_device(ds)
+                tctx = (None if self.trace_root is None
+                        else _tm.tracectx.maybe_start(self.trace_root))
+                with _tm.tracectx.attach(tctx):
+                    with _tm.span("etl.prefetch"):
+                        try:
+                            ds = next(self.base)
+                        except StopIteration:
+                            break
+                        item = self._put_device(ds)
+                if tctx is not None:
+                    # handoff rides the queue with the batch; the consumer
+                    # attaches (its dispatch spans parent under this
+                    # trace) and owns finish()
+                    item._trace_ctx = tctx.handoff()
+                    tctx = None
                 q.put(item)
         except Exception as e:  # surfaced on the consumer side
             if self._queue is q:  # our generation is still live
                 self._error = e
         finally:
+            # thread-exit path: a producer dying mid-span (source raised,
+            # wedged device_put interrupted) must not leave its trace open
+            # forever — close it without ringing
+            if tctx is not None:
+                tctx.abandon()
+            if stop.is_set():
+                # stopped generation: the consumer's close() may have done
+                # its final drain BEFORE our last q.put landed (join timed
+                # out on a wedged batch). Nobody will read this queue
+                # again — abandon any handoffs still in it ourselves.
+                try:
+                    while True:
+                        item = q.get_nowait()
+                        t = getattr(item, "_trace_ctx", None)
+                        if t is not None:
+                            t.abandon()
+                except queue.Empty:
+                    pass
             q.put(_SENTINEL)
 
     def __next__(self):
@@ -353,14 +390,29 @@ class AsyncDataSetIterator(DataSetIterator):
             # observes the stop flag and exits instead of producing the
             # rest of the (possibly huge) epoch into the void
             self._stop.set()
-            try:
-                while self._queue.get_nowait() is not _SENTINEL:
-                    pass
-            except queue.Empty:
-                pass
+            self._drain_abandoning()
             self._thread.join(timeout=5)
+            # drain AGAIN: a producer that was mid-batch when we drained
+            # above may have enqueued one more item (+ sentinel) before
+            # observing the stop flag — its handoff must not stay open
+            self._drain_abandoning()
         self._thread = None
         self._queue = None
+
+    def _drain_abandoning(self):
+        try:
+            while True:
+                item = self._queue.get_nowait()
+                if item is _SENTINEL:
+                    continue  # keep draining: items may follow a stale
+                    #           sentinel from a raced generation
+                # a queued batch nobody will consume: close its trace
+                # (open handoffs are the dangling state close() owns)
+                tctx = getattr(item, "_trace_ctx", None)
+                if tctx is not None:
+                    tctx.abandon()
+        except queue.Empty:
+            pass
 
 
 @dataclasses.dataclass

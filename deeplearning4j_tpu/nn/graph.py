@@ -1043,6 +1043,7 @@ class ComputationGraph:
         pipe = _tm.ScorePipeline()
         emitter = _tm.scorepipe.StepRecordEmitter(self, step_h, etl_h,
                                                   iters_c, score_g, frec)
+        tctx = None
         try:
             with _tm.span("fit", net=type(self).__name__):
                 for _ in range(epochs):
@@ -1062,45 +1063,68 @@ class ComputationGraph:
                                 iters_c.inc()
                                 score_g.set(tb_score)
                             continue
-                        etl_start = time.perf_counter()
-                        with _tm.span("fit.etl"):
-                            bi = {k: jnp.asarray(v) for k, v in bi.items()}
-                            bl = {k: jnp.asarray(v) for k, v in bl.items()}
-                            bm = jnp.asarray(bm) if bm is not None else None
-                        etl_time = time.perf_counter() - etl_start
-                        # for PerformanceListener batch-size inference +
-                        # activation-visualizing listeners (MLN convention)
-                        self.last_input = next(iter(bi.values()))
-                        hb = None
-                        step_i = self.iteration
-                        rec = reg.enabled  # one read: a mid-iteration
-                        # enable() must not see half-initialized locals
-                        want_score = rec or bool(self.listeners)
-                        resolved = meta = None
-                        step_start = time.perf_counter()
-                        with _tm.span("fit.step", iteration=step_i):
-                            self._rng, sub = jax.random.split(self._rng)
-                            if use_health:
-                                (self.params, self.state, self.opt_state,
-                                 loss, hb) = step_fn(
-                                    self.params, self.state, self.opt_state,
-                                    bi, bl, self.iteration, sub, bm)
-                            else:
-                                (self.params, self.state, self.opt_state,
-                                 loss) = step_fn(
-                                    self.params, self.state, self.opt_state,
-                                    bi, bl, self.iteration, sub, bm)
-                            self.score_value = loss  # device scalar
-                            self.iteration += 1
-                            if want_score:
-                                # resolve step i-1 inside the span: the
-                                # fetch overlaps the step just dispatched
-                                meta = {"step": step_i,
-                                        "iteration": self.iteration,
-                                        "etl_time_s": etl_time, "rec": rec,
-                                        "health": use_health,
-                                        "step_time_s": 0.0}
-                                resolved = pipe.push(loss, meta)
+                        # per-step causal trace (tracing on only) — the
+                        # MLN fit-loop pattern exactly; finished by the
+                        # emitter when the score resolves one step late
+                        tctx = _tm.tracectx.maybe_start("train.step")
+                        with _tm.tracectx.attach(tctx):
+                            etl_start = time.perf_counter()
+                            with _tm.span("fit.etl"):
+                                bi = {k: jnp.asarray(v)
+                                      for k, v in bi.items()}
+                                bl = {k: jnp.asarray(v)
+                                      for k, v in bl.items()}
+                                bm = (jnp.asarray(bm) if bm is not None
+                                      else None)
+                            etl_time = time.perf_counter() - etl_start
+                            # for PerformanceListener batch-size inference
+                            # + activation-visualizing listeners (MLN
+                            # convention)
+                            self.last_input = next(iter(bi.values()))
+                            hb = None
+                            step_i = self.iteration
+                            rec = reg.enabled  # one read: a mid-iteration
+                            # enable() must not see half-initialized locals
+                            want_score = rec or bool(self.listeners)
+                            resolved = meta = None
+                            step_start = time.perf_counter()
+                            with _tm.span("fit.step", iteration=step_i):
+                                self._rng, sub = jax.random.split(self._rng)
+                                if use_health:
+                                    (self.params, self.state, self.opt_state,
+                                     loss, hb) = step_fn(
+                                        self.params, self.state, self.opt_state,
+                                        bi, bl, self.iteration, sub, bm)
+                                else:
+                                    (self.params, self.state, self.opt_state,
+                                     loss) = step_fn(
+                                        self.params, self.state, self.opt_state,
+                                        bi, bl, self.iteration, sub, bm)
+                                self.score_value = loss  # device scalar
+                                self.iteration += 1
+                                if want_score:
+                                    # resolve step i-1 inside the span: the
+                                    # fetch overlaps the step just dispatched
+                                    meta = {"step": step_i,
+                                            "iteration": self.iteration,
+                                            "etl_time_s": etl_time, "rec": rec,
+                                            "health": use_health,
+                                            "step_time_s": 0.0,
+                                            "trace": tctx,
+                                            "trace_id": (None if tctx is None
+                                                         else tctx.trace_id)}
+                                    t_res = time.perf_counter()
+                                    resolved = pipe.push(loss, meta)
+                                    if resolved is not None:
+                                        prev_t = resolved[1].get("trace")
+                                        if prev_t is not None:
+                                            # step i-1's one-late fetch
+                                            # lands in ITS trace
+                                            prev_t.add_span(
+                                                "train.score_fetch", t_res,
+                                                time.perf_counter())
+                        if meta is None and tctx is not None:
+                            tctx.finish()  # nobody resolves scores
                         if meta is not None:
                             meta["step_time_s"] = (time.perf_counter()
                                                    - step_start)
@@ -1135,9 +1159,15 @@ class ComputationGraph:
                     hm.flush(apply_policy=False)  # final health into the ring
                 except Exception:
                     pass
+            if tctx is not None:
+                # the step that crashed never reached the pipeline —
+                # close its trace here (idempotent if it did)
+                tctx.abandon()
             _flight.crash_dump(e)
             raise
         finally:
+            pipe.abandon()  # no-op after flush; closes the pending step's
+            #                 trace on the exception path
             _listeners.run_fit_end_hooks(self)
         return self
 

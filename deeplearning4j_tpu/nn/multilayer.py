@@ -405,6 +405,7 @@ class MultiLayerNetwork:
         pipe = _tm.ScorePipeline()
         emitter = _tm.scorepipe.StepRecordEmitter(self, step_h, etl_h,
                                                   iters_c, score_g, frec)
+        tctx = None
         try:
             with _tm.span("fit", net=type(self).__name__):
                 for _ in range(epochs):
@@ -415,52 +416,72 @@ class MultiLayerNetwork:
                                             else None)
                     for batch in batches:
                         x, y, m = batch
-                        etl_start = time.perf_counter()
-                        with _tm.span("fit.etl"):
-                            x, y = jnp.asarray(x), jnp.asarray(y)
-                            m = jnp.asarray(m) if m is not None else None
-                        etl_time = time.perf_counter() - etl_start
-                        self.last_input = x  # for activation-visualizing listeners
-                        hb = None
-                        step_i = self.iteration
-                        rec = reg.enabled  # one read: a mid-iteration
-                        # enable() must not see half-initialized locals
-                        want_score = rec or bool(self.listeners)
-                        resolved = meta = None
-                        step_start = time.perf_counter()
-                        with _tm.span("fit.step", iteration=step_i):
-                            if (self.conf.backprop_type == "tbptt" and x.ndim == 3
-                                    and y.ndim == 3
-                                    and x.shape[1] > self.conf.tbptt_fwd_length):
-                                # TBPTT runs its own chunked step; the
-                                # watchdog bundle covers the plain step only
-                                loss = self._fit_tbptt(x, y, m)
-                            else:
-                                self._rng, step_rng = jax.random.split(self._rng)
-                                if use_health:
-                                    (self.params, self.state, self.opt_state,
-                                     loss, hb) = step_fn(
-                                        self.params, self.state, self.opt_state,
-                                        x, y, self.iteration, step_rng, m)
+                        # per-step causal trace (tracing on only): the
+                        # etl/step spans below parent under it; finished
+                        # by the emitter when the score resolves one step
+                        # late. Off: one call + branch, no contextvars.
+                        tctx = _tm.tracectx.maybe_start("train.step")
+                        with _tm.tracectx.attach(tctx):
+                            etl_start = time.perf_counter()
+                            with _tm.span("fit.etl"):
+                                x, y = jnp.asarray(x), jnp.asarray(y)
+                                m = jnp.asarray(m) if m is not None else None
+                            etl_time = time.perf_counter() - etl_start
+                            self.last_input = x  # for activation-visualizing listeners
+                            hb = None
+                            step_i = self.iteration
+                            rec = reg.enabled  # one read: a mid-iteration
+                            # enable() must not see half-initialized locals
+                            want_score = rec or bool(self.listeners)
+                            resolved = meta = None
+                            step_start = time.perf_counter()
+                            with _tm.span("fit.step", iteration=step_i):
+                                if (self.conf.backprop_type == "tbptt" and x.ndim == 3
+                                        and y.ndim == 3
+                                        and x.shape[1] > self.conf.tbptt_fwd_length):
+                                    # TBPTT runs its own chunked step; the
+                                    # watchdog bundle covers the plain step only
+                                    loss = self._fit_tbptt(x, y, m)
                                 else:
-                                    (self.params, self.state, self.opt_state,
-                                     loss) = step_fn(
-                                        self.params, self.state, self.opt_state,
-                                        x, y, self.iteration, step_rng, m)
-                                self.score_value = loss
-                                self.iteration += 1
-                            if want_score:
-                                # queue step i, resolve step i-1 INSIDE the
-                                # span: the blocking fetch overlaps the step
-                                # just dispatched, so the recorded window
-                                # converges to the device step time without
-                                # a same-step sync
-                                meta = {"step": step_i,
-                                        "iteration": self.iteration,
-                                        "etl_time_s": etl_time, "rec": rec,
-                                        "health": use_health,
-                                        "step_time_s": 0.0}
-                                resolved = pipe.push(loss, meta)
+                                    self._rng, step_rng = jax.random.split(self._rng)
+                                    if use_health:
+                                        (self.params, self.state, self.opt_state,
+                                         loss, hb) = step_fn(
+                                            self.params, self.state, self.opt_state,
+                                            x, y, self.iteration, step_rng, m)
+                                    else:
+                                        (self.params, self.state, self.opt_state,
+                                         loss) = step_fn(
+                                            self.params, self.state, self.opt_state,
+                                            x, y, self.iteration, step_rng, m)
+                                    self.score_value = loss
+                                    self.iteration += 1
+                                if want_score:
+                                    # queue step i, resolve step i-1 INSIDE the
+                                    # span: the blocking fetch overlaps the step
+                                    # just dispatched, so the recorded window
+                                    # converges to the device step time without
+                                    # a same-step sync
+                                    meta = {"step": step_i,
+                                            "iteration": self.iteration,
+                                            "etl_time_s": etl_time, "rec": rec,
+                                            "health": use_health,
+                                            "step_time_s": 0.0,
+                                            "trace": tctx,
+                                            "trace_id": (None if tctx is None
+                                                         else tctx.trace_id)}
+                                    t_res = time.perf_counter()
+                                    resolved = pipe.push(loss, meta)
+                                    if resolved is not None:
+                                        prev_t = resolved[1].get("trace")
+                                        if prev_t is not None:
+                                            # step i-1's one-late fetch
+                                            # lands in ITS trace
+                                            prev_t.add_span(
+                                                "train.score_fetch", t_res,
+                                                time.perf_counter())
+                        if meta is None and tctx is not None:
+                            tctx.finish()  # nobody resolves scores
                         if meta is not None:
                             meta["step_time_s"] = (time.perf_counter()
                                                    - step_start)
@@ -498,9 +519,15 @@ class MultiLayerNetwork:
                     hm.flush(apply_policy=False)  # final health into the ring
                 except Exception:
                     pass
+            if tctx is not None:
+                # the step that crashed never reached the pipeline —
+                # close its trace here (idempotent if it did)
+                tctx.abandon()
             _flight.crash_dump(e)
             raise
         finally:
+            pipe.abandon()  # no-op after flush; closes the pending step's
+            #                 trace on the exception path
             _listeners.run_fit_end_hooks(self)
         return self
 

@@ -120,21 +120,26 @@ class TrainingMaster:
         boundary). A worker whose replica diverged is visible HERE even
         though the pmean would smear it across the fleet one exchange later.
         """
-        vals = jax.device_get(wh)
-        reg = _tm.get_registry()
-        g_nf = reg.gauge("distributed_worker_nonfinite",
-                         "1 when this worker's last round saw NaN/Inf, "
-                         "labeled by master and worker")
-        norm_key = "grad_norm" if "grad_norm" in vals else "param_norm"
-        g_norm = reg.gauge(f"distributed_worker_{norm_key}",
-                           f"per-worker {norm_key.replace('_', ' ')} at the "
-                           "last exchange, labeled by master and worker")
-        flags = np.asarray(vals["nonfinite"]).reshape(-1)
-        norms = np.asarray(vals[norm_key]).reshape(-1)
-        for w in range(len(flags)):
-            g_nf.set(1.0 if flags[w] else 0.0, master=master, worker=str(w))
-            g_norm.set(float(norms[w]), master=master, worker=str(w))
-        bad = [int(w) for w in np.nonzero(flags)[0]]
+        # the rollup span parents under the round trace when the caller
+        # attached one — a slow round decomposes into collective vs rollup
+        with _tm.span("distributed.worker_rollup", master=master):
+            vals = jax.device_get(wh)
+            reg = _tm.get_registry()
+            g_nf = reg.gauge("distributed_worker_nonfinite",
+                             "1 when this worker's last round saw NaN/Inf, "
+                             "labeled by master and worker")
+            norm_key = "grad_norm" if "grad_norm" in vals else "param_norm"
+            g_norm = reg.gauge(f"distributed_worker_{norm_key}",
+                               f"per-worker {norm_key.replace('_', ' ')} "
+                               "at the last exchange, labeled by master "
+                               "and worker")
+            flags = np.asarray(vals["nonfinite"]).reshape(-1)
+            norms = np.asarray(vals[norm_key]).reshape(-1)
+            for w in range(len(flags)):
+                g_nf.set(1.0 if flags[w] else 0.0, master=master,
+                         worker=str(w))
+                g_norm.set(float(norms[w]), master=master, worker=str(w))
+            bad = [int(w) for w in np.nonzero(flags)[0]]
         if bad:
             _health.get_monitor().note_anomaly(
                 "distributed_nonfinite", step=step, master=master,
@@ -267,32 +272,40 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 "examples_dropped", 0) + rem
             for s0 in range(start, n - split_examples + 1, split_examples):
                 t_round = time.perf_counter()
-                with _tm.span("distributed.round",
-                              master="parameter_averaging"):
-                    xs = np.asarray(data[s0:s0 + split_examples]).reshape(
-                        (w, f, b) + data.shape[1:])
-                    ys = np.asarray(labels[s0:s0 + split_examples]).reshape(
-                        (w, f, b) + labels.shape[1:])
-                    rng, *subs = jax.random.split(rng, w + 1)
-                    rngs = _put(jnp.stack(subs), mesh, "data")
-                    out = self._split_fn(
-                        params, state, opt,
-                        _put(jnp.asarray(xs), mesh, "data"),
-                        _put(jnp.asarray(ys), mesh, "data"),
-                        it0, rngs)
-                    params, state, opt, loss = out[:4]
+                # round trace: the averaging round and its per-worker
+                # rollup become one causal timeline in the slow-trace ring
+                tctx = _tm.tracectx.maybe_start("distributed.round",
+                                                master="parameter_averaging")
+                with _tm.tracectx.attach(tctx):
+                    with _tm.span("distributed.round",
+                                  master="parameter_averaging"):
+                        xs = np.asarray(data[s0:s0 + split_examples]).reshape(
+                            (w, f, b) + data.shape[1:])
+                        ys = np.asarray(labels[s0:s0 + split_examples]).reshape(
+                            (w, f, b) + labels.shape[1:])
+                        rng, *subs = jax.random.split(rng, w + 1)
+                        rngs = _put(jnp.stack(subs), mesh, "data")
+                        out = self._split_fn(
+                            params, state, opt,
+                            _put(jnp.asarray(xs), mesh, "data"),
+                            _put(jnp.asarray(ys), mesh, "data"),
+                            it0, rngs)
+                        params, state, opt, loss = out[:4]
+                        if reg.enabled:
+                            # block inside the span so the round time covers the
+                            # collective, not just the async dispatch; disabled,
+                            # no extra sync is added to the round loop
+                            jax.block_until_ready(loss)  # graftlint: disable=R1 -- deliberate, telemetry-gated: the round span must cover the collective, not just its dispatch
                     if reg.enabled:
-                        # block inside the span so the round time covers the
-                        # collective, not just the async dispatch; disabled,
-                        # no extra sync is added to the round loop
-                        jax.block_until_ready(loss)  # graftlint: disable=R1 -- deliberate, telemetry-gated: the round span must cover the collective, not just its dispatch
-                if reg.enabled:
-                    round_h.observe(time.perf_counter() - t_round,
-                                    master="parameter_averaging")
-                    rounds_c.inc(master="parameter_averaging")
-                if self._built_with_health:
-                    self._worker_health_rollup(out[4], "parameter_averaging",
-                                               it0)
+                        round_h.observe(time.perf_counter() - t_round,
+                                        master="parameter_averaging")
+                        rounds_c.inc(master="parameter_averaging")
+                    if self._built_with_health:
+                        self._worker_health_rollup(out[4],
+                                                   "parameter_averaging",
+                                                   it0)
+                if tctx is not None:
+                    tctx.finish()
                 it0 += f
                 self._stats["splits"] += 1
                 self._stats["worker_steps"] += w * f
@@ -451,23 +464,28 @@ class SharedTrainingMaster(TrainingMaster):
                 "examples_dropped", 0) + rem
             for s0 in range(start, n - step_examples + 1, step_examples):
                 t_round = time.perf_counter()
-                with _tm.span("distributed.round", master="shared"):
-                    x = jax.device_put(
-                        jnp.asarray(data[s0:s0 + step_examples]), data_sh)
-                    y = jax.device_put(
-                        jnp.asarray(labels[s0:s0 + step_examples]), data_sh)
-                    rng, sub = jax.random.split(rng)
-                    out = self._step_fn(
-                        params, state, opt, resid, tau, x, y, it, sub)
-                    params, state, opt, resid, tau, loss = out[:6]
+                tctx = _tm.tracectx.maybe_start("distributed.round",
+                                                master="shared")
+                with _tm.tracectx.attach(tctx):
+                    with _tm.span("distributed.round", master="shared"):
+                        x = jax.device_put(
+                            jnp.asarray(data[s0:s0 + step_examples]), data_sh)
+                        y = jax.device_put(
+                            jnp.asarray(labels[s0:s0 + step_examples]), data_sh)
+                        rng, sub = jax.random.split(rng)
+                        out = self._step_fn(
+                            params, state, opt, resid, tau, x, y, it, sub)
+                        params, state, opt, resid, tau, loss = out[:6]
+                        if reg.enabled:
+                            jax.block_until_ready(loss)  # graftlint: disable=R1 -- deliberate, telemetry-gated: the round span must cover the all-reduce, not just its dispatch
                     if reg.enabled:
-                        jax.block_until_ready(loss)  # graftlint: disable=R1 -- deliberate, telemetry-gated: the round span must cover the all-reduce, not just its dispatch
-                if reg.enabled:
-                    round_h.observe(time.perf_counter() - t_round,
-                                    master="shared")
-                    rounds_c.inc(master="shared")
-                if self._built_with_health:
-                    self._worker_health_rollup(out[6], "shared", it)
+                        round_h.observe(time.perf_counter() - t_round,
+                                        master="shared")
+                        rounds_c.inc(master="shared")
+                    if self._built_with_health:
+                        self._worker_health_rollup(out[6], "shared", it)
+                if tctx is not None:
+                    tctx.finish()
                 it += 1
                 self._stats["steps"] += 1
                 if listeners:  # per-step callback, fetched one step late

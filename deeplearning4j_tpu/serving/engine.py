@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import telemetry as _tm
+from deeplearning4j_tpu.telemetry import tracectx as _tracectx
 from deeplearning4j_tpu.datasets.iterator import BucketRegistry
 
 #: fill-ratio buckets: eighths of the padded bucket (shared with
@@ -64,7 +65,8 @@ class InferenceFuture:
 
     # __weakref__ so graftsan (analysis/sanitizer.py) can track instances
     # without keeping them alive
-    __slots__ = ("_event", "_value", "_error", "latency_s", "__weakref__")
+    __slots__ = ("_event", "_value", "_error", "latency_s", "trace_id",
+                 "__weakref__")
 
     def __init__(self):
         self._event = threading.Event()
@@ -73,6 +75,10 @@ class InferenceFuture:
         #: submit-to-result seconds, stamped by the serving worker when the
         #: request completes (None until then / on the direct path)
         self.latency_s = None
+        #: causal trace id for this request (telemetry.tracectx), stamped
+        #: at submit when tracing is on — `latency_s` decomposes into the
+        #: queue-wait/pad/exec/fetch child spans of that trace
+        self.trace_id = None
 
     def done(self):
         """True once a result or error is set (never blocks)."""
@@ -297,41 +303,64 @@ class BucketedForward:
             self._placed = placed
             return placed
 
-    def _run(self, x_padded):
+    def _run(self, x_padded, _phases=None):
         """One compiled forward at the padded signature; jit fallback when
-        AOT lowering was unavailable or rejects the call convention."""
+        AOT lowering was unavailable or rejects the call convention.
+        ``_phases`` (when given) collects measured ``(name, t0, t1, args)``
+        windows — AOT-cache lookup, device exec — that the serving worker
+        copies into every request trace of the batch."""
         x_struct = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), x_padded)
+        t0 = time.perf_counter() if _phases is not None else 0.0
         ex = self._ensure_compiled(x_struct)
+        if _phases is not None:
+            _phases.append(("serving.aot_lookup", t0, time.perf_counter(),
+                            {"aot": ex is not False}))
         params, state = self._resolve()
         x_dev = self._place(x_padded)
-        if ex is not False:
-            try:
-                return ex(params, state, x_dev)
-            except TypeError:
-                pass  # AOT arg-passing quirk on this jax version
-        return self._jit(params, state, x_dev)
+        t0 = time.perf_counter() if _phases is not None else 0.0
+        try:
+            if ex is not False:
+                try:
+                    return ex(params, state, x_dev)
+                except TypeError:
+                    pass  # AOT arg-passing quirk on this jax version
+            return self._jit(params, state, x_dev)
+        finally:
+            if _phases is not None:
+                _phases.append(("serving.device_exec", t0,
+                                time.perf_counter(), {}))
 
-    def __call__(self, x):
+    def __call__(self, x, _phases=None):
         """Padded, bucketed forward of a host batch (any leading size):
         chunks by the largest bucket, pads each chunk up to its nearest
-        registered bucket, slices real rows back out."""
+        registered bucket, slices real rows back out. ``_phases`` collects
+        per-phase timing windows for causal tracing (serving worker)."""
         x = _as_input(x)
         first = jax.tree_util.tree_leaves(x)[0]
         n = first.shape[0]
         outs = []
         step = self.buckets.max
         for i in range(0, n, step):
+            t0 = time.perf_counter() if _phases is not None else 0.0
             chunk = jax.tree_util.tree_map(
                 lambda a: np.asarray(a[i:i + step], dtype=self.dtype), x)
             real = jax.tree_util.tree_leaves(chunk)[0].shape[0]
             bucket = self.buckets.bucket_for(real)
             padded = _pad_rows_np(chunk, bucket)
+            if _phases is not None:
+                _phases.append(("serving.pad", t0, time.perf_counter(),
+                                {"bucket": bucket,
+                                 "fill": round(real / bucket, 4)}))
             with _tm.span("serving.forward", fill=real / bucket,
                           bucket=bucket):
-                y = self._run(padded)
+                y = self._run(padded, _phases)
+                t0 = time.perf_counter() if _phases is not None else 0.0
                 y = jax.tree_util.tree_map(
                     lambda a: np.asarray(a)[:real], y)
+                if _phases is not None:
+                    _phases.append(("serving.fetch", t0,
+                                    time.perf_counter(), {}))
             if self._reg.enabled:
                 self._m_fill.observe(real / bucket, site=self.site)
             outs.append(y)
@@ -441,7 +470,7 @@ class ServingEngine:
             f"request")
         while True:
             try:
-                _, fut, _t, _dl = self._queue.get_nowait()
+                _, fut, _t, _dl, tctx = self._queue.get_nowait()
             except queue.Empty:
                 break
             if not fut.done():
@@ -449,6 +478,10 @@ class ServingEngine:
                 self._count("errors")
                 if self._reg.enabled:
                     self._m_shed.inc(model=self.name, reason="shutdown")
+            if tctx is not None:
+                # a drained request's trace never completed its causal
+                # story — close it without ringing
+                tctx.abandon()
 
     @property
     def running(self):
@@ -489,13 +522,29 @@ class ServingEngine:
         ``stats()``/the SLO ring like any served traffic — a server driven
         synchronously must not read as idle on /serving."""
         enabled = self._reg.enabled
+        # direct-path trace: same root name as the queued path would be
+        # misleading (no queue-wait exists), so it rings separately
+        tctx = _tracectx.maybe_start("serving.request_direct",
+                                     model=self.name)
         t0 = time.perf_counter()
-        with _tm.span("serving.output", model=self.name):
-            out = self._fwd(x)  # asarray/bucketing happens per chunk
+        try:
+            with _tracectx.attach(tctx):
+                with _tm.span("serving.output", model=self.name):
+                    out = self._fwd(x)  # asarray/bucketing per chunk
+        except BaseException:
+            if tctx is not None:
+                # a failed direct call still completes its causal story
+                # (and must not leave the trace open forever)
+                tctx.finish(status="error")
+            raise
         dt = time.perf_counter() - t0
+        if tctx is not None:
+            tctx.finish()
         n = jax.tree_util.tree_leaves(out)[0].shape[0]
         self._count("served", n)
-        self._note_latencies([dt])  # one observation per call
+        # ctxs: the direct request's trace stamps its latency bucket's
+        # exemplar exactly like the queued path's does
+        self._note_latencies([dt], ctxs=[tctx])
         if enabled:
             self._m_requests.inc(n, model=self.name, outcome="served_direct")
         return out
@@ -512,6 +561,12 @@ class ServingEngine:
             raise ServingShutdown(
                 f"serving engine {self.name!r} is stopped")
         fut = InferenceFuture()
+        # the request's causal trace starts HERE: the root span is the
+        # submit->resolve window, and the drain thread attaches via the
+        # handoff carried in the queue tuple. Tracing off: None, a branch.
+        tctx = _tracectx.maybe_start("serving.request", model=self.name)
+        if tctx is not None:
+            fut.trace_id = tctx.trace_id
         now = time.perf_counter()
         if deadline_s is None:
             deadline_s = self.default_deadline_s
@@ -522,13 +577,30 @@ class ServingEngine:
         try:
             # _as_input, not plain asarray: x may be the dict multi-input
             # form (ComputationGraph) the warmup spec and output() support
-            self._queue.put_nowait((_as_input(x), fut, now, deadline))
+            item = _as_input(x)
+        except BaseException:
+            if tctx is not None:
+                # malformed input (asarray raised): the request never
+                # entered the queue — close its trace, don't leak it
+                tctx.abandon()
+            raise
+        try:
+            self._queue.put_nowait((item, fut, now, deadline,
+                                    None if tctx is None
+                                    else tctx.handoff()))
         except queue.Full:
             self._count("shed_queue_full")
             if self._reg.enabled:
                 self._m_shed.inc(model=self.name, reason="queue_full")
                 self._m_requests.inc(model=self.name,
                                      outcome="shed_queue_full")
+            if tctx is not None:
+                # shed decision as a child span, then the trace completes
+                # (a shed IS an end-to-end outcome worth ringing: the p99
+                # story under overload is "we shed you")
+                tctx.add_span("serving.shed", now, time.perf_counter(),
+                              reason="queue_full")
+                tctx.finish(status="shed")
             raise ServingOverloaded(
                 f"model {self.name!r}: admission queue full "
                 f"({self.max_queue} pending)") from None
@@ -579,7 +651,7 @@ class ServingEngine:
             now = time.perf_counter()
             live = []
             for item in batch:
-                _x, fut, t_sub, deadline = item
+                _x, fut, t_sub, deadline, tctx = item
                 if deadline is not None and now > deadline:
                     # stale request: shed it instead of spending a forward
                     # on an answer nobody is waiting for (deadline-aware
@@ -592,6 +664,11 @@ class ServingEngine:
                         self._m_shed.inc(model=self.name, reason="deadline")
                         self._m_requests.inc(model=self.name,
                                              outcome="shed_deadline")
+                    if tctx is not None:
+                        tctx.add_span("serving.queue_wait", t_sub, now)
+                        tctx.add_span("serving.shed", now, now,
+                                      reason="deadline")
+                        tctx.finish(status="shed")
                     continue
                 live.append(item)
             if self._reg.enabled:
@@ -601,25 +678,47 @@ class ServingEngine:
             # a failing forward (bad input shape, mid-swap architecture
             # mismatch) must fail THESE requests, not kill the serving loop
             try:
+                # phase windows (assemble/pad/aot/exec/fetch) are measured
+                # once per device batch and copied into EVERY member
+                # request's trace — the batch is one device-side event
+                # shared by N causal stories
+                phases = ([] if any(it[4] is not None for it in live)
+                          else None)
                 with _tm.span("serving.batch", model=self.name,
                               size=len(live)):
+                    t_asm = time.perf_counter()
                     xs = jax.tree_util.tree_map(  # stacks dict inputs too
                         lambda *leaves: np.stack(leaves),
                         *[b[0] for b in live])
-                    ys = self._fwd(xs)  # one atomic model snapshot
+                    if phases is not None:
+                        phases.append(("serving.assemble", t_asm,
+                                       time.perf_counter(),
+                                       {"size": len(live)}))
+                    ys = self._fwd(xs, _phases=phases)  # one atomic
+                    #                                     model snapshot
                 done = time.perf_counter()
-                lats = []
-                for (_, fut, t_sub, _dl), y in zip(
+                lats, ctxs = [], []
+                for (_, fut, t_sub, _dl, tctx), y in zip(
                         live, _rows(ys, len(live))):
                     fut.latency_s = done - t_sub
                     fut._set(y)
                     lats.append(done - t_sub)
+                    ctxs.append(tctx)
+                    if tctx is not None:
+                        tctx.add_span("serving.queue_wait", t_sub, now)
+                        for nm, a, b, kw in phases:
+                            tctx.add_span(nm, a, b, **kw)
+                        tctx.add_span("serving.resolve", done,
+                                      time.perf_counter())
+                        tctx.finish()
                 self._count("served", len(live))
-                self._note_latencies(lats, outcome="served")
+                self._note_latencies(lats, outcome="served", ctxs=ctxs)
             except Exception as e:  # noqa: BLE001 — propagate to waiters
-                for _, fut, _t, _dl in live:
+                for _, fut, _t, _dl, tctx in live:
                     if not fut.done():
                         fut._set_error(e)
+                    if tctx is not None:
+                        tctx.finish(status="error")
                 self._count("errors", len(live))
                 if self._reg.enabled:
                     self._m_requests.inc(len(live), model=self.name,
@@ -629,18 +728,22 @@ class ServingEngine:
         with self._lock:
             self._counts[key] += n
 
-    def _note_latencies(self, lats, outcome=None):
+    def _note_latencies(self, lats, outcome=None, ctxs=None):
         """Record request latencies into the rolling SLO ring and refresh
         the p50/p99 gauges; with ``outcome`` each also counts into the
         per-model requests counter (the direct path counts its examples
-        separately, so it passes None)."""
+        separately, so it passes None). ``ctxs`` (aligned with ``lats``)
+        attaches each request's trace context around its observation, so
+        the latency histogram's tail bucket carries that request's
+        exemplar — the p99 gauge links to a concrete trace."""
         with self._lock:
             self._recent_latencies.extend(lats)
             del self._recent_latencies[:-512]
             recent = list(self._recent_latencies)
         if self._reg.enabled:
-            for dt in lats:
-                self._m_latency.observe(dt, model=self.name)
+            for i, dt in enumerate(lats):
+                with _tracectx.attach(ctxs[i] if ctxs else None):
+                    self._m_latency.observe(dt, model=self.name)
                 if outcome is not None:
                     self._m_requests.inc(model=self.name, outcome=outcome)
             self._m_p50.set(float(np.percentile(recent, 50)),

@@ -13,6 +13,11 @@ PerformanceListener / BaseStatsListener / OpProfiler (SURVEY.md §5):
   (``get_tracer().export(path)``), forwarded to
   ``jax.profiler.TraceAnnotation`` so host spans line up with XLA device
   ops in xprof.
+* ``tracectx`` — causal trace contexts over those spans: a request/step
+  trace carried via contextvars, handed across thread boundaries with
+  ``ctx.handoff()`` / ``tracectx.attach(token)``, completed traces
+  ringing into the N-slowest-per-root flight ring (``/traces`` endpoint,
+  ``traces`` CLI verb) and stamping histogram exemplars on ``/metrics``.
 * ``health`` — numerics watchdog: ``health.enable(policy="raise")`` folds
   NaN/Inf flags + grad norms + update/weight ratios into the jitted train
   step and applies the policy (record/warn/``NumericsError``).
@@ -45,15 +50,17 @@ from deeplearning4j_tpu.telemetry.registry import (DEFAULT_BUCKETS, Counter,
                                                    MetricsRegistry,
                                                    get_registry, write_jsonl)
 from deeplearning4j_tpu.telemetry.tracing import Tracer, get_tracer, span
-from deeplearning4j_tpu.telemetry import devices, flight, health, scorepipe
+from deeplearning4j_tpu.telemetry import (devices, flight, health,
+                                          scorepipe, tracectx)
 from deeplearning4j_tpu.telemetry.health import NumericsError
 from deeplearning4j_tpu.telemetry.scorepipe import ScorePipeline
+from deeplearning4j_tpu.telemetry.tracectx import TraceContext
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
            "DEFAULT_BUCKETS", "get_registry", "get_tracer", "span",
            "write_jsonl", "enable", "disable", "enabled", "reset",
            "health", "devices", "flight", "scorepipe", "ScorePipeline",
-           "NumericsError"]
+           "NumericsError", "tracectx", "TraceContext"]
 
 
 def enable():
@@ -81,6 +88,8 @@ def reset():
     health.get_monitor().reset()
     devices.reset()
     flight.get_recorder().clear()
+    tracectx.get_ring().clear()
+    tracectx.reset_open_count()
 
 
 def train_metrics():

@@ -105,6 +105,17 @@ class FlightRecorder:
         if extra:
             doc.update(extra)
         doc["records"] = recs
+        try:
+            # the slow-trace ring rides every dump: a crash report then
+            # carries the complete causal timelines of the slowest
+            # requests/dispatches that preceded the anomaly (read them
+            # back with `traces --file <dump.json>`)
+            from deeplearning4j_tpu.telemetry import tracectx as _tracectx
+            traces = _tracectx.get_ring().snapshot()
+            if traces:
+                doc["traces"] = traces
+        except Exception:
+            pass  # a broken ring must never mask the dump itself
         with open(path, "w") as f:
             json.dump(doc, f, indent=1, default=str)
         path = str(path)

@@ -32,8 +32,20 @@ import json
 import os
 import sys
 import threading
+import time
 
 _INF = float("inf")
+
+#: trace-id source for histogram exemplars, injected by telemetry.tracectx
+#: at import (this module cannot import tracectx — tracectx imports it).
+#: None until tracing is wired; the callable returns the attached trace id
+#: or None, and observe() only consults it on the enabled path.
+_exemplar_source = None
+
+
+def set_exemplar_source(fn):
+    global _exemplar_source
+    _exemplar_source = fn
 
 #: default latency buckets (seconds): 100us .. 60s, roughly log-spaced —
 #: wide enough for both a 200us serving forward and a multi-second
@@ -155,6 +167,12 @@ class Histogram(_Metric):
             return
         k = self._key(labels)
         i = bisect.bisect_left(self.buckets, value)
+        # exemplar (OpenMetrics): each bucket remembers the LAST trace id
+        # that landed in it, so a tail bucket on /metrics links straight
+        # to a concrete slow trace in the ring. Resolved outside the lock;
+        # no trace attached (or tracing off) costs one call + branch.
+        src = _exemplar_source
+        tid = src() if src is not None else None
         with self._lock:
             st = self._series.get(k)
             if st is None:
@@ -164,6 +182,9 @@ class Histogram(_Metric):
             st["counts"][i] += 1
             st["sum"] += value
             st["count"] += 1
+            if tid is not None:
+                st.setdefault("exemplars", {})[i] = {
+                    "trace_id": tid, "value": value, "ts": time.time()}
 
     def count(self, **labels):
         with self._lock:
@@ -200,9 +221,15 @@ class Histogram(_Metric):
         return self.buckets[-1]
 
     def _snapshot_value(self, raw):
-        return {"buckets": dict(zip([*map(str, self.buckets), "+Inf"],
-                                    raw["counts"])),
-                "sum": raw["sum"], "count": raw["count"]}
+        les = [*map(str, self.buckets), "+Inf"]
+        out = {"buckets": dict(zip(les, raw["counts"])),
+               "sum": raw["sum"], "count": raw["count"]}
+        ex = raw.get("exemplars")
+        if ex:
+            # keyed by the bucket's le label — the JSONL/Prometheus
+            # exporters and the acceptance tests read it by bound
+            out["exemplars"] = {les[i]: dict(e) for i, e in ex.items()}
+        return out
 
 
 class MetricsRegistry:
@@ -299,31 +326,51 @@ class MetricsRegistry:
         return None if stream is not None else out.getvalue()
 
     def to_prometheus(self):
-        """Prometheus text exposition format (0.0.4) — served by UIServer's
-        /metrics endpoint."""
+        """OpenMetrics text exposition — served by UIServer's /metrics
+        endpoint (as application/openmetrics-text: bucket-line exemplar
+        suffixes are only legal there, and a classic 0.0.4 parser would
+        reject the whole scrape the moment tracing stamped one). Ends
+        with the spec's ``# EOF`` marker."""
         lines = []
         for name, snap in self.snapshot().items():
             if snap["help"]:
-                lines.append(f"# HELP {name} {snap['help']}")
+                # help text is escaped too (\\ and \n per the exposition
+                # format) — a multi-line help string must not corrupt the
+                # whole scrape
+                lines.append(f"# HELP {name} "
+                             f"{_prom_escape_help(snap['help'])}")
             lines.append(f"# TYPE {name} {snap['kind']}")
             for s in snap["series"]:
                 base = dict(s["labels"])
                 if snap["kind"] == "histogram":
                     v = s["value"]
+                    exemplars = v.get("exemplars") or {}
                     cum = 0
                     # exposition-format buckets are CUMULATIVE (le= means
                     # "observations <= bound"); the snapshot stores raw
                     # per-bucket counts, so accumulate here
                     for le, c in v["buckets"].items():
                         cum += c
-                        lines.append(_prom_line(f"{name}_bucket",
-                                                {**base, "le": le}, cum))
+                        line = _prom_line(f"{name}_bucket",
+                                          {**base, "le": le}, cum)
+                        ex = exemplars.get(le)
+                        if ex is not None:
+                            # OpenMetrics exemplar: the last trace that
+                            # landed in this bucket, linking the gauge to
+                            # a concrete causal timeline
+                            line += (f' # {{trace_id="'
+                                     f'{_prom_escape(ex["trace_id"])}"}} '
+                                     f'{ex["value"]} {ex["ts"]}')
+                        lines.append(line)
                     lines.append(_prom_line(f"{name}_sum", base, v["sum"]))
                     lines.append(_prom_line(f"{name}_count", base,
                                             v["count"]))
                 else:
                     lines.append(_prom_line(name, base, s["value"]))
-        return "\n".join(lines) + "\n" if lines else ""
+        if not lines:
+            return ""
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
 
 
 def _prom_line(name, labels, value):
@@ -335,8 +382,17 @@ def _prom_line(name, labels, value):
 
 
 def _prom_escape(v):
+    """THE label-value escaper (exposition format: backslash, double
+    quote, newline) — label values AND exemplar labels route through this
+    one function, so a model named ``he said "hi"\\n`` cannot corrupt a
+    /metrics scrape anywhere."""
     return str(v).replace("\\", r"\\").replace('"', r'\"').replace("\n",
                                                                    r"\n")
+
+
+def _prom_escape_help(v):
+    # help text escapes backslash and newline only (quotes are legal)
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
 
 
 _default = None

@@ -59,6 +59,19 @@ class ScorePipeline:
     def pending(self):
         return self._pending is not None
 
+    def abandon(self):
+        """Drop the pending entry WITHOUT resolving it (the fit loops'
+        ``finally``): after a clean ``flush()`` this is a no-op, and on
+        the exception path it closes the pending step's trace context so
+        a crashed fit leaves no dangling open trace — resolving would add
+        a device fetch to an already-failing path."""
+        prev, self._pending = self._pending, None
+        if prev is not None:
+            meta = prev[1]
+            tctx = meta.get("trace") if isinstance(meta, dict) else None
+            if tctx is not None:
+                tctx.abandon()
+
     @staticmethod
     def _resolve(item):
         loss, meta = item
@@ -77,8 +90,10 @@ class StepRecordEmitter:
 
     ``meta`` keys: ``step`` (0-based step index), ``iteration``
     (post-increment counter handed to listeners), ``etl_time_s``,
-    ``step_time_s``, ``rec`` (registry was enabled at dispatch) and
-    ``health`` (watchdog active).
+    ``step_time_s``, ``rec`` (registry was enabled at dispatch),
+    ``health`` (watchdog active) and optionally ``trace``/``trace_id``
+    (the step's causal TraceContext — the id is stamped into the flight
+    record and the context is finished once the record lands).
 
     Listener skew, documented: records resolve one step late, so
     ``iteration_done`` for step *i* fires while step *i+1* is already
@@ -110,6 +125,10 @@ class StepRecordEmitter:
             return
         fr = {"step": meta["step"], "step_time_s": meta["step_time_s"],
               "etl_time_s": meta["etl_time_s"], "score": score}
+        if meta.get("trace_id"):
+            # StepRecords are traceable: the flight-recorder ring (and any
+            # dump built from it) links each step to its causal timeline
+            fr["trace_id"] = meta["trace_id"]
         if meta["rec"]:
             self.step_hist.observe(meta["step_time_s"])
             self.etl_hist.observe(meta["etl_time_s"])
@@ -123,6 +142,11 @@ class StepRecordEmitter:
         for lst in self.net.listeners:
             lst.iteration_done(self.net, meta["iteration"], score,
                                meta["etl_time_s"])
+        tctx = meta.get("trace")
+        if tctx is not None:
+            # the step's causal story ends when its score resolved (one
+            # step late) and its record/callbacks landed — ring it now
+            tctx.finish()
 
     def _emit_fused(self, scores, meta, _devices):
         """Fan one fused K-step dispatch into K per-step records: the
@@ -141,6 +165,8 @@ class StepRecordEmitter:
         for j, s in enumerate(scores):
             fr = {"step": step0 + j, "step_time_s": step_t,
                   "etl_time_s": etl_t, "score": s, "fused_k": k}
+            if meta.get("trace_id"):
+                fr["trace_id"] = meta["trace_id"]  # one id for the K steps
             if meta["rec"]:
                 self.step_hist.observe(step_t)
                 self.etl_hist.observe(etl_t)
@@ -152,3 +178,6 @@ class StepRecordEmitter:
                 self.recorder.note(**fr)
             for lst in self.net.listeners:
                 lst.iteration_done(self.net, it0 + j + 1, s, etl_t)
+        tctx = meta.get("trace")
+        if tctx is not None:
+            tctx.finish()  # dispatch trace completes at score resolution

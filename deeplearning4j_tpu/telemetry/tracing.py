@@ -24,16 +24,23 @@ import threading
 import time
 
 from deeplearning4j_tpu.telemetry import registry as _registry
+from deeplearning4j_tpu.telemetry import tracectx as _tracectx
 
 _enabled = _registry.env_enabled()
+_tracectx.set_enabled(_enabled)
 
 _ANNOTATION = None
 _ANNOTATION_TRIED = False
+_PROFILE_STATE = False  # False: unprobed; None: unavailable; else state obj
 
 
 def set_enabled(flag):
     global _enabled
     _enabled = bool(flag)
+    # span tracing and causal trace contexts share ONE toggle — a span
+    # recording while its trace silently drops (or vice versa) was the
+    # same support trap as metrics-without-spans
+    _tracectx.set_enabled(_enabled)
 
 
 def enabled():
@@ -55,6 +62,30 @@ def _trace_annotation():
     return _ANNOTATION
 
 
+def _xprof_active():
+    """True while a jax profiler trace (xprof) is collecting.
+
+    Entering TraceAnnotation with NO active session is pure overhead —
+    and measurably worse than the ~0.4us standalone cost when a producer
+    thread annotates while the consumer is inside a jit dispatch (the
+    TraceMe machinery contends with jax's own dispatch instrumentation;
+    several percent of fused steps/s at CPU bench shapes). So spans
+    forward to xprof only when there is an xprof to land on. The probe is
+    a private jax attribute; when it's unavailable, annotate always (the
+    old behavior — never silently lose xprof rows)."""
+    global _PROFILE_STATE
+    if _PROFILE_STATE is False:
+        try:
+            from jax._src.profiler import _profile_state
+            _PROFILE_STATE = _profile_state
+        except Exception:
+            _PROFILE_STATE = None
+    st = _PROFILE_STATE
+    if st is None:
+        return True
+    return st.profile_session is not None
+
+
 class Tracer:
     """Bounded in-memory buffer of Chrome trace 'X' (complete) events.
 
@@ -71,13 +102,16 @@ class Tracer:
         self.events = []
         self.dropped = 0
         self.epoch = time.perf_counter()
+        # cached: os.getpid() is a real syscall on hardened kernels
+        # (several us — it would dominate the span record cost)
+        self._pid = os.getpid()
 
     def now_us(self):
         return (time.perf_counter() - self.epoch) * 1e6
 
     def add_complete(self, name, ts_us, dur_us, args=None, tid=None):
         ev = {"name": name, "ph": "X", "ts": ts_us, "dur": dur_us,
-              "pid": os.getpid(),
+              "pid": self._pid,
               "tid": threading.get_ident() if tid is None else tid}
         if args:
             ev["args"] = args
@@ -90,7 +124,7 @@ class Tracer:
     def add_instant(self, name, args=None):
         """Point event ('i' phase) — markers like trace-start or hot-swap."""
         ev = {"name": name, "ph": "i", "s": "t", "ts": self.now_us(),
-              "pid": os.getpid(), "tid": threading.get_ident()}
+              "pid": self._pid, "tid": threading.get_ident()}
         if args:
             ev["args"] = args
         with self._lock:
@@ -148,7 +182,7 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("name", "args", "_t0", "_ann")
+    __slots__ = ("name", "args", "_t0", "_ann", "_ctx", "_tok")
 
     def __init__(self, name, args):
         self.name = name
@@ -161,13 +195,24 @@ class _Span:
 
     def __enter__(self):
         self._ann = None
-        ann = _trace_annotation()
-        if ann is not None:
-            try:
-                self._ann = ann(self.name)
-                self._ann.__enter__()
-            except Exception:
-                self._ann = None
+        if _xprof_active():
+            ann = _trace_annotation()
+            if ann is not None:
+                try:
+                    self._ann = ann(self.name)
+                    self._ann.__enter__()
+                except Exception:
+                    self._ann = None
+        # causal linkage: with a TraceContext attached to this thread the
+        # span becomes a child of the innermost enclosing span and pushes
+        # itself as the new parent for anything nested (tracectx). No
+        # context attached -> one contextvar read, nothing else.
+        parent = _tracectx._cvar.get()
+        if parent is not None:
+            self._ctx = parent.child()
+            self._tok = _tracectx._cvar.set(self._ctx)
+        else:
+            self._ctx = self._tok = None
         # start the host clock AFTER the annotation so the Chrome span
         # nests inside (not around) its xprof twin
         self._t0 = time.perf_counter()
@@ -180,10 +225,23 @@ class _Span:
                 self._ann.__exit__(*exc)
             except Exception:
                 pass
+        args = self.args or None
+        ctx = self._ctx
+        if ctx is not None:
+            _tracectx._cvar.reset(self._tok)
+            span_args = dict(self.args) if self.args else {}
+            if exc and exc[0] is not None:
+                span_args["error"] = type(exc[0]).__name__
+            ctx.trace.add(self.name, self._t0, t1, span_id=ctx.span_id,
+                          parent_id=ctx.parent_id, **span_args)
+            # the Chrome event carries the ids too, so a Perfetto row and
+            # a /traces timeline cross-reference by trace_id
+            args = dict(self.args) if self.args else {}
+            args["trace_id"] = ctx.trace_id
+            args["span_id"] = ctx.span_id
         tr = _tracer
         ts = (self._t0 - tr.epoch) * 1e6
-        tr.add_complete(self.name, ts, (t1 - self._t0) * 1e6,
-                        self.args or None)
+        tr.add_complete(self.name, ts, (t1 - self._t0) * 1e6, args)
         return False
 
 

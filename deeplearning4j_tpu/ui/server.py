@@ -12,6 +12,10 @@ HTTP server with a self-contained HTML page (inline SVG charts) —
     GET  /serving                    -> serving-tier status JSON (per-model
                                         queue depth, p50/p99, shed counts,
                                         AOT bucket coverage)
+    GET  /traces                     -> slow-trace flight ring JSON (the N
+                                        slowest complete causal traces per
+                                        root span; ?name= / ?trace_id=
+                                        filter — see telemetry/tracectx)
     GET  /train/sessions             -> session ids
     GET  /train/overview?session=s   -> score curve + timing (JSON)
     GET  /train/model?session=s      -> per-param norms over time (JSON)
@@ -99,13 +103,17 @@ class UIServer:
                 if url.path == "/metrics":
                     # Prometheus text exposition of the process-wide
                     # telemetry registry (reference role: the system tab's
-                    # numbers, now scrapeable by standard tooling)
+                    # numbers, now scrapeable by standard tooling).
+                    # Served as OpenMetrics: exemplar suffixes on bucket
+                    # lines are ONLY legal in openmetrics-text — a classic
+                    # 0.0.4 parser would reject the line and drop the
+                    # whole scrape the moment tracing stamped one.
                     from deeplearning4j_tpu import telemetry
                     body = telemetry.get_registry().to_prometheus().encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4; "
-                                     "charset=utf-8")
+                                     "application/openmetrics-text; "
+                                     "version=1.0.0; charset=utf-8")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
@@ -122,6 +130,27 @@ class UIServer:
                     # process-default ModelRegistry (serving/registry.py)
                     from deeplearning4j_tpu.serving import registry as _sreg
                     self._json(_sreg.get_model_registry().status())
+                    return
+                if url.path == "/traces":
+                    # slow-trace flight ring (telemetry/tracectx.py): the
+                    # N slowest complete causal traces per root-span name
+                    # — the place a /metrics exemplar's trace_id resolves
+                    # to a full submit->resolve timeline. ?name= filters
+                    # one root; ?trace_id= returns a single trace doc.
+                    from deeplearning4j_tpu.telemetry import (
+                        tracectx as _tracectx)
+                    ring = _tracectx.get_ring()
+                    tid = q.get("trace_id", [None])[0]
+                    if tid:
+                        doc = ring.find(tid)
+                        if doc is None:
+                            self._json({"error": f"no trace {tid!r} in "
+                                        "the ring"}, code=404)
+                        else:
+                            self._json(doc)
+                        return
+                    name = q.get("name", [None])[0]
+                    self._json({"traces": ring.snapshot(name)})
                     return
                 if url.path in ("/", "/train", "/train/overview.html"):
                     self._html(_PAGE)
@@ -200,7 +229,7 @@ class UIServer:
         return cls._instance
 
     _KNOWN_PATHS = frozenset((
-        "/", "/metrics", "/health", "/serving", "/train",
+        "/", "/metrics", "/health", "/serving", "/traces", "/train",
         "/train/overview.html",
         "/train/sessions", "/train/overview", "/train/model",
         "/train/model.html", "/train/system", "/train/system.html",

@@ -68,4 +68,20 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
   | tee -a BENCH_smoke.json || {
     echo "tier1: serving bench smoke FAILED"; exit 1; }
 
+# Stage 4: trace-overhead smoke (telemetry/tracectx, ISSUE 8) — causal
+# tracing must stay near-free on the fused step path: adjacent off/on
+# fused-fit leg pairs, gated on the BEST pair's ratio (a real regression
+# — an added sync, per-dispatch churn — taxes every pair; noisy-neighbor
+# jitter doesn't survive the best-of). Fail tier-1 if even the best pair
+# regresses steps/s more than 5%.
+echo "== trace-overhead smoke =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
+  timeout -k 10 300 python bench.py trace_overhead \
+  > /tmp/_trace_overhead.jsonl \
+  && tee -a BENCH_smoke.json < /tmp/_trace_overhead.jsonl > /dev/null \
+  && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python scripts/check_trace_overhead.py /tmp/_trace_overhead.jsonl 5.0 \
+  || { echo "tier1: trace-overhead smoke FAILED (>5% fused steps/s"
+       echo "tier1: regression with tracing on)"; exit 1; }
+
 exit $rc

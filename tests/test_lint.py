@@ -330,6 +330,36 @@ class TestR4ImpureJit:
         """
         assert "R4" not in rule_set(src)
 
+    def test_tracectx_in_traced_fires_with_tailored_message(self):
+        # a contextvar read inside traced code fires at trace time only —
+        # R4 knows tracectx specifically and says where it belongs
+        src = """
+            import jax
+            from deeplearning4j_tpu.telemetry import tracectx as _tracectx
+
+            @jax.jit
+            def step(params):
+                ctx = _tracectx.current()
+                return params
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R4"]
+        assert len(fs) == 1
+        assert "trace-context" in fs[0].message
+        assert "attach/handoff" in fs[0].message
+
+    def test_tracectx_listener_path_silent(self):
+        # tracectx reads are telemetry-gated host bookkeeping — the
+        # listener/drain/producer paths use them freely
+        src = """
+            from deeplearning4j_tpu.telemetry import tracectx as _tracectx
+
+            def iteration_done(self, net, it):
+                ctx = _tracectx.maybe_start("step", it=it)
+                with _tracectx.attach(ctx):
+                    pass
+        """
+        assert "R4" not in rule_set(src)
+
 
 # ----------------------------------------------------------------------
 # R5: unguarded backend-specific calls

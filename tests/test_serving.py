@@ -174,6 +174,9 @@ class TestServingEngine:
         assert st["requests"]["shed_queue_full"] == 1
         shed = fresh.get("serving_shed_total")
         assert shed.value(model="default", reason="queue_full") == 1
+        engine.stop()  # drains the queue, abandoning the queued traces
+        from deeplearning4j_tpu.telemetry import tracectx
+        assert tracectx.open_trace_count() == 0
 
     def test_deadline_shed_while_queued(self, fresh):
         net = _mlp()

@@ -501,7 +501,10 @@ class TestMetricsEndpoint:
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{server.port}/metrics") as r:
                 assert r.status == 200
-                assert r.headers["Content-Type"].startswith("text/plain")
+                # openmetrics-text, NOT text/plain 0.0.4: exemplar
+                # suffixes on bucket lines are only legal in OpenMetrics
+                assert r.headers["Content-Type"].startswith(
+                    "application/openmetrics-text")
                 text = r.read().decode()
         finally:
             server.stop()
