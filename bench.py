@@ -887,6 +887,65 @@ def bench_trace_overhead(reps=8):
             "batch": batch, "k": k, "steps_per_leg": steps}
 
 
+def bench_coldstart():
+    """The instant-restart A/B (utils/compile_cache): four FRESH
+    subprocesses — train and serve, each cold then warm — sharing one
+    workdir. The cold legs populate the persistent XLA cache and save the
+    instant-restart artifacts (train bundle with warm manifest; serving
+    warm manifest); the warm legs restore them. Each leg reports its
+    realized time-to-first-step / time-to-first-request (wall ms from
+    process start) plus the compile_cache_total counters
+    scripts/check_coldstart.py gates on: a warm restart must perform ZERO
+    compiles for manifest-covered signatures (hits > 0, no misses, fused
+    jit cache empty). Timings are recorded, not gated — on CPU both legs
+    are dominated by interpreter+jax import, and the compile delta is the
+    claim under test."""
+    import shutil
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    leg_script = os.path.join(repo, "scripts", "coldstart_leg.py")
+    workdir = tempfile.mkdtemp(prefix="coldstart_")
+    legs = {}
+    try:
+        for kind in ("train", "serve"):
+            for mode in ("cold", "warm"):
+                t0 = time.perf_counter()
+                r = subprocess.run(
+                    [sys.executable, leg_script, kind, mode, workdir],
+                    capture_output=True, text=True, timeout=600)
+                wall_s = time.perf_counter() - t0
+                if r.returncode != 0:
+                    tail = (r.stderr.strip().splitlines()
+                            or ["<no stderr>"])[-1]
+                    raise RuntimeError(
+                        f"coldstart leg {kind}/{mode} rc={r.returncode}: "
+                        f"{tail[:400]}")
+                doc = json.loads(r.stdout.strip().splitlines()[-1])
+                doc["leg_wall_s"] = round(wall_s, 3)
+                legs.setdefault(kind, {})[mode] = doc
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    def ratio(kind, key):
+        cold = legs[kind]["cold"].get(key)
+        warm = legs[kind]["warm"].get(key)
+        if not cold or not warm:
+            return None
+        return round(cold / warm, 2)
+
+    warm_ttfr = legs["serve"]["warm"].get("time_to_first_request_ms")
+    return {"metric": "coldstart_time_to_first_request_ms",
+            "value": round(warm_ttfr, 1) if warm_ttfr else 0,
+            "unit": "ms (warm restart)",
+            # cold/warm speedup measured in THIS run, not a cross-machine
+            # baseline
+            "vs_baseline": ratio("serve", "time_to_first_request_ms"),
+            "first_step_cold_over_warm":
+                ratio("train", "time_to_first_step_ms"),
+            "train": legs["train"], "serving": legs["serve"]}
+
+
 def bench_longcontext():
     """Long-sequence decoder LM: seq 4096 is past the measured flash-attention
     crossover, so this config exercises the fused kernel (the naive path's
@@ -900,7 +959,8 @@ CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
            "lstm": bench_lstm, "word2vec": bench_word2vec,
            "parallel": bench_parallel, "transformer": bench_transformer,
            "longcontext": bench_longcontext, "fused": bench_fused,
-           "serving": bench_serving, "trace_overhead": bench_trace_overhead}
+           "serving": bench_serving, "trace_overhead": bench_trace_overhead,
+           "coldstart": bench_coldstart}
 DEFAULT_ORDER = ["lenet", "resnet50", "lstm", "word2vec", "parallel",
                  "transformer", "longcontext", "fused", "serving"]
 

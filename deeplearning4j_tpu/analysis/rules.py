@@ -481,16 +481,26 @@ class RecompileRule(Rule):
     slug = "recompile"
     description = (
         "recompile hazard: jax.jit/shard_map built inside a loop (one "
-        "fresh XLA compile per iteration) or jit of an inline lambda "
-        "rebuilt per call — the storms telemetry.devices counts after "
-        "the fact, caught before they ship")
+        "fresh XLA compile per iteration), jit of an inline lambda "
+        "rebuilt per call, or a raw .lower().compile() chain outside "
+        "utils/compile_cache — AOT compiles that bypass aot_compile() "
+        "can never be served from a warm manifest, so every restart "
+        "pays them again")
 
     _WRAP_ONLY = ("jax.jit", "jax.pmap")
+
+    #: the one blessed .lower().compile() site — everything else routes
+    #: through aot_compile (deliberate one-shots use the split
+    #: lowered/compile idiom, which this matcher leaves alone)
+    _CACHE_TIER = "utils/compile_cache.py"
 
     def check(self, mod: LintModule):
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
+            chained = self._lower_compile_chain(node, mod)
+            if chained:
+                yield chained
             dotted = mod.dotted(node.func)
             if not _is_tracing_wrapper(dotted):
                 continue
@@ -515,6 +525,29 @@ class RecompileRule(Rule):
                     f"{dotted}(lambda ...) inside a function body builds "
                     "a fresh callable (and compile-cache entry) per call; "
                     "define the function once at module/class scope")
+
+    def _lower_compile_chain(self, node, mod):
+        """A chained ``<jit>.lower(...).compile(...)`` call: outside the
+        cache tier it produces an executable the warm manifest can never
+        serve (utils/compile_cache.aot_compile is the one blessed site —
+        it checks the manifest first and serializes live compiles back)."""
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "compile"
+                and isinstance(f.value, ast.Call)
+                and isinstance(f.value.func, ast.Attribute)
+                and f.value.func.attr == "lower"):
+            return None
+        path = str(mod.path).replace("\\", "/")
+        if path == self._CACHE_TIER or path.endswith("/" + self._CACHE_TIER):
+            return None  # the blessed site itself (anchored on a path
+            #              separator so myutils/compile_cache.py is NOT
+            #              silently exempt)
+        return mod.finding(
+            self.name, self.slug, node,
+            "raw .lower().compile() chain bypasses the compile-artifact "
+            "cache tier: route it through utils/compile_cache.aot_compile "
+            "(manifest-first, zero compiles on a warm restart) or "
+            "suppress with justification for one-shot host tooling")
 
 
 # ----------------------------------------------------------------------

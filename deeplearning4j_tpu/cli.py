@@ -33,7 +33,16 @@ def _build_parser():
         description="TPU-native dl4j: train / serve UI / bench")
     sub = p.add_subparsers(dest="command", required=True)
 
+    def add_compile_cache(sp):
+        sp.add_argument(
+            "--compile-cache", metavar="DIR",
+            help="persistent XLA compilation cache directory "
+                 "(utils/compile_cache): every jit in the process reuses "
+                 "on-disk compilations across restarts; defaults to "
+                 "$DL4J_TPU_COMPILE_CACHE when set")
+
     t = sub.add_parser("train", help="data-parallel training over the mesh")
+    add_compile_cache(t)
     src = t.add_mutually_exclusive_group(required=True)
     src.add_argument("--model-path", help="checkpoint zip to resume")
     src.add_argument("--zoo", help="zoo model name (e.g. lenet)")
@@ -67,6 +76,14 @@ def _build_parser():
         help="production inference server: continuous batching over "
              "AOT-warmed shape buckets, bounded admission queue with "
              "load shedding, /serving status on the dashboard port")
+    add_compile_cache(sv)
+    sv.add_argument("--warm-manifest", metavar="PATH",
+                    help="warm AOT manifest (utils/compile_cache "
+                         "WarmManifest zip): when PATH exists, warmup "
+                         "DESERIALIZES each bucket's executable instead "
+                         "of compiling — zero compiles on a warm restart; "
+                         "the manifest is (re)saved to PATH after warmup "
+                         "so the next restart covers every bucket")
     svsrc = sv.add_mutually_exclusive_group(required=True)
     svsrc.add_argument("--model-path", help="checkpoint zip to serve")
     svsrc.add_argument("--zoo", help="zoo model name (fresh init)")
@@ -96,6 +113,7 @@ def _build_parser():
                          "and exit (CI smoke mode)")
 
     e = sub.add_parser("eval", help="evaluate a checkpoint on a dataset")
+    add_compile_cache(e)
     esrc = e.add_mutually_exclusive_group(required=True)
     esrc.add_argument("--model-path", help="checkpoint zip")
     esrc.add_argument("--zoo", help="zoo model name (fresh init)")
@@ -255,12 +273,26 @@ def _load_xy(args):
     y = np.load(args.labels)
     return x, y
 
+def _enable_compile_cache(args):
+    """Point jax's persistent compile cache at --compile-cache (or
+    $DL4J_TPU_COMPILE_CACHE) BEFORE any jax work compiles — the
+    instant-restart tier every CLI verb shares."""
+    from deeplearning4j_tpu.utils import compile_cache as _cc
+    cache_dir = _cc.enable_persistent_cache(
+        getattr(args, "compile_cache", None))
+    if cache_dir:
+        print(f"persistent compile cache: {cache_dir}")
+    return cache_dir
+
+
 def _cmd_train(args):
     import jax
     from jax.sharding import Mesh
     from deeplearning4j_tpu.parallel.distributed import (
         DistributedMultiLayer, ParameterAveragingTrainingMaster,
         SharedTrainingMaster)
+
+    _enable_compile_cache(args)
 
     # CLI training is the preemptable long-running entry point: a SIGTERM
     # (scheduler eviction) leaves a flight-recorder dump behind
@@ -334,11 +366,15 @@ def _cmd_serve(args):
     from deeplearning4j_tpu.ui import UIServer
 
     telemetry.enable()  # SLO gauges/counters are the point of a server
+    _enable_compile_cache(args)
     net = _load_model(args)
     input_spec = _serve_input_spec(args, net)
     buckets = None
     if args.buckets:
         buckets = [int(b) for b in args.buckets.split(",") if b.strip()]
+    # a not-yet-created path is the normal first cold start: the engine
+    # loads it leniently (missing -> None, no warning)
+    warm_manifest = args.warm_manifest or None
     registry = get_model_registry()
     engine = registry.register(
         args.name, net, input_spec=input_spec,
@@ -346,10 +382,28 @@ def _cmd_serve(args):
         max_queue=args.max_queue,
         default_deadline_s=(None if args.deadline_ms is None
                             else args.deadline_ms / 1e3),
-        batch_window_s=args.batch_window_ms / 1e3)
+        batch_window_s=args.batch_window_ms / 1e3,
+        warm_manifest=warm_manifest)
     st = engine.stats()
+    aot = st["aot"]
+    src = (f"{aot['manifest_hits']} from warm manifest, "
+           f"{aot['warmed'] - aot['manifest_hits']} compiled"
+           if warm_manifest else "compiled")
     print(f"model {args.name!r}: AOT-warmed buckets {st['buckets']} "
-          f"in {st['warmup_s']:.2f}s (input {input_spec})")
+          f"in {st['warmup_s']:.2f}s ({src}; input {input_spec})")
+    if args.warm_manifest:
+        # (re)save AFTER warmup so a cold start's live compiles make the
+        # NEXT restart warm — the instant-restart loop closes here.
+        # Export ONCE: each export serializes (and verify-deserializes)
+        # every executable not already in the manifest
+        manifest = engine.export_warm_manifest()
+        if manifest is not None:
+            manifest.save(args.warm_manifest)
+            print(f"warm manifest: {args.warm_manifest} "
+                  f"({len(manifest)} executable(s))")
+        else:
+            print("warm manifest: backend cannot serialize executables "
+                  "(persistent compile cache still applies)")
     ui_server = UIServer(port=args.port).start()
     print(f"serving status: http://127.0.0.1:{ui_server.port}/serving "
           f"(metrics on /metrics)")
@@ -429,6 +483,7 @@ def _cmd_bench(args):
 def _cmd_eval(args):
     """(reference role: Evaluation printed from MultiLayerNetwork.evaluate /
     the examples' eval.stats() tail — here as a CLI verb)."""
+    _enable_compile_cache(args)
     net = _load_model(args)
     x, y = _load_xy(args)
     preds = []

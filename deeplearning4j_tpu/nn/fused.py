@@ -51,6 +51,7 @@ from deeplearning4j_tpu.telemetry import devices as _devices
 from deeplearning4j_tpu.telemetry import flight as _flight
 from deeplearning4j_tpu.telemetry import health as _health
 from deeplearning4j_tpu.nn import listeners as _listeners
+from deeplearning4j_tpu.utils import compile_cache as _cc
 
 __all__ = ["make_train_steps", "fit_fused"]
 
@@ -70,6 +71,62 @@ def _silence_unusable_donation(fn):
     if hasattr(fn, "_cache_size"):
         call._cache_size = fn._cache_size
     return call
+
+
+class _ManifestDispatch:
+    """Manifest-first dispatch for the fused K-step engine: the first call
+    at each input signature deserializes the scan executable from the warm
+    manifest (zero compiles on a warm restart) or live-compiles through
+    ``compile_cache.aot_compile`` — which serializes the result back into
+    the manifest, so saving the bundle after a cold run makes the next
+    restart warm. Any signature the AOT path cannot serve (serialization
+    unsupported on this backend, arg-convention mismatch) falls back to
+    the plain jit permanently — correctness never depends on the cache."""
+
+    def __init__(self, jitted, manifest, kind):
+        self._jit = jitted
+        self._manifest = manifest
+        self._kind = kind
+        self._by_sig = {}  # signature -> executable | False (jit fallback)
+
+    def _cache_size(self):
+        # recompile telemetry (devices.note_jit_cache) keys off the inner
+        # jit's cache: manifest-served signatures never touch it, so a
+        # warm restart reads 0 new compiles — exactly the claim under test
+        return self._jit._cache_size()
+
+    def __call__(self, *args):
+        # params/state/opt_state (args[:3]) are the net's own device
+        # trees, shape-invariant for this engine's lifetime (conf-fixed
+        # architecture) — so the per-dispatch key normalizes and hashes
+        # the BATCH args only: O(batch leaves), not O(model leaves).
+        # asarray gives one signature for Python-int scalars (step0) at
+        # lower time AND call time; leaf-wise — xs/ys may be dict
+        # pytrees (CG inputs)
+        leaves, treedef = jax.tree_util.tree_flatten(args[3:])
+        leaves = [jnp.asarray(l) for l in leaves]
+        args = args[:3] + tuple(jax.tree_util.tree_unflatten(treedef,
+                                                             leaves))
+        key = (treedef, tuple((l.shape, l.dtype.name) for l in leaves))
+        ex = self._by_sig.get(key)
+        if ex is None:
+            sig = _cc.signature_of(args)
+            try:
+                ex, _src = _cc.aot_compile(self._jit, *args,
+                                           manifest=self._manifest,
+                                           kind=self._kind, signature=sig)
+            except Exception:
+                ex = False  # lowering rejected: serve via the jit path,
+                #             which surfaces any real error
+            self._by_sig[key] = ex
+        if ex is not False:
+            try:
+                return ex(*args)
+            except TypeError:
+                # AOT arg-passing quirk on this jax version: permanent
+                # jit fallback for this signature (never per-call retry)
+                self._by_sig[key] = False
+        return self._jit(*args)
 
 
 def make_train_steps(net, k, donate=True, jit=True, with_health=False,
@@ -123,22 +180,60 @@ def make_train_steps(net, k, donate=True, jit=True, with_health=False,
 
     if not jit:
         return steps_fn
+    manifest = getattr(net, "_warm_manifest", None)
+    if manifest is not None:
+        # a serializable executable must NOT bake in donation: a
+        # deserialized executable loses jax's dispatch-time aliasing
+        # guard, so donating a numpy-backed (zero-copy) super-batch or a
+        # checkpoint-restored param tree frees memory the CALLER still
+        # owns — heap corruption at best. The warm path trades the
+        # donation's HBM reuse for restart-safe executables; K=1 and
+        # manifest-less fused fits keep the donating engine unchanged.
+        if donate:
+            # say so: a model fit near device-memory capacity that
+            # resumes via a bundle would otherwise see peak HBM grow
+            # (params/opt_state no longer reused in-place) with nothing
+            # in the logs explaining why
+            warnings.warn(
+                "warm manifest attached: buffer donation is disabled for "
+                "the fused train engine (serialized executables lose "
+                "jax's aliasing guard), so peak device memory for "
+                "params/opt_state is higher than a manifest-less fit — "
+                "detach the manifest (attach_manifest(net, None)) if "
+                "memory-bound", stacklevel=2)
+        donate = False
     donate_argnums = (0, 1, 2) if donate else ()
     if donate and donate_batch:
         donate_argnums += (3, 4, 7)  # the consumed super-batch
     fused = jax.jit(steps_fn, donate_argnums=donate_argnums)
+    if manifest is not None:
+        # warm restart: the K-step scan executable deserializes from the
+        # checkpoint's manifest (utils/compile_cache) instead of paying
+        # the fused retrace+compile — and a live compile serializes back
+        # in, so the NEXT restart is warm
+        fused = _ManifestDispatch(fused, manifest,
+                                  kind=f"fused:k={int(k)}"
+                                       f":health={int(bool(with_health))}")
     return _silence_unusable_donation(fused) if donate_argnums else fused
 
 
 def _steps_fn_for(net, k, with_health):
-    """Per-net cache of compiled fused engines, keyed (k, with_health)."""
+    """Per-net cache of compiled fused engines, keyed (k, with_health).
+
+    Each entry remembers the manifest it was built against, so
+    ``attach_manifest`` after a cold fit rebuilds the engine on the next
+    one — REPLACING the stale entry (never accumulating one engine, and
+    one manifest's worth of executable blobs, per attach cycle)."""
     cache = getattr(net, "_train_steps_fused", None)
     if cache is None:
         cache = net._train_steps_fused = {}
+    manifest = getattr(net, "_warm_manifest", None)
     key = (int(k), bool(with_health))
-    fn = cache.get(key)
-    if fn is None:
-        fn = cache[key] = make_train_steps(net, k, with_health=with_health)
+    entry = cache.get(key)
+    if entry is not None and entry[1] is manifest:
+        return entry[0]
+    fn = make_train_steps(net, k, with_health=with_health)
+    cache[key] = (fn, manifest)
     return fn
 
 
@@ -231,6 +326,10 @@ def fit_fused(net, batch_factory, *, epochs, k, batch_size=None,
                             # last REAL step's loss; device scalar, no sync
                             net.score_value = losses[n_real - 1]
                             net.iteration += n_real
+                            # cold-start gauge: wall-to-first-dispatch
+                            # (includes the compile this tier removes);
+                            # after the stamp it's a dict read + branch
+                            _cc.note_first_step()
                             if want_score:
                                 meta = {"step": step0,
                                         "iteration": net.iteration,

@@ -37,6 +37,7 @@ from deeplearning4j_tpu.nn import listeners as _listeners
 from deeplearning4j_tpu.nn import updaters as _updaters
 from deeplearning4j_tpu.nn.conf import inputs as _inputs
 from deeplearning4j_tpu.nn.layers import base as _base_layers
+from deeplearning4j_tpu.utils import compile_cache as _cc
 from deeplearning4j_tpu.utils import dtypes as _dtypes
 from deeplearning4j_tpu.utils import serde
 
@@ -1102,6 +1103,9 @@ class ComputationGraph:
                                         bi, bl, self.iteration, sub, bm)
                                 self.score_value = loss  # device scalar
                                 self.iteration += 1
+                                # cold-start gauge (compile_cache): stamped
+                                # once, then a dict read
+                                _cc.note_first_step()
                                 if want_score:
                                     # resolve step i-1 inside the span: the
                                     # fetch overlaps the step just dispatched

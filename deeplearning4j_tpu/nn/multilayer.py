@@ -38,6 +38,7 @@ from deeplearning4j_tpu.nn import listeners as _listeners
 from deeplearning4j_tpu.nn.conf import inputs as _inputs
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers import base as _base
+from deeplearning4j_tpu.utils import compile_cache as _cc
 from deeplearning4j_tpu.utils import dtypes as _dtypes
 
 
@@ -456,6 +457,9 @@ class MultiLayerNetwork:
                                             x, y, self.iteration, step_rng, m)
                                     self.score_value = loss
                                     self.iteration += 1
+                                    # cold-start gauge (compile_cache):
+                                    # stamped once, then a dict read
+                                    _cc.note_first_step()
                                 if want_score:
                                     # queue step i, resolve step i-1 INSIDE the
                                     # span: the blocking fetch overlaps the step

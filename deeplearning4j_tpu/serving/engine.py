@@ -30,6 +30,8 @@ model's params with another's apply_fn, and no queued request is dropped.
 
 from __future__ import annotations
 
+import json
+import os
 import queue
 import threading
 import time
@@ -41,6 +43,7 @@ import numpy as np
 from deeplearning4j_tpu import telemetry as _tm
 from deeplearning4j_tpu.telemetry import tracectx as _tracectx
 from deeplearning4j_tpu.datasets.iterator import BucketRegistry
+from deeplearning4j_tpu.utils import compile_cache as _cc
 
 #: fill-ratio buckets: eighths of the padded bucket (shared with
 #: ParallelInference — "how much of each compiled forward was real work")
@@ -150,13 +153,40 @@ class BucketedForward:
     size with no compiled bucket falls back to a lazy compile, counted into
     ``recompiles_total{site=}`` and the engine's ``aot`` stats — a rising
     ``lazy_compiles`` means the registered buckets don't cover live traffic.
+
+    With a warm ``manifest`` (utils/compile_cache.WarmManifest) the warmup
+    DESERIALIZES each bucket's executable instead of compiling it — a warm
+    restart performs zero compiles for manifest-covered signatures; any
+    key mismatch falls back to a live compile, counted separately
+    (``compile_cache_total{event=miss}`` + the ``manifest_misses`` stat).
+    A manifest built for a different architecture or backend is dropped at
+    construction (``manifest: "mismatch"`` in the aot stats) rather than
+    trusted.
     """
 
     def __init__(self, net, buckets: BucketRegistry, mesh=None,
-                 site="serving", dtype=np.float32):
+                 site="serving", dtype=np.float32, manifest=None):
         self.net = net
         self.mesh = mesh
         self.site = site
+        # mesh executables bake in shardings over a concrete device set:
+        # scope the manifest key by mesh shape + device count so a pod
+        # topology change can never resurrect a stale executable
+        self._manifest_kind = ("serving" if mesh is None else
+                               f"serving:mesh={sorted(mesh.shape.items())}"
+                               f":ndev={len(jax.devices())}")
+        self._manifest_state = "none"
+        if manifest is not None:
+            if manifest.matches(net):
+                self._manifest_state = "attached"
+            else:
+                # counted, surfaced, and refused — executables for another
+                # architecture/backend fail at call time with opaque XLA
+                # errors, not a clean fallback
+                self._manifest_state = "mismatch"
+                _cc.count_event("mismatch_drop")
+                manifest = None
+        self.manifest = manifest
         # dtype=None: serve requests in whatever dtype they arrive
         # (ParallelInference back-compat); a FIXED dtype is what lets the
         # serving engine promise one jit signature per bucket
@@ -196,7 +226,8 @@ class BucketedForward:
         self._warmed = False  # has an AOT warmup declared coverage?
         self._lock = threading.Lock()
         self._aot = {"warmed": 0, "lazy_compiles": 0, "hits": 0,
-                     "jit_serves": 0}
+                     "jit_serves": 0, "manifest_hits": 0,
+                     "manifest_misses": 0}
         reg = self._reg = _tm.get_registry()
         self._m_fill = reg.histogram(
             "serving_batch_fill_ratio",
@@ -252,36 +283,77 @@ class BucketedForward:
                         self._m_aot.inc(result="hit", site=self.site)
                 return ex
             # compile under the lock: two threads racing the same bucket
-            # would otherwise both pay (and double-count) the compile
+            # would otherwise both pay (and double-count) the compile.
+            # Manifest-first: a warm restart deserializes the executable
+            # (src == "manifest", ZERO compiles) and only a key miss pays
+            # a live lower+compile. Serialize-back is warmup-only: a LAZY
+            # compile runs under this lock on the request path, and the
+            # put() verify-deserialize would stall every in-flight
+            # request — export_manifest's save-time walk covers lazy
+            # executables instead.
             try:
-                ex = self._jit.lower(self.net.params, self.net.state,
-                                     x_struct).compile()
+                ex, src = _cc.aot_compile(
+                    self._jit, self.net.params, self.net.state, x_struct,
+                    manifest=self.manifest, kind=self._manifest_kind,
+                    signature=json.dumps(key), serialize_back=warm)
             except Exception:
                 if warm:
                     # startup/update_model warmup must fail FAST: a spec
                     # the model rejects, reported as "warmed", would serve
                     # nothing but errors (or silent lazy compiles)
                     raise
-                ex = False  # odd request signature: serve via the jit
-                            # path, which surfaces any real shape error
+                ex, src = False, "compile"
+                # odd request signature: serve via the jit path, which
+                # surfaces any real shape error
             self._compiled[key] = ex
+            if src == "manifest":
+                self._aot["manifest_hits"] += 1
+            elif self.manifest is not None:
+                self._aot["manifest_misses"] += 1
             if warm:
                 self._aot["warmed"] += 1
             else:
-                self._aot["lazy_compiles"] += 1
-                self._m_aot.inc(result="miss", site=self.site)
-                if self._warmed:
+                if src != "manifest":
+                    # a lazy manifest hit compiles nothing — neither the
+                    # lazy counter nor the aot result="miss" series may
+                    # move for it, or hit-ratio alerts fire on requests
+                    # that never paid a compile
+                    self._aot["lazy_compiles"] += 1
+                    self._m_aot.inc(result="miss", site=self.site)
+                if self._warmed and src != "manifest":
                     # a compile the warmup sweep claimed to cover but
                     # didn't IS a recompile (a shape outside the
                     # registered buckets); cold lazy compiles on an
                     # unwarmed forward are just first-fill
                     self._c_rec.inc(site=self.site)
-            self._c_comp.inc(site=self.site)
+            if src != "manifest":
+                # a manifest-served executable performed no compile —
+                # counting it would make a warm restart's "zero compiles"
+                # claim unfalsifiable
+                self._c_comp.inc(site=self.site)
             return ex
 
     def aot_stats(self):
         with self._lock:
-            return dict(self._aot)
+            return dict(self._aot, manifest=self._manifest_state)
+
+    def export_manifest(self):
+        """The warm manifest covering every executable this forward has
+        compiled (or restored): the attached manifest — autofilled by
+        ``aot_compile`` as live compiles happen — or a fresh one built
+        from the compiled buckets. Save it beside the checkpoint and the
+        next restart's warmup performs zero compiles."""
+        m = self.manifest
+        if m is None:
+            m = _cc.WarmManifest.for_net(self.net)
+        with self._lock:
+            compiled = dict(self._compiled)
+        for key, ex in compiled.items():
+            sig = json.dumps(key)
+            if ex is False or m.has(self._manifest_kind, sig):
+                continue  # jit fallback entries have no executable to ship
+            m.put(self._manifest_kind, sig, ex)
+        return m
 
     def _resolve(self):
         """The (params, state) to serve THIS call: always the net's live
@@ -382,21 +454,31 @@ class ServingEngine:
     def __init__(self, net, *, name="default", input_spec=None,
                  buckets=None, max_batch_size=32, mesh=None, max_queue=256,
                  default_deadline_s=None, batch_window_s=0.0,
-                 dtype=np.float32, warmup=None):
+                 dtype=np.float32, warmup=None, warm_manifest=None):
         self.name = name
         self.mesh = mesh
         self.batch_window_s = batch_window_s
         self.default_deadline_s = default_deadline_s
         self._input_spec = input_spec
         self._dtype = np.dtype(dtype)
+        if isinstance(warm_manifest, (str, os.PathLike)):
+            # a path: the instant-restart artifact saved beside the
+            # checkpoint (save_warm_manifest / utils.serialization bundle).
+            # A truncated/non-zip file degrades to a cold warmup — the
+            # manifest tier never turns a working server into a crash
+            warm_manifest = _cc.WarmManifest.load_lenient(
+                warm_manifest, context=f"warm manifest {warm_manifest!r}")
+        self._warm_manifest = warm_manifest
         if buckets is None:
             buckets = BucketRegistry.powers_of_two(max_batch_size)
         elif not isinstance(buckets, BucketRegistry):
             buckets = BucketRegistry(buckets)
         self._fwd = BucketedForward(net, buckets, mesh,
-                                    site=f"serving:{name}", dtype=dtype)
+                                    site=f"serving:{name}", dtype=dtype,
+                                    manifest=warm_manifest)
         self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
         self.max_queue = max_queue
+        self._pending_rows = 0  # queued EXAMPLES (a batched entry is n)
         self._stop = threading.Event()
         self._thread = None
         self._lock = threading.Lock()
@@ -462,6 +544,14 @@ class ServingEngine:
             self._thread = None
         self._fail_pending()
 
+    def _take(self, block=True, timeout=None):
+        """Pop one queue entry, releasing its admission rows (the submit
+        side charged them). Raises queue.Empty like Queue.get."""
+        item = self._queue.get(block=block, timeout=timeout)
+        with self._lock:
+            self._pending_rows -= item[5] or 1
+        return item
+
     def _fail_pending(self):
         """Drain the queue, failing every pending request with
         :class:`ServingShutdown` (stop(), and submit()'s race guard)."""
@@ -470,7 +560,7 @@ class ServingEngine:
             f"request")
         while True:
             try:
-                _, fut, _t, _dl, tctx = self._queue.get_nowait()
+                _, fut, _t, _dl, tctx, _n = self._take(block=False)
             except queue.Empty:
                 break
             if not fut.done():
@@ -503,7 +593,8 @@ class ServingEngine:
         and no queued request is dropped or errored by the swap."""
         fresh = BucketedForward(net, self._fwd.buckets, self.mesh,
                                 site=f"serving:{self.name}",
-                                dtype=self._dtype)
+                                dtype=self._dtype,
+                                manifest=self._warm_manifest)
         if warm is None:
             warm = self._input_spec is not None
         if warm:
@@ -513,6 +604,24 @@ class ServingEngine:
             fresh.warmup(self._input_spec)
         self._fwd = fresh
         self._count("swaps")
+
+    def export_warm_manifest(self):
+        """The warm manifest covering every executable the served forward
+        holds (utils/compile_cache.WarmManifest) — the instant-restart
+        artifact. Returns None when nothing is serializable."""
+        m = self._fwd.export_manifest()
+        return m if len(m) else None
+
+    def save_warm_manifest(self, path):
+        """Serialize the served executables to ``path`` (zip). A restart
+        that passes ``warm_manifest=path`` then warms up with ZERO
+        compiles for every covered bucket. Returns the path, or None when
+        no executable was serializable (the backend cannot export — the
+        persistent compile cache tier still applies)."""
+        m = self.export_warm_manifest()
+        if m is None:
+            return None
+        return m.save(path)
 
     # ---- request paths ----
 
@@ -538,6 +647,7 @@ class ServingEngine:
                 tctx.finish(status="error")
             raise
         dt = time.perf_counter() - t0
+        _cc.note_first_request()
         if tctx is not None:
             tctx.finish()
         n = jax.tree_util.tree_leaves(out)[0].shape[0]
@@ -549,10 +659,18 @@ class ServingEngine:
             self._m_requests.inc(n, model=self.name, outcome="served_direct")
         return out
 
-    def submit(self, x, deadline_s=None):
-        """Queue ONE example; returns an :class:`InferenceFuture`.
+    def submit(self, x, deadline_s=None, *, batched=False):
+        """Queue ONE example (or, with ``batched=True``, one MULTI-example
+        batch — leading axis = examples); returns ONE
+        :class:`InferenceFuture`. A batched future resolves to the stacked
+        ``[n, ...]`` outputs of its rows; the rows ride the same
+        assemble/pad path as single-example requests, so a client holding
+        a natural batch pays one submit and one wait instead of n.
 
-        Admission control: a full queue sheds the request here
+        Admission control bounds queued EXAMPLES: a batched submit of n
+        rows spends n of the ``max_queue`` slots, so batching cannot
+        smuggle unbounded work past the bound. A full queue sheds the
+        request here
         (``ServingOverloaded``, counted per model) rather than letting the
         backlog grow without bound; ``deadline_s`` (or the engine default)
         sheds it later if it goes stale while queued.
@@ -576,18 +694,67 @@ class ServingEngine:
             self._m_requests.inc(model=self.name, outcome="submitted")
         try:
             # _as_input, not plain asarray: x may be the dict multi-input
-            # form (ComputationGraph) the warmup spec and output() support
+            # form (ComputationGraph) the warmup spec and output() support.
+            # The queue carries [n, ...] ROWS for every entry — a single
+            # example is wrapped to n=1 and unwrapped at resolve, so the
+            # worker has ONE assemble path (concatenate) for both forms.
             item = _as_input(x)
+            if batched:
+                # every leaf must carry the examples on a SHARED axis 0:
+                # a multi-input dict with disagreeing leading dims would
+                # be admitted on leaf one's count and detonate inside the
+                # drain batch, failing innocent co-batched requests
+                dims = {(int(np.shape(l)[0]) if np.ndim(l) else -1)
+                        for l in jax.tree_util.tree_leaves(item)}
+                if len(dims) != 1 or -1 in dims:
+                    raise ValueError(
+                        "batched submit requires every input leaf to "
+                        "carry the examples on axis 0 with one shared "
+                        f"length; got leading dims {sorted(dims)}")
+                nrows = dims.pop()
+                if nrows == 0:
+                    # a 0-row entry would still count as one drain slot
+                    # and shift every other request's resolve slice —
+                    # refuse it here, where the caller can see why
+                    raise ValueError(
+                        "batched submit requires at least one example "
+                        "(got a 0-row batch)")
+                if nrows > self.max_queue:
+                    # can NEVER be admitted: shedding it would read as
+                    # transient load and send a well-behaved client into
+                    # a retry-forever loop — fail it as a sizing error
+                    raise ValueError(
+                        f"batched submit of {nrows} rows exceeds the "
+                        f"admission bound (max_queue={self.max_queue}) "
+                        "and could never be admitted — split the batch "
+                        "or raise max_queue")
+            else:
+                nrows = None
+                item = jax.tree_util.tree_map(lambda a: a[None], item)
         except BaseException:
             if tctx is not None:
                 # malformed input (asarray raised): the request never
                 # entered the queue — close its trace, don't leak it
                 tctx.abandon()
             raise
+        rows = 1 if nrows is None else nrows
         try:
-            self._queue.put_nowait((item, fut, now, deadline,
-                                    None if tctx is None
-                                    else tctx.handoff()))
+            with self._lock:
+                # admission bounds queued EXAMPLES, not queue entries: a
+                # batched entry spends one slot per row, so batching
+                # cannot smuggle unbounded work past the load-shedding
+                # contract max_queue documents
+                if self._pending_rows + rows > self.max_queue:
+                    raise queue.Full
+                self._pending_rows += rows
+            try:
+                self._queue.put_nowait((item, fut, now, deadline,
+                                        None if tctx is None
+                                        else tctx.handoff(), nrows))
+            except queue.Full:
+                with self._lock:
+                    self._pending_rows -= rows
+                raise
         except queue.Full:
             self._count("shed_queue_full")
             if self._reg.enabled:
@@ -610,7 +777,7 @@ class ServingEngine:
             # stragglers) rather than hang the waiter forever
             self._fail_pending()
         if self._reg.enabled:
-            self._m_depth.set(self._queue.qsize(), model=self.name)
+            self._m_depth.set(self._pending_rows, model=self.name)
         return fut
 
     # ---- worker ----
@@ -623,22 +790,27 @@ class ServingEngine:
         stragglers. The worst-case added latency is ``batch_window_s``
         total, not per empty slot."""
         cap = self._fwd.buckets.max
+
+        def rows(b):
+            # entries carry [n, ...] rows (batched submits n > 1); the cap
+            # bounds device-batch ROWS, not queue entries
+            return sum(it[5] or 1 for it in b)
         try:
-            batch = [self._queue.get(timeout=0.05)]
+            batch = [self._take(timeout=0.05)]
         except queue.Empty:
             return []
         try:
-            while len(batch) < cap:
-                batch.append(self._queue.get_nowait())
+            while rows(batch) < cap:
+                batch.append(self._take(block=False))
         except queue.Empty:
             if self.batch_window_s > 0:
                 deadline = time.perf_counter() + self.batch_window_s
-                while len(batch) < cap:
+                while rows(batch) < cap:
                     remaining = deadline - time.perf_counter()
                     if remaining <= 0:
                         break
                     try:
-                        batch.append(self._queue.get(timeout=remaining))
+                        batch.append(self._take(timeout=remaining))
                     except queue.Empty:
                         break
         return batch
@@ -651,7 +823,7 @@ class ServingEngine:
             now = time.perf_counter()
             live = []
             for item in batch:
-                _x, fut, t_sub, deadline, tctx = item
+                _x, fut, t_sub, deadline, tctx, _n = item
                 if deadline is not None and now > deadline:
                     # stale request: shed it instead of spending a forward
                     # on an answer nobody is waiting for (deadline-aware
@@ -672,7 +844,7 @@ class ServingEngine:
                     continue
                 live.append(item)
             if self._reg.enabled:
-                self._m_depth.set(self._queue.qsize(), model=self.name)
+                self._m_depth.set(self._pending_rows, model=self.name)
             if not live:
                 continue
             # a failing forward (bad input shape, mid-swap architecture
@@ -684,22 +856,30 @@ class ServingEngine:
                 # shared by N causal stories
                 phases = ([] if any(it[4] is not None for it in live)
                           else None)
+                n_rows = sum(it[5] or 1 for it in live)
                 with _tm.span("serving.batch", model=self.name,
-                              size=len(live)):
+                              size=n_rows):
                     t_asm = time.perf_counter()
-                    xs = jax.tree_util.tree_map(  # stacks dict inputs too
-                        lambda *leaves: np.stack(leaves),
+                    # every entry is [n, ...] rows (single submits n=1, so
+                    # this is the old stack): concatenate dict inputs too
+                    xs = jax.tree_util.tree_map(
+                        lambda *leaves: np.concatenate(leaves),
                         *[b[0] for b in live])
                     if phases is not None:
                         phases.append(("serving.assemble", t_asm,
                                        time.perf_counter(),
-                                       {"size": len(live)}))
+                                       {"size": n_rows}))
                     ys = self._fwd(xs, _phases=phases)  # one atomic
                     #                                     model snapshot
                 done = time.perf_counter()
-                lats, ctxs = [], []
-                for (_, fut, t_sub, _dl, tctx), y in zip(
-                        live, _rows(ys, len(live))):
+                _cc.note_first_request()
+                lats, ctxs, off = [], [], 0
+                for _, fut, t_sub, _dl, tctx, n in live:
+                    width = n or 1
+                    y = jax.tree_util.tree_map(
+                        lambda a: (a[off:off + width] if n is not None
+                                   else a[off]), ys)
+                    off += width
                     fut.latency_s = done - t_sub
                     fut._set(y)
                     lats.append(done - t_sub)
@@ -711,10 +891,10 @@ class ServingEngine:
                         tctx.add_span("serving.resolve", done,
                                       time.perf_counter())
                         tctx.finish()
-                self._count("served", len(live))
+                self._count("served", n_rows)
                 self._note_latencies(lats, outcome="served", ctxs=ctxs)
             except Exception as e:  # noqa: BLE001 — propagate to waiters
-                for _, fut, _t, _dl, tctx in live:
+                for _, fut, _t, _dl, tctx, _n in live:
                     if not fut.done():
                         fut._set_error(e)
                     if tctx is not None:
@@ -773,7 +953,8 @@ class ServingEngine:
             "buckets": self._fwd.buckets.sizes(),
             "mesh": None if self.mesh is None else dict(self.mesh.shape),
             "max_queue": self.max_queue,
-            "queue_depth": self._queue.qsize(),
+            "queue_depth": self._pending_rows,  # EXAMPLES, matching
+            #                                  the admission bound
             "requests": counts,
             "aot": self._fwd.aot_stats(),
             "warmup_s": self._warmup_s,
@@ -783,8 +964,3 @@ class ServingEngine:
         }
 
 
-def _rows(ys, n):
-    """Iterate the first ``n`` per-example rows of a (pytree of) stacked
-    output(s)."""
-    for i in range(n):
-        yield jax.tree_util.tree_map(lambda a: a[i], ys)

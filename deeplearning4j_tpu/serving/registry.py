@@ -68,8 +68,9 @@ class ModelRegistry:
         with self._lock:
             return sorted(self._engines)
 
-    def submit(self, name, x, deadline_s=None):
-        return self.engine(name).submit(x, deadline_s=deadline_s)
+    def submit(self, name, x, deadline_s=None, *, batched=False):
+        return self.engine(name).submit(x, deadline_s=deadline_s,
+                                        batched=batched)
 
     def output(self, name, x):
         return self.engine(name).output(x)

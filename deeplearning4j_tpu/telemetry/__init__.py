@@ -90,6 +90,10 @@ def reset():
     flight.get_recorder().clear()
     tracectx.get_ring().clear()
     tracectx.reset_open_count()
+    # once-per-process cold-start gauges (time_to_first_step/request):
+    # lazy import — utils.compile_cache imports telemetry lazily back
+    from deeplearning4j_tpu.utils import compile_cache as _cc
+    _cc.reset_marks()
 
 
 def train_metrics():

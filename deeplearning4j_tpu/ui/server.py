@@ -289,6 +289,7 @@ def _health_payload():
     from deeplearning4j_tpu.telemetry import devices as _devices
     from deeplearning4j_tpu.telemetry import flight as _flight
     from deeplearning4j_tpu.telemetry import health as _tm_health
+    from deeplearning4j_tpu.utils import compile_cache as _cc
 
     watchdog = _tm_health.get_monitor().summary()
     recompiles = _devices.recompile_counts()
@@ -304,6 +305,9 @@ def _health_payload():
             "watchdog": watchdog,
             "recompiles": recompiles,
             "memory": _devices.memory_summary(),
+            # the cold-start tax, realized: persistent-cache dir, warm-
+            # manifest hit/miss counts, time-to-first-step/request gauges
+            "compile_cache": _cc.status(),
             "flight": {"records": len(ring),
                        "last_step": ring[-1].get("step") if ring else None,
                        "dumps": list(rec.dumps)}}

@@ -1,0 +1,528 @@
+"""Compile-artifact cache tier: persistent XLA cache + warm AOT manifests.
+
+Every process start pays the full retrace+compile bill — ``serve`` re-runs
+``jit(...).lower().compile()`` per registered bucket, ``train`` recompiles
+the fused K-step scan, and a flight-recorder crash→resume restarts from a
+stone-cold jit cache. At fleet scale (autoscaling, hot-swap deploys) that
+is the dominant time-to-first-request cost. Compiled executables are
+artifacts to persist and ship, not side effects to re-derive (the
+whole-program AOT stance of the Julia-to-TPU paper, PAPERS.md arxiv
+1810.09868; the deployment story of the TensorFlow whitepaper, arxiv
+1603.04467). Two complementary tiers:
+
+* **Persistent compilation cache** — :func:`enable_persistent_cache`
+  points jax's on-disk compile cache (``jax_compilation_cache_dir``) at a
+  directory, with the min-compile-time/min-entry-size thresholds opened up
+  so even small executables persist. Every ``jit`` in the process then
+  reuses on-disk compilations across restarts. Wired through the
+  ``train``/``serve``/``eval`` CLI verbs (``--compile-cache DIR``, env
+  ``DL4J_TPU_COMPILE_CACHE``).
+* **Warm manifest** — :class:`WarmManifest` serializes *specific* AOT
+  executables (``jax.experimental.serialize_executable``) keyed by
+  (model fingerprint, backend+jax version, input shape signature) into an
+  artifact stored beside the checkpoint. ``ServingEngine`` warmup and the
+  fused K-step engine deserialize their executables from it instead of
+  compiling — zero compiles on a warm restart — falling back to a live
+  compile on any key mismatch (counted separately, never trusted
+  silently).
+
+Trust model: manifest entries carry pickled jax pytree defs (the
+``serialize_executable`` wire format), so **loading a warm manifest
+executes pickle** — treat manifests and bundles like the checkpoints
+they ship with: trusted deployment artifacts, never untrusted uploads.
+(The plain ``save_model`` zip remains pickle-free; only the
+``warm_manifest.zip`` member carries pickled data.)
+
+Observability: ``compile_cache_total{event=hit|miss|serialize|
+deserialize_fail}`` counts every manifest interaction, and the
+``time_to_first_step_ms`` / ``time_to_first_request_ms`` gauges record the
+realized cold-start tax (surfaced on ``/health`` and in the ``coldstart``
+bench). All jax interaction goes through :func:`aot_compile` — graftlint
+R3 flags raw ``.lower().compile()`` chains elsewhere, so no compile site
+can silently bypass the manifest tier.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import pickle
+import threading
+import time
+import warnings
+import zipfile
+
+import jax
+import numpy as np
+
+__all__ = ["ENV_CACHE_DIR", "WarmManifest", "aot_compile", "attach_manifest",
+           "backend_fingerprint", "enable_persistent_cache",
+           "model_fingerprint", "note_first_request", "note_first_step",
+           "signature_of", "status"]
+
+#: environment variable naming the persistent compile-cache directory
+ENV_CACHE_DIR = "DL4J_TPU_COMPILE_CACHE"
+
+MANIFEST_VERSION = 1
+
+def _process_start_anchor():
+    """The perf_counter value at PROCESS start — /proc-derived on Linux
+    so the first-step/first-request gauges genuinely include interpreter
+    + jax import (the documented claim, and the dominant fixed cost on
+    CPU); falls back to module-import time elsewhere."""
+    try:
+        with open("/proc/self/stat", "rb") as f:
+            # fields after the parenthesized comm; starttime is stat
+            # field 22 -> index 19 here, in clock ticks since boot
+            fields = f.read().rsplit(b")", 1)[1].split()
+        start_ticks = int(fields[19])
+        with open("/proc/uptime") as f:
+            uptime_s = float(f.read().split()[0])
+        age_s = uptime_s - start_ticks / os.sysconf("SC_CLK_TCK")
+        if age_s > 0:
+            return time.perf_counter() - age_s
+    except Exception:
+        pass
+    return time.perf_counter()
+
+
+#: perf_counter at process start (see _process_start_anchor) — the zero
+#: point of the time_to_first_step/request cold-start gauges
+PROCESS_T0 = _process_start_anchor()
+
+_lock = threading.Lock()
+_first_marks: dict = {}
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+def _instruments():
+    from deeplearning4j_tpu import telemetry as _tm
+    reg = _tm.get_registry()
+    return (reg,
+            reg.counter(
+                "compile_cache_total",
+                "warm-manifest interactions by event: hit (executable "
+                "deserialized, no compile), miss (no entry — live "
+                "compile), serialize (executable written into the "
+                "manifest), serialize_fail (backend cannot export), "
+                "deserialize_fail (entry present but unloadable — live "
+                "compile fallback), mismatch_drop (manifest built for "
+                "another model/backend, refused at load)"),
+            reg.gauge(
+                "time_to_first_step_ms",
+                "wall ms from process start to the first completed train "
+                "dispatch — the realized training cold-start tax"),
+            reg.gauge(
+                "time_to_first_request_ms",
+                "wall ms from process start to the first served inference "
+                "request — the realized serving cold-start tax"))
+
+
+def count_event(event, n=1):
+    """Count one ``compile_cache_total`` interaction (hit/miss/serialize/
+    deserialize_fail)."""
+    _, c, _, _ = _instruments()
+    c.inc(n, event=event)
+
+
+def event_counts():
+    """{event: count} snapshot of ``compile_cache_total`` (for /health and
+    the coldstart bench legs)."""
+    from deeplearning4j_tpu import telemetry as _tm
+    c = _tm.get_registry().get("compile_cache_total")
+    if c is None:
+        return {}
+    return {ls.get("event", ""): c.value(**ls) for ls in c.labelsets()}
+
+
+def note_first_step():
+    """Stamp ``time_to_first_step_ms`` once per process (first completed
+    train dispatch). Subsequent calls are two dict reads and a branch."""
+    return _note_first("step", "time_to_first_step_ms")
+
+
+def note_first_request():
+    """Stamp ``time_to_first_request_ms`` once per process (first served
+    inference request)."""
+    return _note_first("request", "time_to_first_request_ms")
+
+
+def _note_first(mark, gauge_name):
+    if mark in _first_marks:                # cheap unlocked fast path
+        return None
+    with _lock:
+        if mark in _first_marks:
+            return None
+        ms = 1e3 * (time.perf_counter() - PROCESS_T0)
+        _first_marks[mark] = ms
+    reg, _, g_step, g_req = _instruments()
+    (g_step if mark == "step" else g_req).set(ms)
+    return ms
+
+
+def first_marks():
+    """{mark: ms} of the stamped first-step/first-request marks."""
+    with _lock:
+        return dict(_first_marks)
+
+
+def reset_marks():
+    """Forget the once-per-process gauges (test isolation — called from
+    ``telemetry.reset()``)."""
+    with _lock:
+        _first_marks.clear()
+
+
+def status():
+    """The /health ``compile_cache`` payload: persistent-cache dir, event
+    counts, and the realized cold-start gauges."""
+    marks = first_marks()
+    return {
+        "persistent_cache_dir": jax.config.jax_compilation_cache_dir,
+        "events": event_counts(),
+        "time_to_first_step_ms": marks.get("step"),
+        "time_to_first_request_ms": marks.get("request"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache (tier a)
+# ---------------------------------------------------------------------------
+
+def enable_persistent_cache(cache_dir=None, *, min_compile_time_s=0.0):
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    ``cache_dir`` defaults to ``$DL4J_TPU_COMPILE_CACHE``; with neither
+    set this is a no-op returning None (callers wire it unconditionally).
+    ``min_compile_time_s=0`` persists even sub-second compiles — the CPU
+    preflight/bench executables jax's 1s default would silently skip —
+    and the min-entry-size threshold is opened to match. jax-0.4.37
+    compatible: flags that don't exist on the running jax are skipped,
+    and the experimental ``set_cache_dir`` entry point is used as the
+    fallback wiring on releases where the config flag alone is inert.
+    """
+    if cache_dir is None:
+        cache_dir = os.environ.get(ENV_CACHE_DIR)
+    if not cache_dir:
+        return None
+    cache_dir = os.path.abspath(str(cache_dir))
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    for flag, val in (
+            ("jax_persistent_cache_min_compile_time_secs",
+             float(min_compile_time_s)),
+            ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(flag, val)
+        except Exception:
+            pass  # older jax: threshold flag not present
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.set_cache_dir(cache_dir)
+    except Exception:
+        pass
+    return cache_dir
+
+
+# ---------------------------------------------------------------------------
+# fingerprints + signatures
+# ---------------------------------------------------------------------------
+
+def backend_fingerprint():
+    """Backend identity an executable is bound to: jax version + platform
+    + device kind. A manifest from another backend must never load."""
+    try:
+        dev = jax.devices()[0]
+        plat = dev.platform
+        kind = getattr(dev, "device_kind", "?")
+    except Exception:
+        plat, kind = "?", "?"
+    return f"jax-{jax.__version__}/{plat}/{kind}"
+
+
+def model_fingerprint(net):
+    """Architecture fingerprint: config JSON + param/state tree paths,
+    shapes and dtypes. Deliberately value-free — XLA executables depend on
+    shapes, not weights, so a retrained checkpoint of the same
+    architecture reuses its manifest."""
+    h = hashlib.sha256()
+    conf = getattr(net, "conf", None)
+    try:
+        h.update(conf.to_json().encode())
+    except Exception:
+        h.update(repr(type(net)).encode())
+    trees = (getattr(net, "params", None), getattr(net, "state", None))
+    for path, leaf in jax.tree_util.tree_flatten_with_path(trees)[0]:
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(str(tuple(np.shape(leaf))).encode())
+        h.update(str(getattr(leaf, "dtype", type(leaf).__name__)).encode())
+    return h.hexdigest()
+
+
+def signature_of(args):
+    """Canonical input-signature string for a pytree of arrays / structs:
+    tree structure + per-leaf (shape, dtype). The manifest key a warm
+    process can recompute without compiling anything."""
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sig = [(tuple(int(d) for d in np.shape(l)),
+            str(getattr(l, "dtype", None) or np.asarray(l).dtype))
+           for l in leaves]
+    return json.dumps([str(treedef), sig], separators=(",", ":"))
+
+
+# ---------------------------------------------------------------------------
+# warm manifest (tier b)
+# ---------------------------------------------------------------------------
+
+class WarmManifest:
+    """Serialized AOT executables keyed by (kind, input signature), scoped
+    to ONE (model fingerprint, backend fingerprint) pair.
+
+    ``put`` serializes a compiled executable
+    (``jax.experimental.serialize_executable``) into the manifest;
+    ``load_executable`` deserializes one back — every interaction counts
+    into ``compile_cache_total``. ``save``/``load`` round-trip the whole
+    manifest as a zip (one entry per executable + a JSON header), and
+    ``to_bytes``/``from_bytes`` embed it inside a checkpoint bundle
+    (utils/serialization.save_bundle)."""
+
+    def __init__(self, model_fp=None, backend_fp=None):
+        self.model_fp = model_fp
+        self.backend_fp = backend_fp or backend_fingerprint()
+        self._entries = {}  # (kind, signature) -> pickled (payload, trees)
+        self._mlock = threading.Lock()
+
+    @classmethod
+    def for_net(cls, net):
+        """A fresh manifest scoped to ``net``'s architecture on this
+        backend."""
+        return cls(model_fingerprint(net))
+
+    def matches(self, net):
+        """True when this manifest's executables were built for ``net``'s
+        architecture on the running backend — the load-time gate before
+        any executable is trusted."""
+        return (self.model_fp == model_fingerprint(net)
+                and self.backend_fp == backend_fingerprint())
+
+    def __len__(self):
+        with self._mlock:
+            return len(self._entries)
+
+    def keys(self):
+        with self._mlock:
+            return sorted(self._entries)
+
+    def has(self, kind, signature):
+        """Uncounted membership probe (export paths — not a cache read)."""
+        with self._mlock:
+            return (str(kind), str(signature)) in self._entries
+
+    # -- executables ---------------------------------------------------
+
+    def put(self, kind, signature, compiled):
+        """Serialize ``compiled`` under (kind, signature). Returns True on
+        success; a non-serializable executable (backend quirk) is counted
+        and skipped — the manifest never hard-fails a working compile.
+
+        The blob is VERIFIED by deserializing it once before it is kept:
+        on some jax releases an executable served from the persistent
+        compilation cache serializes cleanly but cannot load back
+        ("Symbols not found") — catching that here turns a warm-restart
+        surprise into a save-time fallback."""
+        from jax.experimental import serialize_executable as _se
+        try:
+            payload, in_tree, out_tree = _se.serialize(compiled)
+            blob = pickle.dumps((payload, in_tree, out_tree),
+                                protocol=pickle.HIGHEST_PROTOCOL)
+            _se.deserialize_and_load(*pickle.loads(blob))
+        except Exception:
+            count_event("serialize_fail")
+            return False
+        with self._mlock:
+            self._entries[(str(kind), str(signature))] = blob
+        count_event("serialize")
+        return True
+
+    def load_executable(self, kind, signature):
+        """The deserialized executable for (kind, signature), or None
+        (counted as miss / deserialize_fail — the caller live-compiles)."""
+        with self._mlock:
+            blob = self._entries.get((str(kind), str(signature)))
+        if blob is None:
+            count_event("miss")
+            return None
+        from jax.experimental import serialize_executable as _se
+        try:
+            payload, in_tree, out_tree = pickle.loads(blob)
+            loaded = _se.deserialize_and_load(payload, in_tree, out_tree)
+        except Exception:
+            count_event("deserialize_fail")
+            return None
+        count_event("hit")
+        return loaded
+
+    # -- persistence ---------------------------------------------------
+
+    def _write_zip(self, z):
+        with self._mlock:
+            entries = dict(self._entries)
+        names = []
+        for i, ((kind, sig), blob) in enumerate(sorted(entries.items())):
+            fname = f"exec_{i:04d}.bin"
+            names.append({"kind": kind, "signature": sig, "file": fname})
+            z.writestr(fname, blob)
+        z.writestr("manifest.json", json.dumps({
+            "manifest_version": MANIFEST_VERSION,
+            "model_fp": self.model_fp,
+            "backend_fp": self.backend_fp,
+            "jax_version": jax.__version__,
+            "entries": names}, indent=1))
+
+    @classmethod
+    def _read_zip(cls, z):
+        meta = json.loads(z.read("manifest.json"))
+        if meta.get("manifest_version", 0) > MANIFEST_VERSION:
+            raise ValueError(
+                f"warm manifest version {meta['manifest_version']} is "
+                f"newer than supported {MANIFEST_VERSION}")
+        m = cls(meta.get("model_fp"), meta.get("backend_fp"))
+        for e in meta.get("entries", ()):
+            m._entries[(e["kind"], e["signature"])] = z.read(e["file"])
+        return m
+
+    def save(self, path):
+        """Write the manifest zip (atomic: tmp + rename, so a crashed
+        writer never leaves a truncated manifest a warm restart would
+        choke on)."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
+                self._write_zip(z)
+            os.replace(tmp, path)
+        except BaseException:
+            # a failed write (disk full, serialization error) must not
+            # leave orphan temp blobs accumulating beside the checkpoint
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+
+    @classmethod
+    def load(cls, path):
+        with zipfile.ZipFile(path) as z:
+            return cls._read_zip(z)
+
+    @classmethod
+    def load_lenient(cls, source, context="warm manifest"):
+        """``load`` (path) / ``from_bytes`` (bytes) that degrades instead
+        of raising: a truncated or non-zip artifact warns, counts a
+        ``deserialize_fail``, and returns None — the cache tier must
+        never turn a working restart into a crash. The one shared
+        corrupt-manifest path for ServingEngine, load_bundle and the
+        sharded-checkpoint extras."""
+        try:
+            if isinstance(source, bytes):
+                return cls.from_bytes(source)
+            return cls.load(source)
+        except FileNotFoundError:
+            # not-yet-created is the normal FIRST cold start of the
+            # documented save-after-warmup loop — no warning, no
+            # deserialize_fail (that counter means a POISONED artifact)
+            return None
+        except Exception:
+            warnings.warn(
+                f"{context} is unreadable (corrupt or not a manifest "
+                "zip) — ignoring it; the next warmup/fit pays live "
+                "compiles", stacklevel=3)
+            count_event("deserialize_fail")
+            return None
+
+    def to_bytes(self):
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            self._write_zip(z)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data):
+        with zipfile.ZipFile(io.BytesIO(data)) as z:
+            return cls._read_zip(z)
+
+
+# ---------------------------------------------------------------------------
+# the one blessed compile site
+# ---------------------------------------------------------------------------
+
+def aot_compile(jitted, *args, manifest=None, kind="jit", signature=None,
+                serialize_back=True):
+    """Manifest-first AOT compile: the ONE ``.lower().compile()`` site.
+
+    Returns ``(executable, source)`` with source ``"manifest"`` (warm —
+    deserialized, zero compiles) or ``"compile"`` (live — lowered and
+    compiled now, and serialized back into the manifest so the NEXT
+    restart is warm). ``serialize_back=False`` skips that write-back —
+    for compiles on a latency-sensitive path (a serving lazy compile
+    under the forward lock), where the export walk at save time picks
+    the executable up instead. graftlint R3 flags raw
+    ``.lower().compile()`` chains outside this module, so serving/fused
+    compiles cannot silently bypass the cache tier."""
+    sig = signature if signature is not None else signature_of(args)
+    if manifest is not None:
+        ex = manifest.load_executable(kind, sig)
+        if ex is not None:
+            return ex, "manifest"
+    with warnings.catch_warnings():
+        # donated buffers rarely match an output shape; the warning is
+        # per-compile noise, the donation is still wanted (see nn/fused)
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        ex = jitted.lower(*args).compile()
+    if manifest is not None and serialize_back:
+        manifest.put(kind, sig, ex)
+    return ex, "compile"
+
+
+def attach_if_matches(net, manifest, context):
+    """The ONE restore-side refusal policy: attach ``manifest`` when it
+    was built for ``net`` on this backend; otherwise warn with
+    ``context``, count a ``mismatch_drop``, and return None (the
+    checkpoint itself still restores — the next fit pays a live
+    compile). Shared by load_bundle and the sharded-checkpoint extras."""
+    if manifest is None:
+        return None
+    if manifest.matches(net):
+        attach_manifest(net, manifest)
+        return manifest
+    warnings.warn(
+        f"{context}: warm manifest was built for "
+        f"model={manifest.model_fp!r} on backend={manifest.backend_fp!r} "
+        "— not this net/backend; dropping it (state restored; the next "
+        "fit pays a live compile)", stacklevel=3)
+    count_event("mismatch_drop")
+    return None
+
+
+def attach_manifest(net, manifest):
+    """Bind ``manifest`` to ``net`` so the fused fit engine
+    (nn/fused.make_train_steps) serves its K-step scan executable from it.
+    A manifest built for a different architecture/backend is refused —
+    an executable that half-matches would fail at call time with an
+    opaque XLA error instead of a clean fallback."""
+    if manifest is not None and not manifest.matches(net):
+        raise ValueError(
+            "warm manifest does not match this net/backend "
+            f"(manifest model={manifest.model_fp!r} "
+            f"backend={manifest.backend_fp!r}, "
+            f"net model={model_fingerprint(net)!r} "
+            f"backend={backend_fingerprint()!r})")
+    net._warm_manifest = manifest
+    return net

@@ -10,7 +10,19 @@ Layout inside the zip:
     format.json     {"format_version": 1, "kind": "multilayer"|"graph",
                      "iteration": N, "epoch": N}
     config.json     network configuration (serde JSON)
-    arrays.npz      flat {path -> ndarray} for params/state/opt_state pytrees
+    arrays.npz      flat {path -> ndarray} for params/state/opt_state
+                    pytrees + the step RNG chain ("rng"), so a resumed run
+                    continues the SAME dropout/shuffle key sequence instead
+                    of replaying from the seed
+    buckets.json    (bundle only) the BucketRegistry sizes the job compiled
+    warm_manifest.zip  (bundle only) serialized AOT executables
+                    (utils/compile_cache.WarmManifest) — the instant-restart
+                    artifact: a warm restart recompiles nothing
+
+``save_bundle``/``load_bundle`` fold checkpoint + opt_state + RNG chain +
+bucket registry + warm manifest into ONE resumable unit; ``save_model``
+zips remain loadable by ``load_bundle`` (extras absent) and bundles by
+``load_model`` (extras ignored).
 """
 
 from __future__ import annotations
@@ -18,6 +30,7 @@ from __future__ import annotations
 import io
 import json
 import zipfile
+from dataclasses import dataclass
 
 import jax
 import numpy as np
@@ -41,8 +54,8 @@ def _unflatten_like(template, arrays, prefix):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def save_model(net, path, *, save_updater=True):
-    """Write a MultiLayerNetwork or ComputationGraph checkpoint."""
+def _write_model(z, net, save_updater):
+    """Write the model entries (format/config/arrays) into an open zip."""
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     kind = "graph" if isinstance(net, ComputationGraph) else "multilayer"
     arrays = {}
@@ -50,25 +63,34 @@ def save_model(net, path, *, save_updater=True):
     arrays.update(_flatten_tree(net.state, "state"))
     if save_updater and net.opt_state is not None:
         arrays.update(_flatten_tree(net.opt_state, "opt"))
+    rng = getattr(net, "_rng", None)
+    if rng is not None:
+        # the RNG chain: without it a resumed run replays the seed's
+        # dropout/shuffle keys instead of continuing from step N+1 —
+        # crash→resume would diverge from the uninterrupted run
+        arrays["rng"] = np.asarray(rng)
     meta = {"format_version": FORMAT_VERSION, "kind": kind,
             "iteration": net.iteration, "epoch": net.epoch,
-            "has_updater": bool(save_updater and net.opt_state is not None)}
+            "has_updater": bool(save_updater and net.opt_state is not None),
+            "has_rng": rng is not None}
     buf = io.BytesIO()
     np.savez(buf, **arrays)
+    z.writestr("format.json", json.dumps(meta))
+    z.writestr("config.json", net.conf.to_json())
+    z.writestr("arrays.npz", buf.getvalue())
+
+
+def save_model(net, path, *, save_updater=True):
+    """Write a MultiLayerNetwork or ComputationGraph checkpoint."""
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-        z.writestr("format.json", json.dumps(meta))
-        z.writestr("config.json", net.conf.to_json())
-        z.writestr("arrays.npz", buf.getvalue())
+        _write_model(z, net, save_updater)
     return path
 
 
-def load_model(path):
-    """Restore a model (auto-detects kind). Returns the network with params,
-    state, opt_state, iteration/epoch restored."""
-    with zipfile.ZipFile(path) as z:
-        meta = json.loads(z.read("format.json"))
-        config_json = z.read("config.json").decode()
-        arrays = dict(np.load(io.BytesIO(z.read("arrays.npz"))))
+def _read_model(z):
+    meta = json.loads(z.read("format.json"))
+    config_json = z.read("config.json").decode()
+    arrays = dict(np.load(io.BytesIO(z.read("arrays.npz"))))
     if meta["format_version"] > FORMAT_VERSION:
         raise ValueError(f"Checkpoint format {meta['format_version']} is newer "
                          f"than supported {FORMAT_VERSION}")
@@ -84,13 +106,87 @@ def load_model(path):
     net.state = _unflatten_like(net.state, arrays, "state")
     if meta.get("has_updater"):
         net.opt_state = _unflatten_like(net.opt_state, arrays, "opt")
+    if meta.get("has_rng"):
+        import jax.numpy as jnp
+        net._rng = jnp.asarray(arrays["rng"])
     net.iteration = meta.get("iteration", 0)
     net.epoch = meta.get("epoch", 0)
     return net
 
 
+def load_model(path):
+    """Restore a model (auto-detects kind). Returns the network with params,
+    state, opt_state, RNG chain, iteration/epoch restored."""
+    with zipfile.ZipFile(path) as z:
+        return _read_model(z)
+
+
 restore_multilayer_network = load_model
 restore_computation_graph = load_model
+
+
+def bucket_sizes(buckets):
+    """Normalize a BucketRegistry or iterable of sizes to a sorted int
+    list (the buckets.json wire form — shared with sharded_checkpoint)."""
+    if hasattr(buckets, "sizes"):
+        return buckets.sizes()
+    return sorted(int(b) for b in buckets)
+
+
+@dataclass
+class Bundle:
+    """One resumable unit: the restored net (params/state/opt_state/RNG/
+    iteration), the bucket registry the job compiled for, and the warm
+    manifest its executables deserialize from (already attached to the net
+    when it matches this backend)."""
+    net: object
+    buckets: object = None    # datasets.iterator.BucketRegistry | None
+    manifest: object = None   # utils.compile_cache.WarmManifest | None
+
+
+def save_bundle(net, path, *, buckets=None, manifest=None,
+                save_updater=True):
+    """Write the INSTANT-RESTART unit: checkpoint + opt_state + RNG chain
+    + bucket registry + warm AOT manifest in one zip. ``manifest``
+    defaults to the net's attached manifest (compile_cache.attach_manifest
+    — autofilled by fused-fit live compiles); ``buckets`` accepts a
+    BucketRegistry or an iterable of sizes. ``load_bundle`` resumes from
+    it with zero recompiles for every manifest-covered signature."""
+    if manifest is None:
+        manifest = getattr(net, "_warm_manifest", None)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        _write_model(z, net, save_updater)
+        if buckets is not None:
+            z.writestr("buckets.json", json.dumps(bucket_sizes(buckets)))
+        if manifest is not None and len(manifest):
+            z.writestr("warm_manifest.zip", manifest.to_bytes())
+    return path
+
+
+def load_bundle(path):
+    """Restore a :class:`Bundle`. A manifest built for another
+    architecture or backend (different jax version, device kind) is
+    DROPPED with a warning instead of trusted — its executables would fail
+    at call time with opaque XLA errors; the checkpoint itself still
+    loads, and the first fit simply pays the compile (and can re-save a
+    fresh manifest)."""
+    from deeplearning4j_tpu.utils import compile_cache as _cc
+    with zipfile.ZipFile(path) as z:
+        net = _read_model(z)
+        names = set(z.namelist())
+        buckets = None
+        if "buckets.json" in names:
+            from deeplearning4j_tpu.datasets.iterator import BucketRegistry
+            buckets = BucketRegistry(json.loads(z.read("buckets.json")))
+        manifest = None
+        if "warm_manifest.zip" in names:
+            # lenient: a corrupt embedded manifest must not take the
+            # checkpoint down with it — restore the net, pay compiles
+            manifest = _cc.WarmManifest.load_lenient(
+                z.read("warm_manifest.zip"),
+                context=f"bundle {path}: embedded warm manifest")
+    manifest = _cc.attach_if_matches(net, manifest, f"bundle {path}")
+    return Bundle(net=net, buckets=buckets, manifest=manifest)
 
 
 def add_normalizer_to_model(path, normalizer):

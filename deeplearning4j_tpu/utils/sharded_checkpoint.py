@@ -68,15 +68,44 @@ def _trainer_tree(trainer):
     return tree
 
 
-def save_trainer(path, trainer):
+#: the bundle sidecar inside the orbax checkpoint directory (orbax's
+#: template-driven restore reads only its own item files, so the extra
+#: entry rides along without touching the sharded-array layout)
+_EXTRAS_NAME = "dl4j_bundle_extras.zip"
+
+
+def save_trainer(path, trainer, *, buckets=None, manifest=None):
     """Checkpoint a ParallelTrainer / PipelineParallelLM, preserving
-    shardings."""
-    return save_sharded(path, _trainer_tree(trainer))
+    shardings. ``buckets`` (BucketRegistry / sizes) and ``manifest``
+    (utils/compile_cache.WarmManifest; defaults to the trainer net's
+    attached one) fold into the same directory, making it the distributed
+    tier's instant-restart unit — the single-process analog is
+    ``utils.serialization.save_bundle``."""
+    import json
+    import zipfile
+
+    path = save_sharded(path, _trainer_tree(trainer))
+    net = getattr(trainer, "net", trainer)
+    if manifest is None:
+        manifest = getattr(net, "_warm_manifest", None)
+    if buckets is not None or (manifest is not None and len(manifest)):
+        from deeplearning4j_tpu.utils.serialization import bucket_sizes
+        with zipfile.ZipFile(os.path.join(path, _EXTRAS_NAME), "w",
+                             zipfile.ZIP_DEFLATED) as z:
+            if buckets is not None:
+                z.writestr("buckets.json", json.dumps(bucket_sizes(buckets)))
+            if manifest is not None and len(manifest):
+                z.writestr("warm_manifest.zip", manifest.to_bytes())
+    return path
 
 
 def restore_trainer(path, trainer):
     """Restore into an initialized trainer (its current params/opt_state
-    provide the sharding template). Returns the trainer."""
+    provide the sharding template). Returns the trainer with params,
+    opt_state, mutable state, RNG chain and iteration restored; bundle
+    extras (bucket registry, warm manifest) land on ``trainer.buckets`` /
+    the net via ``compile_cache.attach_manifest`` when present and
+    matching this backend."""
     if trainer.params is None:
         trainer.init()
     tree = restore_sharded(path, _trainer_tree(trainer))
@@ -87,4 +116,29 @@ def restore_trainer(path, trainer):
         trainer.state = tree["state"]
     if "rng" in tree:
         trainer._rng = tree["rng"]
+    _restore_extras(path, trainer)
     return trainer
+
+
+def _restore_extras(path, trainer):
+    import json
+    import zipfile
+
+    extras = os.path.join(os.path.abspath(str(path)), _EXTRAS_NAME)
+    if not os.path.exists(extras):
+        return
+    from deeplearning4j_tpu.utils import compile_cache as _cc
+    with zipfile.ZipFile(extras) as z:
+        names = set(z.namelist())
+        if "buckets.json" in names:
+            from deeplearning4j_tpu.datasets.iterator import BucketRegistry
+            trainer.buckets = BucketRegistry(
+                json.loads(z.read("buckets.json")))
+        if "warm_manifest.zip" in names:
+            manifest = _cc.WarmManifest.load_lenient(
+                z.read("warm_manifest.zip"),
+                context=f"checkpoint {path}: embedded warm manifest")
+            if manifest is None:
+                return
+            net = getattr(trainer, "net", trainer)
+            _cc.attach_if_matches(net, manifest, f"checkpoint {path}")

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""tier1.sh cold-start gate: parse a `bench.py coldstart` JSONL stream and
+fail unless the warm restart held the instant-restart contract.
+
+Counter-based, not timing-based — on CPU both legs are dominated by
+interpreter+jax import, so wall deltas are jitter; the CLAIM under test is
+"a warm restart performs zero compiles for manifest-covered signatures":
+
+* warm TRAIN leg: ``compile_cache_total{event=hit}`` > 0, no miss /
+  deserialize_fail, and the fused engine's inner jit cache is EMPTY
+  (0 compiles — every dispatch ran the deserialized executable);
+* warm SERVE leg: every warmed bucket came from the manifest
+  (``manifest_hits == warmed``), no lazy compiles, no misses;
+* both warm legs actually stamped their time_to_first_* gauge (the
+  cold-vs-warm A/B is recorded, whatever the host's timing noise).
+
+Usage: check_coldstart.py <jsonl-file>
+"""
+
+import json
+import sys
+
+
+def main(argv):
+    path = argv[1]
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    recs = [r for r in rows
+            if str(r.get("metric", "")).startswith("coldstart")]
+    if not recs:
+        print("check_coldstart: no coldstart record in", path)
+        return 1
+    rec = recs[-1]
+    if "FAILED" in rec.get("metric", ""):
+        print("check_coldstart: bench leg failed:", rec.get("error"))
+        return 1
+    errors = []
+
+    tw = rec["train"]["warm"]
+    ev = tw.get("events", {})
+    if not ev.get("hit"):
+        errors.append(f"warm train leg deserialized nothing: events={ev}")
+    for bad in ("miss", "deserialize_fail"):
+        if ev.get(bad):
+            errors.append(f"warm train leg counted {bad}={ev[bad]} "
+                          "(manifest did not cover the fused signature)")
+    if tw.get("fused_jit_compiles", 1) != 0:
+        errors.append(f"warm train leg compiled "
+                      f"{tw['fused_jit_compiles']} fused engine(s) "
+                      "(recompiles delta must be 0)")
+    if not tw.get("time_to_first_step_ms"):
+        errors.append("warm train leg never stamped time_to_first_step_ms")
+
+    sw = rec["serving"]["warm"]
+    aot = sw.get("aot", {})
+    if not aot.get("manifest_hits"):
+        errors.append(f"warm serve leg hit no manifest entries: aot={aot}")
+    if aot.get("manifest_hits") != aot.get("warmed"):
+        errors.append(f"warm serve leg compiled buckets the manifest "
+                      f"should cover: aot={aot}")
+    if aot.get("lazy_compiles") or aot.get("manifest_misses"):
+        errors.append(f"warm serve leg paid live compiles: aot={aot}")
+    if not sw.get("time_to_first_request_ms"):
+        errors.append("warm serve leg never stamped "
+                      "time_to_first_request_ms")
+
+    step_x = rec.get("first_step_cold_over_warm")
+    req_x = rec.get("vs_baseline")
+
+    def ms(v):
+        # a leg that never stamped its gauge reports None — the errors
+        # list carries the failure; the summary must still print
+        return "unstamped" if v is None else f"{v:.0f} ms"
+    print(f"coldstart: warm first-step {ms(tw.get('time_to_first_step_ms'))}"
+          f" ({step_x}x faster than cold), warm first-request "
+          f"{ms(sw.get('time_to_first_request_ms'))} ({req_x}x), "
+          f"warm compiles: train={tw.get('fused_jit_compiles')} "
+          f"serve_lazy={aot.get('lazy_compiles')}")
+    for e in errors:
+        print("check_coldstart FAIL:", e)
+    if not errors:
+        print("check_coldstart: warm restart performed zero compiles "
+              "(manifest hits only) — instant-restart contract holds")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -84,4 +84,20 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
   || { echo "tier1: trace-overhead smoke FAILED (>5% fused steps/s"
        echo "tier1: regression with tracing on)"; exit 1; }
 
+# Stage 5: cold-start smoke (utils/compile_cache, ISSUE 9) — the
+# instant-restart A/B: four fresh subprocesses (train/serve x cold/warm)
+# sharing one workdir; the warm legs must restore every executable from
+# the warm manifest (compile_cache_total hits only, zero compiles —
+# counter-gated by scripts/check_coldstart.py; wall times recorded, not
+# gated). The record lands in BENCH_smoke.json next to the other smokes.
+echo "== cold-start smoke =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
+  timeout -k 10 300 python bench.py coldstart \
+  > /tmp/_coldstart.jsonl \
+  && tee -a BENCH_smoke.json < /tmp/_coldstart.jsonl > /dev/null \
+  && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python scripts/check_coldstart.py /tmp/_coldstart.jsonl \
+  || { echo "tier1: cold-start smoke FAILED (warm restart recompiled,"
+       echo "tier1: or a leg crashed)"; exit 1; }
+
 exit $rc

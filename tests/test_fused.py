@@ -332,7 +332,7 @@ class TestFitFused:
             calls.append(1)
             return real(*args, **kwargs)
 
-        net._train_steps_fused = {(k, False): counting}
+        net._train_steps_fused = {(k, False): (counting, None)}
         net.fit(x, y, epochs=1, batch_size=8, steps_per_dispatch=k)
         assert net.iteration == 5
         assert len(calls) == 2  # ceil(5 steps / 4 per dispatch)

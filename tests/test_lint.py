@@ -263,6 +263,45 @@ class TestR3Recompile:
         """
         assert "R3" not in rule_set(src)
 
+    def test_raw_lower_compile_chain_fires(self):
+        # ISSUE 9: an AOT compile outside utils/compile_cache.aot_compile
+        # can never be served from a warm manifest — every restart pays it
+        src = """
+            import jax
+
+            def warmup(self, spec):
+                ex = jax.jit(self.fwd).lower(spec).compile()
+                return ex
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R3"]
+        assert len(fs) == 1
+        assert "compile-artifact cache" in fs[0].message
+
+    def test_lower_compile_in_cache_tier_silent(self):
+        # the blessed site itself: utils/compile_cache.aot_compile
+        src = textwrap.dedent("""
+            def aot_compile(jitted, *args):
+                return jitted.lower(*args).compile()
+        """)
+        from deeplearning4j_tpu.analysis import core
+        mod = core.LintModule(src, path="utils/compile_cache.py")
+        fired = {f.rule for f in analysis.lint_modules([mod])}
+        assert "R3" not in fired
+
+    def test_split_lower_compile_silent(self):
+        # bench.py idiom: lowered kept for cost_analysis, compiled
+        # separately — a deliberate one-shot, not a chained bypass
+        src = """
+            import jax
+
+            def measure(self, step, args):
+                lowered = jax.jit(step).lower(*args)
+                hlo = lowered.as_text()
+                compiled = lowered.compile()
+                return hlo, compiled
+        """
+        assert "R3" not in rule_set(src)
+
 
 # ----------------------------------------------------------------------
 # R4: impure jit bodies

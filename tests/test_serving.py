@@ -124,6 +124,110 @@ class TestServingEngine:
         assert st["requests"]["shed_queue_full"] == 0
         assert st["aot"]["lazy_compiles"] == 0  # every size hit a bucket
 
+    def test_batched_submit_one_future_matches_direct(self):
+        """ISSUE 9 satellite (ROADMAP serving follow-on): one submit call
+        carries a multi-example batch and resolves ONE future to the
+        stacked [n, ...] outputs, through the same assemble/pad path."""
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,),
+                               buckets=(1, 2, 4, 8)).start()
+        try:
+            x = _x(6)
+            fut = engine.submit(x, batched=True)
+            out = fut.get(timeout=30)
+        finally:
+            engine.stop()
+        assert out.shape[0] == 6
+        np.testing.assert_allclose(out, np.asarray(net.output(x)),
+                                   rtol=1e-5)
+        st = engine.stats()
+        assert st["requests"]["submitted"] == 1   # one request...
+        assert st["requests"]["served"] == 6      # ...six examples served
+
+    def test_batched_and_single_submits_mix_in_one_drain(self):
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,), buckets=(1, 2, 4, 8),
+                               batch_window_s=0.05).start()
+        try:
+            x = _x(7)
+            fb = engine.submit(x[:4], batched=True)
+            f1 = engine.submit(x[4])
+            f2 = engine.submit(x[5:7], batched=True)
+            outs = [fb.get(timeout=30), f1.get(timeout=30),
+                    f2.get(timeout=30)]
+        finally:
+            engine.stop()
+        ref = np.asarray(net.output(x))
+        np.testing.assert_allclose(outs[0], ref[:4], rtol=1e-5)
+        np.testing.assert_allclose(outs[1], ref[4], rtol=1e-5)
+        np.testing.assert_allclose(outs[2], ref[5:7], rtol=1e-5)
+
+    def test_batched_submit_counts_rows_against_max_queue(self):
+        # admission bounds EXAMPLES: a batched entry can't smuggle
+        # unbounded rows past max_queue through one queue slot
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,), buckets=(1, 2, 4),
+                               max_queue=8)  # NOT started: queue holds
+        try:
+            f6 = engine.submit(_x(6), batched=True)  # 6 of 8 row slots
+            with pytest.raises(ServingOverloaded):
+                engine.submit(_x(3), batched=True)   # 9 > 8: shed
+            f2 = engine.submit(_x(2), batched=True)  # 8 == 8: admitted
+            assert engine.stats()["requests"]["shed_queue_full"] == 1
+            # the depth stat reports EXAMPLES, matching what admission
+            # bounds — not the 2 queue entries
+            assert engine.stats()["queue_depth"] == 8
+            # a batch that could NEVER be admitted is a sizing error,
+            # not transient load — retrying it would never succeed
+            with pytest.raises(ValueError, match="max_queue"):
+                engine.submit(_x(9), batched=True)
+            # draining releases the slots: start, serve, resubmit fits
+            engine.start()
+            assert f6.get(timeout=30).shape[0] == 6
+            assert f2.get(timeout=30).shape[0] == 2
+            engine.submit(_x(3), batched=True).get(timeout=30)
+        finally:
+            engine.stop()
+
+    def test_batched_submit_mismatched_leading_dims_rejected(self):
+        # multi-input dict whose leaves disagree on the example axis:
+        # admitting it would detonate inside the shared drain batch and
+        # fail innocent co-batched requests — rejected at the boundary
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,), buckets=(1, 2)).start()
+        try:
+            bad = {"a": np.zeros((3, 5), np.float32),
+                   "b": np.zeros((2, 7), np.float32)}
+            with pytest.raises(ValueError, match="leading dims"):
+                engine.submit(bad, batched=True)
+        finally:
+            engine.stop()
+
+    def test_batched_submit_empty_rejected(self):
+        # a 0-row batched entry would shift every other request's resolve
+        # slice in its drain batch — refused at the submit boundary
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,), buckets=(1, 2)).start()
+        try:
+            with pytest.raises(ValueError, match="0-row"):
+                engine.submit(np.empty((0, 5), np.float32), batched=True)
+        finally:
+            engine.stop()
+
+    def test_batched_submit_larger_than_max_bucket(self):
+        # a batch beyond the largest bucket chunks inside the forward —
+        # still one future, still exact
+        net = _mlp()
+        engine = ServingEngine(net, input_spec=(5,),
+                               buckets=(1, 2, 4)).start()
+        try:
+            x = _x(11)
+            out = engine.submit(x, batched=True).get(timeout=30)
+        finally:
+            engine.stop()
+        np.testing.assert_allclose(out, np.asarray(net.output(x)),
+                                   rtol=1e-5)
+
     def test_aot_warmup_recompiles_flat_and_first_request_warm(self, fresh):
         """ISSUE 6 acceptance: after the startup warmup over the registered
         buckets, a steady-state run over RAGGED request sizes keeps the
