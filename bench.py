@@ -946,6 +946,103 @@ def bench_coldstart():
             "train": legs["train"], "serving": legs["serve"]}
 
 
+def bench_zero(batch_per_chip=32, n_batches=16, epochs=3):
+    """ZeRO A/B (ISSUE 10, arxiv 2004.13336): the same data-parallel fit
+    under the three weight-update layouts —
+
+      replicated  opt state a full copy per replica (the pre-PR-10 default)
+      zero1       opt state sharded over 'data', reduce-scattered update
+                  (the new ParallelTrainer default)
+      fsdp        params ALSO stored sharded, gathered per step (ZeRO-3)
+
+    — recording steps/s, addressable-shard-aware per-device param/opt
+    bytes, the jit compile count (recompiles must stay flat: the sharded
+    layouts add no shape churn), and max param divergence vs the
+    replicated leg (the layouts are bit-exact re-expressions, so this must
+    be ~0). Layer dims are divisible by the data-axis size so the ideal
+    1/N per-device ratio is visible, not blurred by replicated ragged
+    leaves. scripts/check_zero.py gates the bytes ratio + compile counters
+    in tier1.sh (stage 6 pins an 8-device CPU mesh via XLA_FLAGS);
+    steps/s is recorded, not gated — CPU legs jitter ±15-30%."""
+    import jax
+    from deeplearning4j_tpu.nn import layers as L
+    from deeplearning4j_tpu.nn import updaters as U
+    from deeplearning4j_tpu.nn.conf import inputs as I
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import (MeshSpec, ParallelTrainer,
+                                             make_mesh)
+    from deeplearning4j_tpu.telemetry import devices as _devices
+
+    hidden = 256
+    if _preflight():
+        batch_per_chip, n_batches, epochs, hidden = 16, 8, 2, 128
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshSpec(data=n_dev, model=1))
+    batch = batch_per_chip * n_dev
+    rs = np.random.RandomState(0)
+    n = batch * n_batches
+    x = rs.rand(n, 64).astype(np.float32)
+    y = np.eye(8, dtype=np.float32)[rs.randint(0, 8, n)]
+
+    def make_trainer(mode):
+        conf = NeuralNetConfig(seed=5, updater=U.Adam(learning_rate=1e-3)) \
+            .list(L.DenseLayer(n_out=hidden, activation="relu"),
+                  L.DenseLayer(n_out=hidden, activation="relu"),
+                  L.OutputLayer(n_out=8, loss="mcxent"),
+                  input_type=I.FeedForwardType(64))
+        net = MultiLayerNetwork(conf)
+        return ParallelTrainer(
+            net, mesh,
+            shard_optimizer_state=(mode != "replicated"),
+            shard_params="fsdp" if mode == "fsdp" else None).init()
+
+    legs = {}
+    ref_w = None
+    for mode in ("replicated", "zero1", "fsdp"):
+        tr = make_trainer(mode)
+        tr.fit(x, y, batch_size=batch, epochs=1)      # compile + warm epoch
+        jax.device_get(jax.tree_util.tree_leaves(tr.params)[0])
+        compiles_warm = tr._step_fn._cache_size()
+        t0 = time.perf_counter()
+        tr.fit(x, y, batch_size=batch, epochs=epochs)
+        jax.device_get(jax.tree_util.tree_leaves(tr.params)[0])
+        dt = time.perf_counter() - t0
+        steps = epochs * n_batches
+        p_log, p_dev = _devices.tree_shard_bytes(tr.params)
+        o_log, o_dev = _devices.tree_shard_bytes(tr.opt_state)
+        w = np.asarray(tr.params[0]["W"])
+        if mode == "replicated":
+            ref_w = w
+        legs[mode] = {
+            "steps_per_sec": round(steps / dt, 1),
+            "samples_per_sec": round(steps * batch / dt, 1),
+            "param_bytes_logical": p_log, "param_bytes_per_device": p_dev,
+            "opt_state_bytes_logical": o_log,
+            "opt_state_bytes_per_device": o_dev,
+            "compiles": compiles_warm,
+            "recompiles": tr._step_fn._cache_size() - compiles_warm,
+            "final_loss": float(np.asarray(tr.score_value)),
+            "max_param_diff_vs_replicated":
+                float(np.abs(w - ref_w).max()),
+        }
+    z, r = legs["zero1"], legs["replicated"]
+    return {"metric": "zero_sharded_update_ab",
+            "value": z["steps_per_sec"], "unit": "steps/sec",
+            # speedup (or cost) of the sharded update vs the replicated
+            # leg of THIS run — the A/B factor, not a cross-machine number
+            "vs_baseline": round(z["steps_per_sec"]
+                                 / max(r["steps_per_sec"], 1e-9), 2),
+            "n_devices": n_dev, "batch": batch, "hidden": hidden,
+            "opt_bytes_ratio": round(
+                r["opt_state_bytes_per_device"]
+                / max(z["opt_state_bytes_per_device"], 1), 2),
+            "fsdp_param_bytes_ratio": round(
+                r["param_bytes_per_device"]
+                / max(legs["fsdp"]["param_bytes_per_device"], 1), 2),
+            "legs": legs}
+
+
 def bench_longcontext():
     """Long-sequence decoder LM: seq 4096 is past the measured flash-attention
     crossover, so this config exercises the fused kernel (the naive path's
@@ -960,9 +1057,9 @@ CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
            "parallel": bench_parallel, "transformer": bench_transformer,
            "longcontext": bench_longcontext, "fused": bench_fused,
            "serving": bench_serving, "trace_overhead": bench_trace_overhead,
-           "coldstart": bench_coldstart}
+           "coldstart": bench_coldstart, "zero": bench_zero}
 DEFAULT_ORDER = ["lenet", "resnet50", "lstm", "word2vec", "parallel",
-                 "transformer", "longcontext", "fused", "serving"]
+                 "transformer", "longcontext", "fused", "serving", "zero"]
 
 _MEASURED_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "BENCH_TPU_MEASURED.json")
@@ -1019,6 +1116,7 @@ _CANONICAL_SHAPES = {
     "parallel": {},
     "fused": {"batch": 128},
     "serving": {"hidden": 2048},
+    "zero": {"hidden": 256},
 }
 
 

@@ -130,7 +130,7 @@ class _ManifestDispatch:
 
 
 def make_train_steps(net, k, donate=True, jit=True, with_health=False,
-                     donate_batch=True):
+                     donate_batch=True, base_step=None):
     """Build the fused K-step engine over ``net``'s single train step:
 
     ``(params, state, opt_state, xs, ys, step0, rng, masks, step_valid)
@@ -144,9 +144,25 @@ def make_train_steps(net, k, donate=True, jit=True, with_health=False,
     run back-to-back inside ONE XLA computation — one dispatch, no
     host round-trips between steps. Works for any net exposing the
     ``make_train_step`` contract (MultiLayerNetwork, ComputationGraph).
+
+    ``base_step`` substitutes the single-step body (same signature as
+    ``make_train_step(jit=False)``): ParallelTrainer injects its ZeRO
+    step here, so the sharded optimizer state and the explicit
+    reduce-scatter/all-gather grad→update boundary are carried through
+    all K scanned steps, not just the K=1 path.
     """
-    base = net.make_train_step(donate=False, jit=False,
-                               with_health=with_health)
+    if base_step is not None and with_health:
+        # the injected step's contract is the PLAIN 4-tuple; the scan
+        # body would otherwise fail mid-trace with an opaque unpack
+        # error ("expected 5, got 4") when the watchdog is armed
+        raise ValueError(
+            "make_train_steps: base_step and with_health=True don't "
+            "compose — an injected step returns (params, state, opt, "
+            "loss) without the health bundle; build the health variant "
+            "into base_step or leave it to net.make_train_step")
+    base = (base_step if base_step is not None
+            else net.make_train_step(donate=False, jit=False,
+                                     with_health=with_health))
 
     def steps_fn(params, state, opt_state, xs, ys, step0, rng, masks,
                  step_valid):

@@ -935,6 +935,13 @@ class ComputationGraph:
                                             updates)
         return new_params, new_opt
 
+    def apply_constraints(self, params, step):
+        """MultiLayerNetwork.apply_constraints counterpart: the graph's
+        apply_update has no constraint pass, so this is the identity —
+        here so the distributed masters' sharded update can call ONE
+        method on either net kind."""
+        return params
+
     def make_train_step(self, donate=True, jit=True, with_health=False):
         def train_step(params, state, opt_state, inputs, labels, step, rng, mask=None):
             loss, new_state, grads = self.compute_gradients(

@@ -294,9 +294,16 @@ class MultiLayerNetwork:
         updates, new_opt = self.conf.updater.update(grads, opt_state, params,
                                                     step)
         new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
-        new_params = [l.apply_constraints(p, step, 0) if p else p
-                      for l, p in zip(self.conf.layers, new_params)]
-        return new_params, new_opt
+        return self.apply_constraints(new_params, step), new_opt
+
+    def apply_constraints(self, params, step):
+        """The constraint pass of apply_update, exposed separately for
+        update paths that run the updater elsewhere (the distributed
+        masters' sharded weight update applies the updater to flat
+        1/w shards, then constrains the reassembled params HERE — one
+        definition, no drift)."""
+        return [l.apply_constraints(p, step, 0) if p else p
+                for l, p in zip(self.conf.layers, params)]
 
     def make_train_step(self, donate=True, jit=True, with_health=False):
         """Build the jitted train step:

@@ -284,43 +284,21 @@ class ComposedParallelLM:
         return self
 
     def _zero1_sharding(self, sharding, leaf):
-        """Extend a param sharding's FIRST axis with 'data' for the
-        optimizer-state copy of that leaf — only when the per-device size
-        along that axis divides by dp (leaves that don't divide stay at
-        the param sharding; correctness is unaffected either way)."""
-        dp = self.mesh.shape["data"]
-        if dp == 1 or jnp.ndim(leaf) == 0:
-            return sharding
-        spec = list(sharding.spec) if sharding.spec else []
-        spec += [None] * (jnp.ndim(leaf) - len(spec))
-        first = spec[0]
-        axes = (first if isinstance(first, tuple)
-                else () if first is None else (first,))
-        if "data" in axes:
-            return sharding
-        shard_n = np.prod([self.mesh.shape[a] for a in axes], dtype=int)
-        if (leaf.shape[0] // shard_n) % dp != 0:
-            return sharding
-        spec[0] = tuple(axes) + ("data",)
-        return NamedSharding(self.mesh, P(*spec))
+        """ZeRO-1 layout for one optimizer-state leaf: the shared
+        ``parallel.mesh.zero1_sharding`` discipline (param sharding +
+        'data' extension on the first divisible dim) — one definition
+        for this facade AND ParallelTrainer."""
+        return _mesh.zero1_sharding(self.mesh, sharding, leaf)
 
     def _opt_shardings(self, opt_state):
-        p_struct = jax.tree_util.tree_structure(self.params)
         repl = NamedSharding(self.mesh, P())
         if self.shard_optimizer_state:
             p_shards = jax.tree_util.tree_map(
                 self._zero1_sharding, self.param_shardings, self.params)
         else:
             p_shards = self.param_shardings
-
-        def per_entry(sub):
-            if jax.tree_util.tree_structure(sub) == p_struct:
-                return p_shards
-            return jax.tree_util.tree_map(lambda _: repl, sub)
-
-        if isinstance(opt_state, dict):
-            return {k: per_entry(v) for k, v in opt_state.items()}
-        return per_entry(opt_state)
+        return _mesh.opt_shardings_like(opt_state, self.params, p_shards,
+                                        repl)
 
     # -- training --------------------------------------------------------
     def _loss_fn(self, params, ids, labels):

@@ -19,6 +19,24 @@ collectives.
 The reference's separate "averaging frequency" machinery is unnecessary —
 per-step all-reduce is the synchronous limit of averaging every step — but
 ``average_every`` is supported for loose (local-SGD style) training.
+
+Weight-update sharding (Xu et al. 2020, arxiv 2004.13336) is the DEFAULT:
+optimizer state lives in the ZeRO-1 layout (param sharding + 'data' on the
+first divisible dim, ``mesh.zero1_sharding``), the step constrains the
+grad→update boundary so the gradient reduction feeds the sharded update
+directly (reduce-scatter on TPU; CPU's partitioner emits the decomposed
+all-reduce + dynamic-slice), and params all-gather back out.
+``shard_params="fsdp"`` is one tier deeper: params are STORED in the same
+1/N layout between steps and gathered inside the step. For Adam (3 copies
+of P), ZeRO-1 cuts steady-state per-replica bytes from 3P to P + 2P/N and
+FSDP to ~3P/N — capacity that buys bigger per-chip batches (the
+measured-MFU item on the ROADMAP; the realized numbers are the
+``param_bytes``/``opt_state_bytes`` gauges on ``/health``). Honest scope:
+the gather is one constraint over the whole tree at step entry — XLA
+schedules the all-gathers, but nothing forces a layer-by-layer
+gather-use-discard, so the WITHIN-step peak still holds the full params
+alongside activations (full ZeRO-3 streaming is future work); what FSDP
+frees is everything those trees pinned BETWEEN steps.
 """
 
 from __future__ import annotations
@@ -30,6 +48,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel import mesh as _mesh
+from deeplearning4j_tpu.telemetry import devices as _devices
 
 
 def _layer_param_spec(layer, pname, arr):
@@ -108,17 +127,33 @@ class ParallelTrainer:
     """
 
     def __init__(self, net, mesh: Mesh | None = None, *, tensor_parallel=False,
-                 donate=True, shard_optimizer_state=False):
+                 donate=True, shard_optimizer_state=True, shard_params=None):
         self.net = net
         self.mesh = mesh if mesh is not None else _mesh.make_mesh()
         self.tensor_parallel = tensor_parallel
         self.donate = donate
+        if shard_params not in (None, "fsdp"):
+            raise ValueError(
+                f"shard_params={shard_params!r}: None (replicated between "
+                "steps) or 'fsdp' (ZeRO-3: params stored P('data') between "
+                "steps, all-gathered inside the step)")
         # ZeRO-1 / cross-replica weight-update sharding (Xu et al. 2020,
-        # arxiv 2004.13336 — the paper behind GSPMD's optimizer sharding):
-        # optimizer-state leaves split over the 'data' axis, so Adam moments
-        # cost HBM/N per replica; GSPMD reduce-scatters the grads into the
-        # sharded update and all-gathers the (replicated-out) params.
-        self.shard_optimizer_state = shard_optimizer_state
+        # arxiv 2004.13336 — the paper behind GSPMD's optimizer sharding)
+        # is the DEFAULT: optimizer-state leaves split over the 'data' axis
+        # (derived FROM the param shardings via mesh.zero1_sharding, so a
+        # tensor-parallel leaf's moments keep their 'model' axes and are
+        # never resharded against their param), Adam moments cost HBM/N
+        # per replica, and the step pins the grad→update boundary with
+        # with_sharding_constraint so XLA reduce-scatters gradients into
+        # the sharded update and all-gathers params out (on CPU the
+        # partitioner emits the decomposed all-reduce+dynamic-slice pair;
+        # TPU/GPU pipelines fuse it into a reduce-scatter — inspected in
+        # tests/test_zero.py, not assumed). ``shard_params="fsdp"`` grows
+        # this one tier deeper (ZeRO-3): params themselves are STORED in
+        # the zero1 layout between steps and gathered per step.
+        self.shard_optimizer_state = bool(shard_optimizer_state) \
+            or shard_params == "fsdp"
+        self.shard_params = shard_params
         self._step_fn = None
         self._score_fn = None
         self.params = None
@@ -154,45 +189,166 @@ class ParallelTrainer:
         self.sync_to_net()
         return self.net.output(x, mask=mask)
 
-    def init(self, rng=None):
-        params, state = self.net.init(rng)
-        self.param_shardings = make_param_shardings(self.mesh, self.net, params,
-                                                    self.tensor_parallel)
+    def _derive_shardings(self, params, opt):
+        """All four sharding trees from a (host or device) params/opt
+        TEMPLATE — structure and shapes only, no arrays are placed:
+
+        * ``param_shardings``       compute layout (replicated / TP)
+        * ``param_store_shardings`` between-step storage — the compute
+          layout, or its zero1 'data' extension under FSDP (ZeRO-3,
+          all-gathered inside the step)
+        * ``_opt_leaf_shards``      per-param-leaf layout of the opt
+          state (and the grad→update constraint)
+        * ``_opt_shardings``        the full updater-state tree
+        """
+        self.param_shardings = make_param_shardings(
+            self.mesh, self.net, params, self.tensor_parallel)
+        # ONE zero1 tree serves both uses: FSDP's between-step param
+        # storage and the opt-state layout are the same extension rule
+        # by design (the constructor forces shard_optimizer_state on
+        # under fsdp), so build it once and alias
+        zero1_tree = (jax.tree_util.tree_map(
+            lambda s, p: _mesh.zero1_sharding(self.mesh, s, p),
+            self.param_shardings, params)
+            if self.shard_optimizer_state else None)
+        self.param_store_shardings = (zero1_tree
+                                      if self.shard_params == "fsdp"
+                                      else self.param_shardings)
+        self._opt_leaf_shards = (zero1_tree if self.shard_optimizer_state
+                                 else self.param_shardings)
+        self._opt_shardings = _mesh.opt_shardings_like(
+            opt, params, self._opt_leaf_shards,
+            NamedSharding(self.mesh, P()))
+        # a stateless updater (Sgd, NoOp: state=()) has nothing to shard
+        # — routing it through the constrained step would pay the
+        # reduce-scatter/all-gather machinery every step for zero saved
+        # bytes. FSDP still needs the constrained step (the PARAMS are
+        # sharded); plain ZeRO-1 falls back to the unconstrained path.
+        self._zero_step_active = (
+            self.shard_params == "fsdp"
+            or (self.shard_optimizer_state
+                and any(hasattr(l, "shape")
+                        for l in jax.tree_util.tree_leaves(opt))))
+
+    def _place(self, params, state, opt):
+        """Derive the layouts and put all three trees on the mesh — ONE
+        definition shared by init() and adopt_net_state(), so a
+        fresh-init and a checkpoint-resumed trainer can never place (or
+        account) their trees differently."""
+        self._derive_shardings(params, opt)
         self.params = jax.tree_util.tree_map(jax.device_put, params,
-                                             self.param_shardings)
-        repl = NamedSharding(self.mesh, P())
-        self.state = jax.device_put(state, repl)
-        opt = self.net.conf.updater.init(params)
-        self._opt_shardings = jax.tree_util.tree_map(
-            self._opt_leaf_sharding, opt)
+                                             self.param_store_shardings)
+        self.state = jax.device_put(state, NamedSharding(self.mesh, P()))
         self.opt_state = jax.tree_util.tree_map(jax.device_put, opt,
                                                 self._opt_shardings)
+        _devices.note_train_tree_bytes(params=self.params,
+                                       opt_state=self.opt_state,
+                                       site="parallel_trainer")
+
+    def init(self, rng=None):
+        params, state = self.net.init(rng)
+        self._place(params, state, self.net.conf.updater.init(params))
         return self
 
-    def _opt_leaf_sharding(self, leaf):
-        """P('data') on the first evenly-divisible axis when optimizer-state
-        sharding is on; replicated otherwise."""
-        repl = NamedSharding(self.mesh, P())
-        if not self.shard_optimizer_state or not hasattr(leaf, "shape"):
-            return repl
-        nd = self.mesh.shape["data"]
-        for axis, size in enumerate(leaf.shape):
-            if size % nd == 0 and size > 0:
-                spec = [None] * len(leaf.shape)
-                spec[axis] = "data"
-                return NamedSharding(self.mesh, P(*spec))
-        return repl
+    def adopt_net_state(self):
+        """Place the wrapped net's (host) params/state/opt_state/RNG chain
+        and counters onto the mesh in THIS trainer's layouts — the resume
+        path from a single-process checkpoint (utils.serialization
+        load_model/load_bundle): a replicated zip resumes into a ZeRO-1 or
+        FSDP trainer, the layout re-derived here rather than trusted from
+        the file. The inverse of ``sync_to_net``. The net's own trees are
+        the sharding template — no throwaway re-init or placement of a
+        fresh model (a resume is the cold-start path; it pays exactly one
+        device_put per adopted tree)."""
+        net = self.net
+        params, state, opt = net.params, net.state, net.opt_state
+        if params is None:
+            raise ValueError(
+                "adopt_net_state: the wrapped net has no params — load a "
+                "checkpoint into it (utils.serialization load_model/"
+                "load_bundle) or net.init() first")
+        if opt is None:
+            opt = net.conf.updater.init(params)
+        self._place(params, state, opt)
+        rng = getattr(net, "_rng", None)
+        if rng is not None:
+            self._rng = jnp.asarray(rng)
+        self.iteration = int(getattr(net, "iteration", 0))
+        self.epoch = int(getattr(net, "epoch", 0))
+        return self
+
+    def _sharded_update_step(self):
+        """The net's single train step with the ZeRO grad→update boundary
+        made explicit (make_train_step signature, shared by the K=1 jit
+        and the fused K-step scan): FSDP-stored params gather to the
+        compute layout inside the step, gradients pin to the opt-shard
+        layout — the constraint XLA lowers to a reduce-scatter feeding
+        the sharded update — and the new params' storage constraint
+        all-gathers them back out."""
+        net = self.net
+        gather_sh = self.param_shardings
+        store_sh = self.param_store_shardings
+        grad_sh = self._opt_leaf_shards
+        fsdp = self.shard_params == "fsdp"
+        wsc = jax.lax.with_sharding_constraint
+
+        def step(params, state, opt_state, x, y, it, rng, mask=None):
+            if fsdp:
+                # ZeRO-3: params live sharded between steps; constraining
+                # to the compute layout IS the per-step all-gather
+                full = jax.tree_util.tree_map(wsc, params, gather_sh)
+            else:
+                full = params
+            loss, new_state, grads = net.compute_gradients(
+                full, state, x, y, rng=rng, mask=mask)
+            grads = jax.tree_util.tree_map(wsc, grads, grad_sh)
+            new_params, new_opt = net.apply_update(params, opt_state, grads,
+                                                   it)
+            if fsdp:
+                new_params = jax.tree_util.tree_map(wsc, new_params,
+                                                    store_sh)
+            return new_params, new_state, new_opt, loss
+
+        return step
+
+    def _resolve_donate(self, donate):
+        """PR 9's warm-manifest donation-off rule, respected here too: a
+        net with an attached warm manifest runs every engine without
+        buffer donation (deserialized executables lose jax's aliasing
+        guard; the trainer keeps the uniform rule so a bundle-resumed job
+        behaves identically through every fit path)."""
+        if donate and getattr(self.net, "_warm_manifest", None) is not None:
+            import warnings
+            if not getattr(self, "_warned_manifest_donate", False):
+                # say so once (the nn/fused convention): peak HBM for
+                # params/opt_state grows with donation off, and nothing
+                # else in the logs would explain why
+                self._warned_manifest_donate = True
+                warnings.warn(
+                    "warm manifest attached to the wrapped net: buffer "
+                    "donation is disabled for the ParallelTrainer engines "
+                    "(serialized executables lose jax's aliasing guard) — "
+                    "detach the manifest (attach_manifest(net, None)) if "
+                    "memory-bound", stacklevel=3)
+            return False
+        return donate
 
     def _build_step(self, donate):
-        base_step = self.net.make_train_step(jit=False)
+        base_step = (self._sharded_update_step()
+                     if self._zero_step_active
+                     else self.net.make_train_step(jit=False))
+        donate = self._resolve_donate(donate)
         data_sh = _mesh.data_sharded(self.mesh)
         repl = NamedSharding(self.mesh, P())
         opt_sh = self._opt_shardings
 
-        # in: params, state, opt, x, y, step, rng, mask
-        in_sh = (self.param_shardings, jax.tree_util.tree_map(lambda _: repl, self.state),
-                 opt_sh, data_sh, data_sh, None, repl, None)
-        out_sh = (self.param_shardings,
+        # in: params, state, opt, x, y, step, rng, mask — the mask shards
+        # over 'data' WITH its batch (replicating it per dispatch would
+        # broadcast [B,...] host bytes to every replica for nothing)
+        in_sh = (self.param_store_shardings,
+                 jax.tree_util.tree_map(lambda _: repl, self.state),
+                 opt_sh, data_sh, data_sh, None, repl, data_sh)
+        out_sh = (self.param_store_shardings,
                   jax.tree_util.tree_map(lambda _: repl, self.state),
                   opt_sh, repl, repl)
 
@@ -215,6 +371,8 @@ class ParallelTrainer:
             self._step_fn = self._build_step(self.donate)
         x = _mesh.ensure_data_sharded(self.mesh, x)
         y = _mesh.ensure_data_sharded(self.mesh, y)
+        if mask is not None:
+            mask = _mesh.ensure_data_sharded(self.mesh, mask)
         (self.params, self.state, self.opt_state, loss,
          self._rng) = self._step_fn(
             self.params, self.state, self.opt_state, x, y, self.iteration,
@@ -308,19 +466,27 @@ class ParallelTrainer:
         """Sharded fused K-step engine: the raw scan from nn/fused.py
         jitted with the trainer's param/opt shardings, super-batches
         sharded [K, B/data, ...] and the RNG chain carried through the
-        dispatch (the _build_step conventions, amortized K-fold)."""
+        dispatch (the _build_step conventions, amortized K-fold). Under
+        ZeRO the scan body is the trainer's constrained step, so the
+        sharded opt state is CARRIED through all K steps — reduce-scatter
+        grads / sharded update / all-gather params happen inside the scan
+        body, K times per dispatch, with no host round-trip between."""
         from deeplearning4j_tpu.nn import fused as _fused
 
-        base = _fused.make_train_steps(self.net, k, jit=False)
+        base = _fused.make_train_steps(
+            self.net, k, jit=False,
+            base_step=(self._sharded_update_step()
+                       if self._zero_step_active else None))
+        donate = self._resolve_donate(donate)
         repl = NamedSharding(self.mesh, P())
         sb_sh = _mesh.superbatch_sharded(self.mesh)
         state_sh = jax.tree_util.tree_map(lambda _: repl, self.state)
         opt_sh = self._opt_shardings
 
         # in: params, state, opt, xs, ys, step0, rng, masks, step_valid
-        in_sh = (self.param_shardings, state_sh, opt_sh, sb_sh, sb_sh,
+        in_sh = (self.param_store_shardings, state_sh, opt_sh, sb_sh, sb_sh,
                  None, repl, sb_sh, repl)
-        out_sh = (self.param_shardings, state_sh, opt_sh, repl, repl)
+        out_sh = (self.param_store_shardings, state_sh, opt_sh, repl, repl)
 
         def steps(params, state, opt_state, xs, ys, step0, rng, masks, sv):
             rng_next, sub = jax.random.split(rng)
@@ -452,10 +618,17 @@ class ParallelTrainer:
         return float(self._score_fn(self.params, self.state, xd, yd, mask))
 
     def sync_to_net(self):
-        """Copy trained params back into the wrapped MultiLayerNetwork."""
+        """Copy trained params back into the wrapped MultiLayerNetwork.
+        ``device_get`` gathers whatever the storage layout is — FSDP
+        shards included — so the result is always a full host copy the
+        single-process checkpoint formats (save_model/save_bundle) can
+        write; ``adopt_net_state`` is the inverse."""
         gather = lambda t: jax.tree_util.tree_map(
             lambda a: jax.device_get(a), t)
         self.net.params = gather(self.params)
         self.net.state = gather(self.state)
         self.net.opt_state = gather(self.opt_state)
+        self.net._rng = jax.device_get(self._rng)
+        self.net.iteration = self.iteration
+        self.net.epoch = self.epoch
         return self.net

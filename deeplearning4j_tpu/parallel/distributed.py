@@ -152,6 +152,71 @@ def _stack_worker_dim(tree, n):
     return tree_map(lambda a: jnp.broadcast_to(a[None], (n,) + a.shape), tree)
 
 
+# ----------------------------------------------------------------------
+# ZeRO exchange primitives (Xu et al. 2020, arxiv 2004.13336): the wire
+# form of "shard the weight update across workers" inside a shard_map —
+# flatten each leaf, pad to a multiple of the worker count, and
+# reduce-scatter so each worker owns exactly its 1/w slice of the mean.
+# lax.psum_scatter lowers to a LITERAL `reduce-scatter` HLO op (asserted
+# in tests/test_zero.py), where the jit/GSPMD trainers get whatever the
+# partitioner picks per backend.
+# ----------------------------------------------------------------------
+
+def _flat_pad(a, w):
+    v = jnp.ravel(a)
+    pad = (-v.size) % w
+    return jnp.pad(v, (0, pad)) if pad else v
+
+
+def _scatter_mean(tree, w, axis="data"):
+    """Reduce-scatter each leaf's mean over ``axis``: worker i receives
+    flat slice i of mean(tree) — 1/w of the bytes a pmean would hand
+    every worker."""
+    def leaf(a):
+        return jax.lax.psum_scatter(_flat_pad(a, w), axis,
+                                    scatter_dimension=0, tiled=True) / w
+    return tree_map(leaf, tree)
+
+
+def _scatter_pmean(tree, w, axis="data"):
+    """``lax.pmean`` decomposed into psum_scatter + all_gather (the
+    canonical lowering of an all-reduce, made explicit): each worker
+    averages only its flat 1/w shard before the gather, so the transient
+    exchange buffer is shard-sized — the ZeRO discipline applied to the
+    PA master's updater-state averaging. Bit-identical result."""
+    def leaf(a):
+        s = jax.lax.psum_scatter(_flat_pad(a, w), axis,
+                                 scatter_dimension=0, tiled=True) / w
+        g = jax.lax.all_gather(s, axis, axis=0, tiled=True)
+        return g[:a.size].reshape(a.shape)
+    return tree_map(leaf, tree)
+
+
+def _local_shard(tree, w, axis="data"):
+    """Worker i's flat 1/w slice of each (replicated) leaf."""
+    idx = jax.lax.axis_index(axis)
+    return tree_map(lambda a: _flat_pad(a, w).reshape(w, -1)[idx], tree)
+
+
+def _gather_like(shard_tree, like_tree, axis="data"):
+    """all_gather each flat shard and reshape back to the template's
+    leaf shapes (the params leaving the sharded update)."""
+    def leaf(s, a):
+        g = jax.lax.all_gather(s, axis, axis=0, tiled=True)
+        return g[:a.size].reshape(a.shape)
+    return tree_map(leaf, shard_tree, like_tree)
+
+
+def _apply_net_constraints(net, params, it):
+    """The constraint half of the net's apply_update, applied to params
+    reassembled from a sharded update (the updater half ran on the flat
+    shards). Delegates to ``net.apply_constraints`` — ONE definition on
+    the net (identity for ComputationGraph), so the sharded and
+    replicated update paths can never drift."""
+    fn = getattr(net, "apply_constraints", None)
+    return params if fn is None else fn(params, it)
+
+
 def _put(tree, mesh, *specs):
     sh = NamedSharding(mesh, P(*specs))
     return tree_map(lambda a: jax.device_put(a, sh), tree)
@@ -186,6 +251,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
     def _build(self, net, with_health):
         base_step = net.make_train_step(jit=False)
         avg_upd = self.average_updaters
+        n_workers = self.n_workers
 
         def split_step(params, state, opt, xs, ys, it0, rngs):
             # inside shard_map: leading worker dim is 1 on every stacked leaf
@@ -211,7 +277,12 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                          "param_norm": jnp.sqrt(_health.tree_sq_sum(p))})
             p = jax.lax.pmean(p, "data")
             if avg_upd:
-                o = jax.lax.pmean(o, "data")
+                # updater-state averaging sharded (ZeRO discipline):
+                # reduce-scatter + all-gather instead of pmean-ing the
+                # full opt tree — same result bit-for-bit, but each
+                # worker's transient exchange buffer is 1/w of the tree
+                # and the HLO carries a literal reduce-scatter
+                o = _scatter_pmean(o, n_workers)
             out = (ex(p), ex(s), ex(o),
                    jax.lax.pmean(jnp.mean(losses), "data"))
             return out + (wh,) if with_health else out
@@ -354,7 +425,8 @@ class SharedTrainingMaster(TrainingMaster):
     """
 
     def __init__(self, mesh: Mesh | None = None, *, batch_size_per_worker=32,
-                 threshold=None, min_threshold=1e-5, threshold_step=1e-5):
+                 threshold=None, min_threshold=1e-5, threshold_step=1e-5,
+                 shard_updater_state=True):
         if threshold is not None and threshold <= 0:
             raise ValueError(
                 "threshold must be positive; pass threshold=None for exact "
@@ -365,14 +437,25 @@ class SharedTrainingMaster(TrainingMaster):
         self.threshold = threshold
         self.min_threshold = float(min_threshold)
         self.threshold_step = float(threshold_step)
+        # ZeRO (default): updater state lives SHARDED across workers —
+        # each worker stores flat slice i of every opt leaf, the gradient
+        # exchange is a reduce-scatter into exactly that slice, the update
+        # runs on the shard, and one all-gather rebuilds the params every
+        # worker needs for the next forward. Per-worker updater-state
+        # bytes drop to 1/w; the exchanged bytes are the all-reduce's own
+        # canonical decomposition, so the wire cost is unchanged.
+        self.shard_updater_state = bool(shard_updater_state)
         self._step_fn = None
         self._step_fns = {}  # keyed by watchdog flag
         self._net = None
-        self._stats = {"steps": 0}
+        self._stats = {"steps": 0,
+                       "updater_state_sharded": self.shard_updater_state}
 
     def _build(self, net, with_health):
         compress = self.threshold is not None
         min_t, t_step = self.min_threshold, self.threshold_step
+        zero = self.shard_updater_state
+        w = self.n_workers
 
         def step(params, state, opt, resid, tau, x, y, it, rng):
             loss, new_state, grads = net.compute_gradients(
@@ -394,7 +477,7 @@ class SharedTrainingMaster(TrainingMaster):
                     lambda r: (jnp.abs(r) >= tau).astype(r.dtype), resid)
                 q = tree_map(lambda r, f: jnp.sign(r) * tau * f, resid, flags)
                 resid = tree_map(lambda r, qq: r - qq, resid, q)
-                shared = jax.lax.pmean(q, "data")
+                exchange = q
                 # adaptive tau from the global flag density
                 nflag = sum(jnp.sum(f) for f in jax.tree_util.tree_leaves(flags))
                 ntot = sum(f.size for f in jax.tree_util.tree_leaves(flags))
@@ -406,8 +489,26 @@ class SharedTrainingMaster(TrainingMaster):
                                           tau))
                 resid = tree_map(lambda a: a[None], resid)
             else:
-                shared = jax.lax.pmean(grads, "data")
-            new_params, new_opt = net.apply_update(params, opt, shared, it)
+                exchange = grads
+            if zero:
+                # opt enters stacked [w, S]-flat, sharded over 'data':
+                # this worker's slice is its WHOLE local copy
+                opt_shard = tree_map(lambda a: a[0], opt)
+                # reduce-scatter the (possibly quantized) grads straight
+                # into the shard this worker updates — no worker ever
+                # materializes the full mean-gradient tree
+                g_shard = _scatter_mean(exchange, w)
+                p_shard = _local_shard(params, w)
+                upd, new_opt_shard = net.conf.updater.update(
+                    g_shard, opt_shard, p_shard, it)
+                new_p_shard = tree_map(jnp.add, p_shard, upd)
+                new_params = _gather_like(new_p_shard, params)
+                new_params = _apply_net_constraints(net, new_params, it)
+                new_opt = tree_map(lambda a: a[None], new_opt_shard)
+            else:
+                shared = jax.lax.pmean(exchange, "data")
+                new_params, new_opt = net.apply_update(params, opt, shared,
+                                                       it)
             # BN-style running stats: average float leaves across workers
             new_state = tree_map(
                 lambda a: jax.lax.pmean(a, "data")
@@ -416,13 +517,14 @@ class SharedTrainingMaster(TrainingMaster):
                    jax.lax.pmean(loss, "data"))
             return out + (wh,) if with_health else out
 
-        out_specs = (P(), P(), P(), P("data"), P(), P())
+        opt_spec = P("data") if zero else P()
+        out_specs = (P(), P(), opt_spec, P("data"), P(), P())
         if with_health:
             out_specs = out_specs + (P("data"),)
         fn = _compat.shard_map(
             step, mesh=self.mesh,
-            in_specs=(P(), P(), P(), P("data"), P(), P("data"), P("data"),
-                      P(), P()),
+            in_specs=(P(), P(), opt_spec, P("data"), P(), P("data"),
+                      P("data"), P(), P()),
             out_specs=out_specs,
             check_vma=False)
         return jax.jit(fn, donate_argnums=(0, 1, 2, 3))
@@ -445,7 +547,21 @@ class SharedTrainingMaster(TrainingMaster):
             raise ValueError(f"need >= {step_examples} examples per step")
 
         repl = lambda t: _put(t, mesh)
-        params, state, opt = repl(net.params), repl(net.state), repl(net.opt_state)
+        params, state = repl(net.params), repl(net.state)
+        if self.shard_updater_state:
+            # opt state ships as [w, S]-flat leaves sharded over 'data':
+            # worker i's row is its 1/w slice of the (param-shaped) state
+            # a replicated checkpoint holds — resume re-slices here, and
+            # the fit's end re-assembles, so the wire format round-trips
+            # replicated ↔ sharded transparently
+            opt = _put(tree_map(
+                lambda a: _flat_pad(jnp.asarray(a), w).reshape(w, -1),
+                net.opt_state), mesh, "data")
+        else:
+            opt = repl(net.opt_state)
+        from deeplearning4j_tpu.telemetry import devices as _devices
+        _devices.note_train_tree_bytes(params=params, opt_state=opt,
+                                       site="shared_master")
         resid = _put(_stack_worker_dim(
             tree_map(lambda a: jnp.zeros_like(a), net.params), w), mesh, "data")
         tau = jnp.asarray(self.threshold if self.threshold is not None
@@ -498,7 +614,17 @@ class SharedTrainingMaster(TrainingMaster):
             for l in listeners:
                 l.iteration_done(net, tail[1], tail[0])
         get = lambda t: tree_map(lambda a: np.asarray(jax.device_get(a)), t)
-        net.params, net.state, net.opt_state = get(params), get(state), get(opt)
+        net.params, net.state = get(params), get(state)
+        if self.shard_updater_state:
+            # reassemble the [w, S]-flat shards back into the net's
+            # param-shaped opt tree (its pre-fit leaves are the shape
+            # template) so checkpoints/save_model see the usual layout
+            net.opt_state = tree_map(
+                lambda st, t: np.asarray(jax.device_get(st)).reshape(-1)[
+                    :np.asarray(t).size].reshape(np.asarray(t).shape),
+                opt, net.opt_state)
+        else:
+            net.opt_state = get(opt)
         net.iteration = it  # training position survives re-save/resume
         net.epoch = int(getattr(net, "epoch", 0)) + epochs
         self._stats["final_threshold"] = float(jax.device_get(tau))

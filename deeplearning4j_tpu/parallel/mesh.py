@@ -69,6 +69,72 @@ def superbatch_sharded(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, "data"))
 
 
+def zero1_sharding(mesh: Mesh, sharding: NamedSharding, leaf, axis="data"):
+    """Extend a param sharding with ``axis`` for the ZeRO (cross-replica
+    sharded weight update, Xu et al. 2020 arxiv 2004.13336) copy of that
+    leaf — optimizer-state moments, or the params themselves in the FSDP
+    tier. Derived FROM the param sharding, so a tensor-parallel leaf
+    keeps its 'model' axes and only gains 'data' on top (the moments of a
+    column-sharded W are never resharded against their param).
+
+    The FIRST dim whose per-device size divides by the axis size takes
+    the extension (dim 0 in the common case; an embedding-table moment
+    like [4097, 512] on an 8-way axis falls through to P(None, 'data')
+    instead of replicating). Leaves with no divisible dim keep the param
+    sharding unchanged — correctness is unaffected either way; they just
+    stay replicated over ``axis``.
+    """
+    ax_n = mesh.shape[axis]
+    if ax_n == 1 or jnp.ndim(leaf) == 0:
+        return sharding
+    spec = list(sharding.spec) if sharding.spec else []
+    spec += [None] * (jnp.ndim(leaf) - len(spec))
+    flat = [a for e in spec for a in
+            (e if isinstance(e, tuple) else () if e is None else (e,))]
+    if axis in flat:
+        return sharding
+    for dim, entry in enumerate(spec):
+        axes = (entry if isinstance(entry, tuple)
+                else () if entry is None else (entry,))
+        shard_n = int(np.prod([mesh.shape[a] for a in axes], dtype=int))
+        if (leaf.shape[dim] // shard_n) % ax_n != 0:
+            continue
+        merged = tuple(axes) + (axis,)
+        # normalize 1-tuples to the bare name: P('data') and
+        # P(('data',)) are the same placement, and the bare form is what
+        # tests/specs compare
+        spec[dim] = merged[0] if len(merged) == 1 else merged
+        return NamedSharding(mesh, P(*spec))
+    return sharding
+
+
+def opt_shardings_like(opt_state, params, p_shards, replicated_sharding):
+    """Sharding pytree for an updater-state tree: every entry structured
+    like the params tree (Adam m/v, Nesterov momenta, ...) takes the
+    per-leaf ``p_shards``; anything else (bare scalars, empty states)
+    replicates. Shared by ParallelTrainer and ComposedParallelLM so the
+    ZeRO discipline is one definition, not two."""
+    p_struct = jax.tree_util.tree_structure(params)
+    # a params-shaped state (Nesterovs/AdaGrad/RmsProp momenta) takes the
+    # per-leaf shardings WHOLE — checked before the dict fan-out below,
+    # because a ComputationGraph's params tree is ITSELF a dict (keyed by
+    # vertex): fanning such a state out per-vertex would compare each
+    # vertex sub-dict against the full params structure, fail, and
+    # silently replicate every moment leaf
+    if jax.tree_util.tree_structure(opt_state) == p_struct:
+        return p_shards
+
+    def per_entry(sub):
+        if jax.tree_util.tree_structure(sub) == p_struct:
+            return p_shards
+        return jax.tree_util.tree_map(lambda _: replicated_sharding, sub)
+
+    # a dict wrapper holding several params-shaped entries (Adam m/v)
+    if isinstance(opt_state, dict):
+        return {k: per_entry(v) for k, v in opt_state.items()}
+    return per_entry(opt_state)
+
+
 def shard_batch(mesh: Mesh, batch):
     """Place a host batch sharded over the data axis."""
     return jax.tree_util.tree_map(

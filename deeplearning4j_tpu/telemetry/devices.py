@@ -35,6 +35,7 @@ RECOMPILE_STORM_THRESHOLD = 8
 _lock = threading.Lock()
 _cache_sizes = {}        # (site, id(fn)) -> last observed jit cache size
 _mem_unsupported = False  # latched: this backend has no memory_stats()
+_train_bytes = {}        # site -> last note_train_tree_bytes snapshot
 
 
 def reset():
@@ -43,6 +44,7 @@ def reset():
     global _mem_unsupported
     with _lock:
         _cache_sizes.clear()
+        _train_bytes.clear()
         _mem_unsupported = False
 
 
@@ -135,6 +137,71 @@ def memory_summary():
     except Exception:
         pass
     return out
+
+
+def tree_shard_bytes(tree):
+    """``(logical_bytes, per_device_bytes)`` for a pytree of arrays.
+
+    ``logical`` counts every element once — the model's size on paper.
+    ``per_device`` is addressable-shard-aware: what ONE device actually
+    stores, via ``sharding.shard_shape`` — a ZeRO/FSDP layout reads ~1/N
+    of the replicated number HERE, which is the whole point of the layout.
+    Host numpy leaves (no sharding) count their full nbytes into both."""
+    logical = per_dev = 0
+    for a in jax.tree_util.tree_leaves(tree):
+        nbytes = getattr(a, "nbytes", None)
+        if nbytes is None:
+            continue
+        logical += int(nbytes)
+        try:
+            shard = a.sharding.shard_shape(a.shape)
+            n = 1
+            for d in shard:
+                n *= int(d)
+            per_dev += n * a.dtype.itemsize
+        except Exception:
+            per_dev += int(nbytes)
+    return logical, per_dev
+
+
+def note_train_tree_bytes(params=None, opt_state=None, site="trainer"):
+    """Record the HBM ledger of a training job's persistent trees:
+    ``param_bytes`` / ``opt_state_bytes`` gauges labeled
+    ``{site, scope=logical|per_device}`` plus a registry-independent
+    snapshot for ``/health`` (``train_memory_summary``) and bench records.
+    Called once per trainer init/restore — the 1/N saving of a sharded
+    weight-update layout becomes a number in the flight recorder, not a
+    claim. Returns the snapshot dict."""
+    snap = {}
+    if params is not None:
+        lg, pd = tree_shard_bytes(params)
+        snap["param_bytes"] = {"logical": lg, "per_device": pd}
+    if opt_state is not None:
+        lg, pd = tree_shard_bytes(opt_state)
+        snap["opt_state_bytes"] = {"logical": lg, "per_device": pd}
+    with _lock:
+        _train_bytes[site] = snap
+    reg = _registry.get_registry()
+    if reg.enabled:
+        for name, vals in snap.items():
+            g = reg.gauge(name,
+                          "bytes of the training job's persistent "
+                          f"{'params' if name.startswith('param') else 'updater state'}"
+                          ", labeled by site and scope (logical = every "
+                          "element once; per_device = addressable-shard-"
+                          "aware resident bytes on ONE device — ~1/N "
+                          "under a ZeRO/FSDP layout)")
+            for scope, v in vals.items():
+                g.set(float(v), site=site, scope=scope)
+    return snap
+
+
+def train_memory_summary():
+    """{site: {param_bytes: {logical, per_device}, opt_state_bytes: ...}}
+    — the last note_train_tree_bytes snapshot per site, registry-
+    independent (for /health next to memory_summary)."""
+    with _lock:
+        return {k: dict(v) for k, v in _train_bytes.items()}
 
 
 def note_jit_cache(site, fn):

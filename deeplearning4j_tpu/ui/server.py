@@ -305,6 +305,10 @@ def _health_payload():
             "watchdog": watchdog,
             "recompiles": recompiles,
             "memory": _devices.memory_summary(),
+            # the HBM ledger of the training job's persistent trees:
+            # per_device vs logical bytes shows the realized 1/N of a
+            # ZeRO-1/FSDP layout (PROFILE.md "Reading the HBM ledger")
+            "train_memory": _devices.train_memory_summary(),
             # the cold-start tax, realized: persistent-cache dir, warm-
             # manifest hit/miss counts, time-to-first-step/request gauges
             "compile_cache": _cc.status(),

@@ -105,7 +105,14 @@ def restore_trainer(path, trainer):
     opt_state, mutable state, RNG chain and iteration restored; bundle
     extras (bucket registry, warm manifest) land on ``trainer.buckets`` /
     the net via ``compile_cache.attach_manifest`` when present and
-    matching this backend."""
+    matching this backend.
+
+    The layout is the DESTINATION trainer's policy, never the file's:
+    orbax restores each array into the template's sharding, so a
+    checkpoint written by a replicated trainer resumes into a ZeRO-1 or
+    FSDP one (and back) with the arrays landing directly in the new
+    layout — no gather-to-host hop (tests/test_zero.py pins the full
+    cross-layout matrix bit-exact)."""
     if trainer.params is None:
         trainer.init()
     tree = restore_sharded(path, _trainer_tree(trainer))
@@ -117,6 +124,15 @@ def restore_trainer(path, trainer):
     if "rng" in tree:
         trainer._rng = tree["rng"]
     _restore_extras(path, trainer)
+    # refresh the HBM ledger gauges: a resume is a new process whose
+    # /health should show the restored layout's realized bytes
+    try:
+        from deeplearning4j_tpu.telemetry import devices as _devices
+        _devices.note_train_tree_bytes(params=trainer.params,
+                                       opt_state=trainer.opt_state,
+                                       site="parallel_trainer")
+    except Exception:
+        pass
     return trainer
 
 

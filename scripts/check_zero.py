@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Gate the `bench.py zero` A/B record (tier1.sh stage 6).
+
+The ZeRO acceptance is counters and bytes, never wall time (CPU legs
+jitter ±15-30%, so steps/s is recorded in the A/B row but not gated):
+
+  * per-device opt_state bytes under zero1 must realize at least HALF the
+    ideal 1/N saving vs the replicated leg (with the bench's divisible
+    layer dims it is exactly 1/N; the slack covers future layer edits
+    that add a non-divisible leaf without silently killing the gate);
+  * the FSDP leg must shard the params themselves the same way;
+  * every leg compiles its step exactly once and recompiles ZERO times
+    across epochs — the sharded layouts add no shape churn;
+  * zero1/fsdp params must match the replicated leg's (the layouts are
+    re-expressions of the same math, bit-exact on CPU — tests pin ==0,
+    the gate allows float-print slack).
+
+Usage: check_zero.py BENCH_JSONL [min_ratio_frac]
+Exit 0 when the record passes, 1 with a reason otherwise.
+"""
+
+import json
+import sys
+
+
+def main():
+    if len(sys.argv) < 2:
+        print("usage: check_zero.py BENCH_JSONL [min_ratio_frac]")
+        return 1
+    frac = float(sys.argv[2]) if len(sys.argv) > 2 else 0.5
+    rec = None
+    with open(sys.argv[1]) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if obj.get("metric") == "zero_sharded_update_ab":
+                rec = obj
+    if rec is None:
+        print("check_zero: no zero_sharded_update_ab record found")
+        return 1
+    legs = rec.get("legs") or {}
+    missing = {"replicated", "zero1", "fsdp"} - set(legs)
+    if missing:
+        print(f"check_zero: legs missing from the record: {sorted(missing)}")
+        return 1
+    n = int(rec.get("n_devices", 1))
+    if n <= 1:
+        # a single-device mesh cannot shard anything: the record is still
+        # useful (parity + compile counters) but the byte gate is vacuous
+        print("check_zero: n_devices=1 — bytes-ratio gate skipped "
+              "(run under XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    else:
+        want = frac * n
+        opt_ratio = (legs["replicated"]["opt_state_bytes_per_device"]
+                     / max(legs["zero1"]["opt_state_bytes_per_device"], 1))
+        if opt_ratio < want:
+            print(f"check_zero: zero1 per-device opt_state bytes ratio "
+                  f"{opt_ratio:.2f} < {want:.2f} (n_devices={n}) — the "
+                  "sharded layout is not actually sharding")
+            return 1
+        par_ratio = (legs["replicated"]["param_bytes_per_device"]
+                     / max(legs["fsdp"]["param_bytes_per_device"], 1))
+        if par_ratio < want:
+            print(f"check_zero: fsdp per-device param bytes ratio "
+                  f"{par_ratio:.2f} < {want:.2f} (n_devices={n})")
+            return 1
+        print(f"check_zero: opt bytes ratio {opt_ratio:.2f}, fsdp param "
+              f"bytes ratio {par_ratio:.2f} (ideal {n})")
+    for mode, leg in legs.items():
+        # compiles ≤ 2: the warm-up fill (jax re-traces the step once on
+        # its second call under a flipped trace context — pre-existing,
+        # identical in the replicated leg). recompiles — growth across
+        # the TIMED epochs — is the steady-state claim and must be 0.
+        if leg.get("compiles", 0) > 2 or leg.get("recompiles", 0) != 0:
+            print(f"check_zero: {mode} leg compiled {leg.get('compiles')} "
+                  f"times / recompiled {leg.get('recompiles')} — the "
+                  "sharded update must not churn shapes")
+            return 1
+        diff = leg.get("max_param_diff_vs_replicated")
+        # written as a negated <= so a NaN diff (diverged leg) FAILS the
+        # gate — `diff > 1e-6` is False for NaN, which would green-light
+        # exactly the broken-math case this gate exists to catch; a
+        # missing field is equally a failure, not a silent pass
+        if diff is None or not (float(diff) <= 1e-6):
+            print(f"check_zero: {mode} params diverged from the "
+                  f"replicated leg by {diff} — the layouts must be "
+                  "re-expressions of the same math")
+            return 1
+    print("check_zero: PASS "
+          f"(zero1 {legs['zero1']['steps_per_sec']} steps/s vs replicated "
+          f"{legs['replicated']['steps_per_sec']}, fsdp "
+          f"{legs['fsdp']['steps_per_sec']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -100,4 +100,23 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
   || { echo "tier1: cold-start smoke FAILED (warm restart recompiled,"
        echo "tier1: or a leg crashed)"; exit 1; }
 
+# Stage 6: ZeRO sharded-weight-update smoke (ISSUE 10) — the A/B row:
+# replicated vs zero1 vs fsdp layouts of the same data-parallel fit on an
+# 8-device CPU mesh (XLA_FLAGS pins the device count; the other stages
+# run single-device and don't want it). scripts/check_zero.py gates on
+# COUNTERS AND BYTES, never wall time: per-device opt_state (and fsdp
+# param) bytes must realize the 1/N sharding, each leg compiles once
+# with zero recompiles, and the sharded legs' params match the
+# replicated leg's. steps/s lands in the record, ungated.
+echo "== zero sharded-update smoke =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  timeout -k 10 300 python bench.py zero \
+  > /tmp/_zero.jsonl \
+  && tee -a BENCH_smoke.json < /tmp/_zero.jsonl > /dev/null \
+  && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python scripts/check_zero.py /tmp/_zero.jsonl \
+  || { echo "tier1: zero smoke FAILED (sharded layout not 1/N, a leg"
+       echo "tier1: recompiled, or sharded params diverged)"; exit 1; }
+
 exit $rc
