@@ -511,12 +511,20 @@ class RecompileRule(Rule):
                 # inside traced code the loop unrolls ONCE at trace time;
                 # per-layer jax.checkpoint wrapping is the remat idiom
                 continue
-            if fn is not None and mod.in_loop_within(node, fn):
+            if fn is not None and mod.in_loop_within(node, fn) \
+                    and not self._feeds_aot_compile(node, mod):
+                # a jit whose result flows into aot_compile() in the same
+                # loop body is the AUTOTUNE idiom (tuning/measure.py):
+                # one deliberate, manifest-aware compile per candidate is
+                # the search working, not a recompile hazard — the
+                # blessed site counts and caches it
                 yield mod.finding(
                     self.name, self.slug, node,
                     f"{dotted or 'jit'} built inside a loop: every "
                     "iteration pays a fresh trace+compile; hoist and "
-                    "cache the jitted callable")
+                    "cache the jitted callable (or route deliberate "
+                    "per-candidate compiles through "
+                    "utils/compile_cache.aot_compile)")
             if (dotted in self._WRAP_ONLY and node.args
                     and isinstance(node.args[0], ast.Lambda)
                     and fn is not None):
@@ -525,6 +533,47 @@ class RecompileRule(Rule):
                     f"{dotted}(lambda ...) inside a function body builds "
                     "a fresh callable (and compile-cache entry) per call; "
                     "define the function once at module/class scope")
+
+    @staticmethod
+    def _is_aot_compile(call, mod):
+        dotted = mod.dotted(call.func) or ""
+        return dotted == "aot_compile" or dotted.endswith(".aot_compile")
+
+    def _feeds_aot_compile(self, node, mod):
+        """True when the jit built at ``node`` is handed to the blessed
+        ``utils/compile_cache.aot_compile`` site within the same loop —
+        directly (``aot_compile(jax.jit(f), ...)``) or through a local
+        binding (``jitted = jax.jit(f); ex, _ = aot_compile(jitted,
+        ...)``). That is the tuner's measurement harness compiling one
+        candidate per iteration through the manifest-aware site — a
+        deliberate compile, not a hazard."""
+        parent = mod.parent(node)
+        if (isinstance(parent, ast.Call)
+                and self._is_aot_compile(parent, mod)
+                and any(a is node for a in parent.args)):
+            return True
+        names = set()
+        for a in mod.ancestors(node):
+            if isinstance(a, ast.Assign):
+                names.update(t.id for t in a.targets
+                             if isinstance(t, ast.Name))
+                break
+            if isinstance(a, (ast.For, ast.While, ast.AsyncFor,
+                              ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                break
+        if not names:
+            return False
+        loop = next((a for a in mod.ancestors(node)
+                     if isinstance(a, (ast.For, ast.While, ast.AsyncFor))),
+                    None)
+        if loop is None:
+            return False
+        return any(
+            isinstance(n, ast.Call) and self._is_aot_compile(n, mod)
+            and any(isinstance(a, ast.Name) and a.id in names
+                    for a in n.args)
+            for n in ast.walk(loop))
 
     def _lower_compile_chain(self, node, mod):
         """A chained ``<jit>.lower(...).compile(...)`` call: outside the

@@ -14,7 +14,6 @@ the north-star-mandated long-context capability, designed TPU-first:
 from __future__ import annotations
 
 import dataclasses
-import os
 
 import jax
 import jax.numpy as jnp
@@ -60,19 +59,6 @@ class LayerNormalization(ParamLayer):
         return self.activation_fn()(y), state
 
 
-def _flash_block_env(name, default=512):
-    """Env block size, validated: positive multiple of 128 (the TPU lane
-    tile rule the kernel's BlockSpecs must satisfy) or the default."""
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        val = int(raw)
-    except ValueError:
-        return default
-    return val if val >= 128 and val % 128 == 0 else default
-
-
 def dot_product_attention(q, k, v, *, mask=None, causal=False, scale=None):
     """q,k,v: [B, T, H, D]. Returns [B, T, H, D]. bf16 matmuls, f32 softmax.
 
@@ -81,18 +67,25 @@ def dot_product_attention(q, k, v, *, mask=None, causal=False, scale=None):
     traffic instead of the [B,H,T,T] logits tensor; the dispatch seam
     mirrors the LSTM fused path."""
     from deeplearning4j_tpu.ops import attention_pallas as _ap
-    if (_ap.enabled() and _ap.supported(q.shape, k.shape, mask, q.dtype)
-            and (scale is None or isinstance(scale, (int, float)))):
-        # block-size tuning knobs for live-window A/B sweeps (the 512x512
-        # default has never been tuned on hardware; longcontext MFU ~0.14
-        # says there may be real headroom). Read once per trace — jit
-        # caches the chosen blocks into the compiled step. Malformed or
-        # non-lane-multiple values fall back to the default rather than
-        # killing a scarce live-window leg mid-trace.
-        bq = _flash_block_env("DL4J_TPU_FLASH_BLOCK_Q")
-        bk = _flash_block_env("DL4J_TPU_FLASH_BLOCK_K")
-        return _ap.flash_attention(q, k, v, mask=mask, causal=causal,
-                                   scale=scale, block_q=bq, block_k=bk)
+    resolved = (_ap.resolve_attention(q.shape, k.shape, mask, q.dtype)
+                if (_ap.enabled() and (scale is None
+                                       or isinstance(scale, (int, float))))
+                else None)
+    if resolved is not None:
+        # one DB lookup decides dispatch AND geometry: TuningDB winner >
+        # the DL4J_TPU_FLASH_BLOCK_Q/K env knobs (live-window A/B
+        # sweeps) > the hand-picked 512x512. Read once per trace — jit
+        # caches the chosen blocks into the compiled step. A tuned
+        # remat=True wraps the kernel in jax.checkpoint: the backward
+        # recomputes the forward instead of saving out/lse residuals
+        # (the searched memory-for-time dimension).
+        bq, bk, remat = resolved
+
+        def flash(q, k, v):
+            return _ap.flash_attention(q, k, v, mask=mask, causal=causal,
+                                       scale=scale, block_q=bq, block_k=bk)
+
+        return (jax.checkpoint(flash) if remat else flash)(q, k, v)
     cd, ad = _dtypes.compute_dtypes_for(q.dtype)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, ad))

@@ -67,36 +67,109 @@ def enabled():
 
 # Measured v5e crossover (fwd+bwd, bf16, h=8 d=64, chained in-jit timing):
 # naive XLA wins at T<=512 (0.4-0.9x), flash wins from T=1024 (1.4x) through
-# T=8192 (23x — the [B,H,T,T] logits start thrashing HBM). Dispatch follows.
+# T=8192 (23x — the [B,H,T,T] logits start thrashing HBM). Dispatch follows
+# — unless a TuningDB entry for the shape bucket carries a MEASURED
+# decision (tuning/tune.py times the naive path as an implicit candidate).
 _MIN_SEQ = 1024
 
+#: hand-picked default block geometry — the fallback when neither the
+#: tuning DB nor the env override speaks (chosen once on one v5e window;
+#: the whole point of the tuner is retiring this constant per bucket)
+_DEFAULT_BLOCK_Q = 512
+_DEFAULT_BLOCK_K = 512
 
-def supported(q_shape, k_shape, mask, dtype, *, min_seq=None):
-    """Fast path applies: self-attention shapes only (q and k share the
-    sequence length — KV-cache decode goes to the naive path), head_dim
-    <= 128, float dtype, and sequences long enough that the kernel beats
-    XLA's fused naive path (see _MIN_SEQ crossover note; override via
-    DL4J_TPU_FUSED_ATTENTION_MIN_SEQ or min_seq=). Padding masks are
-    supported when they are key-side [B, Tk] (the reference's masking
-    contract, MaskedReductionUtil.java) — arbitrary-rank score masks go to
-    the naive path."""
+
+def _tuned(q_shape, dtype):
+    """The TuningDB entry for a [B, T, H, D] call (tuning/db.py), or
+    None. Trace-time host lookup — the resolved config compiles into the
+    step, so the counters move once per compile."""
+    from deeplearning4j_tpu.tuning.db import tuned_config
+    return tuned_config("attention", tuple(int(d) for d in q_shape), dtype)
+
+
+def env_block(name, default=512):
+    """Env block-size override, validated: a positive 128-multiple (the
+    TPU lane tile rule the kernel's BlockSpecs must satisfy) or the
+    default. Malformed values fall back rather than killing a scarce
+    live-window leg mid-trace."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        return default
+    return val if val >= 128 and val % 128 == 0 else default
+
+
+def resolve_block_sizes(q_shape, dtype):
+    """(block_q, block_k, remat) for a [B, T, H, D] call — the ONE
+    default table both ``flash_attention`` and ``flash_attention_block``
+    resolve through: TuningDB entry (searched winner for this shape
+    bucket) > ``DL4J_TPU_FLASH_BLOCK_Q/K`` env override (live-window
+    A/B sweeps) > the hand-picked 512x512 default."""
+    cfg = _tuned(q_shape, dtype)
+    if cfg and cfg.get("backend", "flash") == "flash":
+        return (int(cfg.get("block_q", _DEFAULT_BLOCK_Q)),
+                int(cfg.get("block_k", _DEFAULT_BLOCK_K)),
+                bool(cfg.get("remat", False)))
+    return (env_block("DL4J_TPU_FLASH_BLOCK_Q", _DEFAULT_BLOCK_Q),
+            env_block("DL4J_TPU_FLASH_BLOCK_K", _DEFAULT_BLOCK_K),
+            False)
+
+
+def resolve_attention(q_shape, k_shape, mask, dtype, *, min_seq=None):
+    """The whole dispatch decision in ONE TuningDB lookup: None when the
+    naive path should run, else the ``(block_q, block_k, remat)`` to run
+    the kernel with. Structural gates first (self-attention shapes only
+    — KV-cache decode goes naive; head_dim <= 128; float dtype; masks
+    only as key-side [B, Tk] padding, the reference's masking contract
+    (MaskedReductionUtil.java) — arbitrary-rank score masks go naive).
+    Then the flash-vs-naive crossover: a TuningDB entry for this shape
+    bucket carries a MEASURED verdict (``{"backend": "xla"}`` = the
+    naive path won there, else the winning block geometry); without one
+    the hand-measured _MIN_SEQ heuristic applies (override via
+    DL4J_TPU_FUSED_ATTENTION_MIN_SEQ or min_seq=) with the env/default
+    block table."""
     if mask is not None:
         mshape = tuple(getattr(mask, "shape", ()))
         if mshape != (q_shape[0], k_shape[1]):
-            return False
+            return None
     if tuple(q_shape) != tuple(k_shape):
-        return False
+        return None
     if q_shape[-1] > _LANE:
-        return False
+        return None
+    if not jnp.issubdtype(dtype, jnp.floating):
+        return None
     if min_seq is None:
+        cfg = _tuned(q_shape, dtype)
+        if cfg is not None:
+            # measured crossover: the tuner timed the naive XLA path as
+            # an implicit candidate at this bucket — its verdict replaces
+            # the one-window _MIN_SEQ constant
+            if cfg.get("backend", "flash") != "flash":
+                return None
+            return (int(cfg.get("block_q", _DEFAULT_BLOCK_Q)),
+                    int(cfg.get("block_k", _DEFAULT_BLOCK_K)),
+                    bool(cfg.get("remat", False)))
         try:
             min_seq = int(os.environ.get("DL4J_TPU_FUSED_ATTENTION_MIN_SEQ",
                                          _MIN_SEQ))
         except ValueError:  # malformed override: keep the measured default
             min_seq = _MIN_SEQ
     if q_shape[1] < min_seq:
-        return False
-    return jnp.issubdtype(dtype, jnp.floating)
+        return None
+    return (env_block("DL4J_TPU_FLASH_BLOCK_Q", _DEFAULT_BLOCK_Q),
+            env_block("DL4J_TPU_FLASH_BLOCK_K", _DEFAULT_BLOCK_K),
+            False)
+
+
+def supported(q_shape, k_shape, mask, dtype, *, min_seq=None):
+    """Whether the fast path applies (see ``resolve_attention``, which
+    callers on the dispatch path should prefer — it returns the resolved
+    block geometry from the SAME single DB lookup)."""
+    return resolve_attention(q_shape, k_shape, mask, dtype,
+                             min_seq=min_seq) is not None
 
 
 def _attn_kernel(t_true, causal, scale, block_q, block_k, has_mask,
@@ -341,26 +414,33 @@ def flash_attention_block(q, k, v, causal, scale, interpret):
     """(out [B,T,H,D], lse [B,H,T]) for ONE ring-attention block pair —
     the fused-kernel replacement for a naive [B,H,Tq,Tk]-logits block in
     parallel/sequence.py. The lse output lets the caller combine blocks by
-    log-sum-exp; its cotangent is handled exactly (see _bwd_core)."""
+    log-sum-exp; its cotangent is handled exactly (see _bwd_core). Block
+    sizes resolve through the same TuningDB/env/default table as the main
+    ``flash_attention`` entry (this entry used to hardcode 512x512 and
+    bypass even the env override)."""
     b, t, h, d = q.shape
+    bq, bk, _ = resolve_block_sizes(q.shape, q.dtype)
     out, lse = _run_fwd(_fold_heads(q), _fold_heads(k), _fold_heads(v),
-                        None, h, causal, scale, 512, 512, interpret)
+                        None, h, causal, scale, bq, bk, interpret)
     return _unfold_heads(out, b, h), lse.reshape(b, h, t)
 
 
 def _flash_block_fwd(q, k, v, causal, scale, interpret):
     b, t, h, d = q.shape
+    bq, bk, _ = resolve_block_sizes(q.shape, q.dtype)
     qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
-    out, lse = _run_fwd(qf, kf, vf, None, h, causal, scale, 512, 512,
+    out, lse = _run_fwd(qf, kf, vf, None, h, causal, scale, bq, bk,
                         interpret)
     return (_unfold_heads(out, b, h), lse.reshape(b, h, t)), \
-        (qf, kf, vf, out, lse, b, h)
+        (qf, kf, vf, out, lse, b, h, bk)
 
 
 def _flash_block_bwd(causal, scale, interpret, res, grads):
-    qf, kf, vf, out, lse, b, h = res
+    # bk rides the residuals so fwd and bwd tile identically even if the
+    # DB/env resolution were to change between the two traces
+    qf, kf, vf, out, lse, b, h, bk = res
     g_out, g_lse = grads
-    dq, dk, dv = _bwd_core(causal, scale, 512, (qf, kf, vf, None, out, lse),
+    dq, dk, dv = _bwd_core(causal, scale, bk, (qf, kf, vf, None, out, lse),
                            _fold_heads(g_out),
                            g_lse=g_lse.reshape(b * h, -1))
     return (_unfold_heads(dq, b, h), _unfold_heads(dk, b, h),
@@ -374,13 +454,20 @@ _attention.defvjp(_attention_fwd, _attention_bwd)
 
 
 def flash_attention(q, k, v, *, mask=None, causal=False, scale=None,
-                    block_q=512, block_k=512, interpret=False):
+                    block_q=None, block_k=None, interpret=False):
     """Fused attention over [B, T, H, D] self-attention inputs (same
     contract as nn/layers/attention.py dot_product_attention minus
     cross-length decode). ``mask``: optional [B, Tk] key-side padding mask
     (1 = valid). Fully-masked query rows emit 0 (the naive path emits NaN
-    there — 0 is what the downstream masked-output multiply expects)."""
+    there — 0 is what the downstream masked-output multiply expects).
+    ``block_q``/``block_k`` default to ``resolve_block_sizes`` (TuningDB
+    winner for the shape bucket > env override > 512x512); explicit
+    values win unconditionally (tests, the tuner's own candidates)."""
     b, t, h, d = q.shape
+    if block_q is None or block_k is None:
+        rq, rk, _ = resolve_block_sizes(q.shape, q.dtype)
+        block_q = rq if block_q is None else block_q
+        block_k = rk if block_k is None else block_k
     if scale is None:
         scale = 1.0 / float(d) ** 0.5
     # custom_vjp needs an array operand in every slot: a zero-width [B, 0]

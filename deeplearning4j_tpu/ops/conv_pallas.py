@@ -67,9 +67,20 @@ def _pad_to(n, m):
 # tile geometry: rows (sublane dim) and Cout lanes; bk tiles the Cin
 # reduction of the 1x1 matmul. VMEM at the defaults: f32 acc 256x512 =
 # 512 KiB + double-buffered bf16 x/w blocks well under the ~16 MiB budget.
+# These are the HAND-PICKED fallbacks — a TuningDB entry for the call's
+# shape bucket (tuning/db.py, kernel ids "conv_matmul"/"conv3x3")
+# overrides them at trace time.
 _BN = 256
 _BK = 256
 _BJ = 512
+_BT_TARGET = 256
+
+
+def _tuned(kernel, shape, dtype):
+    """Trace-time TuningDB lookup (None without a DB/entry — the
+    hand-picked defaults above apply)."""
+    from deeplearning4j_tpu.tuning.db import tuned_config
+    return tuned_config(kernel, shape, dtype)
 
 
 def _mm_stats_kernel(nk, x_ref, w_ref, z_ref, s_ref, acc_s, st_s):
@@ -104,21 +115,29 @@ def _mm_stats_kernel(nk, x_ref, w_ref, z_ref, s_ref, acc_s, st_s):
             s_ref[:] = st_s[:]  # rows 0/1 live; 2-7 sublane padding
 
 
-def _matmul_stats(x2d, w2d, interpret):
+def _matmul_stats(x2d, w2d, interpret, *, bn=None, bk=None, bj=None):
     """x2d [N, Cin] @ w2d [Cin, Cout] -> (z [N, Cout] in x.dtype,
     stats [2, Cout] f32 = per-channel [sum, sum_of_squares]).
 
     Pads every axis to tile multiples with zeros; zero rows contribute 0
     to both stats sums, so the caller divides by the REAL row count.
+    Tile geometry: explicit ``bn/bk/bj`` (the tuner's candidates) >
+    TuningDB winner for the shape bucket > hand-picked defaults; every
+    choice is clamped to the padded array like the defaults always were.
     """
     if not _HAS_PLTPU:
         raise NotImplementedError("Pallas TPU support unavailable")
     n, cin = x2d.shape
     cout = w2d.shape[1]
     dt = x2d.dtype
-    bn = min(_BN, _pad_to(n, 8))
-    bk = min(_BK, _pad_to(cin, 128))
-    bj = min(_BJ, _pad_to(cout, 128))
+    if bn is None or bk is None or bj is None:
+        cfg = _tuned("conv_matmul", (n, cin, cout), dt) or {}
+        bn = cfg.get("bn", _BN) if bn is None else bn
+        bk = cfg.get("bk", _BK) if bk is None else bk
+        bj = cfg.get("bj", _BJ) if bj is None else bj
+    bn = min(int(bn), _pad_to(n, 8))
+    bk = min(int(bk), _pad_to(cin, 128))
+    bj = min(int(bj), _pad_to(cout, 128))
     np_, kp, jp = _pad_to(n, bn), _pad_to(cin, bk), _pad_to(cout, bj)
     xp = jnp.pad(x2d, ((0, np_ - n), (0, kp - cin)))
     wp = jnp.pad(w2d, ((0, kp - cin), (0, jp - cout)))
@@ -182,7 +201,7 @@ def _conv3x3_stats_kernel(stride, x0_ref, x1_ref, x2_ref, w_ref, z_ref,
         s_ref[:] = st_s[:]
 
 
-def _conv3x3_stats(x, w, interpret, stride=1):
+def _conv3x3_stats(x, w, interpret, stride=1, *, bt_target=None, bj=None):
     """SAME 3x3 conv with fused stats, stride 1 or 2. x [B,H,W,Cin] NHWC,
     w [3,3,Cin,Cout] HWIO -> (z [B,Ho,Wo,Cout], stats [2, Cout] f32).
 
@@ -198,15 +217,21 @@ def _conv3x3_stats(x, w, interpret, stride=1):
     cout = w.shape[3]
     dt = x.dtype
     cinp = _pad_to(cin, 128)
-    bj = min(_BJ, _pad_to(cout, 128))
-    jp = _pad_to(cout, bj)
     ho = -(-h // stride)
     wo = -(-wd // stride)
-    # batch tile: keep the row-block GEMM M-dim (bt*Wo) near the 256-row
-    # sweet spot without exceeding it wildly on large images
-    bt = max(1, min(bsz, _pad_to(256 // max(wo, 1), 1)))
-    while bsz % bt:
-        bt -= 1
+    if bt_target is None or bj is None:
+        cfg = _tuned("conv3x3", (bsz, h, wd, cin, cout), dt) or {}
+        bt_target = cfg.get("bt_target", _BT_TARGET) \
+            if bt_target is None else bt_target
+        bj = cfg.get("bj", _BJ) if bj is None else bj
+    bj = min(int(bj), _pad_to(cout, 128))
+    jp = _pad_to(cout, bj)
+    # batch tile: keep the row-block GEMM M-dim (bt*Wo) near the tuned
+    # row target (hand-picked sweet spot: 256) without exceeding it
+    # wildly on large images — shared arithmetic with the tuner's static
+    # validity estimate (tuning/space.conv3x3_bt)
+    from deeplearning4j_tpu.tuning.space import conv3x3_bt
+    bt = conv3x3_bt(bt_target, bsz, wo)
     bp = bsz  # batch stays unpadded (bt divides it)
     # zero-pad: spatial halo + channel/cout lane padding. SAME paddings:
     # s=1 -> (1, 1); s=2 on EVEN dims -> (lo 0, hi 1). Odd dims under s=2

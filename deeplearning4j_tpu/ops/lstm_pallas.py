@@ -176,9 +176,12 @@ def _lstm_seq_kernel_tiled(n_tiles, has_peephole, has_mask, *refs):
             cT_ref[:] = c.astype(cT_ref.dtype)
 
 
-def _run_kernel_any(xz, wh, wp, h0, c0, mask, interpret):
+def _run_kernel_any(xz, wh, wp, h0, c0, mask, interpret, tile_cols=None):
     """Dispatch to the resident or tiled kernel; wp/mask may be None.
-    mask is time-major [T, B] (1=valid)."""
+    mask is time-major [T, B] (1=valid). ``tile_cols`` picks the tiled
+    kernel's Wh column width: explicit (the tuner's candidates) >
+    TuningDB winner for the shape bucket > the widest 128-multiple
+    divisor of 4H under the hand-picked _TILE_COLS ceiling."""
     t, b, four_h = xz.shape
     hsz = four_h // 4
     dt = xz.dtype
@@ -193,8 +196,23 @@ def _run_kernel_any(xz, wh, wp, h0, c0, mask, interpret):
         pl.BlockSpec((hsz, four_h), lambda i: (0, 0)),
     ]
     if tiled:
-        tile = next(c for c in range(min(_TILE_COLS, four_h), 0, -128)
-                    if four_h % c == 0)
+        if tile_cols is None:
+            from deeplearning4j_tpu.tuning.db import tuned_config
+            cfg = tuned_config("lstm", (t, b, hsz), dt)
+            if cfg:
+                tile_cols = cfg.get("tile_cols")
+        tile = None
+        if tile_cols:
+            tile_cols = int(tile_cols)
+            # honor only a geometry the kernel grid can express; an
+            # invalid value (stale DB vs a new shape) falls back to the
+            # default divisor rather than failing the compile
+            if (tile_cols % 128 == 0 and 0 < tile_cols <= four_h
+                    and four_h % tile_cols == 0):
+                tile = tile_cols
+        if tile is None:
+            tile = next(c for c in range(min(_TILE_COLS, four_h), 0, -128)
+                        if four_h % c == 0)
         n_tiles = four_h // tile
         in_specs_t = [  # tiled: grid (T, K)
             pl.BlockSpec((1, b, tile), lambda i, k: (i, 0, k)),
@@ -248,21 +266,24 @@ def _run_kernel_any(xz, wh, wp, h0, c0, mask, interpret):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
-def _fused_seq(xz, wh, wp, h0, c0, mask, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def _fused_seq(xz, wh, wp, h0, c0, mask, interpret=False, tile_cols=None):
     """xz [T,B,4H] (= x@Wx + b, time-major), wh [H,4H], wp [3,H] (i|f|o
     rows) or None, h0/c0 [B,H], mask [T,B] (1=valid) or None. Returns
-    (hs [T,B,H], (hT, cT))."""
-    hs, cs, hT, cT = _run_kernel_any(xz, wh, wp, h0, c0, mask, interpret)
+    (hs [T,B,H], (hT, cT)). ``tile_cols``: explicit tiled-kernel column
+    width (see _run_kernel_any)."""
+    hs, cs, hT, cT = _run_kernel_any(xz, wh, wp, h0, c0, mask, interpret,
+                                     tile_cols)
     return hs, (hT, cT)
 
 
-def _fwd(xz, wh, wp, h0, c0, mask, interpret):
-    hs, cs, hT, cT = _run_kernel_any(xz, wh, wp, h0, c0, mask, interpret)
+def _fwd(xz, wh, wp, h0, c0, mask, interpret, tile_cols):
+    hs, cs, hT, cT = _run_kernel_any(xz, wh, wp, h0, c0, mask, interpret,
+                                     tile_cols)
     return (hs, (hT, cT)), (xz, wh, wp, h0, c0, mask, hs, cs)
 
 
-def _bwd(interpret, res, grads):
+def _bwd(interpret, tile_cols, res, grads):
     xz, wh, wp, h0, c0, mask, hs, cs = res
     dhs, (dhT, dcT) = grads
     t, b, hsz = hs.shape
@@ -378,7 +399,7 @@ def pad_hidden(hsz):
 
 
 def fused_sequence_padded(xz, wh, h0, c0, wp=None, mask=None,
-                          interpret=False):
+                          interpret=False, tile_cols=None):
     """Dispatch wrapper that lane-pads H to a 128-multiple when needed.
 
     Padding is exact, not approximate: padded xz/Wh/Wp/h0/c0 lanes are zero,
@@ -396,7 +417,7 @@ def fused_sequence_padded(xz, wh, h0, c0, wp=None, mask=None,
     if mask is not None:
         mask = mask.astype(jnp.float32)  # float cotangent (always zero)
     if hp == hsz:
-        return _fused_seq(xz, wh, wp, h0, c0, mask, interpret)
+        return _fused_seq(xz, wh, wp, h0, c0, mask, interpret, tile_cols)
 
     dpad = hp - hsz
     # re-lay the packed 4H axis as [4, H] blocks, pad each gate block
@@ -407,7 +428,8 @@ def fused_sequence_padded(xz, wh, h0, c0, wp=None, mask=None,
     h0p = jnp.pad(h0, ((0, 0), (0, dpad)))
     c0p = jnp.pad(c0, ((0, 0), (0, dpad)))
     wpp = None if wp is None else jnp.pad(wp, ((0, 0), (0, dpad)))
-    hsp, (hTp, cTp) = _fused_seq(xzp, whp, wpp, h0p, c0p, mask, interpret)
+    hsp, (hTp, cTp) = _fused_seq(xzp, whp, wpp, h0p, c0p, mask, interpret,
+                                 tile_cols)
     return hsp[:, :, :hsz], (hTp[:, :hsz], cTp[:, :hsz])
 
 

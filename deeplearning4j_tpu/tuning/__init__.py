@@ -1,0 +1,40 @@
+"""Kernel autotuner: searched Pallas configs with a persistent tuning DB.
+
+TVM-mold pipeline (PAPERS.md arxiv 1802.04799) over the hand-tuned
+Pallas kernels:
+
+* :mod:`tuning.space` — per-kernel config spaces with static validity
+  pruning (VMEM budget, the TPU (8,128) tile rule) so invalid candidates
+  never pay a compile;
+* :mod:`tuning.measure` — chained in-jit candidate timing with a
+  parity gate (every winner verified ≤tol against the reference path);
+* :mod:`tuning.db` — the persistent :class:`TuningDB`, keyed kernel id x
+  shape bucket x dtype x backend+jax version, consulted by the ops-layer
+  dispatch seams at trace time (env ``DL4J_TPU_TUNING_DB``), every
+  interaction counted into ``tuning_db_total{event=}``;
+* :mod:`tuning.tune` — the per-kernel search drivers behind the ``tune``
+  CLI verb.
+
+A populated DB composes with PR 9's warm manifests: the tuned configs
+resolve at trace time, so ``aot_compile`` serializes TUNED executables —
+and folds the DB's content fingerprint into the manifest signature, so a
+warm restart loads tuned kernels with zero compiles while a re-tuned DB
+cleanly invalidates the stale entries.
+"""
+
+from deeplearning4j_tpu.tuning.db import (ENV_DB, TuningDB, active_db,
+                                          active_fingerprint, bucket_shape,
+                                          event_counts, set_db,
+                                          tuned_config)
+from deeplearning4j_tpu.tuning.measure import (Measurement, parity_diff,
+                                               search, time_callable)
+from deeplearning4j_tpu.tuning.space import (SPACES, VMEM_BUDGET,
+                                             enumerate_space, prune,
+                                             validate)
+from deeplearning4j_tpu.tuning.tune import KERNELS, tune_kernels
+
+__all__ = ["ENV_DB", "KERNELS", "Measurement", "SPACES", "TuningDB",
+           "VMEM_BUDGET", "active_db", "active_fingerprint",
+           "bucket_shape", "enumerate_space", "event_counts",
+           "parity_diff", "prune", "search", "set_db", "time_callable",
+           "tune_kernels", "tuned_config", "validate"]

@@ -1,0 +1,286 @@
+"""Persistent kernel-tuning database + process-wide runtime lookup.
+
+The search half lives in tuning/measure.py / tuning/tune.py; this module
+owns what survives it: winners keyed like PR 9's ``WarmManifest`` —
+**kernel id x shape bucket x dtype x backend+jax version** — persisted as
+one JSON artifact (env ``DL4J_TPU_TUNING_DB``, populated by the ``tune``
+CLI verb) that the ops-layer dispatch seams consult at trace time.
+
+Trust/degradation model mirrors the compile-cache tier: a corrupt or
+newer-versioned DB warns, counts a ``mismatch_drop``, and degrades to the
+hand-picked kernel defaults — never a crash; a DB tuned on another
+backend simply yields misses (the backend fingerprint is part of every
+key). Every interaction counts into
+``tuning_db_total{event=hit|miss|tune|reject|mismatch_drop}``:
+
+* ``hit``/``miss`` — a dispatch-seam lookup found / did not find a tuned
+  config for the (bucketed) call shape;
+* ``tune`` — a searched winner was recorded;
+* ``reject`` — a candidate failed the parity gate during search (see
+  tuning/measure.py) and was discarded;
+* ``mismatch_drop`` — a corrupt/newer-version DB artifact was refused.
+
+Lookups happen at TRACE time (shapes are static), so the counters move
+once per compile, not per step — and ``aot_compile`` folds the active
+DB's content fingerprint into manifest signatures, so a re-tuned DB
+invalidates stale warm-manifest executables instead of silently serving
+kernels tuned under the old configs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import warnings
+
+__all__ = ["ENV_DB", "TuningDB", "active_db", "active_fingerprint",
+           "bucket_shape", "count_event", "event_counts", "set_db",
+           "tuned_config"]
+
+#: environment variable naming the tuning-DB JSON artifact
+ENV_DB = "DL4J_TPU_TUNING_DB"
+
+DB_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+def _counter():
+    from deeplearning4j_tpu import telemetry as _tm
+    return _tm.get_registry().counter(
+        "tuning_db_total",
+        "kernel-tuning DB interactions by event: hit (dispatch found a "
+        "tuned config for the call's shape bucket), miss (no entry — "
+        "hand-picked defaults apply), tune (a searched winner was "
+        "recorded), reject (a candidate failed the parity gate during "
+        "search), mismatch_drop (corrupt or newer-version DB artifact "
+        "refused at load — defaults apply)")
+
+
+def count_event(event, n=1):
+    """Count one ``tuning_db_total`` interaction."""
+    _counter().inc(n, event=event)
+
+
+def event_counts():
+    """{event: count} snapshot of ``tuning_db_total`` (bench gates and
+    the CLI summary)."""
+    from deeplearning4j_tpu import telemetry as _tm
+    c = _tm.get_registry().get("tuning_db_total")
+    if c is None:
+        return {}
+    return {ls.get("event", ""): c.value(**ls) for ls in c.labelsets()}
+
+
+# ---------------------------------------------------------------------------
+# keys
+# ---------------------------------------------------------------------------
+
+def bucket_shape(shape):
+    """Each dim rounded up to the next power of two — one tuned entry
+    covers the whole bucket, the same shape-coarsening the serving tier's
+    batch buckets apply (a T=1000 call reuses the T=1024 winner instead
+    of missing)."""
+    out = []
+    for d in shape:
+        d = int(d)
+        out.append(d if d <= 1 else 1 << (d - 1).bit_length())
+    return tuple(out)
+
+
+def _dtype_str(dtype):
+    """Canonical dtype spelling ("float32", "bfloat16") whatever form the
+    caller holds — np.dtype, the jnp scalar type, or a string."""
+    try:
+        import numpy as np
+        return str(np.dtype(dtype))
+    except Exception:
+        return str(getattr(dtype, "name", dtype) or dtype)
+
+
+def _key(kernel, shape, dtype, backend_fp):
+    bucket = ",".join(str(d) for d in bucket_shape(shape))
+    return f"{kernel}|{bucket}|{_dtype_str(dtype)}|{backend_fp}"
+
+
+class TuningDB:
+    """Searched kernel winners, keyed (kernel, shape bucket, dtype,
+    backend fingerprint), JSON round-trip."""
+
+    def __init__(self, path=None):
+        self.path = path
+        self.entries = {}  # key -> {"config": {...}, "score_ms": ...}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def backend_fingerprint():
+        from deeplearning4j_tpu.utils.compile_cache import backend_fingerprint
+        return backend_fingerprint()
+
+    def __len__(self):
+        with self._lock:
+            return len(self.entries)
+
+    def record(self, kernel, shape, dtype, config, score_ms=None,
+               meta=None):
+        """Persist a parity-gated winner for this shape bucket (counts
+        ``tune``). Overwrites any previous winner for the key — a
+        re-tune IS the refresh."""
+        entry = {"config": dict(config),
+                 "kernel": kernel,
+                 "shape_bucket": list(bucket_shape(shape)),
+                 "dtype": _dtype_str(dtype)}
+        if score_ms is not None:
+            entry["score_ms"] = round(float(score_ms), 6)
+        if meta:
+            entry.update(meta)
+        key = _key(kernel, shape, dtype, self.backend_fingerprint())
+        with self._lock:
+            self.entries[key] = entry
+        count_event("tune")
+        return entry
+
+    def lookup(self, kernel, shape, dtype):
+        """The tuned config dict for this call's shape bucket, or None.
+        Counts ``hit``/``miss`` — at trace time, so once per compile."""
+        key = _key(kernel, shape, dtype, self.backend_fingerprint())
+        with self._lock:
+            entry = self.entries.get(key)
+        if entry is None:
+            count_event("miss")
+            return None
+        count_event("hit")
+        return dict(entry["config"])
+
+    def fingerprint(self):
+        """Content hash of the entries — folded into warm-manifest
+        signatures (utils/compile_cache.full_signature) so a DB refresh
+        invalidates executables baked with stale configs."""
+        with self._lock:
+            doc = json.dumps(self.entries, sort_keys=True)
+        return hashlib.sha256(doc.encode()).hexdigest()[:16]
+
+    # -- persistence ---------------------------------------------------
+
+    def save(self, path=None):
+        """Atomic JSON write (tmp + rename — a crashed tuner never
+        leaves a truncated DB a later start would refuse)."""
+        path = path or self.path
+        if not path:
+            raise ValueError("TuningDB.save: no path (pass one or "
+                             "construct with path=)")
+        with self._lock:
+            entries = dict(self.entries)
+        doc = {"tuning_db_version": DB_VERSION,
+               "backend_note": self.backend_fingerprint(),
+               "entries": entries}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self.path = path
+        return path
+
+    @classmethod
+    def load(cls, path):
+        with open(path) as f:
+            doc = json.load(f)
+        ver = doc.get("tuning_db_version", 0)
+        if not isinstance(doc.get("entries"), dict):
+            raise ValueError("not a tuning DB (no entries map)")
+        if ver > DB_VERSION:
+            raise ValueError(f"tuning DB version {ver} is newer than "
+                             f"supported {DB_VERSION}")
+        db = cls(path)
+        db.entries = dict(doc["entries"])
+        return db
+
+    @classmethod
+    def load_lenient(cls, path, context="tuning DB"):
+        """``load`` that degrades instead of raising: a corrupt or
+        newer-version artifact warns, counts ``mismatch_drop``, and
+        returns None — the hand-picked defaults apply, never a crash. A
+        missing file is the normal before-first-tune state (silent)."""
+        try:
+            return cls.load(path)
+        except FileNotFoundError:
+            return None
+        except Exception as e:
+            warnings.warn(
+                f"{context} at {path!r} is unusable ({e}) — ignoring it; "
+                "the hand-picked kernel defaults apply", stacklevel=3)
+            count_event("mismatch_drop")
+            return None
+
+
+# ---------------------------------------------------------------------------
+# process-wide runtime lookup (the dispatch seams' entry point)
+# ---------------------------------------------------------------------------
+
+_rt_lock = threading.Lock()
+_rt = {"explicit": False, "db": None, "path": None, "mtime": None}
+
+
+def set_db(db):
+    """Bind ``db`` as the process's active tuning DB (tests, the tune
+    CLI, bench legs). ``set_db(None)`` returns to env-var resolution."""
+    with _rt_lock:
+        _rt["explicit"] = db is not None
+        _rt["db"] = db
+        _rt["path"] = None
+        _rt["mtime"] = None
+
+
+def active_db():
+    """The active TuningDB: an explicit ``set_db`` binding, else the
+    ``$DL4J_TPU_TUNING_DB`` artifact (cached by path+mtime so trace-time
+    lookups never re-read an unchanged file), else None."""
+    with _rt_lock:
+        if _rt["explicit"]:
+            return _rt["db"]
+        path = os.environ.get(ENV_DB)
+        if not path:
+            _rt["db"], _rt["path"], _rt["mtime"] = None, None, None
+            return None
+        try:
+            mtime = os.stat(path).st_mtime_ns
+        except OSError:
+            mtime = None  # missing file: cache the miss until it appears
+        if _rt["path"] == path and _rt["mtime"] == mtime:
+            return _rt["db"]
+        _rt["path"], _rt["mtime"] = path, mtime
+        _rt["db"] = (TuningDB.load_lenient(path)
+                     if mtime is not None else None)
+        return _rt["db"]
+
+
+def active_fingerprint():
+    """Content fingerprint of the active DB, or None when no DB is
+    bound — the manifest-signature ingredient (see
+    utils/compile_cache.full_signature)."""
+    db = active_db()
+    return None if db is None or not len(db) else db.fingerprint()
+
+
+def tuned_config(kernel, shape, dtype):
+    """The tuned config for this call, or None (no DB bound, or no entry
+    for the bucket — hand-picked defaults apply). The ONE function the
+    ops dispatch seams call; it never raises."""
+    try:
+        db = active_db()
+        if db is None:
+            return None
+        return db.lookup(kernel, shape, dtype)
+    except Exception:  # a tuning lookup must never kill a trace
+        return None

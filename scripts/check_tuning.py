@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Gate the `bench.py kernels` autotuner record (tier1.sh stage 7).
+
+The autotuner acceptance is parity and counters, never wall time (CPU
+legs run the kernels in interpret mode and jitter ±15-30% besides):
+
+  * every benched kernel produced a winner from >=1 measured candidate,
+    and its tuned output matches the default-config output <=1e-6 (the
+    layouts are re-expressions of the same math; a NaN diff FAILS);
+  * no candidate that failed the parity gate leaked into a DB record
+    (rejected_parity is reported, the tune event count must equal the
+    DB entry count);
+  * the warm-restart composition holds: with the populated TuningDB +
+    warm manifest, the simulated restart served its executable FROM the
+    manifest (warm_source == "manifest") with zero compiles
+    (compile_cache_total delta: hits only, no miss/serialize) and only
+    tuning hit events (no miss/reject/mismatch_drop), recompiles_total
+    delta 0, and the restart's output matching the default path <=1e-6.
+
+Usage: check_tuning.py BENCH_JSONL [tol]
+Exit 0 when the record passes, 1 with a reason otherwise.
+"""
+
+import json
+import sys
+
+
+def _ok_diff(val, tol):
+    # negated <= so NaN/None FAILS (`diff > tol` is False for NaN, which
+    # would green-light exactly the broken-math case)
+    return val is not None and (float(val) <= tol)
+
+
+def main():
+    if len(sys.argv) < 2:
+        print("usage: check_tuning.py BENCH_JSONL [tol]")
+        return 1
+    tol = float(sys.argv[2]) if len(sys.argv) > 2 else 1e-6
+    rec = None
+    with open(sys.argv[1]) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if obj.get("metric") == "kernel_autotuner_ab":
+                rec = obj
+    if rec is None:
+        print("check_tuning: no kernel_autotuner_ab record found")
+        return 1
+    kernels = rec.get("kernels") or {}
+    if not kernels:
+        print("check_tuning: record benched no kernels")
+        return 1
+    for name, leg in kernels.items():
+        if not leg.get("winner"):
+            print(f"check_tuning: {name} produced no winner "
+                  f"({leg.get('candidates')} candidates, "
+                  f"{leg.get('rejected_parity')} parity-rejected)")
+            return 1
+        if int(leg.get("candidates") or 0) < 1:
+            print(f"check_tuning: {name} measured no candidates")
+            return 1
+        if not _ok_diff(leg.get("parity_tuned_vs_default"), tol):
+            print(f"check_tuning: {name} tuned output diverged from the "
+                  f"default config by {leg.get('parity_tuned_vs_default')}"
+                  f" — tuned tilings must be re-expressions of the same "
+                  "math")
+            return 1
+    events = rec.get("db_events") or {}
+    if events.get("tune", 0) != rec.get("db_entries", -1):
+        print(f"check_tuning: {events.get('tune', 0)} tune events vs "
+              f"{rec.get('db_entries')} DB entries — a rejected candidate "
+              "may have been persisted (or a winner dropped)")
+        return 1
+    for bad in ("mismatch_drop",):
+        if events.get(bad, 0):
+            print(f"check_tuning: {events[bad]} {bad} event(s) — the "
+                  "bench's own DB should never be refused")
+            return 1
+    warm = rec.get("warm") or {}
+    if warm.get("warm_source") != "manifest":
+        print(f"check_tuning: warm restart compiled (source="
+              f"{warm.get('warm_source')!r}) instead of loading the "
+              "tuned executable from the manifest")
+        return 1
+    ccd = warm.get("compile_cache_delta") or {}
+    if ccd.get("hit", 0) < 1 or ccd.get("miss", 0) != 0 \
+            or ccd.get("serialize", 0) != 0:
+        print(f"check_tuning: warm-restart compile_cache delta {ccd} — "
+              "expected hits only (zero compiles)")
+        return 1
+    tdd = warm.get("tuning_db_delta") or {}
+    if tdd.get("hit", 0) < 1 or any(
+            tdd.get(k, 0) for k in ("miss", "reject", "mismatch_drop")):
+        print(f"check_tuning: warm-restart tuning_db delta {tdd} — "
+              "expected only hit events")
+        return 1
+    if warm.get("recompiles_delta", None) != 0:
+        print(f"check_tuning: warm restart recompiles_delta="
+              f"{warm.get('recompiles_delta')} — the tuned executable "
+              "must load without recompiling")
+        return 1
+    if not _ok_diff(warm.get("parity_warm_vs_default"), tol):
+        print(f"check_tuning: warm-restart output diverged by "
+              f"{warm.get('parity_warm_vs_default')}")
+        return 1
+    attn = kernels.get("attention", {})
+    print("check_tuning: PASS "
+          f"(kernels {sorted(kernels)}, attention tuned "
+          f"{attn.get('tuned_ms')} ms vs default {attn.get('default_ms')}"
+          f" ms [recorded, not gated], warm restart manifest-served with "
+          "hits only)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

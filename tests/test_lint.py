@@ -302,6 +302,54 @@ class TestR3Recompile:
         """
         assert "R3" not in rule_set(src)
 
+    def test_jit_in_loop_into_aot_compile_silent(self):
+        # ISSUE 11: the autotuner's measurement harness deliberately
+        # compiles one candidate per loop iteration — routed through the
+        # blessed manifest-aware site, that is the search working, not a
+        # recompile hazard (tuning/measure.py's idiom)
+        src = """
+            import jax
+            from deeplearning4j_tpu.utils.compile_cache import aot_compile
+
+            def search(self, candidates, args):
+                best = None
+                for cand in candidates:
+                    jitted = jax.jit(self.build(cand))
+                    ex, _src = aot_compile(jitted, *args)
+                    best = self.keep_best(best, ex, args)
+                return best
+        """
+        assert "R3" not in rule_set(src)
+
+    def test_jit_in_loop_into_aot_compile_direct_arg_silent(self):
+        # direct-argument form, via the module-alias spelling
+        src = """
+            import jax
+            from deeplearning4j_tpu.utils import compile_cache as _cc
+
+            def search(self, candidates, args):
+                for cand in candidates:
+                    ex, _src = _cc.aot_compile(jax.jit(self.build(cand)),
+                                               *args)
+                    self.note(ex)
+        """
+        assert "R3" not in rule_set(src)
+
+    def test_jit_in_loop_without_aot_compile_still_fires(self):
+        # the bad twin: same loop shape, but the compile bypasses the
+        # cache tier — every iteration is an untracked recompile
+        src = """
+            import jax
+
+            def search(self, candidates, args):
+                for cand in candidates:
+                    jitted = jax.jit(self.build(cand))
+                    jitted(*args)
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R3"]
+        assert len(fs) == 1
+        assert "aot_compile" in fs[0].message
+
 
 # ----------------------------------------------------------------------
 # R4: impure jit bodies
