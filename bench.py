@@ -802,6 +802,211 @@ def _bench_serving_sweep(hidden, duration_s, probe_s,
             "aot": stats["aot"], "curve": curve}
 
 
+def bench_fleet(duration_s=1.2, probe_s=0.35):
+    """The fleet tier end to end (deeplearning4j_tpu/fleet): N worker
+    PROCESSES from one checkpoint + warm manifest behind the admission/
+    routing front — capacity probe, offered-load sweep, and the
+    kill-a-worker chaos leg (SIGKILL mid-sweep, router retries onto the
+    survivors, supervisor respawns, the REPLACEMENT warm-starts with
+    zero compiles). scripts/check_fleet.py gates on COUNTERS AND PARITY
+    (fleet answers == single-engine answers <=1e-6, warm starts
+    counter-asserted, zero uncounted request losses) — never wall time
+    on CPU. One BENCH JSON record."""
+    import shutil
+    import signal
+    import tempfile
+
+    import numpy as np
+
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.fleet import FleetRouter, FleetSupervisor
+    from deeplearning4j_tpu.nn import layers as L
+    from deeplearning4j_tpu.nn import updaters as U
+    from deeplearning4j_tpu.nn.conf import inputs as I
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import ServingEngine, ServingOverloaded
+    from deeplearning4j_tpu.utils.serialization import save_model
+
+    telemetry.enable()
+    n_workers = 3
+    hidden = 1024
+    if _preflight():
+        hidden, duration_s, probe_s = 256, 0.8, 0.25
+    conf = NeuralNetConfig(seed=7, updater=U.Sgd(learning_rate=0.1)).list(
+        L.DenseLayer(n_out=hidden, activation="relu"),
+        L.DenseLayer(n_out=hidden, activation="relu"),
+        L.OutputLayer(n_out=10, loss="mcxent"),
+        input_type=I.FeedForwardType(64))
+    net = MultiLayerNetwork(conf)
+    net.init()
+    buckets = (1, 2, 4, 8)
+    workdir = tempfile.mkdtemp(prefix="fleet_bench_")
+    sup = router = None
+    try:
+        ckpt = os.path.join(workdir, "ckpt.zip")
+        save_model(net, ckpt)
+        # the instant-restart artifact every worker AND every elastic
+        # replacement restores executables from (PR 9 tier) — built once
+        # in THIS process; also the single-engine parity reference
+        engine = ServingEngine(net, name="default", input_spec=(64,),
+                               buckets=buckets)
+        wm = engine.save_warm_manifest(os.path.join(workdir, "wm.zip"))
+        rs = np.random.RandomState(0)
+        xs = rs.rand(64, 64).astype(np.float32)
+        ref = np.asarray(engine.output(xs[:16]))
+        engine.stop()
+
+        t0 = time.perf_counter()
+        sup = FleetSupervisor(n_workers, model_path=ckpt,
+                              buckets=buckets, warm_manifest=wm,
+                              probe_interval_s=0.25, max_missed_probes=2)
+        router = FleetRouter(name="default", max_queue=96,
+                             default_deadline_s=0.5)
+        sup.attach(router)
+        sup.start()
+        spawn_s = time.perf_counter() - t0
+        worker_warm = {
+            w.wid: {"warm": FleetSupervisor.replacement_is_warm(
+                w.ready_doc), "aot": (w.ready_doc or {}).get("aot")}
+            for w in sup._workers.values()}
+
+        # parity: fleet answers == the single-engine answers (<=1e-6)
+        futs = [router.submit(xs[i], deadline_s=30.0) for i in range(16)]
+        got = np.stack([np.asarray(f.get(timeout=30)) for f in futs])
+        parity = float(np.nanmax(np.abs(got - ref)))
+
+        def drain(futs):
+            lats, shed, errors = [], 0, 0
+            for f in futs:
+                try:
+                    f.get(timeout=30)
+                    lats.append(f.latency_s)
+                except ServingOverloaded:
+                    shed += 1
+                except Exception:
+                    errors += 1
+            return lats, shed, errors
+
+        def point(n_or_probe, rps=None):
+            """Submit a load leg; returns the curve point dict."""
+            futs, shed_submit = [], 0
+            t0 = time.perf_counter()
+            if rps is None:  # flat-out capacity probe
+                i = 0
+                while time.perf_counter() - t0 < probe_s:
+                    try:
+                        futs.append(router.submit(xs[i % 64]))
+                    except ServingOverloaded:
+                        shed_submit += 1
+                        time.sleep(0.0005)
+                    i += 1
+            else:
+                interval = 1.0 / rps
+                for j in range(n_or_probe):
+                    target = t0 + j * interval
+                    now = time.perf_counter()
+                    if target > now:
+                        time.sleep(target - now)
+                    try:
+                        futs.append(router.submit(xs[j % 64]))
+                    except ServingOverloaded:
+                        shed_submit += 1
+            offered_dt = max(time.perf_counter() - t0, 1e-9)
+            lats, shed_late, errors = drain(futs)
+            total_dt = max(time.perf_counter() - t0, 1e-9)
+            pt = {"offered": len(futs) + shed_submit,
+                  "offered_rps": round((len(futs) + shed_submit)
+                                       / offered_dt, 1),
+                  "served": len(lats),
+                  "served_rps": round(len(lats) / total_dt, 1),
+                  "shed": shed_submit + shed_late, "errors": errors}
+            if lats:
+                pt["p50_ms"] = round(
+                    1e3 * float(np.percentile(lats, 50)), 2)
+                pt["p99_ms"] = round(
+                    1e3 * float(np.percentile(lats, 99)), 2)
+            return pt
+
+        probe_pt = point(None)
+        capacity = max(probe_pt["served_rps"], 1.0)
+        curve = []
+        for ratio in (0.5, 1.5):
+            n = max(1, min(int(capacity * ratio * duration_s), 3000))
+            pt = point(n, rps=capacity * ratio)
+            pt["load_ratio"] = ratio
+            curve.append(pt)
+
+        # --- kill-a-worker chaos leg: SIGKILL mid-sweep ---
+        kill_rps = max(capacity * 0.6, 4.0)
+        n = max(8, min(int(kill_rps * duration_s * 2), 3000))
+        futs, shed_submit = [], 0
+        killed_at = n // 3
+        t0 = time.perf_counter()
+        for j in range(n):
+            if j == killed_at:
+                sup.kill_worker("w0", sig=signal.SIGKILL)
+            target = t0 + j / kill_rps
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            try:
+                futs.append(router.submit(xs[j % 64]))
+            except ServingOverloaded:
+                shed_submit += 1
+        lats, shed_late, errors = drain(futs)
+        kill_leg = {"killed": "w0", "offered": n,
+                    "served": len(lats),
+                    "shed": shed_submit + shed_late, "errors": errors}
+        if lats:
+            kill_leg["p99_ms"] = round(
+                1e3 * float(np.percentile(lats, 99)), 2)
+
+        # elastic replacement: wait for the respawn ledger entry, then
+        # prove the fleet recovered inside one more probe window
+        respawn = None
+        t_wait = time.perf_counter()
+        while time.perf_counter() - t_wait < 90:
+            evs = sup.status()["respawns"]
+            if evs and evs[-1].get("spawn_s") is not None:
+                respawn = evs[-1]
+                break
+            time.sleep(0.2)
+        kill_leg["respawn"] = respawn
+        recovery_pt = point(None)
+        kill_leg["recovery_probe"] = recovery_pt
+        futs = [router.submit(xs[i], deadline_s=30.0) for i in range(16)]
+        got = np.stack([np.asarray(f.get(timeout=30)) for f in futs])
+        kill_leg["post_parity_max_diff"] = float(
+            np.nanmax(np.abs(got - ref)))
+
+        counts = router.stats()["requests"]
+        losses = (counts["submitted"] - counts["served"]
+                  - counts["shed_queue_full"] - counts["shed_deadline"]
+                  - counts["shed_no_worker"] - counts["shed_worker"]
+                  - counts["errors"])
+        peak = max(p["served_rps"] for p in curve + [probe_pt])
+        return {"metric": "fleet_offered_load_sweep",
+                "value": round(peak, 1), "unit": "requests/sec",
+                "vs_baseline": None,  # net-new tier: no reference analog
+                "workers": n_workers, "hidden": hidden,
+                "buckets": list(buckets),
+                "spawn_s": round(spawn_s, 2),
+                "worker_warm_starts": worker_warm,
+                "parity_max_diff": parity,
+                "capacity_probe": probe_pt,
+                "curve": curve, "kill_leg": kill_leg,
+                "accounting": dict(counts, uncounted_losses=losses)}
+    finally:
+        try:
+            if router is not None:
+                router.stop()
+            if sup is not None:
+                sup.stop()
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_trace_overhead(reps=8):
     """Causal-tracing overhead on the fused step path: the same fused CPU
     fit measured with span/trace recording OFF and ON in adjacent
@@ -1199,7 +1404,7 @@ CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
            "longcontext": bench_longcontext, "fused": bench_fused,
            "serving": bench_serving, "trace_overhead": bench_trace_overhead,
            "coldstart": bench_coldstart, "zero": bench_zero,
-           "kernels": bench_kernels}
+           "kernels": bench_kernels, "fleet": bench_fleet}
 DEFAULT_ORDER = ["lenet", "resnet50", "lstm", "word2vec", "parallel",
                  "transformer", "longcontext", "fused", "serving", "zero"]
 

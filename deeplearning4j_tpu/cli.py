@@ -112,6 +112,48 @@ def _build_parser():
                     help="serve N synthetic requests, print the stats, "
                          "and exit (CI smoke mode)")
 
+    fl = sub.add_parser(
+        "fleet",
+        help="multi-process serving fleet (fleet/): N worker processes "
+             "from one checkpoint + warm manifest behind one admission/"
+             "routing front with elastic worker replacement; /fleet "
+             "status on the dashboard port")
+    add_compile_cache(fl)
+    flsrc = fl.add_mutually_exclusive_group(required=True)
+    flsrc.add_argument("--model-path", help="checkpoint zip every worker "
+                                            "serves")
+    flsrc.add_argument("--zoo", help="zoo model name (fresh init per "
+                                     "worker)")
+    fl.add_argument("--workers", type=int, default=2,
+                    help="worker processes to spawn (default 2)")
+    fl.add_argument("--name", default="default",
+                    help="served model name (default: 'default')")
+    fl.add_argument("--max-batch", type=int, default=32)
+    fl.add_argument("--buckets",
+                    help="comma-separated batch buckets each worker "
+                         "AOT-warms (default: powers of two up to "
+                         "--max-batch)")
+    fl.add_argument("--input-shape",
+                    help="per-example feature shape, e.g. 28,28,1 "
+                         "(default: derived from the model conf)")
+    fl.add_argument("--warm-manifest", metavar="PATH",
+                    help="serving warm manifest every worker (and every "
+                         "elastic REPLACEMENT) restores executables "
+                         "from — the zero-compile respawn contract")
+    fl.add_argument("--max-queue", type=int, default=256,
+                    help="front admission bound (queued examples); a "
+                         "full front sheds with ServingOverloaded")
+    fl.add_argument("--max-inflight", type=int, default=64,
+                    help="per-worker bounded in-flight window (rows)")
+    fl.add_argument("--deadline-ms", type=float,
+                    help="default request deadline (front AND workers "
+                         "shed stale requests)")
+    fl.add_argument("--port", type=int, default=9000,
+                    help="dashboard/status port (/fleet, /metrics)")
+    fl.add_argument("--smoke", type=int, metavar="N",
+                    help="serve N synthetic requests through the fleet, "
+                         "print the front + worker status, and exit")
+
     e = sub.add_parser("eval", help="evaluate a checkpoint on a dataset")
     add_compile_cache(e)
     esrc = e.add_mutually_exclusive_group(required=True)
@@ -487,6 +529,106 @@ def _cmd_serve(args):
         pass
     finally:
         registry.stop()
+        ui_server.stop()
+    return 0
+
+
+def _cmd_fleet(args):
+    """The multi-process serving entry point (ROADMAP's "millions of
+    users" tier): spawn N workers from one checkpoint + warm manifest,
+    put the admission/routing front before them, and keep the pool
+    elastic — a worker death is a respawn, not an outage."""
+    import time
+
+    from deeplearning4j_tpu import fleet, telemetry
+    from deeplearning4j_tpu.ui import UIServer
+
+    telemetry.enable()
+    _enable_compile_cache(args)
+    if args.model_path is None:
+        # zoo mode: workers init the model themselves (same seed = same
+        # params); a checkpoint is the production path
+        print("note: --zoo workers each init fresh (same seed); use "
+              "--model-path for a real deployment")
+    input_shape = (tuple(int(d) for d in args.input_shape.split(",")
+                         if d.strip()) if args.input_shape else None)
+    buckets = ([int(b) for b in args.buckets.split(",") if b.strip()]
+               if args.buckets else None)
+    supervisor = fleet.FleetSupervisor(
+        args.workers, model_path=args.model_path, zoo=args.zoo,
+        name=args.name, buckets=buckets, input_shape=input_shape,
+        warm_manifest=args.warm_manifest or None,
+        compile_cache=getattr(args, "compile_cache", None),
+        max_queue=args.max_queue, max_batch=args.max_batch,
+        deadline_ms=args.deadline_ms)
+    router = fleet.FleetRouter(
+        name=args.name, max_queue=args.max_queue,
+        max_inflight_rows=args.max_inflight,
+        default_deadline_s=(None if args.deadline_ms is None
+                            else args.deadline_ms / 1e3))
+    supervisor.attach(router)
+    print(f"fleet: spawning {args.workers} worker(s)...")
+    t0 = time.perf_counter()
+    supervisor.start()
+    fleet.set_default_front(router=router, supervisor=supervisor)
+    starts = ", ".join(
+        f"{w.wid}:" + ("warm" if fleet.FleetSupervisor
+                       .replacement_is_warm(w.ready_doc) else "cold")
+        for w in supervisor._workers.values())
+    print(f"fleet: {args.workers} worker(s) ready in "
+          f"{time.perf_counter() - t0:.1f}s ({starts})")
+    ui_server = UIServer(port=args.port).start()
+    print(f"fleet status: http://127.0.0.1:{ui_server.port}/fleet "
+          f"(metrics on /metrics)")
+    try:
+        if args.smoke:
+            import json
+
+            import numpy as np
+            from deeplearning4j_tpu.serving import ServingOverloaded
+            spec = input_shape
+            if spec is None:
+                # read one worker's bucket spec indirectly: derive from
+                # the model conf like the workers do
+                net = _load_model(args)
+                spec = _serve_input_spec(args, net)
+            rs = np.random.RandomState(0)
+            xs = rs.rand(args.smoke, *spec).astype(np.float32)
+            futs, shed = [], 0
+            for i in range(args.smoke):
+                for _ in range(1000):
+                    try:
+                        futs.append(router.submit(xs[i]))
+                        break
+                    except ServingOverloaded:
+                        time.sleep(0.001)
+                else:
+                    raise SystemExit("fleet smoke: admission queue "
+                                     "never drained")
+            for f in futs:
+                try:
+                    f.get(timeout=60)
+                except ServingOverloaded:
+                    shed += 1
+            if shed:
+                print(f"fleet smoke: {shed} request(s) shed")
+            print(json.dumps({"router": router.stats(),
+                              "workers": supervisor.status()},
+                             indent=1, default=str))
+            return 0
+        import signal
+
+        def _term(signum, frame):
+            raise KeyboardInterrupt
+        signal.signal(signal.SIGTERM, _term)
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.stop()
+        supervisor.stop()
+        fleet.reset()
         ui_server.stop()
     return 0
 
@@ -999,6 +1141,8 @@ def main(argv=None):
         return _cmd_ui(args)
     if args.command == "serve":
         return _cmd_serve(args)
+    if args.command == "fleet":
+        return _cmd_fleet(args)
     if args.command == "bench":
         return _cmd_bench(args)
     if args.command == "eval":

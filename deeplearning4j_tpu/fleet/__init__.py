@@ -1,0 +1,103 @@
+"""Fleet serving tier: a multi-process engine pool behind one front.
+
+The multi-process half of the serving story (ROADMAP "millions of users"
+tier; the PS/worker deployment architecture of the TensorFlow system
+papers, PAPERS.md arxiv 1603.04467 §deployment / 1605.08695) re-expressed
+over this framework's serving seams:
+
+* :class:`FleetWorker` (``fleet/worker.py``) — ONE process serving ONE
+  :class:`~deeplearning4j_tpu.serving.ServingEngine` behind a local HTTP
+  wire protocol (``/submit``, ``/health``, ``/stats``, ``/swap``).
+  Started from a checkpoint/bundle + warm manifest, a worker warms up
+  with ZERO compiles (PR 9's instant-restart tier) — which is what makes
+  elastic replacement a seconds-long blip instead of an outage.
+* :class:`FleetRouter` (``fleet/router.py``) — the single admission/
+  routing front: load-aware dispatch (least outstanding rows, bounded
+  per-worker in-flight window), deadline-aware shedding with the serving
+  tier's shed semantics (``serving_shed_total`` + new ``fleet_*``
+  counters), cross-worker ``/health`` aggregation, and idempotent
+  retry-on-dead-worker — a request is answered, retried onto a live
+  worker, or counted-shed; never silently dropped (inference is
+  stateless, so a replay is safe by construction).
+* :class:`FleetSupervisor` (``fleet/supervisor.py``) — spawns N workers
+  as subprocesses, probes liveness, and elastically REPLACES a dead
+  worker from the same bundle + manifest (replacement warm-start is
+  counter-asserted: manifest hits only, zero compiles), fanning
+  ``ModelRegistry``-style hot swaps out to every worker warm-then-atomic.
+
+Quickstart (also: ``python -m deeplearning4j_tpu fleet --workers 3``)::
+
+    from deeplearning4j_tpu import fleet
+    sup = fleet.FleetSupervisor(3, model_path="ckpt.zip",
+                                warm_manifest="wm.zip", buckets=[1, 8])
+    router = fleet.FleetRouter(max_queue=256, default_deadline_s=0.25)
+    sup.attach(router)      # endpoints follow respawns automatically
+    sup.start()
+    y = router.submit(example).get(timeout=1.0)
+
+The process-default front (what the UIServer ``/fleet`` endpoint reads)
+is registered by the ``fleet`` CLI verb via :func:`set_default_front`.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from deeplearning4j_tpu.fleet.router import FleetRouter
+from deeplearning4j_tpu.fleet.supervisor import (FleetSupervisor,
+                                                 default_worker_env)
+from deeplearning4j_tpu.fleet.worker import FleetWorker
+
+__all__ = ["FleetRouter", "FleetSupervisor", "FleetWorker",
+           "default_worker_env", "fleet_status", "get_default_front",
+           "reset", "set_default_front"]
+
+_front_lock = threading.Lock()
+_front = {"router": None, "supervisor": None}
+
+
+def set_default_front(router=None, supervisor=None):
+    """Register the process-default fleet front — the router/supervisor
+    pair the UIServer's ``/fleet`` endpoint reports on (the ``fleet``
+    CLI verb calls this)."""
+    with _front_lock:
+        if router is not None:
+            _front["router"] = router
+        if supervisor is not None:
+            _front["supervisor"] = supervisor
+
+
+def get_default_front():
+    """(router, supervisor) of the process-default front (either may be
+    None when nothing registered them)."""
+    with _front_lock:
+        return _front["router"], _front["supervisor"]
+
+
+def reset():
+    """Drop the process-default front (tests). Does NOT stop the router
+    or supervisor — ownership stays with whoever built them."""
+    with _front_lock:
+        _front["router"] = None
+        _front["supervisor"] = None
+
+
+def fleet_status(probe=False):
+    """The ``/fleet`` payload: router counters + per-worker dispatch
+    state, the supervisor's worker table + respawn ledger and its CACHED
+    last health probe per worker (the cross-worker aggregation, served
+    without re-probing). ``probe=True`` (``/fleet?probe=1``) re-probes
+    every worker's ``/health`` live through the router instead."""
+    router, supervisor = get_default_front()
+    if router is None and supervisor is None:
+        return {"active": False,
+                "note": "no fleet front registered in this process "
+                        "(start one with the `fleet` CLI verb)"}
+    out = {"active": True}
+    if router is not None:
+        out["router"] = router.stats()
+        if probe:
+            out["health"] = router.health()
+    if supervisor is not None:
+        out["workers"] = supervisor.status()
+    return out

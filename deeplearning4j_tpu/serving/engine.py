@@ -52,7 +52,30 @@ FILL_BUCKETS = tuple(i / 8.0 for i in range(1, 9))
 
 class ServingOverloaded(RuntimeError):
     """Request shed by admission control: the bounded queue is full, or the
-    request's deadline passed before a worker picked it up."""
+    request's deadline passed before a worker picked it up. ``reason``
+    (``"queue_full"`` / ``"deadline"`` / ...) is machine-readable — the
+    fleet wire protocol must not sniff it out of the message text (which
+    embeds the free-form model name). A future re-raised fresh chains
+    ``from`` the original, so the reason survives on ``__cause__``."""
+
+    reason = None
+
+
+def _overloaded(msg, reason):
+    e = ServingOverloaded(msg)
+    e.reason = reason
+    return e
+
+
+def shed_reason(exc):
+    """The structured shed reason off a ServingOverloaded — directly, or
+    from the original it was re-raised ``from`` (InferenceFuture.get
+    raises a fresh copy chained to the one that carries the attr)."""
+    for e in (exc, getattr(exc, "__cause__", None)):
+        r = getattr(e, "reason", None)
+        if r is not None:
+            return r
+    return None
 
 
 class ServingShutdown(RuntimeError):
@@ -782,9 +805,9 @@ class ServingEngine:
                 tctx.add_span("serving.shed", now, time.perf_counter(),
                               reason="queue_full")
                 tctx.finish(status="shed")
-            raise ServingOverloaded(
+            raise _overloaded(
                 f"model {self.name!r}: admission queue full "
-                f"({self.max_queue} pending)") from None
+                f"({self.max_queue} pending)", "queue_full") from None
         if self._stop.is_set():
             # raced stop(): its drain may already have run, leaving this
             # request in a queue nobody reads — fail it (and any other
@@ -842,9 +865,10 @@ class ServingEngine:
                     # stale request: shed it instead of spending a forward
                     # on an answer nobody is waiting for (deadline-aware
                     # load shedding)
-                    fut._set_error(ServingOverloaded(
+                    fut._set_error(_overloaded(
                         f"model {self.name!r}: deadline exceeded while "
-                        f"queued ({1e3 * (now - t_sub):.1f} ms)"))
+                        f"queued ({1e3 * (now - t_sub):.1f} ms)",
+                        "deadline"))
                     self._count("shed_deadline")
                     if self._reg.enabled:
                         self._m_shed.inc(model=self.name, reason="deadline")
@@ -946,6 +970,18 @@ class ServingEngine:
                             model=self.name)
 
     # ---- status ----
+
+    def health(self):
+        """The per-process health export the fleet wire protocol ships
+        (fleet/worker.py ``/health``): the engine's serving stats plus
+        the compile-cache events and recompile counters a supervisor
+        needs to counter-assert "this worker warm-started and is not
+        compiling on the request path" without reaching into the
+        process."""
+        from deeplearning4j_tpu.telemetry import devices as _devices
+        return {"stats": self.stats(),
+                "compile_cache_events": _cc.event_counts(),
+                "recompiles": _devices.recompile_counts()}
 
     def latency_percentiles(self):
         """(p50_s, p99_s) over the recent-latency ring, or (None, None)."""

@@ -81,6 +81,13 @@ class ModelRegistry:
             engines = list(self._engines.values())
         return {"models": {e.name: e.stats() for e in engines}}
 
+    def health(self):
+        """Per-model engine health exports (the fleet worker wire
+        payload, aggregated over every registered model)."""
+        with self._lock:
+            engines = list(self._engines.values())
+        return {"models": {e.name: e.health() for e in engines}}
+
     def stop(self):
         with self._lock:
             engines = list(self._engines.values())

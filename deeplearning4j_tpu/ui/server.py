@@ -131,6 +131,18 @@ class UIServer:
                     from deeplearning4j_tpu.serving import registry as _sreg
                     self._json(_sreg.get_model_registry().status())
                     return
+                if url.path == "/fleet":
+                    # fleet-tier status (fleet/): the process-default
+                    # front's router counters + per-worker dispatch state
+                    # + the supervisor's worker table, respawn ledger and
+                    # cached per-worker /health (cross-worker
+                    # aggregation). ?probe=1 re-probes every worker's
+                    # /health live through the router.
+                    from deeplearning4j_tpu import fleet as _fleet
+                    probe = q.get("probe", ["0"])[0] not in ("0", "",
+                                                             "false")
+                    self._json(_fleet.fleet_status(probe=probe))
+                    return
                 if url.path == "/traces":
                     # slow-trace flight ring (telemetry/tracectx.py): the
                     # N slowest complete causal traces per root-span name
@@ -229,7 +241,8 @@ class UIServer:
         return cls._instance
 
     _KNOWN_PATHS = frozenset((
-        "/", "/metrics", "/health", "/serving", "/traces", "/train",
+        "/", "/metrics", "/health", "/serving", "/fleet", "/traces",
+        "/train",
         "/train/overview.html",
         "/train/sessions", "/train/overview", "/train/model",
         "/train/model.html", "/train/system", "/train/system.html",

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""tier1.sh fleet gate: parse a `bench.py fleet` JSONL stream and fail
+unless the fleet tier held its contracts. Counter- and parity-based,
+NEVER wall time (CPU legs jitter; the claims under test are exact):
+
+* every worker (and the kill leg's REPLACEMENT) warm-started from the
+  manifest: ``aot.manifest_hits == warmed`` > 0, zero lazy compiles —
+  the zero-recompile elastic-restart claim, counter-asserted;
+* parity: fleet answers == the single-engine answers on the same inputs
+  (<= 1e-6, NaN-hostile), before AND after the kill;
+* the kill leg lost nothing silently: served + counted sheds == offered,
+  zero errors, and the router's global accounting balances
+  (``uncounted_losses == 0``);
+* the fleet recovered: a respawn ledger entry exists with ``warm: true``
+  and the post-respawn recovery probe served requests.
+
+Usage: check_fleet.py <jsonl-file>
+"""
+
+import json
+import sys
+
+TOL = 1e-6
+
+
+def main(argv):
+    path = argv[1]
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    recs = [r for r in rows
+            if str(r.get("metric", "")).startswith("fleet")]
+    if not recs:
+        print("check_fleet: no fleet record in", path)
+        return 1
+    rec = recs[-1]
+    if "FAILED" in rec.get("metric", ""):
+        print("check_fleet: bench leg failed:", rec.get("error"))
+        return 1
+    errors = []
+
+    def warm_ok(aot, who):
+        aot = aot or {}
+        if not aot.get("warmed"):
+            errors.append(f"{who}: warmed no buckets: aot={aot}")
+            return
+        if aot.get("manifest_hits") != aot.get("warmed"):
+            errors.append(f"{who}: compiled buckets the manifest should "
+                          f"cover: aot={aot}")
+        if aot.get("lazy_compiles") or aot.get("manifest_misses"):
+            errors.append(f"{who}: paid live compiles: aot={aot}")
+
+    warm = rec.get("worker_warm_starts", {})
+    if not warm:
+        errors.append("no worker_warm_starts in the record")
+    for wid, doc in warm.items():
+        if not doc.get("warm"):
+            errors.append(f"worker {wid} did not warm-start: {doc}")
+        warm_ok(doc.get("aot"), f"worker {wid}")
+
+    parity = rec.get("parity_max_diff")
+    if parity is None or not (parity <= TOL):  # NaN fails the <=
+        errors.append(f"fleet/single-engine parity broke: "
+                      f"max diff {parity}")
+
+    kill = rec.get("kill_leg", {})
+    if kill.get("errors", 1) != 0:
+        errors.append(f"kill leg had error outcomes: {kill}")
+    offered = kill.get("offered", 0)
+    if kill.get("served", 0) + kill.get("shed", 0) != offered:
+        errors.append(f"kill leg lost requests silently: {kill}")
+    if kill.get("served", 0) <= 0:
+        errors.append("kill leg served nothing (survivors never "
+                      "answered)")
+    respawn = kill.get("respawn")
+    if not respawn:
+        errors.append("supervisor never respawned the killed worker")
+    else:
+        if respawn.get("warm") is not True:
+            errors.append(f"replacement was not warm: {respawn}")
+        warm_ok(respawn.get("aot"), "replacement worker")
+    recovery = kill.get("recovery_probe", {})
+    if recovery.get("served", 0) <= 0:
+        errors.append(f"post-respawn probe served nothing: {recovery}")
+    post_parity = kill.get("post_parity_max_diff")
+    if post_parity is None or not (post_parity <= TOL):
+        errors.append(f"post-kill parity broke: max diff {post_parity}")
+
+    acct = rec.get("accounting", {})
+    if acct.get("uncounted_losses", 1) != 0:
+        errors.append(f"router accounting does not balance: {acct}")
+    if acct.get("errors", 1) != 0:
+        errors.append(f"router counted error outcomes: {acct}")
+
+    print(f"fleet: {rec.get('workers')} workers, peak "
+          f"{rec.get('value')} req/s, parity {parity} / "
+          f"{post_parity} post-kill, kill leg served "
+          f"{kill.get('served')}/{offered} (+{kill.get('shed')} counted "
+          f"shed), respawn warm={bool(respawn) and respawn.get('warm')} "
+          f"in {respawn.get('spawn_s') if respawn else '?'}s, recovery "
+          f"probe {recovery.get('served_rps')} req/s")
+    for e in errors:
+        print("check_fleet FAIL:", e)
+    if not errors:
+        print("check_fleet: kill-one-of-N held — zero-compile warm "
+              "replacement, zero uncounted losses, parity exact")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -32,7 +32,8 @@ timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   GRAFTSAN=1 GRAFTSAN_REPORT=/tmp/graftsan_tier1.json \
   python -m pytest tests/test_serving.py tests/test_fused.py \
   tests/test_streaming.py tests/test_parallel.py tests/test_native.py \
-  tests/test_ui.py tests/test_sanitizer.py -q -m 'not slow' \
+  tests/test_ui.py tests/test_sanitizer.py tests/test_fleet.py \
+  -q -m 'not slow' \
   -p no:cacheprovider -p no:xdist -p no:randomly || {
     echo "tier1: graftsan stage FAILED"; exit 1; }
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -136,5 +137,24 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
   || { echo "tier1: kernel-autotuner smoke FAILED (parity broke, a"
        echo "tier1: rejected candidate persisted, or the warm restart"
        echo "tier1: recompiled instead of loading tuned executables)"; exit 1; }
+
+# Stage 8: fleet serving smoke (deeplearning4j_tpu/fleet, ISSUE 12) —
+# the multi-process pool end to end: 3 worker processes warm-started
+# from one checkpoint + manifest behind the router, capacity probe +
+# offered-load sweep + the kill-a-worker chaos leg (SIGKILL mid-sweep,
+# retry onto survivors, elastic respawn). scripts/check_fleet.py gates
+# on COUNTERS AND PARITY (every worker and the replacement warm-start
+# with zero compiles, fleet answers == single-engine answers <=1e-6,
+# zero uncounted request losses) — never wall time on CPU.
+echo "== fleet serving smoke =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
+  timeout -k 10 300 python bench.py fleet \
+  > /tmp/_fleet.jsonl \
+  && tee -a BENCH_smoke.json < /tmp/_fleet.jsonl > /dev/null \
+  && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python scripts/check_fleet.py /tmp/_fleet.jsonl \
+  || { echo "tier1: fleet smoke FAILED (a worker cold-started, the"
+       echo "tier1: replacement recompiled, requests were lost"
+       echo "tier1: uncounted, or fleet/single-engine parity broke)"; exit 1; }
 
 exit $rc

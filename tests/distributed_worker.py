@@ -12,12 +12,12 @@ import json
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("XLA_FLAGS", None)  # exactly one local device per process
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import procutil  # noqa: E402 — shared subprocess plumbing
+
+procutil.pin_single_cpu_device()  # BEFORE jax: one local CPU device
 
 import jax  # noqa: E402
-
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main():

@@ -9,46 +9,26 @@ must match the same training run on a single-process 2-device mesh
 the gradient-sharing equivalence tests in dl4j-spark).
 """
 
-import json
-import os
-import socket
-import subprocess
+import os.path
 import sys
 
 import numpy as np
 import pytest
 
-HERE = os.path.dirname(os.path.abspath(__file__))
-WORKER = os.path.join(HERE, "distributed_worker.py")
+import procutil
 
-
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+WORKER = os.path.join(procutil.HERE, "distributed_worker.py")
 
 
 @pytest.mark.slow
 def test_two_process_shared_training_master():
-    port = _free_port()
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU tunnel
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    procs = [subprocess.Popen(
-        [sys.executable, WORKER, str(i), "2", str(port)],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
-        for i in range(2)]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("distributed worker timed out")
-        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
+    port = procutil.free_port()
+    procs = [procutil.spawn([sys.executable, WORKER, str(i), "2",
+                             str(port)])
+             for i in range(2)]
+    outs = [procutil.last_json_line(out)
+            for out, _err in procutil.communicate_all(
+                procs, timeout=300, fail=pytest.fail)]
 
     assert all(o["n_devices"] == 2 for o in outs)
     # both processes hold identical replicated results
