@@ -1007,6 +1007,177 @@ def bench_fleet(duration_s=1.2, probe_s=0.35):
             shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_continuous():
+    """The continuous-learning loop under injected faults (ISSUE 13):
+    a REAL runner subprocess trains from a live pubsub stream while the
+    harness kills the producer mid-stream (a replacement resumes it),
+    poisons one batch with NaN (watchdog -> rollback -> resume), and
+    delays one batch past the staleness bound (counted admission drop) —
+    then an uninterrupted offline reference over the same deterministic
+    stream must match the chaos run's state digest EXACTLY (params +
+    opt_state + RNG chain + iteration). A second leg SIGTERMs a run
+    mid-round (flight ring dumps) and resumes it from the on-disk bundle,
+    again to digest equality. scripts/check_continuous.py gates on
+    COUNTERS AND PARITY — never wall time on CPU. One BENCH JSON
+    record."""
+    import json as _json
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    from deeplearning4j_tpu.fleet.supervisor import default_worker_env
+    from deeplearning4j_tpu.streaming.pubsub import StreamingBroker
+
+    n, poison, stale, seed = 10, 4, 6, 42
+    good_steps = n - 2  # poison rolled back, stale dropped
+    workdir = tempfile.mkdtemp(prefix="continuous_bench_")
+    env = default_worker_env()
+    env["DL4J_TPU_FLIGHT_DIR"] = workdir
+    runner_cmd = [sys.executable, "-m",
+                  "deeplearning4j_tpu.continuous.runner"]
+    pub_cmd = [sys.executable, "-m", "deeplearning4j_tpu.continuous.chaos"]
+
+    _spawn_n = [0]
+
+    def spawn(argv):
+        # stderr to a FILE, not a pipe: the harness reads stdout
+        # line-by-line while children run, and a child spewing more
+        # than the pipe buffer to an undrained stderr would deadlock
+        _spawn_n[0] += 1
+        efpath = os.path.join(workdir, f"proc{_spawn_n[0]}.stderr")
+        p = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                             stderr=open(efpath, "w"), text=True)
+        p.efpath = efpath
+        return p
+
+    def errtail(proc):
+        try:
+            with open(proc.efpath) as f:
+                return f.read()[-2000:]
+        except OSError:
+            return "<no stderr>"
+
+    def read_ready(proc):
+        while True:
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError("runner died before ready: "
+                                   + errtail(proc))
+            line = line.strip()
+            if line.startswith("{") and "continuous_ready" in line:
+                return _json.loads(line)
+
+    def done_line(out, proc):
+        for line in reversed(out.strip().splitlines()):
+            if line.startswith("{") and "continuous_done" in line:
+                return _json.loads(line)
+        raise RuntimeError("no done line; stderr tail: " + errtail(proc))
+
+    broker = StreamingBroker().start()
+    try:
+        # --- chaos leg: producer death + NaN poison + stale batch ------
+        # the staleness bound must separate the INJECTED delay from the
+        # leg's own scheduling jitter by orders of magnitude: a noisy CPU
+        # can queue a legitimate batch for seconds behind a hot-swap
+        # compile, and a counted-but-unexpected drop would break the
+        # deterministic parity gate. 600s-old vs a 45s bound is
+        # unambiguous on any machine that finishes the stage at all.
+        chaos_args = runner_cmd + [
+            "--snapshot", os.path.join(workdir, "chaos.zip"),
+            "--broker-port", str(broker.port), "--gen-seed", str(seed),
+            "--staleness-s", "45", "--quiet-timeout-s", "1.0",
+            "--ingest-retries", "8", "--until-steps", str(good_steps),
+            "--serve-registry"]
+        runner = spawn(chaos_args)
+        read_ready(runner)
+        pub_args = pub_cmd + [
+            "--port", str(broker.port), "--n", str(n),
+            "--gen-seed", str(seed), "--poison", str(poison),
+            "--delay-index", str(stale), "--delay-s", "600",
+            "--interval-s", "0.08"]
+        p1 = spawn(pub_args + ["--die-after", "3"])
+        p1.communicate(timeout=120)  # dies abruptly after 3 publishes
+        time.sleep(1.2)              # quiet stream: the retry path ticks
+        p2 = spawn(pub_args + ["--start", "3"])
+        out, _ = runner.communicate(timeout=240)
+        p2.communicate(timeout=120)
+        chaos_done = done_line(out, runner)
+
+        ref = spawn(runner_cmd + [
+            "--snapshot", os.path.join(workdir, "ref.zip"),
+            "--offline-n", str(n), "--gen-seed", str(seed),
+            "--offline-skip", f"{poison},{stale}"])
+        rout, _ = ref.communicate(timeout=240)
+        ref_done = done_line(rout, ref)
+
+        # --- SIGTERM leg: dump mid-round, resume bit-exact -------------
+        sn, sseed = 8, 55
+        term = spawn(runner_cmd + [
+            "--snapshot", os.path.join(workdir, "term.zip"),
+            "--offline-n", str(sn), "--gen-seed", str(sseed),
+            "--install-sigterm", "--round-lines",
+            "--round-sleep-s", "0.35"])
+        read_ready(term)
+        rounds_seen = 0
+        while rounds_seen < 2:
+            line = term.stdout.readline().strip()
+            if not line:
+                raise RuntimeError("SIGTERM-leg runner exited early: "
+                                   + errtail(term))
+            if line.startswith("{") and '"round"' in line:
+                rounds_seen = _json.loads(line).get("round", 0)
+        os.kill(term.pid, signal.SIGTERM)
+        term.wait(timeout=60)
+        term_rc = term.returncode
+        term.stdout.close()
+        dump_reason = None
+        dumps = sorted(f for f in os.listdir(workdir)
+                       if f.startswith("dl4j_tpu_flight_"
+                                       f"{term.pid}_"))
+        if dumps:
+            with open(os.path.join(workdir, dumps[-1])) as f:
+                dump_reason = _json.load(f).get("reason")
+
+        resumed = spawn(runner_cmd + [
+            "--snapshot", os.path.join(workdir, "term.zip"), "--resume",
+            "--offline-n", str(sn), "--gen-seed", str(sseed),
+            "--offline-start", "-1"])
+        ref2 = spawn(runner_cmd + [
+            "--snapshot", os.path.join(workdir, "ref_full.zip"),
+            "--offline-n", str(sn), "--gen-seed", str(sseed)])
+        mout, _ = resumed.communicate(timeout=240)
+        fout, _ = ref2.communicate(timeout=240)
+        resume_done = done_line(mout, resumed)
+        full_done = done_line(fout, ref2)
+
+        return {
+            "metric": "continuous_chaos",
+            "value": int(chaos_done["iteration"]), "unit": "steps",
+            "vs_baseline": None,  # net-new tier: no reference analog
+            "n_batches": n, "poison_index": poison, "stale_index": stale,
+            "expected_steps": good_steps,
+            "chaos": {k: chaos_done[k]
+                      for k in ("digest", "iteration", "summary",
+                                "counters", "serving_probe_diff",
+                                "flight_dumps")},
+            "ref_digest": ref_done["digest"],
+            "parity": chaos_done["digest"] == ref_done["digest"],
+            "sigterm": {"rc": term_rc,
+                        "expected_rc": -int(signal.SIGTERM),
+                        "dump_reason": dump_reason,
+                        "rounds_before_signal": rounds_seen,
+                        "resume_digest": resume_done["digest"],
+                        "resume_iteration": resume_done["iteration"],
+                        "ref_digest": full_done["digest"],
+                        "parity": (resume_done["digest"]
+                                   == full_done["digest"])},
+        }
+    finally:
+        broker.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_trace_overhead(reps=8):
     """Causal-tracing overhead on the fused step path: the same fused CPU
     fit measured with span/trace recording OFF and ON in adjacent
@@ -1404,7 +1575,8 @@ CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
            "longcontext": bench_longcontext, "fused": bench_fused,
            "serving": bench_serving, "trace_overhead": bench_trace_overhead,
            "coldstart": bench_coldstart, "zero": bench_zero,
-           "kernels": bench_kernels, "fleet": bench_fleet}
+           "kernels": bench_kernels, "fleet": bench_fleet,
+           "continuous": bench_continuous}
 DEFAULT_ORDER = ["lenet", "resnet50", "lstm", "word2vec", "parallel",
                  "transformer", "longcontext", "fused", "serving", "zero"]
 

@@ -174,6 +174,18 @@ def _build_parser():
     b = sub.add_parser("bench", help="run a BASELINE.md bench config")
     b.add_argument("config", nargs="?", default="all")
 
+    cn = sub.add_parser(
+        "continuous",
+        help="continuous-learning loop (continuous/): streaming ingest "
+             "with bounded staleness -> watchdog-policed StepDriver "
+             "rounds with rollback-to-last-good-bundle -> periodic "
+             "snapshot + serving hot-swap handoff; all arguments forward "
+             "to continuous.runner (use `continuous --help-runner` or "
+             "`python -m deeplearning4j_tpu.continuous.runner --help`)")
+    cn.add_argument("--help-runner", action="store_true",
+                    help="print the runner's own argument reference")
+    cn.add_argument("runner_args", nargs=argparse.REMAINDER)
+
     tn = sub.add_parser(
         "tune",
         help="kernel autotuner (tuning/): search Pallas configs "
@@ -1134,6 +1146,19 @@ def _cmd_flightrec(args):
 
 
 def main(argv=None):
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "continuous":
+        # forwarded verbatim BEFORE argparse: REMAINDER cannot capture
+        # leading option-style args, so `continuous --snapshot ...`
+        # would otherwise die with "unrecognized arguments"
+        rest = list(argv[1:])
+        if rest and rest[0] == "--":
+            rest = rest[1:]
+        if "--help-runner" in rest:
+            rest = ["--help"]
+        from deeplearning4j_tpu.continuous import runner
+        return runner.main(rest)
     args = _build_parser().parse_args(argv)
     if args.command == "train":
         return _cmd_train(args)

@@ -1,0 +1,38 @@
+"""Continuous learning: the resumable step driver + streaming trainer.
+
+The deployment story of the TF system papers (PAPERS.md arxiv
+1603.04467 §4.3, arxiv 1605.08695) is not "fit an array": it is a
+training loop that consumes a live stream, checkpoints as it goes,
+survives faults, and keeps handing fresh snapshots to the serving tier.
+This package is that loop:
+
+* :mod:`driver` — ``StepDriver``, the resumable dispatch loop refactored
+  OUT of the three fit paths (MultiLayerNetwork / ComputationGraph /
+  ParallelTrainer ``fit()`` are thin wrappers over it): explicit
+  ``run_round(k_dispatches)``, checkpointable between rounds via
+  ``save_bundle``, RNG-chain exact on restore.
+* :mod:`trainer` — ``ContinuousTrainer``: streaming ingest with bounded
+  staleness, the numerics watchdog policing every round, rollback to the
+  last good bundle on ``NumericsError`` (counted, bit-exact incl. the
+  RNG chain), and periodic healthy snapshots handed to the serving tier
+  (``ModelRegistry.update_model`` / ``FleetSupervisor.update_model``).
+* :mod:`chaos` — the fault-injection harness (poisoned batches, producer
+  death, delayed ingest, SIGTERM) and the deterministic batch/digest
+  plumbing the parity gates are built on.
+* :mod:`runner` — the real-subprocess entry point
+  (``python -m deeplearning4j_tpu.continuous.runner``) the chaos tests
+  and ``bench.py continuous`` drive.
+"""
+
+__all__ = ["RoundResult", "StepDriver"]
+
+
+def __getattr__(name):
+    # lazy: the chaos PUBLISHER subprocess imports this package on its
+    # way to chaos.py, which never touches the driver — eagerly pulling
+    # driver.py would build the whole nn/telemetry import graph for a
+    # process that only writes codec frames to a socket
+    if name in __all__:
+        from deeplearning4j_tpu.continuous import driver
+        return getattr(driver, name)
+    raise AttributeError(name)

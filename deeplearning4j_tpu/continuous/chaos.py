@@ -1,0 +1,160 @@
+"""Chaos harness: deterministic streams, fault injection, parity digests.
+
+The contract under test (ISSUE 13, tier-1 stage 9): every injected fault
+— a poisoned NaN batch, a producer killed mid-stream, a batch delayed
+past the staleness bound, SIGTERM mid-round — must end in
+recovered-with-PARITY or a counted graceful degradation; never a hang,
+never an uncounted loss. Parity is falsifiable because everything here
+is deterministic:
+
+* :func:`gen_batches` derives the stream from one seed — poisoning batch
+  *i* overwrites values without consuming extra randomness, so the GOOD
+  batches of a chaos stream and of a clean reference stream are
+  bit-identical;
+* :func:`state_digest` hashes params + state + opt_state + the RNG chain
+  + the iteration counter — two runs match iff they are bit-exact
+  through the whole optimizer/RNG history, which is the rollback-resume
+  claim stated strongly enough to fail.
+
+The module doubles as the **publisher subprocess**
+(``python -m deeplearning4j_tpu.continuous.chaos --port ... --topic ...``)
+so producer death is a real process death: the harness SIGKILLs it
+mid-stream (or ``--die-after`` makes it exit abruptly on its own) and
+spawns a replacement that resumes at ``--start``. ``--delay-index``
+publishes one batch with its timestamp aged past the staleness bound —
+the delayed-ingest fault arrives already stale, deterministically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import sys
+import time
+
+import numpy as np
+
+__all__ = ["gen_batches", "state_digest", "smoke_net", "publish_batches"]
+
+
+def gen_batches(seed, n, batch=8, features=12, classes=3, poison=()):
+    """N deterministic ``(x, y)`` float32 minibatches from one seed.
+    Indices in ``poison`` get a NaN feature — injected AFTER drawing, so
+    the other batches are unchanged by the injection."""
+    poison = set(int(i) for i in poison)
+    rs = np.random.RandomState(int(seed))
+    out = []
+    for i in range(int(n)):
+        x = rs.rand(int(batch), int(features)).astype(np.float32)
+        y = np.eye(int(classes), dtype=np.float32)[
+            rs.randint(0, int(classes), int(batch))]
+        if i in poison:
+            x = x.copy()
+            x[0, 0] = np.nan
+        out.append((x, y))
+    return out
+
+
+def state_digest(net):
+    """SHA-256 over params + state + opt_state + RNG chain + iteration:
+    equal digests == bit-exact training history."""
+    import jax
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(
+            (net.params, net.state, net.opt_state)):
+        h.update(np.asarray(leaf).tobytes())
+    rng = getattr(net, "_rng", None)
+    if rng is not None:
+        h.update(np.asarray(rng).tobytes())
+    h.update(str(int(net.iteration)).encode())
+    return h.hexdigest()
+
+
+def smoke_net(seed=0, features=12, hidden=16, classes=3):
+    """The tiny deterministic MLP every chaos leg trains — ONE definition
+    so the chaos run, the resume run and the reference run can never
+    drift architecturally."""
+    from deeplearning4j_tpu.nn import layers as L
+    from deeplearning4j_tpu.nn import updaters as U
+    from deeplearning4j_tpu.nn.conf import inputs as I
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = NeuralNetConfig(
+        seed=seed, updater=U.Adam(learning_rate=0.01)).list(
+        L.DenseLayer(n_out=hidden, activation="tanh"),
+        L.OutputLayer(n_out=classes, loss="mcxent"),
+        input_type=I.FeedForwardType(features))
+    return MultiLayerNetwork(conf)
+
+
+def publish_batches(port, topic, batches, *, start=0, interval_s=0.02,
+                    delay_index=None, delay_s=0.0, die_after=None,
+                    host="127.0.0.1"):
+    """Publish ``batches[start:]`` to a broker topic, one every
+    ``interval_s``. ``delay_index`` publishes that batch with its
+    timestamp aged by ``delay_s`` (the delayed-ingest fault: it arrives
+    already stale). ``die_after`` aborts the process abruptly after that
+    many publishes (producer-death fault) — only meaningful in the
+    subprocess entry. Returns the number published."""
+    from deeplearning4j_tpu.streaming.pubsub import NDArrayPublisher
+    pub = NDArrayPublisher(topic, host=host, port=int(port))
+    sent = 0
+    try:
+        for i in range(int(start), len(batches)):
+            if die_after is not None and sent >= int(die_after):
+                import os
+                os._exit(1)  # abrupt: no close frames, a REAL crash
+            x, y = batches[i]
+            ts = None
+            if delay_index is not None and i == int(delay_index):
+                ts = time.time() - float(delay_s)
+            pub.publish_dataset(x, y, ts=ts)
+            sent += 1
+            if interval_s:
+                time.sleep(float(interval_s))
+    finally:
+        try:
+            pub.close()
+        except OSError:
+            pass
+    return sent
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="chaos publisher: stream deterministic batches at a "
+                    "broker, with optional fault injection")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--topic", default="train")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--gen-seed", type=int, default=123)
+    p.add_argument("--n", type=int, required=True,
+                   help="total batches in the deterministic stream")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--features", type=int, default=12)
+    p.add_argument("--classes", type=int, default=3)
+    p.add_argument("--poison", default="",
+                   help="comma-separated batch indices to NaN-poison")
+    p.add_argument("--start", type=int, default=0,
+                   help="resume publishing at this index (replacement "
+                        "producer after a kill)")
+    p.add_argument("--interval-s", type=float, default=0.02)
+    p.add_argument("--delay-index", type=int, default=None)
+    p.add_argument("--delay-s", type=float, default=0.0)
+    p.add_argument("--die-after", type=int, default=None)
+    args = p.parse_args(argv)
+    poison = [int(i) for i in args.poison.split(",") if i.strip()]
+    batches = gen_batches(args.gen_seed, args.n, batch=args.batch,
+                          features=args.features, classes=args.classes,
+                          poison=poison)
+    sent = publish_batches(args.port, args.topic, batches,
+                           start=args.start, interval_s=args.interval_s,
+                           delay_index=args.delay_index,
+                           delay_s=args.delay_s, die_after=args.die_after,
+                           host=args.host)
+    print(f'{{"published": {sent}}}', flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -236,14 +236,39 @@ _SENTINEL = object()
 
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch + device placement (reference:
-    AsyncDataSetIterator.java — queue-based double buffering)."""
+    AsyncDataSetIterator.java — queue-based double buffering).
+
+    Transient producer errors (a streaming source's socket reset, a
+    quiet-stream timeout — ``retry_on``, default the connection/timeout
+    family) are retried with capped exponential backoff up to
+    ``retry_transient`` times per batch, counted
+    ``etl_retry_total{outcome=retried|recovered|fatal}``; past the cap
+    the error surfaces PROMPTLY on the consumer exactly as any producer
+    error always has. OPT-IN (``retry_transient=0`` default — fail on
+    first, the historical contract): retrying ``next()`` is only
+    meaningful on a re-nextable ITERATOR source (a pub/sub stream, a
+    queue). A plain generator closes on its first raise (PEP 255), so a
+    retried ``next()`` would read as a clean-but-truncated epoch — the
+    continuous ingest layer passes its own budget explicitly.
+    """
+
+    #: errors worth retrying: the connection family a streaming source
+    #: (broker restart, producer respawn) throws while the stream heals.
+    #: ConnectionError is an OSError subclass; TimeoutError covers the
+    #: quiet-stream timeout continuous ingest raises.
+    RETRY_ON = (OSError, TimeoutError)
 
     def __init__(self, base: DataSetIterator, queue_size=2, device_put=True,
-                 sharding=None, callback=None, trace_root=None):
+                 sharding=None, callback=None, trace_root=None,
+                 retry_transient=0, retry_backoff_s=0.05, retry_on=None):
         self.base = base
         self.queue_size = queue_size
         self.device_put = device_put
         self.sharding = sharding
+        self.retry_transient = int(retry_transient)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.retry_on = (self.RETRY_ON if retry_on is None
+                         else tuple(retry_on))
         if callback is not None and sharding is not None:
             raise ValueError(
                 "callback and sharding are mutually exclusive: the callback "
@@ -270,6 +295,11 @@ class AsyncDataSetIterator(DataSetIterator):
             "etl_batches_total", "batches delivered by async prefetch")
         self._m_depth = reg.gauge(
             "etl_queue_depth", "prefetched batches ready in the queue")
+        self._m_retry = reg.counter(
+            "etl_retry_total",
+            "transient producer errors, by outcome (retried = one backoff "
+            "attempt, recovered = a batch arrived after retries, fatal = "
+            "the retry budget ran out and the error surfaced)")
 
     @property
     def batch_size(self):
@@ -318,7 +348,7 @@ class AsyncDataSetIterator(DataSetIterator):
                 with _tm.tracectx.attach(tctx):
                     with _tm.span("etl.prefetch"):
                         try:
-                            ds = next(self.base)
+                            ds = self._next_with_retry(stop)
                         except StopIteration:
                             break
                         item = self._put_device(ds)
@@ -353,6 +383,38 @@ class AsyncDataSetIterator(DataSetIterator):
                     pass
             q.put(_SENTINEL)
 
+    def _next_with_retry(self, stop):
+        """``next(self.base)`` with the bounded transient-retry policy
+        (producer thread only). StopIteration passes through untouched;
+        a retryable error sleeps a capped exponential backoff (stop-flag
+        aware, so ``close()`` is never held hostage) and tries again up
+        to the budget — then re-raises, counted fatal, and the consumer
+        sees it promptly via the usual error path."""
+        attempts = 0
+        while True:
+            try:
+                ds = next(self.base)
+            except StopIteration:
+                raise
+            except self.retry_on:
+                attempts += 1
+                if stop.is_set():
+                    raise  # closing, not a stream verdict: don't count
+                if attempts > self.retry_transient:
+                    if self._reg.enabled:
+                        self._m_retry.inc(outcome="fatal")
+                    raise
+                if self._reg.enabled:
+                    self._m_retry.inc(outcome="retried")
+                delay = min(self.retry_backoff_s * (2 ** (attempts - 1)),
+                            2.0)
+                if stop.wait(delay):  # closing: don't burn the budget
+                    raise
+            else:
+                if attempts and self._reg.enabled:
+                    self._m_retry.inc(outcome="recovered")
+                return ds
+
     def __next__(self):
         if self._queue is None:
             self.reset()
@@ -385,13 +447,17 @@ class AsyncDataSetIterator(DataSetIterator):
         self._shutdown()
 
     def _shutdown(self):
-        if self._thread is not None and self._thread.is_alive():
+        if self._thread is not None:
             # flag first, then drain: a producer blocked in put() wakes,
             # observes the stop flag and exits instead of producing the
-            # rest of the (possibly huge) epoch into the void
+            # rest of the (possibly huge) epoch into the void. Drain even
+            # when the thread ALREADY exited (a short epoch fits in the
+            # queue): its queued handoffs must not stay open — nobody
+            # will ever consume them, and reset() replaces the queue.
             self._stop.set()
             self._drain_abandoning()
-            self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                self._thread.join(timeout=5)
             # drain AGAIN: a producer that was mid-batch when we drained
             # above may have enqueued one more item (+ sentinel) before
             # observing the stop flag — its handoff must not stay open

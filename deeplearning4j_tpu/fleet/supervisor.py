@@ -67,6 +67,7 @@ class _WorkerProc:
         self.port = None
         self.ready = threading.Event()
         self.ready_doc = None
+        self.ready_at = None  # monotonic time the ready line landed
         self.missed = 0
         self.last_health = None
         self.out_ring = deque(maxlen=50)
@@ -93,7 +94,9 @@ class FleetSupervisor:
                  max_batch=32, deadline_ms=None, batch_window_ms=1.0,
                  env=None, worker_command=None, python=None,
                  spawn_timeout_s=180.0, probe_interval_s=0.5,
-                 probe_timeout_s=2.0, max_missed_probes=3):
+                 probe_timeout_s=2.0, max_missed_probes=3,
+                 respawn_backoff_base_s=0.5, respawn_backoff_cap_s=30.0,
+                 crashloop_window_s=5.0):
         if model_path is None and zoo is None and worker_command is None:
             raise ValueError("FleetSupervisor needs model_path=, zoo=, "
                              "or a custom worker_command=")
@@ -116,9 +119,13 @@ class FleetSupervisor:
         self.probe_interval_s = probe_interval_s
         self.probe_timeout_s = probe_timeout_s
         self.max_missed_probes = max_missed_probes
+        self.respawn_backoff_base_s = float(respawn_backoff_base_s)
+        self.respawn_backoff_cap_s = float(respawn_backoff_cap_s)
+        self.crashloop_window_s = float(crashloop_window_s)
         self._lock = threading.Lock()
         self._workers = {}        # wid -> _WorkerProc
         self._respawns = []       # ledger: one dict per replacement
+        self._backoff = {}        # wid -> {level, not_before, gen}
         self._router = None
         self._stop = threading.Event()
         self._monitor = None
@@ -131,6 +138,12 @@ class FleetSupervisor:
         self._m_probe = reg.counter(
             "fleet_probe_total",
             "supervisor liveness probes by result (ok/missed/dead)")
+        self._m_backoff = reg.counter(
+            "fleet_respawn_backoff_total",
+            "respawns deferred by the crash-loop backoff (a worker that "
+            "died within crashloop_window_s of becoming ready, or whose "
+            "respawn itself failed, waits min(cap, base*2^level) before "
+            "the next attempt), labeled by worker")
 
     # ---- spawning ----
 
@@ -210,6 +223,7 @@ class FleetSupervisor:
                 raise RuntimeError(
                     f"fleet worker {w.wid} (gen {w.generation}) not "
                     f"ready after {self.spawn_timeout_s:.0f}s")
+        w.ready_at = time.monotonic()  # crash-loop window anchor
         return w
 
     @staticmethod
@@ -301,9 +315,45 @@ class FleetSupervisor:
                     self._m_probe.inc(result="missed")
                 if not exited and w.missed < self.max_missed_probes:
                     continue
+                if self._in_backoff(w):
+                    continue  # crash-loop: defer the respawn this tick
                 self._replace(w, reason=("exited rc="
                                          f"{w.proc.returncode}" if exited
                                          else f"{w.missed} missed probes"))
+
+    def _in_backoff(self, w):
+        """Capped exponential backoff between respawns of a crash-looping
+        worker, so a worker that dies the moment it comes up (bad model
+        path after a botched hot-swap, OOM on load) cannot spin the
+        supervisor — and the node — hot. A worker that lived at least
+        ``crashloop_window_s`` after its ready line respawns immediately
+        and resets the level; one that died inside the window (or whose
+        respawn attempt itself failed: no ready line at all) waits
+        ``min(cap, base * 2^level)`` first, each deferral scheduled once
+        per death and counted ``fleet_respawn_backoff_total``."""
+        now = time.monotonic()
+        deferred = False
+        with self._lock:  # status() snapshots this map concurrently
+            bo = self._backoff.setdefault(w.wid,
+                                          {"level": 0, "not_before": 0.0,
+                                           "gen": None})
+            if bo["gen"] != w.generation:  # first tick observing THIS death
+                bo["gen"] = w.generation
+                lived = None if w.ready_at is None else now - w.ready_at
+                if lived is not None and lived >= self.crashloop_window_s:
+                    bo["level"] = 0
+                    bo["not_before"] = 0.0
+                else:
+                    bo["level"] = min(bo["level"] + 1, 16)
+                    delay = min(self.respawn_backoff_base_s
+                                * (2 ** (bo["level"] - 1)),
+                                self.respawn_backoff_cap_s)
+                    bo["not_before"] = now + delay
+                    deferred = True
+            backing_off = now < bo["not_before"]
+        if deferred and self._reg.enabled:
+            self._m_backoff.inc(worker=w.wid)
+        return backing_off
 
     def _replace(self, dead, reason):
         """Elastic replacement: same spec (bundle + warm manifest), fresh
@@ -333,6 +383,25 @@ class FleetSupervisor:
             # the respawn itself failed: record it and let the next
             # monitor tick try again (the worker slot stays dead)
             event["error"] = str(e)[:300]
+            with self._lock:
+                # when _spawn itself raised (bad command, Popen failure),
+                # the dead generation is still installed — _in_backoff's
+                # per-death gen marker would never re-arm and the monitor
+                # would retry every probe tick forever. Escalate the
+                # backoff HERE for that case.
+                spawn_failed = self._workers.get(dead.wid) is dead
+                if spawn_failed:
+                    bo = self._backoff.setdefault(
+                        dead.wid, {"level": 0, "not_before": 0.0,
+                                   "gen": None})
+                    bo["gen"] = dead.generation
+                    bo["level"] = min(bo["level"] + 1, 16)
+                    bo["not_before"] = time.monotonic() + min(
+                        self.respawn_backoff_base_s
+                        * (2 ** (bo["level"] - 1)),
+                        self.respawn_backoff_cap_s)
+            if spawn_failed and self._reg.enabled:
+                self._m_backoff.inc(worker=dead.wid)
         with self._lock:
             self._respawns.append(event)
         if self._reg.enabled:
@@ -375,7 +444,9 @@ class FleetSupervisor:
         return {"n_workers": self.n_workers, "workers": workers,
                 "respawns": list(self._respawns),
                 "probe_interval_s": self.probe_interval_s,
-                "max_missed_probes": self.max_missed_probes}
+                "max_missed_probes": self.max_missed_probes,
+                "backoff": {wid: dict(bo)
+                            for wid, bo in self._backoff.items()}}
 
     def stop(self):
         """Graceful stop: /shutdown every worker, then make sure the

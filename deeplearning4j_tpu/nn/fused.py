@@ -40,17 +40,11 @@ as they did for the reference's ragged-batch handling.
 from __future__ import annotations
 
 import functools
-import time
 import warnings
 
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu import telemetry as _tm
-from deeplearning4j_tpu.telemetry import devices as _devices
-from deeplearning4j_tpu.telemetry import flight as _flight
-from deeplearning4j_tpu.telemetry import health as _health
-from deeplearning4j_tpu.nn import listeners as _listeners
 from deeplearning4j_tpu.utils import compile_cache as _cc
 
 __all__ = ["make_train_steps", "fit_fused"]
@@ -266,152 +260,14 @@ def fit_fused(net, batch_factory, *, epochs, k, batch_size=None,
     ``AsyncDataSetIterator`` producer thread while the current dispatch
     runs (double buffering) — the thread is joined in ``finally`` so a
     fit exception never leaves a dangling producer.
-    """
-    from deeplearning4j_tpu.datasets.iterator import (AsyncDataSetIterator,
-                                                      SuperBatchIterator)
 
-    hm = _health.get_monitor()
-    use_health = hm.active
-    steps_fn = _steps_fn_for(net, k, use_health)
-    reg, step_h, etl_h, iters_c, score_g = _tm.train_metrics()
-    frec = _flight.get_recorder()
-    # scores resolve one DISPATCH late: the K stacked losses of dispatch i
-    # are fetched (one transfer) while dispatch i+1 runs — the K=1 loops'
-    # pipelining discipline, amortized (see telemetry/scorepipe)
-    pipe = _tm.ScorePipeline()
-    emitter = _tm.scorepipe.StepRecordEmitter(net, step_h, etl_h, iters_c,
-                                              score_g, frec)
-    sbit = SuperBatchIterator(batch_factory, k, batch_size=batch_size)
-    src = (AsyncDataSetIterator(sbit, queue_size=2,
-                                trace_root="train.dispatch")
-           if prefetch else sbit)
-    tctx = None
-    try:
-        with _tm.span("fit", net=type(net).__name__, fused_k=k):
-            for _ in range(epochs):
-                for l in net.listeners:
-                    l.on_epoch_start(net)
-                for sb in src:
-                    # causal trace for THIS dispatch: with prefetch it
-                    # originated on the producer thread (assembly +
-                    # device_put spans already recorded); attach so the
-                    # etl/step spans below parent under it. Finished when
-                    # its scores resolve — one dispatch late — by the
-                    # emitter; tracing off costs a getattr and a branch.
-                    tctx = getattr(sb, "_trace_ctx", None)
-                    if tctx is None:
-                        tctx = _tm.tracectx.maybe_start("train.dispatch")
-                    with _tm.tracectx.attach(tctx):
-                        etl_start = time.perf_counter()
-                        with _tm.span("fit.etl"):
-                            # prefetched super-batches are already on
-                            # device; asarray is then a no-op per leaf
-                            xs = jax.tree_util.tree_map(jnp.asarray,
-                                                        sb.features)
-                            ys = jax.tree_util.tree_map(jnp.asarray,
-                                                        sb.labels)
-                            ms = jnp.asarray(sb.labels_mask)
-                            sv = jnp.asarray(sb.step_valid)
-                        etl_time = time.perf_counter() - etl_start
-                        if net.listeners:
-                            # listener convention only — the [0] slice is
-                            # a device op, so don't dispatch it for nobody
-                            first = (next(iter(xs.values()))
-                                     if isinstance(xs, dict) else xs)
-                            net.last_input = first[0]
-                        n_real = sb.n_steps
-                        hb = None
-                        step0 = net.iteration
-                        rec = reg.enabled  # one read per dispatch
-                        want_score = rec or bool(net.listeners)
-                        resolved = meta = None
-                        step_start = time.perf_counter()
-                        with _tm.span("fit.step", iteration=step0,
-                                      fused_k=n_real):
-                            net._rng, step_rng = jax.random.split(net._rng)
-                            if use_health:
-                                (net.params, net.state, net.opt_state,
-                                 losses, hb) = steps_fn(
-                                    net.params, net.state, net.opt_state,
-                                    xs, ys, step0, step_rng, ms, sv)
-                            else:
-                                (net.params, net.state, net.opt_state,
-                                 losses) = steps_fn(
-                                    net.params, net.state, net.opt_state,
-                                    xs, ys, step0, step_rng, ms, sv)
-                            # last REAL step's loss; device scalar, no sync
-                            net.score_value = losses[n_real - 1]
-                            net.iteration += n_real
-                            # cold-start gauge: wall-to-first-dispatch
-                            # (includes the compile this tier removes);
-                            # after the stamp it's a dict read + branch
-                            _cc.note_first_step()
-                            if want_score:
-                                meta = {"step": step0,
-                                        "iteration": net.iteration,
-                                        "k": n_real,
-                                        "etl_time_s": etl_time, "rec": rec,
-                                        "health": use_health,
-                                        "step_time_s": 0.0,
-                                        "trace": tctx,
-                                        "trace_id": (None if tctx is None
-                                                     else tctx.trace_id)}
-                                t_res = time.perf_counter()
-                                resolved = pipe.push(losses, meta)
-                                if resolved is not None:
-                                    prev_t = resolved[1].get("trace")
-                                    if prev_t is not None:
-                                        # the one-late fetch of dispatch
-                                        # i-1 happens HERE, overlapped by
-                                        # dispatch i — record it in ITS
-                                        # trace, not this one's
-                                        prev_t.add_span(
-                                            "train.score_fetch", t_res,
-                                            time.perf_counter())
-                    if meta is not None:
-                        meta["step_time_s"] = (time.perf_counter()
-                                               - step_start)
-                    elif tctx is not None:
-                        # nobody resolves scores (no registry, no
-                        # listeners): the dispatch trace completes now
-                        tctx.finish()
-                    if resolved is not None:
-                        emitter.emit(*resolved)
-                    elif use_health and not want_score:
-                        frec.note(step=step0, fused_k=n_real,
-                                  step_time_s=(time.perf_counter()
-                                               - step_start),
-                                  etl_time_s=etl_time)
-                    if rec:
-                        _devices.note_jit_cache("fit.step", steps_fn)
-                    if hb is not None:
-                        # stacked bundle: K records per resolve, padded
-                        # K-tail entries dropped via the k meta
-                        hm.on_step(hb, step=step0, k=n_real)
-                tail = pipe.flush()
-                if tail is not None:
-                    emitter.emit(*tail)
-                for l in net.listeners:
-                    l.on_epoch_end(net)
-                net.epoch += 1
-        if use_health:
-            hm.flush()
-    except BaseException as e:
-        if use_health:
-            try:
-                hm.flush(apply_policy=False)
-            except Exception:
-                pass
-        if tctx is not None:
-            # the dispatch that crashed never reached the pipeline —
-            # close its trace here (idempotent if it did)
-            tctx.abandon()
-        _flight.crash_dump(e)
-        raise
-    finally:
-        pipe.abandon()  # no-op after a clean flush; closes the pending
-        #                 dispatch's trace on the exception path
-        if hasattr(src, "close"):
-            src.close()
-        _listeners.run_fit_end_hooks(net)
-    return net
+    The loop itself lives in ``continuous/driver.py`` (``StepDriver``
+    with the fused engine — the resumable round API the continuous
+    trainer checkpoints between); this wrapper is the historical entry
+    point the ``fit(steps_per_dispatch=K)`` facades call.
+    """
+    from deeplearning4j_tpu.continuous.driver import StepDriver
+    drv = StepDriver(net, batch_factory, k=k, batch_size=batch_size,
+                     prefetch=prefetch,
+                     fit_span_kw={"net": type(net).__name__, "fused_k": k})
+    return drv.run(epochs)

@@ -29,7 +29,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import telemetry as _tm
-from deeplearning4j_tpu.telemetry import devices as _devices
 from deeplearning4j_tpu.telemetry import flight as _flight
 from deeplearning4j_tpu.telemetry import health as _health
 from deeplearning4j_tpu.nn import gradnorm as _gradnorm
@@ -37,7 +36,6 @@ from deeplearning4j_tpu.nn import listeners as _listeners
 from deeplearning4j_tpu.nn import updaters as _updaters
 from deeplearning4j_tpu.nn.conf import inputs as _inputs
 from deeplearning4j_tpu.nn.layers import base as _base_layers
-from deeplearning4j_tpu.utils import compile_cache as _cc
 from deeplearning4j_tpu.utils import dtypes as _dtypes
 from deeplearning4j_tpu.utils import serde
 
@@ -1029,29 +1027,29 @@ class ComputationGraph:
                 self,
                 lambda: self._fit_batches(inputs, labels, batch_size, mask),
                 epochs=epochs, k=k, batch_size=batch_size)
-        hm = _health.get_monitor()
-        use_health = hm.active and not use_tbptt
-        if use_health:
-            if self._train_step_health is None:
-                self._train_step_health = self.make_train_step(
-                    with_health=True)
-            step_fn = self._train_step_health
-        elif not use_tbptt:
-            if self._train_step is None:
-                self._train_step = self.make_train_step()
-            step_fn = self._train_step
-        else:
-            step_fn = None
-        reg, step_h, etl_h, iters_c, score_g = _tm.train_metrics()
-        frec = _flight.get_recorder()
-        # score path is PIPELINED one step late (graftlint R1): queue step
-        # i's device loss, fetch it while step i+1 runs — the MLN fit-loop
-        # pattern exactly; record schema + listener fan-out shared via
-        # StepRecordEmitter (see telemetry/scorepipe)
-        pipe = _tm.ScorePipeline()
-        emitter = _tm.scorepipe.StepRecordEmitter(self, step_h, etl_h,
-                                                  iters_c, score_g, frec)
-        tctx = None
+        if use_tbptt:
+            return self._fit_tbptt_loop(inputs, labels, batch_size, mask,
+                                        pad_ragged, epochs)
+        # the K=1 loop is the shared StepDriver (continuous/driver.py) —
+        # the MLN fit-loop body exactly (one-step-late score fetch via
+        # ScorePipeline, one-late health bundles, trace handoff, flight
+        # records), now resumable between rounds for the
+        # continuous-learning tier
+        from deeplearning4j_tpu.continuous.driver import StepDriver
+        drv = StepDriver(
+            self,
+            lambda: self._fit_batches(inputs, labels, batch_size, mask,
+                                      pad_to=bool(pad_ragged)))
+        return drv.run(epochs)
+
+    def _fit_tbptt_loop(self, inputs, labels, batch_size, mask, pad_ragged,
+                        epochs):
+        """Whole-fit TBPTT: every minibatch runs the chunked on-device
+        scan (``_fit_tbptt``) — its own loop because the chunk scan owns
+        the RNG chain and score accumulation the StepDriver engines
+        otherwise drive; one macro-batch = one recorded step, the MLN
+        TBPTT-branch granularity."""
+        reg, step_h, _etl_h, iters_c, score_g = _tm.train_metrics()
         try:
             with _tm.span("fit", net=type(self).__name__):
                 for _ in range(epochs):
@@ -1060,125 +1058,20 @@ class ComputationGraph:
                     for bi, bl, bm in self._fit_batches(
                             inputs, labels, batch_size, mask,
                             pad_to=bool(pad_ragged)):
-                        if use_tbptt:   # TBPTT per minibatch, as MLN
-                            t_tb = time.perf_counter()
-                            with _tm.span("fit.step", tbptt=True):
-                                tb_score = self._fit_tbptt(bi, bl, bm)
-                            if reg.enabled:
-                                # one macro-batch = one recorded step, the
-                                # same granularity as the MLN TBPTT branch
-                                step_h.observe(time.perf_counter() - t_tb)
-                                iters_c.inc()
-                                score_g.set(tb_score)
-                            continue
-                        # per-step causal trace (tracing on only) — the
-                        # MLN fit-loop pattern exactly; finished by the
-                        # emitter when the score resolves one step late
-                        tctx = _tm.tracectx.maybe_start("train.step")
-                        with _tm.tracectx.attach(tctx):
-                            etl_start = time.perf_counter()
-                            with _tm.span("fit.etl"):
-                                bi = {k: jnp.asarray(v)
-                                      for k, v in bi.items()}
-                                bl = {k: jnp.asarray(v)
-                                      for k, v in bl.items()}
-                                bm = (jnp.asarray(bm) if bm is not None
-                                      else None)
-                            etl_time = time.perf_counter() - etl_start
-                            # for PerformanceListener batch-size inference
-                            # + activation-visualizing listeners (MLN
-                            # convention)
-                            self.last_input = next(iter(bi.values()))
-                            hb = None
-                            step_i = self.iteration
-                            rec = reg.enabled  # one read: a mid-iteration
-                            # enable() must not see half-initialized locals
-                            want_score = rec or bool(self.listeners)
-                            resolved = meta = None
-                            step_start = time.perf_counter()
-                            with _tm.span("fit.step", iteration=step_i):
-                                self._rng, sub = jax.random.split(self._rng)
-                                if use_health:
-                                    (self.params, self.state, self.opt_state,
-                                     loss, hb) = step_fn(
-                                        self.params, self.state, self.opt_state,
-                                        bi, bl, self.iteration, sub, bm)
-                                else:
-                                    (self.params, self.state, self.opt_state,
-                                     loss) = step_fn(
-                                        self.params, self.state, self.opt_state,
-                                        bi, bl, self.iteration, sub, bm)
-                                self.score_value = loss  # device scalar
-                                self.iteration += 1
-                                # cold-start gauge (compile_cache): stamped
-                                # once, then a dict read
-                                _cc.note_first_step()
-                                if want_score:
-                                    # resolve step i-1 inside the span: the
-                                    # fetch overlaps the step just dispatched
-                                    meta = {"step": step_i,
-                                            "iteration": self.iteration,
-                                            "etl_time_s": etl_time, "rec": rec,
-                                            "health": use_health,
-                                            "step_time_s": 0.0,
-                                            "trace": tctx,
-                                            "trace_id": (None if tctx is None
-                                                         else tctx.trace_id)}
-                                    t_res = time.perf_counter()
-                                    resolved = pipe.push(loss, meta)
-                                    if resolved is not None:
-                                        prev_t = resolved[1].get("trace")
-                                        if prev_t is not None:
-                                            # step i-1's one-late fetch
-                                            # lands in ITS trace
-                                            prev_t.add_span(
-                                                "train.score_fetch", t_res,
-                                                time.perf_counter())
-                        if meta is None and tctx is not None:
-                            tctx.finish()  # nobody resolves scores
-                        if meta is not None:
-                            meta["step_time_s"] = (time.perf_counter()
-                                                   - step_start)
-                        if resolved is not None:
-                            emitter.emit(*resolved)
-                        elif use_health and not want_score:
-                            frec.note(step=step_i,
-                                      step_time_s=(time.perf_counter()
-                                                   - step_start),
-                                      etl_time_s=etl_time)
-                        if rec:
-                            _devices.note_jit_cache("fit.step", step_fn)
-                        if hb is not None:
-                            # queues this bundle, resolves the previous one
-                            # (policy may raise NumericsError one step late)
-                            hm.on_step(hb, step=step_i)
-                    # drain the score pipeline at the epoch edge (one sync
-                    # per epoch) before the epoch-end callbacks fire
-                    tail = pipe.flush()
-                    if tail is not None:
-                        emitter.emit(*tail)
+                        t_tb = time.perf_counter()
+                        with _tm.span("fit.step", tbptt=True):
+                            tb_score = self._fit_tbptt(bi, bl, bm)
+                        if reg.enabled:
+                            step_h.observe(time.perf_counter() - t_tb)
+                            iters_c.inc()
+                            score_g.set(tb_score)
                     for l in self.listeners:
                         l.on_epoch_end(self)
                     self.epoch += 1
-            if use_health:
-                # resolve the tail bundle; an anomaly on the last step still
-                # runs the policy (may raise) before fit returns
-                hm.flush()
         except BaseException as e:
-            if use_health:
-                try:
-                    hm.flush(apply_policy=False)  # final health into the ring
-                except Exception:
-                    pass
-            if tctx is not None:
-                # the step that crashed never reached the pipeline —
-                # close its trace here (idempotent if it did)
-                tctx.abandon()
             _flight.crash_dump(e)
             raise
         finally:
-            pipe.abandon()  # no-op after flush; closes the pending step's
-            #                 trace on the exception path
             _listeners.run_fit_end_hooks(self)
         return self
 

@@ -23,22 +23,16 @@ from __future__ import annotations
 
 import functools
 import inspect
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu import telemetry as _tm
-from deeplearning4j_tpu.telemetry import devices as _devices
-from deeplearning4j_tpu.telemetry import flight as _flight
 from deeplearning4j_tpu.telemetry import health as _health
 from deeplearning4j_tpu.nn import gradnorm as _gradnorm
-from deeplearning4j_tpu.nn import listeners as _listeners
 from deeplearning4j_tpu.nn.conf import inputs as _inputs
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers import base as _base
-from deeplearning4j_tpu.utils import compile_cache as _cc
 from deeplearning4j_tpu.utils import dtypes as _dtypes
 
 
@@ -389,158 +383,27 @@ class MultiLayerNetwork:
                 self,
                 lambda: self._batches(data, labels, batch_size, mask),
                 epochs=epochs, k=k, batch_size=batch_size)
-        hm = _health.get_monitor()
-        use_health = hm.active  # one read per fit: the watchdog variant of
-        # the step is picked (and compiled) at fit entry, not mid-epoch
-        if use_health:
-            if self._train_step_health is None:
-                self._train_step_health = self.make_train_step(
-                    with_health=True)
-            step_fn = self._train_step_health
-        else:
-            if self._train_step is None:
-                self._train_step = self.make_train_step()
-            step_fn = self._train_step
-        reg, step_h, etl_h, iters_c, score_g = _tm.train_metrics()
-        frec = _flight.get_recorder()
-        # score path is PIPELINED: step i's loss is queued on dispatch and
-        # fetched while step i+1 runs on device — the same one-step-late
-        # pattern as HealthMonitor.on_step and the TBPTT on-device
-        # accumulation below. No per-iteration float(loss) sync remains
-        # in this loop (graftlint R1). Record schema + listener fan-out
-        # (and the documented one-step listener skew) live in the shared
-        # StepRecordEmitter.
-        pipe = _tm.ScorePipeline()
-        emitter = _tm.scorepipe.StepRecordEmitter(self, step_h, etl_h,
-                                                  iters_c, score_g, frec)
-        tctx = None
-        try:
-            with _tm.span("fit", net=type(self).__name__):
-                for _ in range(epochs):
-                    for l in self.listeners:
-                        l.on_epoch_start(self)
-                    batches = self._batches(data, labels, batch_size, mask,
-                                            pad_to=True if pad_ragged
-                                            else None)
-                    for batch in batches:
-                        x, y, m = batch
-                        # per-step causal trace (tracing on only): the
-                        # etl/step spans below parent under it; finished
-                        # by the emitter when the score resolves one step
-                        # late. Off: one call + branch, no contextvars.
-                        tctx = _tm.tracectx.maybe_start("train.step")
-                        with _tm.tracectx.attach(tctx):
-                            etl_start = time.perf_counter()
-                            with _tm.span("fit.etl"):
-                                x, y = jnp.asarray(x), jnp.asarray(y)
-                                m = jnp.asarray(m) if m is not None else None
-                            etl_time = time.perf_counter() - etl_start
-                            self.last_input = x  # for activation-visualizing listeners
-                            hb = None
-                            step_i = self.iteration
-                            rec = reg.enabled  # one read: a mid-iteration
-                            # enable() must not see half-initialized locals
-                            want_score = rec or bool(self.listeners)
-                            resolved = meta = None
-                            step_start = time.perf_counter()
-                            with _tm.span("fit.step", iteration=step_i):
-                                if (self.conf.backprop_type == "tbptt" and x.ndim == 3
-                                        and y.ndim == 3
-                                        and x.shape[1] > self.conf.tbptt_fwd_length):
-                                    # TBPTT runs its own chunked step; the
-                                    # watchdog bundle covers the plain step only
-                                    loss = self._fit_tbptt(x, y, m)
-                                else:
-                                    self._rng, step_rng = jax.random.split(self._rng)
-                                    if use_health:
-                                        (self.params, self.state, self.opt_state,
-                                         loss, hb) = step_fn(
-                                            self.params, self.state, self.opt_state,
-                                            x, y, self.iteration, step_rng, m)
-                                    else:
-                                        (self.params, self.state, self.opt_state,
-                                         loss) = step_fn(
-                                            self.params, self.state, self.opt_state,
-                                            x, y, self.iteration, step_rng, m)
-                                    self.score_value = loss
-                                    self.iteration += 1
-                                    # cold-start gauge (compile_cache):
-                                    # stamped once, then a dict read
-                                    _cc.note_first_step()
-                                if want_score:
-                                    # queue step i, resolve step i-1 INSIDE the
-                                    # span: the blocking fetch overlaps the step
-                                    # just dispatched, so the recorded window
-                                    # converges to the device step time without
-                                    # a same-step sync
-                                    meta = {"step": step_i,
-                                            "iteration": self.iteration,
-                                            "etl_time_s": etl_time, "rec": rec,
-                                            "health": use_health,
-                                            "step_time_s": 0.0,
-                                            "trace": tctx,
-                                            "trace_id": (None if tctx is None
-                                                         else tctx.trace_id)}
-                                    t_res = time.perf_counter()
-                                    resolved = pipe.push(loss, meta)
-                                    if resolved is not None:
-                                        prev_t = resolved[1].get("trace")
-                                        if prev_t is not None:
-                                            # step i-1's one-late fetch
-                                            # lands in ITS trace
-                                            prev_t.add_span(
-                                                "train.score_fetch", t_res,
-                                                time.perf_counter())
-                        if meta is None and tctx is not None:
-                            tctx.finish()  # nobody resolves scores
-                        if meta is not None:
-                            meta["step_time_s"] = (time.perf_counter()
-                                                   - step_start)
-                        if resolved is not None:
-                            emitter.emit(*resolved)
-                        elif use_health and not want_score:
-                            # watchdog-only run: flight-record the step
-                            # shape without fetching a score
-                            frec.note(step=step_i,
-                                      step_time_s=(time.perf_counter()
-                                                   - step_start),
-                                      etl_time_s=etl_time)
-                        if rec:
-                            _devices.note_jit_cache("fit.step", step_fn)
-                        if hb is not None:
-                            # queues this bundle, resolves the previous one
-                            # (policy may raise NumericsError one step late)
-                            hm.on_step(hb, step=step_i)
-                    # drain the score pipeline at the epoch edge so the
-                    # last iteration's record/callback lands before
-                    # on_epoch_end (one sync per epoch, not per step)
-                    tail = pipe.flush()
-                    if tail is not None:
-                        emitter.emit(*tail)
-                    for l in self.listeners:
-                        l.on_epoch_end(self)
-                    self.epoch += 1
-            if use_health:
-                # resolve the tail bundle; an anomaly on the last step still
-                # runs the policy (may raise) before fit returns
-                hm.flush()
-        except BaseException as e:
-            if use_health:
-                try:
-                    hm.flush(apply_policy=False)  # final health into the ring
-                except Exception:
-                    pass
-            if tctx is not None:
-                # the step that crashed never reached the pipeline —
-                # close its trace here (idempotent if it did)
-                tctx.abandon()
-            _flight.crash_dump(e)
-            raise
-        finally:
-            pipe.abandon()  # no-op after flush; closes the pending step's
-            #                 trace on the exception path
-            _listeners.run_fit_end_hooks(self)
-        return self
+        # the K=1 loop is the shared StepDriver (continuous/driver.py):
+        # the identical pipelined body (one-step-late score fetch via
+        # ScorePipeline — no per-iteration float(loss) sync, graftlint R1
+        # — one-late health bundles, trace handoff, flight records), now
+        # resumable between rounds for the continuous-learning tier. The
+        # per-batch TBPTT hook preserves the historical contract: a long
+        # 3-d sequence batch runs the chunked on-device scan instead.
+        from deeplearning4j_tpu.continuous.driver import StepDriver
+        conf = self.conf
+
+        def tbptt_fn(x, y):
+            return (conf.backprop_type == "tbptt" and x.ndim == 3
+                    and y.ndim == 3
+                    and x.shape[1] > conf.tbptt_fwd_length)
+
+        drv = StepDriver(
+            self,
+            lambda: self._batches(data, labels, batch_size, mask,
+                                  pad_to=True if pad_ragged else None),
+            tbptt_fn=tbptt_fn)
+        return drv.run(epochs)
 
     def _batches(self, data, labels, batch_size, mask, pad_to=None):
         from deeplearning4j_tpu.datasets.iterator import iter_batches

@@ -415,52 +415,44 @@ class ParallelTrainer:
             return self._fit_fused(x, y, epochs=epochs,
                                    batch_size=batch_size, mask=mask,
                                    k=int(steps_per_dispatch))
-        from deeplearning4j_tpu.telemetry.scorepipe import ScorePipeline
+        # the loop is the shared StepDriver (continuous/driver.py) in its
+        # lite profile — the sharded engine wraps self.step, listener
+        # scores resolve one step late through the driver's ScorePipeline
+        # (graftlint R1; the MultiLayerNetwork.fit pipelining convention)
+        from deeplearning4j_tpu.continuous.driver import (
+            StepDriver, _ShardedPlainEngine)
 
         data_size = self.mesh.shape["data"]
         self.examples_dropped = 0
-        last = None
-        # listener scores resolve one step late: the fetch of step i's
-        # loss overlaps step i+1's device work (graftlint R1; the
-        # MultiLayerNetwork.fit pipelining convention exactly)
-        pipe = ScorePipeline()
+        drv = StepDriver(self, lambda: iter_batches(x, y, batch_size, mask),
+                         engine=_ShardedPlainEngine(self),
+                         instrumented=False)
+        self._run_epochs(drv, epochs, data_size)
+        if self.examples_dropped:
+            warnings.warn(f"ParallelTrainer.fit dropped "
+                          f"{self.examples_dropped} examples in ragged "
+                          f"batches not divisible by data={data_size}")
+        return drv.last_score
+
+    def _run_epochs(self, drv, epochs, data_size):
+        """N epochs of driver rounds with the trainer's historical
+        epoch-edge contract: an empty first epoch is a hard error, an
+        exhausted generator on a later epoch is too (silently "training"
+        zero steps would lie to the caller), and epoch-end listeners fire
+        only for epochs that trained."""
         for epoch in range(epochs):
-            steps = 0
-            for bx, by, bm in iter_batches(x, y, batch_size, mask):
-                if bx.shape[0] % data_size:
-                    self.examples_dropped += int(bx.shape[0])
-                    continue
-                last = self.step(bx, by, mask=bm)
-                steps += 1
-                if self.listeners:
-                    resolved = pipe.push(last, self.iteration)
-                    if resolved is not None:
-                        for li in self.listeners:
-                            li.iteration_done(self, resolved[1], resolved[0])
-            # drain at the epoch edge so the last callback lands before
-            # on_epoch_end (one sync per epoch, not per step)
-            tail = pipe.flush()
-            if tail is not None:
-                for li in self.listeners:
-                    li.iteration_done(self, tail[1], tail[0])
-            if steps == 0 and epoch == 0:
+            rr = drv.run_round(None)
+            if rr.steps == 0 and epoch == 0:
                 raise ValueError(
                     "no trainable batches: every batch's leading dim must "
                     f"be divisible by the data-axis size {data_size}")
-            if steps == 0 and epoch > 0:
-                # a plain generator exhausts after one epoch — silently
-                # "training" zero steps for the rest would lie to the caller
+            if rr.steps == 0 and epoch > 0:
                 raise ValueError(
                     f"input exhausted before epoch {epoch + 1}: pass a "
                     "resettable DataSetIterator (or arrays) for epochs>1")
             for li in self.listeners:
                 li.on_epoch_end(self)
             self.epoch += 1
-        if self.examples_dropped:
-            warnings.warn(f"ParallelTrainer.fit dropped "
-                          f"{self.examples_dropped} examples in ragged "
-                          f"batches not divisible by data={data_size}")
-        return last
 
     def _build_steps_fused(self, k, donate):
         """Sharded fused K-step engine: the raw scan from nn/fused.py
@@ -500,19 +492,16 @@ class ParallelTrainer:
     def _fit_fused(self, x, y, *, epochs, batch_size, mask, k):
         """fit() at steps_per_dispatch=K: one sharded dispatch per K
         minibatches; scores resolve one dispatch late as stacked arrays
-        (the ScorePipeline discipline, amortized)."""
-        from deeplearning4j_tpu.datasets.iterator import (
-            AsyncDataSetIterator, SuperBatchIterator, iter_batches)
-        from deeplearning4j_tpu.telemetry.scorepipe import ScorePipeline
+        (the ScorePipeline discipline, amortized). The loop is the shared
+        StepDriver's lite profile over the sharded fused engine —
+        super-batch assembly + sharded ``device_put`` overlap the running
+        dispatch on the prefetch thread exactly as before."""
+        from deeplearning4j_tpu.datasets.iterator import iter_batches
+        from deeplearning4j_tpu.continuous.driver import (
+            StepDriver, _ShardedFusedEngine)
 
         if self.params is None:
             self.init()
-        fns = getattr(self, "_steps_fns_fused", None)
-        if fns is None:
-            fns = self._steps_fns_fused = {}
-        if k not in fns:
-            fns[k] = self._build_steps_fused(k, self.donate)
-        fused_fn = fns[k]
         data_size = self.mesh.shape["data"]
         # validate BEFORE the prefetch thread: its sharded device_put hits
         # the non-divisible dim first and would surface as a raw sharding
@@ -525,57 +514,15 @@ class ParallelTrainer:
                 f"bucketed batch size {nominal} not divisible by the "
                 f"data-axis size {data_size}")
         self.examples_dropped = 0  # bucketing pads; nothing is dropped
-        sbit = SuperBatchIterator(lambda: iter_batches(x, y, batch_size,
-                                                       mask), k,
-                                  batch_size=batch_size)
-        # prefetch thread assembles + device_puts the next super-batch
-        # ALREADY SHARDED while the current dispatch runs
-        src = AsyncDataSetIterator(sbit, queue_size=2,
-                                   sharding=_mesh.superbatch_sharded(
-                                       self.mesh))
-        pipe = ScorePipeline()
-        last = None
+        eng = _ShardedFusedEngine(self, k)
+        eng.batch_size = batch_size
+        drv = StepDriver(self, lambda: iter_batches(x, y, batch_size, mask),
+                         engine=eng, instrumented=False)
         try:
-            for epoch in range(epochs):
-                steps = 0
-                for sb in src:
-                    feats = (next(iter(sb.features.values()))
-                             if isinstance(sb.features, dict)
-                             else sb.features)
-                    if feats.shape[1] % data_size:
-                        raise ValueError(
-                            f"bucketed batch size {feats.shape[1]} not "
-                            f"divisible by the data-axis size {data_size}")
-                    (self.params, self.state, self.opt_state, losses,
-                     self._rng) = fused_fn(
-                        self.params, self.state, self.opt_state,
-                        sb.features, sb.labels, self.iteration, self._rng,
-                        sb.labels_mask, jnp.asarray(sb.step_valid))
-                    n = sb.n_steps
-                    self.iteration += n
-                    self.score_value = last = losses[n - 1]
-                    steps += n
-                    if self.listeners:
-                        resolved = pipe.push(
-                            losses, {"iteration": self.iteration, "k": n})
-                        if resolved is not None:
-                            self._fan_listener_scores(*resolved)
-                tail = pipe.flush()
-                if tail is not None:
-                    self._fan_listener_scores(*tail)
-                if steps == 0 and epoch == 0:
-                    raise ValueError("no trainable batches")
-                if steps == 0 and epoch > 0:
-                    raise ValueError(
-                        f"input exhausted before epoch {epoch + 1}: pass "
-                        "a resettable DataSetIterator (or arrays) for "
-                        "epochs>1")
-                for li in self.listeners:
-                    li.on_epoch_end(self)
-                self.epoch += 1
+            self._run_epochs(drv, epochs, data_size)
         finally:
-            src.close()
-        return last
+            drv.close_source()
+        return drv.last_score
 
     def _fan_listener_scores(self, scores, meta):
         """K per-step listener callbacks from one resolved fused

@@ -57,12 +57,24 @@ def decode_ndarray(buf) -> np.ndarray:
     return np.frombuffer(raw, dtype=np.dtype(h["dtype"])).reshape(h["shape"])
 
 
-def encode_dataset(features, labels) -> bytes:
+def encode_dataset(features, labels, ts=None) -> bytes:
+    """``ts`` (optional, seconds since the epoch — the PUBLISH time)
+    rides the self-describing JSON header, so a bounded-staleness
+    consumer can age a batch from its source rather than from queue
+    residency alone (delayed-ingest faults arrive already-stale).
+    Decoders that predate the field ignore it (header is JSON)."""
     f, l = _np(features), _np(labels)
-    return _pack(_KIND_DATASET,
-                 {"dtype": f.dtype.str, "shape": f.shape,
-                  "label_dtype": l.dtype.str, "label_shape": l.shape},
-                 [f.tobytes(), l.tobytes()])
+    header = {"dtype": f.dtype.str, "shape": f.shape,
+              "label_dtype": l.dtype.str, "label_shape": l.shape}
+    if ts is not None:
+        header["ts"] = float(ts)
+    return _pack(_KIND_DATASET, header, [f.tobytes(), l.tobytes()])
+
+
+def dataset_ts(buf):
+    """The publish timestamp of a dataset payload, or None."""
+    _kind, h, _raw = _unpack(buf)
+    return h.get("ts")
 
 
 def decode_dataset(buf):

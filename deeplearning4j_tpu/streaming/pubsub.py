@@ -24,10 +24,20 @@ import queue
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
+from deeplearning4j_tpu import telemetry as _tm
 from deeplearning4j_tpu.streaming import codec
+
+
+def _dropped_counter():
+    return _tm.get_registry().counter(
+        "stream_dropped_total",
+        "payloads dropped oldest-first by a bounded streaming queue, "
+        "labeled by site (broker = a slow subscriber's outbox overflowed; "
+        "subscriber = the consumer fell behind its own receive queue)")
 
 
 def _send_frame(sock, payload: bytes):
@@ -52,6 +62,76 @@ def _recv_frame(sock):
     return _recv_exact(sock, n)
 
 
+class _Outbox:
+    """One subscriber's bounded send queue + writer thread.
+
+    The broker used to ``sendall`` synchronously inside ``_fanout``: one
+    subscriber whose TCP buffer filled (a trainer busy in a long
+    dispatch) stalled EVERY publish to the topic — the unbounded-blocking
+    analog of unbounded memory growth. Now each publish lands in a
+    bounded per-subscriber deque (drop-OLDEST on overflow, counted
+    ``stream_dropped_total{site=broker}`` — Kafka retention semantics,
+    not backpressure) and a writer thread drains it; the socket write
+    happens OUTSIDE the lock, so a wedged subscriber costs only its own
+    queue."""
+
+    def __init__(self, sock, capacity):
+        self.sock = sock
+        self.capacity = int(capacity)
+        self.dropped = 0
+        self._buf = collections.deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._m_dropped = _dropped_counter()
+        self._reg = _tm.get_registry()
+        self._t = threading.Thread(target=self._writer, daemon=True)
+        self._t.start()
+
+    def put(self, payload):
+        with self._cv:
+            if self._closed:
+                return False
+            if len(self._buf) >= self.capacity:
+                self._buf.popleft()  # drop oldest: fresh data wins
+                self.dropped += 1
+                if self._reg.enabled:
+                    self._m_dropped.inc(site="broker")
+            self._buf.append(payload)
+            self._cv.notify()
+        return True
+
+    def _writer(self):
+        while True:
+            with self._cv:
+                while not self._buf and not self._closed:
+                    self._cv.wait()
+                if not self._buf and self._closed:
+                    return
+                payload = self._buf.popleft()
+            try:
+                # outside the lock: a slow socket blocks only this writer
+                _send_frame(self.sock, payload)
+            except OSError:
+                self.close()
+                return
+
+    @property
+    def closed(self):
+        with self._cv:
+            return self._closed
+
+    def close(self):
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
 class StreamingBroker:
     """In-process topic broker (the Kafka stand-in)."""
 
@@ -60,8 +140,7 @@ class StreamingBroker:
         self._srv = socket.create_server((host, port))
         self.port = self._srv.getsockname()[1]
         self.subscriber_buffer = subscriber_buffer
-        self._subs = collections.defaultdict(list)  # topic -> [socket]
-        self._send_locks = {}  # socket -> Lock (frame-atomic writes)
+        self._subs = collections.defaultdict(list)  # topic -> [_Outbox]
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads = []
@@ -101,9 +180,13 @@ class StreamingBroker:
                 return  # unknown/garbage handshake: drop the connection
             mode, topic = parts
             if mode == "SUB":
+                # the outbox's writer thread owns the socket from here —
+                # its queue serializes frames, so concurrent publishers
+                # can never interleave bytes inside a length-prefixed
+                # frame (the role the per-socket send locks used to play)
                 with self._lock:
-                    self._subs[topic].append(conn)
-                    self._send_locks[conn] = threading.Lock()
+                    self._subs[topic].append(
+                        _Outbox(conn, self.subscriber_buffer))
                 keep_open = True  # broker pushes to it; ownership transferred
                 return
             while True:
@@ -122,34 +205,29 @@ class StreamingBroker:
 
     def _fanout(self, topic, payload):
         with self._lock:
-            subs = [(s, self._send_locks[s]) for s in self._subs[topic]]
-        dead = []
-        for s, lock in subs:
-            try:
-                # frame-atomic: concurrent publishers to one subscriber must
-                # not interleave bytes inside a length-prefixed frame
-                with lock:
-                    _send_frame(s, payload)
-            except OSError:
-                dead.append(s)
+            boxes = list(self._subs[topic])
+        dead = [b for b in boxes if not b.put(payload)]
         if dead:
             with self._lock:
-                for s in dead:
-                    if s in self._subs[topic]:
-                        self._subs[topic].remove(s)
-                    self._send_locks.pop(s, None)
+                for b in dead:
+                    if b in self._subs[topic]:
+                        self._subs[topic].remove(b)
+
+    def dropped_total(self):
+        """Broker-side drops across all subscriber outboxes (also counted
+        into ``stream_dropped_total{site=broker}``)."""
+        with self._lock:
+            boxes = [b for subs in self._subs.values() for b in subs]
+        return sum(b.dropped for b in boxes)
 
     def close(self):
         self._stop.set()
         self._srv.close()
         with self._lock:
-            for subs in self._subs.values():
-                for s in subs:
-                    try:
-                        s.close()
-                    except OSError:
-                        pass
+            boxes = [b for subs in self._subs.values() for b in subs]
             self._subs.clear()
+        for b in boxes:
+            b.close()
 
 
 class NDArrayPublisher:
@@ -162,8 +240,13 @@ class NDArrayPublisher:
     def publish(self, array):
         _send_frame(self.sock, codec.encode_ndarray(array))
 
-    def publish_dataset(self, features, labels):
-        _send_frame(self.sock, codec.encode_dataset(features, labels))
+    def publish_dataset(self, features, labels, ts=None):
+        """``ts`` defaults to NOW — every dataset payload carries its
+        publish time, so a bounded-staleness consumer can age it from
+        the source (pass an older ts to model upstream delay)."""
+        ts = time.time() if ts is None else float(ts)
+        _send_frame(self.sock, codec.encode_dataset(features, labels,
+                                                    ts=ts))
 
     def close(self):
         self.sock.close()
@@ -177,6 +260,9 @@ class NDArraySubscriber:
         self.sock = socket.create_connection((host, port))
         self.sock.sendall(f"SUB {topic}\n".encode())
         self.queue = queue.Queue(maxsize=buffer)
+        self.dropped = 0
+        self._m_dropped = _dropped_counter()
+        self._reg = _tm.get_registry()
         self._closed = threading.Event()
         self._t = threading.Thread(target=self._pump, daemon=True)
         self._t.start()
@@ -190,23 +276,43 @@ class NDArraySubscriber:
             if payload is None:
                 self._closed.set()
                 return
+            item = (time.monotonic(), payload)  # enqueue time for aging
             while True:
                 try:
-                    self.queue.put_nowait(payload)
+                    self.queue.put_nowait(item)
                     break
                 except queue.Full:
                     try:
                         self.queue.get_nowait()  # drop oldest
+                        self.dropped += 1
+                        if self._reg.enabled:
+                            self._m_dropped.inc(site="subscriber")
                     except queue.Empty:
                         pass
 
     def receive(self, timeout=None):
         """Next payload decoded (ndarray or (features, labels))."""
-        payload = self.queue.get(timeout=timeout)
-        kind, _, _ = codec._unpack(payload)
+        return self.receive_timed(timeout=timeout)[1]
+
+    def receive_timed(self, timeout=None):
+        """``(age_s, decoded, publish_ts)``: the decoded payload plus how
+        stale it is. ``age_s`` is time spent waiting in this subscriber's
+        queue, extended back to the PUBLISH timestamp when the payload
+        carries one (codec ``ts``) — the bounded-staleness admission
+        signal for continuous training. ``publish_ts`` is None for
+        payloads without the header field."""
+        t_enq, payload = self.queue.get(timeout=timeout)
+        age = time.monotonic() - t_enq
+        kind, header, _ = codec._unpack(payload)
+        ts = header.get("ts")
+        if ts is not None:
+            # wall-clock spans processes (publisher may be another pid);
+            # never let clock skew make a batch look fresher than its
+            # queue residency says it is
+            age = max(age, time.time() - float(ts))
         if kind == codec._KIND_DATASET:
-            return codec.decode_dataset(payload)
-        return codec.decode_ndarray(payload)
+            return age, codec.decode_dataset(payload), ts
+        return age, codec.decode_ndarray(payload), ts
 
     def close(self):
         self._closed.set()

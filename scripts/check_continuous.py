@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""tier1.sh continuous gate: parse a `bench.py continuous` JSONL stream
+and fail unless the chaos contracts held. Counter- and parity-based,
+NEVER wall time (CPU legs jitter; the claims under test are exact):
+
+* chaos-leg PARITY: the faulted streaming run's state digest (params +
+  opt_state + RNG chain + iteration) EQUALS the uninterrupted offline
+  reference that never saw the poisoned/stale batches — rollback+resume
+  is bit-exact including the RNG chain;
+* every fault COUNTED: exactly one numerics rollback (with its
+  rolled-back step on the books and a flight-dump postmortem), exactly
+  one stale admission drop, producer death absorbed by counted retries
+  that RECOVERED (zero fatal), zero recompiles (the rollback re-armed
+  the cached step);
+* serving never went dark or sick: snapshots published, every hot-swap
+  handoff ok, the served probe matches the trainer's net <= 1e-6;
+* SIGTERM leg: the process died by the DEFAULT disposition after the
+  flight ring dumped (reason signal:SIGTERM), and the resumed process
+  finished the stream digest-equal to an uninterrupted run.
+
+Usage: check_continuous.py <jsonl-file>
+"""
+
+import json
+import sys
+
+TOL = 1e-6
+
+
+def main(argv):
+    path = argv[1]
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    recs = [r for r in rows
+            if str(r.get("metric", "")).startswith("continuous")]
+    if not recs:
+        print("check_continuous: no continuous record in", path)
+        return 1
+    rec = recs[-1]
+    if "FAILED" in rec.get("metric", ""):
+        print("check_continuous: bench leg failed:", rec.get("error"))
+        return 1
+    errors = []
+    chaos = rec.get("chaos") or {}
+    summary = chaos.get("summary") or {}
+    counters = chaos.get("counters") or {}
+
+    def counter(name, label=""):
+        return (counters.get(name) or {}).get(label, 0)
+
+    # ---- parity: the headline claim -----------------------------------
+    if not rec.get("parity"):
+        errors.append(
+            f"chaos digest {chaos.get('digest')} != reference "
+            f"{rec.get('ref_digest')}: rollback/resume was NOT bit-exact")
+    if chaos.get("iteration") != rec.get("expected_steps"):
+        errors.append(
+            f"trained {chaos.get('iteration')} steps, expected "
+            f"{rec.get('expected_steps')} (a good batch was lost, or a "
+            f"faulted one trained)")
+    if summary.get("status") not in ("target_steps", "stream_closed"):
+        errors.append(f"chaos run ended {summary.get('status')!r}, not a "
+                      "clean completion")
+
+    # ---- every fault counted ------------------------------------------
+    if counter("continuous_rollback_total", "reason=numerics") != 1:
+        errors.append("expected exactly 1 numerics rollback, counters="
+                      f"{counters.get('continuous_rollback_total')}")
+    if sum((counters.get("continuous_rolled_back_steps_total")
+            or {}).values()) != 1:
+        errors.append("rolled-back steps not on the books: "
+                      f"{counters.get('continuous_rolled_back_steps_total')}")
+    if counter("continuous_dropped_total", "reason=stale") != 1:
+        errors.append("expected exactly 1 stale admission drop, counters="
+                      f"{counters.get('continuous_dropped_total')}")
+    if counter("etl_retry_total", "outcome=retried") < 1:
+        errors.append("producer death left no retry trace "
+                      f"({counters.get('etl_retry_total')})")
+    if counter("etl_retry_total", "outcome=fatal"):
+        errors.append("ingest went fatal — the run survived by luck, not "
+                      "by the retry policy")
+    if sum((counters.get("recompiles_total") or {}).values()):
+        errors.append("rollback/resume recompiled: "
+                      f"{counters.get('recompiles_total')}")
+    if not chaos.get("flight_dumps"):
+        errors.append("the numerics anomaly left no flight-dump postmortem")
+
+    # ---- serving stayed up and healthy --------------------------------
+    if counter("continuous_snapshots_total", "verdict=published") < 1:
+        errors.append("no snapshot ever published to serving")
+    serve = counters.get("continuous_serve_updates_total") or {}
+    if serve.get("outcome=error"):
+        errors.append(f"serving hot-swap handoffs failed: {serve}")
+    if serve.get("outcome=ok", 0) < 1:
+        errors.append("no successful serving hot-swap handoff")
+    probe = chaos.get("serving_probe_diff")
+    if probe is None or probe > TOL:
+        errors.append(f"served probe diverged from the trained net: "
+                      f"{probe}")
+
+    # ---- SIGTERM leg ---------------------------------------------------
+    st = rec.get("sigterm") or {}
+    if st.get("rc") != st.get("expected_rc"):
+        errors.append(f"SIGTERM leg rc={st.get('rc')}, expected "
+                      f"{st.get('expected_rc')} (default disposition)")
+    if st.get("dump_reason") != "signal:SIGTERM":
+        errors.append("SIGTERM left no flight dump (dump_reason="
+                      f"{st.get('dump_reason')!r})")
+    if not st.get("parity"):
+        errors.append(
+            f"SIGTERM resume digest {st.get('resume_digest')} != "
+            f"uninterrupted {st.get('ref_digest')}: resume not bit-exact")
+
+    if errors:
+        print("check_continuous: FAILED")
+        for e in errors:
+            print("  -", e)
+        return 1
+    print("check_continuous: ok — chaos parity exact "
+          f"({chaos.get('iteration')} steps, 1 rollback, 1 stale drop, "
+          f"{int(counter('etl_retry_total', 'outcome=retried'))} retries, "
+          f"sigterm dump+resume exact)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -33,6 +33,7 @@ timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python -m pytest tests/test_serving.py tests/test_fused.py \
   tests/test_streaming.py tests/test_parallel.py tests/test_native.py \
   tests/test_ui.py tests/test_sanitizer.py tests/test_fleet.py \
+  tests/test_continuous.py \
   -q -m 'not slow' \
   -p no:cacheprovider -p no:xdist -p no:randomly || {
     echo "tier1: graftsan stage FAILED"; exit 1; }
@@ -156,5 +157,27 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
   || { echo "tier1: fleet smoke FAILED (a worker cold-started, the"
        echo "tier1: replacement recompiled, requests were lost"
        echo "tier1: uncounted, or fleet/single-engine parity broke)"; exit 1; }
+
+# Stage 9: continuous-learning chaos smoke (deeplearning4j_tpu/continuous,
+# ISSUE 13) — the streaming loop end to end under injected faults: a REAL
+# runner subprocess trains from the pubsub stream while the producer is
+# killed mid-stream (replacement resumes it), one batch is NaN-poisoned
+# (watchdog -> rollback to the last bundle -> resume) and one arrives
+# past the staleness bound (counted drop); a second leg SIGTERMs the run
+# mid-round (flight dump) and resumes from the bundle.
+# scripts/check_continuous.py gates on COUNTERS AND PARITY (faulted run
+# == clean reference digest-EXACT incl. the RNG chain, every fault
+# counted, zero recompiles on rollback, serving handoff healthy, zero
+# hangs) — never wall time on CPU.
+echo "== continuous chaos smoke =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
+  timeout -k 10 300 python bench.py continuous \
+  > /tmp/_continuous.jsonl \
+  && tee -a BENCH_smoke.json < /tmp/_continuous.jsonl > /dev/null \
+  && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python scripts/check_continuous.py /tmp/_continuous.jsonl \
+  || { echo "tier1: continuous chaos smoke FAILED (rollback/resume not"
+       echo "tier1: bit-exact, a fault went uncounted, ingest went"
+       echo "tier1: fatal, or the SIGTERM dump/resume path broke)"; exit 1; }
 
 exit $rc

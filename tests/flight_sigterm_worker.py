@@ -1,0 +1,40 @@
+"""Jax-free worker for the SIGTERM -> flight-dump subprocess test.
+
+PR 2 installed the handler and tested installation; this script is the
+other half of the claim: a REAL process with records in its flight ring
+receives a REAL SIGTERM, dumps the ring to $DL4J_TPU_FLIGHT_DIR, and
+dies by the default disposition (rc == -SIGTERM). The flight recorder
+itself is pure stdlib, so no device work happens — the process only
+pays the package import before its ready line.
+
+Usage: flight_sigterm_worker.py [n_records]
+"""
+
+import json
+import sys
+import time
+
+from procutil import pin_single_cpu_device
+
+pin_single_cpu_device()
+
+from deeplearning4j_tpu import telemetry                     # noqa: E402
+from deeplearning4j_tpu.telemetry import flight as _flight   # noqa: E402
+
+
+def main(argv):
+    n = int(argv[1]) if len(argv) > 1 else 5
+    telemetry.enable()  # arms the recorder
+    rec = _flight.get_recorder()
+    for i in range(n):
+        rec.note(step=i, score=float(i) * 0.5, step_time_s=0.01)
+    installed = _flight.install_signal_handler()
+    print(json.dumps({"ready": True, "installed": installed,
+                      "records": n}), flush=True)
+    time.sleep(120)  # the test SIGTERMs us long before this
+    print(json.dumps({"error": "never signaled"}), flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,161 @@
+"""Continuous-learning chaos tests over REAL subprocesses (ISSUE 13):
+the SIGTERM -> flight-dump path end to end (PR 2 installed the handler;
+here a real process with a populated ring takes a real signal), and the
+chaos legs — NaN poison -> rollback -> bit-exact parity, and SIGTERM
+mid-run -> resume-from-bundle -> bit-exact parity — driven through
+``continuous.runner`` exactly as tier-1 stage 9's bench does."""
+
+import json
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+import procutil
+from deeplearning4j_tpu.continuous import chaos
+
+RUNNER = [sys.executable, "-m", "deeplearning4j_tpu.continuous.runner"]
+PUBLISHER = [sys.executable, "-m", "deeplearning4j_tpu.continuous.chaos"]
+
+
+def _env(tmp_path):
+    return procutil.scrubbed_env(DL4J_TPU_FLIGHT_DIR=str(tmp_path))
+
+
+def _read_ready(proc, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        line = line.strip()
+        if line.startswith("{"):
+            doc = json.loads(line)
+            if doc.get("continuous_ready"):
+                return doc
+    proc.kill()
+    pytest.fail("runner never printed its ready line")
+
+
+class TestSigtermFlightDump:
+    def test_sigterm_dumps_ring_then_dies_default(self, tmp_path):
+        """Satellite: the dump-on-signal path in a real process — ring
+        dumped to $DL4J_TPU_FLIGHT_DIR with reason signal:SIGTERM and
+        the noted records, then the default disposition kills us."""
+        worker = os.path.join(procutil.HERE, "flight_sigterm_worker.py")
+        p = procutil.spawn([sys.executable, worker, "7"],
+                           env=_env(tmp_path), cwd=procutil.HERE)
+        line = p.stdout.readline().strip()
+        doc = json.loads(line)
+        assert doc["ready"] and doc["installed"]
+        os.kill(p.pid, signal.SIGTERM)
+        p.wait(timeout=30)
+        assert p.returncode == -signal.SIGTERM  # default action ran
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("dl4j_tpu_flight_")]
+        assert len(dumps) == 1
+        with open(tmp_path / dumps[0]) as f:
+            dump = json.load(f)
+        assert dump["reason"] == "signal:SIGTERM"
+        assert dump["n_records"] == 7
+        assert [r["step"] for r in dump["records"]] == list(range(7))
+        p.stdout.close()
+        p.stderr.close()
+
+
+class TestChaosSubprocess:
+    def test_nan_rollback_parity_real_subprocess(self, tmp_path):
+        """Streaming run with one poisoned batch: the subprocess rolls
+        back and resumes; its final digest equals an offline reference
+        that never saw the poison — bit-exact incl. the RNG chain."""
+        from deeplearning4j_tpu.streaming.pubsub import StreamingBroker
+        n, poison, seed = 6, 2, 77
+        env = _env(tmp_path)
+        broker = StreamingBroker().start()
+        try:
+            runner = procutil.spawn(
+                RUNNER + ["--snapshot", str(tmp_path / "chaos.zip"),
+                          "--broker-port", str(broker.port),
+                          "--gen-seed", str(seed),
+                          "--quiet-timeout-s", "1.0",
+                          "--ingest-retries", "8",
+                          "--until-steps", str(n - 1)], env=env)
+            _read_ready(runner)
+            pub = procutil.spawn(
+                PUBLISHER + ["--port", str(broker.port), "--n", str(n),
+                             "--gen-seed", str(seed),
+                             "--poison", str(poison),
+                             "--interval-s", "0.05"], env=env)
+            (out, _err), (pout, _perr) = procutil.communicate_all(
+                [runner, pub], timeout=240, fail=pytest.fail)
+        finally:
+            broker.close()
+        done = procutil.last_json_line(out)
+        assert done["continuous_done"]
+        assert done["summary"]["rollbacks"] == 1
+        assert done["iteration"] == n - 1
+        # the rollback wrote a postmortem (numerics flight dump)
+        assert done["flight_dumps"]
+        # zero uncounted losses: steps + rolled-back == published batches
+        rolled = done["counters"]["continuous_rolled_back_steps_total"]
+        assert sum(rolled.values()) == 1
+
+        ref = procutil.spawn(
+            RUNNER + ["--snapshot", str(tmp_path / "ref.zip"),
+                      "--offline-n", str(n), "--gen-seed", str(seed),
+                      "--offline-skip", str(poison)], env=env)
+        (rout, _rerr), = procutil.communicate_all([ref], timeout=240,
+                                                  fail=pytest.fail)
+        rdone = procutil.last_json_line(rout)
+        assert done["digest"] == rdone["digest"]  # bit-exact parity
+
+    def test_sigterm_midrun_resume_bit_exact(self, tmp_path):
+        """SIGTERM mid-run: flight ring dumps, the process dies; a fresh
+        process resumes from the on-disk bundle and finishes the stream
+        bit-exactly equal to an uninterrupted run."""
+        n, seed = 8, 55
+        env = _env(tmp_path)
+        runner = procutil.spawn(
+            RUNNER + ["--snapshot", str(tmp_path / "term.zip"),
+                      "--offline-n", str(n), "--gen-seed", str(seed),
+                      "--install-sigterm", "--round-lines",
+                      "--round-sleep-s", "0.4"], env=env)
+        _read_ready(runner)
+        # wait for at least two completed rounds, then SIGTERM mid-run
+        rounds_seen = 0
+        deadline = time.time() + 120
+        while rounds_seen < 2 and time.time() < deadline:
+            line = runner.stdout.readline().strip()
+            if line.startswith("{") and "round" in line:
+                rounds_seen = json.loads(line)["round"]
+            elif not line:
+                break
+        assert rounds_seen >= 2
+        os.kill(runner.pid, signal.SIGTERM)
+        runner.wait(timeout=30)
+        assert runner.returncode == -signal.SIGTERM
+        runner.stdout.close()
+        runner.stderr.close()
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("dl4j_tpu_flight_")]
+        assert dumps  # the preemption left a postmortem
+
+        # resume from the bundle; --offline-start -1 = the bundle's
+        # iteration counter (k=1: one step per batch, no faults)
+        resumed = procutil.spawn(
+            RUNNER + ["--snapshot", str(tmp_path / "term.zip"),
+                      "--resume", "--offline-n", str(n),
+                      "--gen-seed", str(seed), "--offline-start", "-1"],
+            env=env)
+        ref = procutil.spawn(
+            RUNNER + ["--snapshot", str(tmp_path / "ref2.zip"),
+                      "--offline-n", str(n), "--gen-seed", str(seed)],
+            env=env)
+        (out, _e1), (rout, _e2) = procutil.communicate_all(
+            [resumed, ref], timeout=240, fail=pytest.fail)
+        done = procutil.last_json_line(out)
+        rdone = procutil.last_json_line(rout)
+        assert done["iteration"] == rdone["iteration"] == n
+        assert done["digest"] == rdone["digest"]  # resume is bit-exact

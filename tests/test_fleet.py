@@ -542,6 +542,59 @@ class TestFleetSupervisor:
         finally:
             sup.stop()
 
+    def test_respawn_backoff_on_crash_loop(self, fresh):
+        """ISSUE 13 satellite: a worker that dies instantly on every
+        respawn must NOT spin the supervisor hot — attempts space out
+        under the capped exponential backoff, each deferral counted."""
+        sup = _fake_supervisor(1, probe_interval_s=0.05,
+                               respawn_backoff_base_s=0.2,
+                               respawn_backoff_cap_s=1.0,
+                               crashloop_window_s=10.0)
+        try:
+            sup.start()
+            # every replacement from now on exits before its ready line
+            sup._worker_command = lambda wid: [
+                sys.executable, "-c", "raise SystemExit(1)"]
+            sup.kill_worker("w0", sig=signal.SIGKILL)
+            time.sleep(2.5)
+            st = sup.status()
+            bo = st["backoff"]["w0"]
+            assert bo["level"] >= 2  # the loop kept escalating
+            # without backoff the 0.05s probe tick would attempt ~50
+            # respawns in 2.5s; the 0.2/0.4/0.8/1.0... schedule allows
+            # only a handful (each also pays ~0.2s of await_ready)
+            assert 1 <= len(st["respawns"]) <= 8
+            # every crash-loop attempt recorded its failure, never silent
+            assert all(e.get("error") for e in st["respawns"])
+            c = fresh.get("fleet_respawn_backoff_total")
+            assert c is not None and c.value(worker="w0") >= 2
+        finally:
+            sup.stop()
+
+    def test_long_lived_death_respawns_immediately(self, fresh):
+        """The backoff is for crash LOOPS: a worker that lived past the
+        window respawns on the next tick with level reset to zero."""
+        sup = _fake_supervisor(1, probe_interval_s=0.05,
+                               respawn_backoff_base_s=5.0,
+                               crashloop_window_s=0.0)
+        try:
+            sup.start()
+            sup.kill_worker("w0", sig=signal.SIGKILL)
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                evs = sup.status()["respawns"]
+                if evs and evs[-1].get("spawn_s") is not None:
+                    break
+                time.sleep(0.05)
+            st = sup.status()
+            assert st["respawns"] and \
+                st["respawns"][-1]["spawn_s"] is not None
+            assert st["backoff"]["w0"]["level"] == 0
+            c = fresh.get("fleet_respawn_backoff_total")
+            assert c is None or c.value(worker="w0") == 0
+        finally:
+            sup.stop()
+
 
 # ---------------------------------------------------------------------------
 # /fleet endpoint + UIServer port=0 satellites
