@@ -1323,23 +1323,35 @@ def bench_coldstart():
 
 
 def bench_zero(batch_per_chip=32, n_batches=16, epochs=3):
-    """ZeRO A/B (ISSUE 10, arxiv 2004.13336): the same data-parallel fit
-    under the three weight-update layouts —
+    """ZeRO A/B (ISSUES 10+14, arxiv 2004.13336 + 1910.02054): the same
+    data-parallel fit under the four weight-update/storage layouts —
 
-      replicated  opt state a full copy per replica (the pre-PR-10 default)
-      zero1       opt state sharded over 'data', reduce-scattered update
-                  (the new ParallelTrainer default)
-      fsdp        params ALSO stored sharded, gathered per step (ZeRO-3)
+      replicated   opt state a full copy per replica (the pre-PR-10 default)
+      zero1        opt state sharded over 'data', reduce-scattered update
+                   (the ParallelTrainer default)
+      fsdp         params ALSO stored sharded, whole-tree gather at step
+                   entry (ZeRO-3 storage)
+      fsdp_stream  the homogeneous trunk scanned block-by-block, each
+                   block gathered INSIDE the scan body and discarded
+                   (ZeRO-3 streamed: step-peak = one block, not the model)
 
     — recording steps/s, addressable-shard-aware per-device param/opt
-    bytes, the jit compile count (recompiles must stay flat: the sharded
-    layouts add no shape churn), and max param divergence vs the
-    replicated leg (the layouts are bit-exact re-expressions, so this must
-    be ~0). Layer dims are divisible by the data-axis size so the ideal
-    1/N per-device ratio is visible, not blurred by replicated ragged
-    leaves. scripts/check_zero.py gates the bytes ratio + compile counters
-    in tier1.sh (stage 6 pins an 8-device CPU mesh via XLA_FLAGS);
-    steps/s is recorded, not gated — CPU legs jitter ±15-30%."""
+    bytes, the ANALYZED step-peak bytes per leg
+    (``compiled.memory_analysis()`` via step_memory_analysis — the
+    within-step number the steady-state gauges cannot see), the jit
+    compile count (recompiles must stay flat: the sharded layouts add no
+    shape churn), and max param divergence vs the replicated leg (the
+    layouts are bit-exact re-expressions, so this must be ~0). A fifth
+    COMPOSED leg runs the DP×TP×PP path (ComposedTrainer, 2×2×2 mesh)
+    against the DP-only reference — per-step loss and end params ≤1e-6 —
+    plus a ragged fit riding the pad_batch bucketing, pinned bit-exact
+    vs manually padded steps. Layer dims are divisible by the data-axis
+    size so the ideal 1/N per-device ratio is visible, not blurred by
+    replicated ragged leaves. scripts/check_zero.py gates the bytes
+    ratios, the streamed-vs-fsdp peak ratio, compile counters and the
+    composed parity in tier1.sh (stage 6 pins an 8-device CPU mesh via
+    XLA_FLAGS); steps/s is recorded, not gated — CPU legs jitter
+    ±15-30%."""
     import jax
     from deeplearning4j_tpu.nn import layers as L
     from deeplearning4j_tpu.nn import updaters as U
@@ -1350,7 +1362,7 @@ def bench_zero(batch_per_chip=32, n_batches=16, epochs=3):
                                              make_mesh)
     from deeplearning4j_tpu.telemetry import devices as _devices
 
-    hidden = 256
+    hidden, trunk = 256, 4
     if _preflight():
         batch_per_chip, n_batches, epochs, hidden = 16, 8, 2, 128
     n_dev = len(jax.devices())
@@ -1362,20 +1374,25 @@ def bench_zero(batch_per_chip=32, n_batches=16, epochs=3):
     y = np.eye(8, dtype=np.float32)[rs.randint(0, 8, n)]
 
     def make_trainer(mode):
+        # a homogeneous 4-deep hidden trunk so the streamed leg has a
+        # stacked slab to scan (the entry layer maps 64 -> hidden and
+        # stays outside it, like an embedding)
         conf = NeuralNetConfig(seed=5, updater=U.Adam(learning_rate=1e-3)) \
             .list(L.DenseLayer(n_out=hidden, activation="relu"),
-                  L.DenseLayer(n_out=hidden, activation="relu"),
+                  *[L.DenseLayer(n_out=hidden, activation="relu")
+                    for _ in range(trunk)],
                   L.OutputLayer(n_out=8, loss="mcxent"),
                   input_type=I.FeedForwardType(64))
         net = MultiLayerNetwork(conf)
         return ParallelTrainer(
             net, mesh,
             shard_optimizer_state=(mode != "replicated"),
-            shard_params="fsdp" if mode == "fsdp" else None).init()
+            shard_params=(mode if mode in ("fsdp", "fsdp_stream")
+                          else None)).init()
 
     legs = {}
     ref_w = None
-    for mode in ("replicated", "zero1", "fsdp"):
+    for mode in ("replicated", "zero1", "fsdp", "fsdp_stream"):
         tr = make_trainer(mode)
         tr.fit(x, y, batch_size=batch, epochs=1)      # compile + warm epoch
         jax.device_get(jax.tree_util.tree_leaves(tr.params)[0])
@@ -1387,7 +1404,8 @@ def bench_zero(batch_per_chip=32, n_batches=16, epochs=3):
         steps = epochs * n_batches
         p_log, p_dev = _devices.tree_shard_bytes(tr.params)
         o_log, o_dev = _devices.tree_shard_bytes(tr.opt_state)
-        w = np.asarray(tr.params[0]["W"])
+        recompiles = tr._step_fn._cache_size() - compiles_warm
+        w = np.asarray(tr.params[1]["W"])   # a trunk block's weights
         if mode == "replicated":
             ref_w = w
         legs[mode] = {
@@ -1397,12 +1415,19 @@ def bench_zero(batch_per_chip=32, n_batches=16, epochs=3):
             "opt_state_bytes_logical": o_log,
             "opt_state_bytes_per_device": o_dev,
             "compiles": compiles_warm,
-            "recompiles": tr._step_fn._cache_size() - compiles_warm,
+            "recompiles": recompiles,
             "final_loss": float(np.asarray(tr.score_value)),
             "max_param_diff_vs_replicated":
                 float(np.abs(w - ref_w).max()),
+            # the within-step XLA ledger (analysis-only AOT compile,
+            # AFTER the counters above so it cannot blur the recompile
+            # claim); None when the backend has no memory_analysis
+            "step_peak": tr.step_memory_analysis(x[:batch], y[:batch]),
         }
+    composed = _bench_zero_composed()
     z, r = legs["zero1"], legs["replicated"]
+    peak = {m: (legs[m].get("step_peak") or {}).get("peak_bytes")
+            for m in ("replicated", "fsdp", "fsdp_stream")}
     return {"metric": "zero_sharded_update_ab",
             "value": z["steps_per_sec"], "unit": "steps/sec",
             # speedup (or cost) of the sharded update vs the replicated
@@ -1410,13 +1435,80 @@ def bench_zero(batch_per_chip=32, n_batches=16, epochs=3):
             "vs_baseline": round(z["steps_per_sec"]
                                  / max(r["steps_per_sec"], 1e-9), 2),
             "n_devices": n_dev, "batch": batch, "hidden": hidden,
+            "trunk_layers": trunk,
             "opt_bytes_ratio": round(
                 r["opt_state_bytes_per_device"]
                 / max(z["opt_state_bytes_per_device"], 1), 2),
             "fsdp_param_bytes_ratio": round(
                 r["param_bytes_per_device"]
                 / max(legs["fsdp"]["param_bytes_per_device"], 1), 2),
+            # step-peak: the number the streamed tier exists to shrink
+            "stream_peak_ratio": (
+                round(peak["fsdp"] / peak["fsdp_stream"], 3)
+                if peak["fsdp"] and peak["fsdp_stream"] else None),
+            "composed": composed,
             "legs": legs}
+
+
+def _bench_zero_composed():
+    """The DP×TP×PP composed-parity leg of ``bench.py zero``: a tiny
+    ComposedTrainer on a 2×2×2 mesh vs the SAME model on a DP-only mesh
+    (Sgd updater so fp noise is not Adam-eps-amplified — the claim under
+    test is the parallel composition, not the optimizer conditioning),
+    plus a ragged fit through the pad_batch bucketing pinned bit-exact
+    against manually padded steps. Counters and parity only — never wall
+    time."""
+    import jax
+    from deeplearning4j_tpu.nn import updaters as U
+    from deeplearning4j_tpu.parallel import MeshSpec, make_mesh
+    from deeplearning4j_tpu.parallel.composed import (ComposedParallelLM,
+                                                      ComposedTrainer)
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        # the 2×2×2 composition needs 8 devices; the CI gate always has
+        # them (XLA_FLAGS), a smaller live topology records the skip
+        return {"skipped": f"needs 8 devices, have {len(devs)}"}
+    cfg = dict(vocab_size=32, n_layers=2, d_model=16, n_heads=2, seq_len=8,
+               n_microbatches=2)
+    mesh_c = make_mesh(MeshSpec(data=2, model=2, seq=1, stage=2),
+                       devices=devs[:8])
+    mesh_d = make_mesh(MeshSpec(data=8, model=1, seq=1, stage=1),
+                       devices=devs[:8])
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 32, (16, 8))
+    labels = np.roll(ids, -1, axis=1)
+
+    def make(mesh):
+        return ComposedTrainer(ComposedParallelLM(
+            mesh=mesh, updater=U.Sgd(learning_rate=0.1), **cfg).init())
+
+    tr, ref = make(mesh_c), make(mesh_d)
+    loss_diffs = [abs(float(tr.step(ids, labels))
+                      - float(ref.step(ids, labels))) for _ in range(3)]
+    pdiff = max(jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+        tr.params, ref.params)))
+
+    # ragged stream through the bucketing machinery == manual padding
+    t_fit, t_man = make(mesh_c), make(mesh_c)
+    t_fit.fit(ids[:12], labels[:12], batch_size=8)
+    t_man.step(ids[:8], labels[:8], np.ones(8, np.float32))
+    m = np.zeros(8, np.float32)
+    m[:4] = 1
+    xp = np.zeros((8, 8), ids.dtype)
+    xp[:4] = ids[8:12]
+    yp = np.zeros((8, 8), labels.dtype)
+    yp[:4] = labels[8:12]
+    t_man.step(xp, yp, m)
+    ragged = max(jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+        t_fit.params, t_man.params)))
+    return {"mesh": "2x2x2", "steps": 3,
+            "max_loss_diff_vs_dp": max(loss_diffs),
+            "max_param_diff_vs_dp": pdiff,
+            "ragged_pad_param_diff": ragged,
+            "masked_compiles": t_fit.lm._step_fn_masked._cache_size()}
 
 
 def bench_longcontext():

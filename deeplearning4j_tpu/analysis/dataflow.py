@@ -123,6 +123,17 @@ COLLECTIVES = {"psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
                "all_gather": 1, "all_to_all": 1, "ppermute": 1,
                "pshuffle": 1, "psum_scatter": 1, "axis_index": 0}
 
+#: jax higher-order combinators that invoke their function argument IN
+#: the caller's trace context: a body handed to ``lax.scan`` (the
+#: streamed-gather idiom — a collective with a scan-carried block index),
+#: ``fori_loop``, ``checkpoint``/``remat`` wrappers, ... runs under
+#: exactly the mapped axes of the function that calls the combinator, so
+#: R8 can check its literal collective axes against the REAL axis set
+#: instead of writing the body off as escaped-with-unknown-axes
+_HO_COMBINATORS = {"scan", "fori_loop", "while_loop", "cond", "switch",
+                   "map", "associative_scan", "checkpoint", "remat",
+                   "vmap"}
+
 _LOCK_CTORS = {"threading.Lock": "Lock", "threading.RLock": "RLock",
                "threading.Condition": "Condition"}
 _QUEUE_CTOR_SUFFIXES = ("queue.Queue", "queue.LifoQueue",
@@ -681,6 +692,33 @@ class ProjectFacts:
                                      isinstance(kwv.value, str)) else None
                 roots[tgt.node] = (roots.get(tgt.node) or set()) | ax \
                     if ax else roots.get(tgt.node, None)
+        # higher-order jax combinators run their function argument in the
+        # CALLER's trace context: record (body -> enclosing fn) edges so
+        # the closure below propagates the caller's mapped AXES into the
+        # body (the streamed-gather idiom: a ppermute/all_gather with a
+        # scan-carried index must sit under a mapped context whose mesh
+        # binds the axis), and keep those Name uses OUT of the
+        # escaped-callable bailout — a body only ever scanned from an
+        # unmapped function really is outside every mapped context
+        ho_edges = {}   # body fn node -> [enclosing fn node]
+        ho_args = set()  # id(Name node) consumed as a combinator body
+        for mod in self.mods:
+            for call in (n for n in ast.walk(mod.tree)
+                         if isinstance(n, ast.Call)):
+                d = mod.dotted(call.func) or ""
+                if not (d.startswith("jax.")
+                        and d.rsplit(".", 1)[-1] in _HO_COMBINATORS):
+                    continue
+                encl = mod.enclosing_function(call)
+                for a in call.args:
+                    if not isinstance(a, ast.Name):
+                        continue
+                    tgt = self._resolve_name(mod, call, a.id)
+                    if tgt is None:
+                        continue
+                    ho_args.add(id(a))
+                    if encl is not None:
+                        ho_edges.setdefault(tgt.node, []).append(encl)
         # escaped callables: a def referenced as a VALUE (passed as an
         # argument, returned, stored) may be invoked from a mapped
         # context we cannot see — treat as mapped with unknown axes, so
@@ -700,6 +738,8 @@ class ProjectFacts:
                         if not (isinstance(n, ast.Name)
                                 and isinstance(n.ctx, ast.Load)):
                             continue
+                        if id(n) in ho_args:
+                            continue  # combinator body: precise edges above
                         parent = getattr(n, "_gl_parent", None)
                         if isinstance(parent, ast.Call) \
                                 and parent.func is n:
@@ -738,6 +778,25 @@ class ProjectFacts:
                                    <= self.mapped[tgt.node])):
                         self.mapped[tgt.node] = (self.mapped[tgt.node]
                                                  | self.mapped[fn])
+                        changed = True
+            # combinator bodies inherit their scanning caller's axes —
+            # like a direct callee, but through the lax.scan/fori_loop/
+            # checkpoint argument position. A body that ALSO escaped
+            # through a non-combinator route already sits at None
+            # (unknown axes) and stays there: the precise edge never
+            # narrows a conservative fact.
+            for body_fn, callers in ho_edges.items():
+                for c in callers:
+                    if c not in self.mapped:
+                        continue
+                    ax = self.mapped[c]
+                    if body_fn not in self.mapped:
+                        self.mapped[body_fn] = ax
+                        changed = True
+                    elif (ax is not None
+                          and self.mapped[body_fn] is not None
+                          and not (ax <= self.mapped[body_fn])):
+                        self.mapped[body_fn] = self.mapped[body_fn] | ax
                         changed = True
 
     def _binding_call_target(self, mod, site, name):

@@ -143,7 +143,14 @@ def make_train_steps(net, k, donate=True, jit=True, with_health=False,
     ``make_train_step(jit=False)``): ParallelTrainer injects its ZeRO
     step here, so the sharded optimizer state and the explicit
     reduce-scatter/all-gather grad→update boundary are carried through
-    all K scanned steps, not just the K=1 path.
+    all K scanned steps, not just the K=1 path. The fsdp_stream tier
+    rides the same seam: its injected step holds an INNER ``lax.scan``
+    over the stacked trunk (per-block gather-use-discard), so a K-step
+    dispatch is a scan-of-scans whose carry — params, opt state, RNG
+    chain — stays in the streamed ``P('data')`` storage layout for all
+    K steps; the full param tree never materializes across the whole
+    dispatch, not just within one step (parity pinned K=4 == K=1
+    replicated in tests/test_zero.py).
     """
     if base_step is not None and with_health:
         # the injected step's contract is the PLAIN 4-tuple; the scan

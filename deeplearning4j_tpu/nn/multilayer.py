@@ -83,51 +83,62 @@ class MultiLayerNetwork:
         new_state = list(state)
         cur_type = self.conf.input_type
         n = len(self.conf.layers) if layer_limit is None else layer_limit
-        frozen = set(getattr(self, "frozen_layers", ()))
         for i in range(n):
-            layer = self.conf.layers[i]
-            # FrozenLayer.java:23 contract: a frozen layer "behaves as the
-            # layer within it would during TEST regardless of the
-            # training/test mode" — frozen BN normalizes with its running
-            # statistics and does NOT update them; frozen dropout is off
-            l_train = train and i not in frozen
-            fam = layer.input_family
-            if fam is not None and not isinstance(cur_type, fam):
-                x = _inputs.adapt(x, cur_type, fam)
-                cur_type = _inputs.adapted_type(cur_type, fam)
-            if l_train and layer.dropout > 0.0 and rng is not None:
-                rng, sub = jax.random.split(rng)
-                from deeplearning4j_tpu.nn.layers.base import dropout_mask
-                x = dropout_mask(sub, x, layer.dropout)
-            kwargs = {}
-            if self._mask_aware[i] and mask is not None \
-                    and mask.ndim >= 2:
-                # a 1-d mask is an example-validity mask (shape
-                # bucketing): it has no timestep info to forward into
-                # mask-aware layers, which require [batch, time]
-                kwargs["mask"] = mask
-            if rng is not None:
-                rng, sub = jax.random.split(rng)
-            else:
-                sub = None
-            layer_params = params[i]
-            wn = getattr(layer, "weight_noise", None)
-            if l_train and wn is not None and sub is not None \
-                    and layer_params:
-                sub, noise_rng = jax.random.split(sub)
-                layer_params = wn.perturb(noise_rng, layer, layer_params)
-
-            def run(p, s, xx, r, _layer=layer, _kwargs=kwargs,
-                    _train=l_train):
-                return _layer.apply(p, s, xx, train=_train, rng=r, **_kwargs)
-
-            if self.conf.gradient_checkpointing:
-                # remat: drop this layer's activations after the forward and
-                # recompute them during backprop — HBM for FLOPs
-                run = jax.checkpoint(run)
-            x, new_state[i] = run(layer_params, state[i], x, sub)
-            cur_type = layer.output_type(cur_type)
+            x, new_state[i], rng, cur_type = self._apply_layer(
+                i, params[i], state[i], x, cur_type, train=train, rng=rng,
+                mask=mask)
         return x, new_state
+
+    def _apply_layer(self, i, layer_params, state_i, x, cur_type, *, train,
+                     rng, mask):
+        """ONE layer of the forward loop — the definition ``apply_fn``
+        iterates and the ZeRO-3 streamed-gather scan body reuses
+        (parallel/data_parallel._streamed_loss runs it inside a
+        ``lax.scan`` over the stacked trunk slab, so the adapt / input
+        dropout / rng-split / weight-noise / remat order here IS the
+        bit-exactness contract between the two paths). Returns
+        ``(y, new_state_i, rng, next_type)``."""
+        layer = self.conf.layers[i]
+        # FrozenLayer.java:23 contract: a frozen layer "behaves as the
+        # layer within it would during TEST regardless of the
+        # training/test mode" — frozen BN normalizes with its running
+        # statistics and does NOT update them; frozen dropout is off
+        l_train = train and i not in set(getattr(self, "frozen_layers", ()))
+        fam = layer.input_family
+        if fam is not None and not isinstance(cur_type, fam):
+            x = _inputs.adapt(x, cur_type, fam)
+            cur_type = _inputs.adapted_type(cur_type, fam)
+        if l_train and layer.dropout > 0.0 and rng is not None:  # graftlint: disable=R2 -- layer is conf metadata picked by a Python int index, never a tracer
+            rng, sub = jax.random.split(rng)
+            from deeplearning4j_tpu.nn.layers.base import dropout_mask
+            x = dropout_mask(sub, x, layer.dropout)
+        kwargs = {}
+        if self._mask_aware[i] and mask is not None \
+                and mask.ndim >= 2:
+            # a 1-d mask is an example-validity mask (shape
+            # bucketing): it has no timestep info to forward into
+            # mask-aware layers, which require [batch, time]
+            kwargs["mask"] = mask
+        if rng is not None:
+            rng, sub = jax.random.split(rng)
+        else:
+            sub = None
+        wn = getattr(layer, "weight_noise", None)
+        if l_train and wn is not None and sub is not None \
+                and layer_params:
+            sub, noise_rng = jax.random.split(sub)
+            layer_params = wn.perturb(noise_rng, layer, layer_params)
+
+        def run(p, s, xx, r, _layer=layer, _kwargs=kwargs,
+                _train=l_train):
+            return _layer.apply(p, s, xx, train=_train, rng=r, **_kwargs)
+
+        if self.conf.gradient_checkpointing:
+            # remat: drop this layer's activations after the forward and
+            # recompute them during backprop — HBM for FLOPs
+            run = jax.checkpoint(run)
+        y, new_state_i = run(layer_params, state_i, x, sub)
+        return y, new_state_i, rng, layer.output_type(cur_type)
 
     def loss_fn(self, params, state, x, y, *, train=True, rng=None, mask=None,
                 label_mask=None):

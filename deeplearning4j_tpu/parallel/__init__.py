@@ -3,3 +3,4 @@ from deeplearning4j_tpu.parallel.data_parallel import ParallelTrainer  # noqa: F
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
 from deeplearning4j_tpu.parallel.pipeline import PipelineParallelLM  # noqa: F401
 from deeplearning4j_tpu.parallel.composed import ComposedParallelLM  # noqa: F401
+from deeplearning4j_tpu.parallel.composed import ComposedTrainer  # noqa: F401

@@ -53,7 +53,8 @@ from deeplearning4j_tpu.nn import layers as L
 from deeplearning4j_tpu.nn import updaters as U
 from deeplearning4j_tpu.nn.conf import inputs as I
 from deeplearning4j_tpu.parallel.pipeline import (gpipe_schedule,
-                                                  lm_1f1b_loss_and_grads)
+                                                  lm_1f1b_loss_and_grads,
+                                                  stack_blocks)
 
 
 def _ln(x, g, b, eps=1e-5):
@@ -212,6 +213,7 @@ class ComposedParallelLM:
         self.params = None
         self.opt_state = None
         self._step_fn = None
+        self._step_fn_masked = None
         self.iteration = 0
 
     # -- init ------------------------------------------------------------
@@ -262,7 +264,7 @@ class ComposedParallelLM:
         ke, kh, *kb = jax.random.split(key, 2 + self.n_layers)
         embed_p = self.embed.init(ke, I.RecurrentType(1, self.seq_len))
         blocks = [self._init_one_block(k) for k in kb]
-        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+        stacked = stack_blocks(blocks)
         head_p = {
             "W": jax.random.normal(kh, (self.d_model, self.vocab_size),
                                    jnp.float32) / np.sqrt(self.d_model),
@@ -301,7 +303,7 @@ class ComposedParallelLM:
                                         repl)
 
     # -- training --------------------------------------------------------
-    def _loss_fn(self, params, ids, labels):
+    def _loss_fn(self, params, ids, labels, mask=None):
         emb, _ = self.embed.apply(params["embed"], {}, ids)
         b, t, d = emb.shape
         mb = b // self.n_micro
@@ -326,8 +328,17 @@ class ComposedParallelLM:
         logits = h @ params["head"]["W"] + params["head"]["b"]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
-                                   axis=-1)
-        return jnp.mean(nll)
+                                   axis=-1)[..., 0]
+        if mask is None:
+            return jnp.mean(nll)
+        # validity-masked token mean (the bucketing contract of
+        # datasets.iterator.pad_batch: padded rows carry mask 0, so a
+        # padded batch scores exactly the unpadded one). The head runs
+        # OUTSIDE the pipelined region, so the mask never has to ride
+        # the schedule — it folds in here and only here.
+        m = mask if mask.ndim == 2 else mask[:, None]
+        m = jnp.broadcast_to(m, nll.shape).astype(nll.dtype)
+        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
 
     def _build_step_1f1b(self):
         """1F1B for the composed facade: the explicit-VJP schedule replaces
@@ -360,37 +371,58 @@ class ComposedParallelLM:
                            NamedSharding(self.mesh, P())),
             donate_argnums=(0, 1))
 
-    def _build_step(self):
+    def _build_step(self, masked=False):
         if self.schedule == "1f1b":
+            if masked:
+                raise ValueError(
+                    "masked (bucketed/padded) batches need the gpipe "
+                    "schedule: the 1f1b head loss runs inside the "
+                    "pipelined region and does not take a validity mask")
             return self._build_step_1f1b()
         upd = self.updater
 
-        def step(params, opt_state, ids, labels, it):
-            loss, grads = jax.value_and_grad(self._loss_fn)(params, ids,
-                                                            labels)
+        def step(params, opt_state, ids, labels, it, mask=None):
+            loss, grads = jax.value_and_grad(self._loss_fn)(
+                params, ids, labels, mask)
             updates, opt_state = upd.update(grads, opt_state, params, it)
             params = jax.tree_util.tree_map(jnp.add, params, updates)
             return params, opt_state, loss
 
         data_sh = NamedSharding(self.mesh, P("data"))
         opt_sh = self._opt_shardings(self.opt_state)
+        in_sh = (self.param_shardings, opt_sh, data_sh, data_sh, None)
+        if masked:
+            # the mask shards over 'data' WITH its batch (the
+            # ParallelTrainer mask-input rule)
+            in_sh = in_sh + (data_sh,)
         return jax.jit(
             step,
-            in_shardings=(self.param_shardings, opt_sh, data_sh, data_sh,
-                          None),
+            in_shardings=in_sh,
             out_shardings=(self.param_shardings, opt_sh,
                            NamedSharding(self.mesh, P())),
             donate_argnums=(0, 1))
 
-    def step(self, ids, labels):
+    def step(self, ids, labels, mask=None):
+        """One update. ``mask`` (example [B] or token [B, T] validity,
+        1=real / 0=bucketing padding) selects the masked engine — one
+        compiled signature per (masked?) variant, so a bucketed stream
+        that always carries a mask never recompiles."""
         if self.params is None:
             self.init()
-        if self._step_fn is None:
-            self._step_fn = self._build_step()
         ids = _mesh.ensure_data_sharded(self.mesh, ids)
         labels = _mesh.ensure_data_sharded(self.mesh, labels)
-        self.params, self.opt_state, loss = self._step_fn(
-            self.params, self.opt_state, ids, labels, self.iteration)
+        if mask is None:
+            if self._step_fn is None:
+                self._step_fn = self._build_step()
+            self.params, self.opt_state, loss = self._step_fn(
+                self.params, self.opt_state, ids, labels, self.iteration)
+        else:
+            if getattr(self, "_step_fn_masked", None) is None:
+                self._step_fn_masked = self._build_step(masked=True)
+            mask = _mesh.ensure_data_sharded(self.mesh, mask)
+            self.params, self.opt_state, loss = self._step_fn_masked(
+                self.params, self.opt_state, ids, labels, self.iteration,
+                mask)
         self.iteration += 1
         return loss
 
@@ -422,3 +454,87 @@ class ComposedParallelLM:
         nll = -jnp.take_along_axis(
             logp, jnp.asarray(labels)[..., None].astype(jnp.int32), axis=-1)
         return jnp.mean(nll)
+
+
+class ComposedTrainer:
+    """fit()-style training facade for the DP×TP×PP(×SP) composed path:
+    one ``MeshSpec`` (``data`` × ``model`` × ``stage`` on ONE Mesh), with
+    microbatches riding the existing bucketing machinery —
+    ``datasets.iterator.iter_batches(pad_to=...)`` buckets every batch to
+    one jit signature, zero-pads ragged tails, and the validity mask
+    folds into the masked token loss (exact: a padded batch scores and
+    steps identically to the unpadded one), so a ragged stream trains
+    over the composed mesh with ZERO recompiles.
+
+    The model is a :class:`ComposedParallelLM` (gpipe schedule — the mask
+    folds in at the head, outside the pipelined region). Parity: the
+    composed path matches a DP-only reference ≤1e-6 on a 2×2×2 mesh
+    (tests/test_composed.py; gated in the stage-6 ``bench.py zero``
+    record by scripts/check_zero.py).
+    """
+
+    def __init__(self, lm: ComposedParallelLM):
+        if lm.schedule != "gpipe":
+            raise ValueError(
+                "ComposedTrainer buckets+masks ragged batches, which "
+                "needs the gpipe schedule (the 1f1b head loss cannot "
+                "take a mask)")
+        self.lm = lm
+        self.mesh = lm.mesh
+        self.score_value = None
+
+    @property
+    def iteration(self):
+        return self.lm.iteration
+
+    @property
+    def params(self):
+        return self.lm.params
+
+    @property
+    def opt_state(self):
+        return self.lm.opt_state
+
+    def step(self, ids, labels, mask=None):
+        loss = self.lm.step(ids, labels, mask)
+        self.score_value = loss  # device scalar; float() on demand
+        return loss
+
+    def fit(self, x, y=None, *, epochs=1, batch_size=None):
+        """Train on arrays, an (x, y) pair, or any DataSetIterator. Every
+        batch is bucketed to ``batch_size`` (default: the first batch's
+        size) — which must divide by ``n_microbatches`` × the data-axis
+        size — and ragged tails pad with masked rows instead of being
+        dropped or recompiling."""
+        from deeplearning4j_tpu.datasets.iterator import iter_batches
+
+        if self.lm.params is None:
+            self.lm.init()
+        dp = self.mesh.shape["data"]
+        chunk = self.lm.n_micro * dp
+        feats = x[0] if (y is None and isinstance(x, (tuple, list))) else x
+        bucket = batch_size if batch_size is not None else (
+            feats.shape[0] if hasattr(feats, "shape") else None)
+        loss = None
+        for epoch in range(epochs):
+            steps = 0
+            for bx, by, bm in iter_batches(x, y, batch_size,
+                                           pad_to=bucket or True):
+                # the ONE divisibility check — it must sit in the loop
+                # anyway (iterator inputs fix the bucket at the first
+                # batch's size, invisible before iteration), and it
+                # fires on the first batch BEFORE anything compiles,
+                # not as a raw reshape/sharding error inside the
+                # schedule
+                if bx.shape[0] % chunk:
+                    raise ValueError(
+                        f"bucketed batch size {bx.shape[0]} not "
+                        f"divisible by n_microbatches*data = "
+                        f"{self.lm.n_micro}*{dp} = {chunk}")
+                loss = self.step(bx, by, bm)
+                steps += 1
+            if steps == 0:
+                raise ValueError(
+                    "no trainable batches: empty input (or a "
+                    "non-resettable iterator on a later epoch)")
+        return loss

@@ -31,12 +31,27 @@ all-reduce + dynamic-slice), and params all-gather back out.
 of P), ZeRO-1 cuts steady-state per-replica bytes from 3P to P + 2P/N and
 FSDP to ~3P/N — capacity that buys bigger per-chip batches (the
 measured-MFU item on the ROADMAP; the realized numbers are the
-``param_bytes``/``opt_state_bytes`` gauges on ``/health``). Honest scope:
-the gather is one constraint over the whole tree at step entry — XLA
-schedules the all-gathers, but nothing forces a layer-by-layer
+``param_bytes``/``opt_state_bytes`` gauges on ``/health``). Honest scope
+of plain fsdp: the gather is one constraint over the whole tree at step
+entry — XLA schedules the all-gathers, but nothing forces a layer-by-layer
 gather-use-discard, so the WITHIN-step peak still holds the full params
-alongside activations (full ZeRO-3 streaming is future work); what FSDP
-frees is everything those trees pinned BETWEEN steps.
+alongside activations; what it frees is everything those trees pinned
+BETWEEN steps.
+
+``shard_params="fsdp_stream"`` closes that remaining ZeRO-3 half (Rajbhandari
+et al. 2019, arxiv 1910.02054 §5.3): the network's homogeneous trunk — a run
+of identical layers, the same stacked-slab pytree discipline
+parallel/pipeline.py scans — is stacked ``[L, ...]`` INSIDE the step and
+scanned block by block, each block's params all-gathered from their
+``P('data')`` shards inside the scan body, used, and discarded; the body is
+``jax.checkpoint``'d so the backward sweep RE-gathers each block instead of
+stashing L gathered copies, and the gather constraint's transpose
+reduce-scatters each block's grads straight back into the shard — neither
+the full param tree nor the full grad tree ever materializes. Within-step
+peak = one block's weights + activations (``step_peak_bytes`` gauges /
+``compiled.memory_analysis()``, gated streamed < fsdp in
+scripts/check_zero.py), and the HLO shows ONE block-shaped all-gather
+inside the scan's while body instead of L hoisted to step entry.
 """
 
 from __future__ import annotations
@@ -45,6 +60,7 @@ import weakref
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel import mesh as _mesh
@@ -84,6 +100,64 @@ def _layer_param_items(net, params):
         return [(layer_of(name), name, params[name]) for name in params]
     return [(layer, i, p) for i, (layer, p)
             in enumerate(zip(net.conf.layers, params))]
+
+
+def _chunked_device_get(tree):
+    """Host copy of a device pytree ONE LEAF at a time: ``tree_map``
+    visits leaves sequentially and ``jax.device_get`` on a single array
+    blocks until it is assembled, so at most one layer's gathered copy
+    is in flight. The contract this helper pins (don't "simplify" it to
+    ``jax.device_get(tree)``): the whole-tree form launches every
+    leaf's shard fetch concurrently, which for an FSDP-sharded model
+    briefly stages the entire gathered tree in transfer buffers —
+    exactly the fit-end spike the sharded layout exists to avoid. Works
+    for any registered pytree, container types preserved."""
+    return jax.tree_util.tree_map(lambda a: jax.device_get(a), tree)
+
+
+def streamable_trunk(net, params, state):
+    """``(i0, i1)`` bounds of the longest homogeneous trunk the streamed
+    ZeRO-3 step can scan — a run of >= 2 identical, stateless,
+    param-carrying layers (same frozen-dataclass config, same input type,
+    same param treedef/shapes/dtypes) that excludes the output layer —
+    or None. Identical layers applied to a stable input type are exactly
+    a ``lax.scan`` over their stacked param slab; statelessness keeps the
+    scan carry to (activation, rng) so the bit-exactness contract with
+    the unrolled ``apply_fn`` loop is just the rng-split order."""
+    layers = getattr(getattr(net, "conf", None), "layers", None)
+    if layers is None or isinstance(params, dict) or params is None:
+        return None
+    n = len(layers)
+    frozen = set(getattr(net, "frozen_layers", ()))
+
+    def leaf_sig(p):
+        leaves, treedef = jax.tree_util.tree_flatten(p)
+        return (treedef, tuple((tuple(np.shape(l)),
+                                str(getattr(l, "dtype", type(l).__name__)))
+                               for l in leaves))
+
+    def eligible(i):
+        return (i < n - 1 and i not in frozen and bool(params[i])
+                and not jax.tree_util.tree_leaves(state[i]))
+
+    def same(i, j):
+        return (type(layers[i]) is type(layers[j])
+                and layers[i] == layers[j]          # frozen dataclasses
+                and net.layer_inputs[i] == net.layer_inputs[j]
+                and leaf_sig(params[i]) == leaf_sig(params[j]))
+
+    best, i = None, 0
+    while i < n:
+        if not eligible(i):
+            i += 1
+            continue
+        j = i + 1
+        while j < n and eligible(j) and same(i, j):
+            j += 1
+        if j - i >= 2 and (best is None or (j - i) > (best[1] - best[0])):
+            best = (i, j)
+        i = j
+    return best
 
 
 def make_param_shardings(mesh: Mesh, net, params, tensor_parallel=False):
@@ -132,11 +206,15 @@ class ParallelTrainer:
         self.mesh = mesh if mesh is not None else _mesh.make_mesh()
         self.tensor_parallel = tensor_parallel
         self.donate = donate
-        if shard_params not in (None, "fsdp"):
+        if shard_params not in (None, "fsdp", "fsdp_stream"):
             raise ValueError(
                 f"shard_params={shard_params!r}: None (replicated between "
-                "steps) or 'fsdp' (ZeRO-3: params stored P('data') between "
-                "steps, all-gathered inside the step)")
+                "steps), 'fsdp' (ZeRO-3 storage: params stored P('data') "
+                "between steps, whole-tree gather at step entry) or "
+                "'fsdp_stream' (ZeRO-3 streamed: the homogeneous trunk is "
+                "scanned block-by-block, each block gathered inside the "
+                "scan body and discarded — step-peak HBM is one block, "
+                "not the model)")
         # ZeRO-1 / cross-replica weight-update sharding (Xu et al. 2020,
         # arxiv 2004.13336 — the paper behind GSPMD's optimizer sharding)
         # is the DEFAULT: optimizer-state leaves split over the 'data' axis
@@ -152,7 +230,7 @@ class ParallelTrainer:
         # this one tier deeper (ZeRO-3): params themselves are STORED in
         # the zero1 layout between steps and gathered per step.
         self.shard_optimizer_state = bool(shard_optimizer_state) \
-            or shard_params == "fsdp"
+            or shard_params in ("fsdp", "fsdp_stream")
         self.shard_params = shard_params
         self._step_fn = None
         self._score_fn = None
@@ -212,7 +290,8 @@ class ParallelTrainer:
             self.param_shardings, params)
             if self.shard_optimizer_state else None)
         self.param_store_shardings = (zero1_tree
-                                      if self.shard_params == "fsdp"
+                                      if self.shard_params
+                                      in ("fsdp", "fsdp_stream")
                                       else self.param_shardings)
         self._opt_leaf_shards = (zero1_tree if self.shard_optimizer_state
                                  else self.param_shardings)
@@ -225,7 +304,7 @@ class ParallelTrainer:
         # bytes. FSDP still needs the constrained step (the PARAMS are
         # sharded); plain ZeRO-1 falls back to the unconstrained path.
         self._zero_step_active = (
-            self.shard_params == "fsdp"
+            self.shard_params in ("fsdp", "fsdp_stream")
             or (self.shard_optimizer_state
                 and any(hasattr(l, "shape")
                         for l in jax.tree_util.tree_leaves(opt))))
@@ -235,6 +314,20 @@ class ParallelTrainer:
         definition shared by init() and adopt_net_state(), so a
         fresh-init and a checkpoint-resumed trainer can never place (or
         account) their trees differently."""
+        if self.shard_params == "fsdp_stream":
+            # the streamed step needs the stacked-slab trunk; detect it on
+            # the HOST template so an unstreamable net fails loudly at
+            # placement, not as an opaque trace error inside the scan
+            self._trunk = streamable_trunk(self.net, params, state)
+            if (self._trunk is None
+                    or hasattr(self.net.conf.layers[-1],
+                               "loss_from_features")):
+                raise ValueError(
+                    "shard_params='fsdp_stream' needs a homogeneous trunk "
+                    "to scan: >= 2 consecutive identical stateless layers "
+                    "(same config, same param shapes) below a standard "
+                    "loss head. This net has none — use "
+                    "shard_params='fsdp' (whole-tree gather) instead")
         self._derive_shardings(params, opt)
         self.params = jax.tree_util.tree_map(jax.device_put, params,
                                              self.param_store_shardings)
@@ -277,6 +370,145 @@ class ParallelTrainer:
         self.epoch = int(getattr(net, "epoch", 0))
         return self
 
+    @property
+    def layout(self):
+        """The storage-layout name ('replicated' | 'zero1' | 'fsdp' |
+        'fsdp_stream') — the label on the HBM/step-peak gauges and the
+        bench.py zero leg keys."""
+        if self.shard_params:
+            return self.shard_params
+        return "zero1" if self.shard_optimizer_state else "replicated"
+
+    def _streamed_loss(self):
+        """Mirror of ``MultiLayerNetwork.loss_fn`` with the homogeneous
+        trunk scanned instead of unrolled: the per-layer forward is the
+        net's own ``_apply_layer`` (one definition — the rng-split /
+        dropout / adapt order cannot drift), but the trunk's stacked slab
+        rides a ``lax.scan`` whose checkpointed body gathers ONE block
+        from its ``P('data')`` shards, applies it, and discards it — the
+        ZeRO-3 streamed gather. Regularization penalties accumulate as a
+        per-block scan output and are re-added in original layer order,
+        so the addition order (and hence the bits) match the unrolled
+        loss exactly."""
+        net, mesh = self.net, self.mesh
+        i0, i1 = self._trunk
+        layers = net.conf.layers
+        n = len(layers)
+        trunk_layer = layers[i0]
+        gather_sh = self.param_shardings
+        block_gather = gather_sh[i0]
+        slab_store = jax.tree_util.tree_map(
+            lambda s: _mesh.slab_sharding(mesh, s),
+            self.param_store_shardings[i0])
+        wsc = jax.lax.with_sharding_constraint
+
+        from deeplearning4j_tpu.nn.conf import inputs as _inputs
+        from deeplearning4j_tpu.nn.layers import base as _lbase
+        from deeplearning4j_tpu.parallel.pipeline import stack_blocks
+
+        def loss_fn(params, state, x, y, rng, mask):
+            out_layer = layers[-1]
+            if not hasattr(out_layer, "compute_loss"):
+                raise ValueError(
+                    "Last layer must be an output/loss layer, got "
+                    f"{type(out_layer).__name__}")
+            new_state = list(state)
+
+            def edge(i, h, rng, cur_type):
+                # non-trunk layers gather individually just-in-time (XLA
+                # may still hoist these few; the trunk is the bulk)
+                full = (jax.tree_util.tree_map(wsc, params[i],
+                                               gather_sh[i])
+                        if params[i] else params[i])
+                h, new_state[i], rng, cur_type = net._apply_layer(
+                    i, full, state[i], h, cur_type, train=True, rng=rng,
+                    mask=mask)
+                return h, rng, cur_type
+
+            h, cur_type = x, net.conf.input_type
+            for i in range(i0):
+                h, rng, cur_type = edge(i, h, rng, cur_type)
+            # the trunk's one-time input adaptation: apply_fn adapts at
+            # the FIRST block and the type is stable after it, so inside
+            # the scan body _apply_layer must see the adapted type
+            fam = trunk_layer.input_family
+            if fam is not None and not isinstance(cur_type, fam):
+                h = _inputs.adapt(h, cur_type, fam)
+                cur_type = _inputs.adapted_type(cur_type, fam)
+            slab = stack_blocks(params[i0:i1])
+            slab = jax.tree_util.tree_map(wsc, slab, slab_store)
+            st0, ct = state[i0], cur_type
+
+            def body(carry, bp):
+                h, rng = carry
+                # the per-block all-gather: constraining the slab SLICE
+                # to the compute layout inside the loop body is what XLA
+                # cannot hoist — one block lives gathered at a time, and
+                # the constraint's transpose reduce-scatters this block's
+                # grads straight back into the shard
+                bp_full = jax.tree_util.tree_map(wsc, bp, block_gather)
+                h, _, rng, _ = net._apply_layer(
+                    i0, bp_full, st0, h, ct, train=True, rng=rng,
+                    mask=mask)
+                pen = trunk_layer.regularization_penalty(bp_full)
+                # scan stacks the per-block penalties into an array; a
+                # python-float 0.0 (no l1/l2 configured) needs a dtype,
+                # a traced penalty keeps its own (x64-safe)
+                if isinstance(pen, float):
+                    pen = jnp.asarray(pen, jnp.float32)
+                return (h, rng), pen
+
+            # checkpoint: the backward sweep RE-gathers each block from
+            # its shards instead of stashing i1-i0 gathered copies — the
+            # residual per block is the sharded slice + the activation
+            body = jax.checkpoint(body)
+            (h, rng), pens = jax.lax.scan(body, (h, rng), slab)
+            cur_type = trunk_layer.output_type(ct)
+            for i in range(i1, n):
+                h, rng, cur_type = edge(i, h, rng, cur_type)
+            preds = h
+            loss = out_layer.compute_loss(preds, y, mask)
+            for i in range(n):
+                if i0 <= i < i1:
+                    loss = loss + pens[i - i0]
+                elif params[i]:
+                    full = jax.tree_util.tree_map(wsc, params[i],
+                                                  gather_sh[i])
+                    loss = loss + layers[i].regularization_penalty(full)
+            loss, new_state = _lbase.pop_aux_losses(loss, new_state)
+            return loss, (new_state, preds)
+
+        return loss_fn
+
+    def _streamed_update_step(self):
+        """``_sharded_update_step`` for the fsdp_stream tier: same
+        make_train_step signature and the same grad→update constraint
+        chain, but the loss is the streamed-trunk mirror, differentiated
+        w.r.t. the STORED (sharded) params — grads arrive through the
+        gather constraints' transposes already reduce-scattered, so the
+        full grad tree never materializes either."""
+        from deeplearning4j_tpu.nn import gradnorm as _gradnorm
+
+        net = self.net
+        store_sh = self.param_store_shardings
+        grad_sh = self._opt_leaf_shards
+        wsc = jax.lax.with_sharding_constraint
+        loss_fn = self._streamed_loss()
+
+        def step(params, state, opt_state, x, y, it, rng, mask=None):
+            (loss, (new_state, _)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, state, x, y, rng, mask)
+            grads = _gradnorm.normalize_grads(
+                net.conf.gradient_normalization, grads,
+                net.conf.gradient_normalization_threshold)
+            grads = jax.tree_util.tree_map(wsc, grads, grad_sh)
+            new_params, new_opt = net.apply_update(params, opt_state,
+                                                   grads, it)
+            new_params = jax.tree_util.tree_map(wsc, new_params, store_sh)
+            return new_params, new_state, new_opt, loss
+
+        return step
+
     def _sharded_update_step(self):
         """The net's single train step with the ZeRO grad→update boundary
         made explicit (make_train_step signature, shared by the K=1 jit
@@ -284,7 +516,11 @@ class ParallelTrainer:
         compute layout inside the step, gradients pin to the opt-shard
         layout — the constraint XLA lowers to a reduce-scatter feeding
         the sharded update — and the new params' storage constraint
-        all-gathers them back out."""
+        all-gathers them back out. The fsdp_stream tier swaps in the
+        streamed-trunk loss (``_streamed_update_step``) under the same
+        contract."""
+        if self.shard_params == "fsdp_stream":
+            return self._streamed_update_step()
         net = self.net
         gather_sh = self.param_shardings
         store_sh = self.param_store_shardings
@@ -564,17 +800,47 @@ class ParallelTrainer:
         xd, yd = self._score_cache
         return float(self._score_fn(self.params, self.state, xd, yd, mask))
 
+    def step_memory_analysis(self, x, y, mask=None):
+        """Compile the current step ahead-of-time for ``(x, y[, mask])``
+        and export its ``compiled.memory_analysis()`` ledger into the
+        ``step_peak_bytes`` gauges (labeled by this trainer's layout) —
+        the within-step peak the steady-state ``tree_shard_bytes`` gauges
+        cannot see, and the number the fsdp_stream tier exists to shrink.
+        Routed through the blessed ``compile_cache.aot_compile`` site (a
+        second, analysis-only compile — call it from benches/operators,
+        not per step). Returns the stats dict, or None when the backend
+        has no memory analysis."""
+        from deeplearning4j_tpu.utils import compile_cache as _cc
+
+        if self.params is None:
+            self.init()
+        if self._step_fn is None:
+            self._step_fn = self._build_step(self.donate)
+        x = _mesh.ensure_data_sharded(self.mesh, x)
+        y = _mesh.ensure_data_sharded(self.mesh, y)
+        if mask is not None:
+            mask = _mesh.ensure_data_sharded(self.mesh, mask)
+        ex, _src = _cc.aot_compile(
+            self._step_fn, self.params, self.state, self.opt_state, x, y,
+            self.iteration, self._rng, mask,
+            kind=f"trainer_step:{self.layout}")
+        return _devices.note_step_peak_bytes(
+            "parallel_trainer", ex, layout=self.layout)
+
     def sync_to_net(self):
         """Copy trained params back into the wrapped MultiLayerNetwork.
         ``device_get`` gathers whatever the storage layout is — FSDP
         shards included — so the result is always a full host copy the
         single-process checkpoint formats (save_model/save_bundle) can
-        write; ``adopt_net_state`` is the inverse."""
-        gather = lambda t: jax.tree_util.tree_map(
-            lambda a: jax.device_get(a), t)
-        self.net.params = gather(self.params)
-        self.net.state = gather(self.state)
-        self.net.opt_state = gather(self.opt_state)
+        write; ``adopt_net_state`` is the inverse. The gather goes
+        through ``_chunked_device_get`` — leaf-at-a-time, each transfer
+        complete before the next starts — so ending a large FSDP fit
+        stages at most one assembled array on the host, a contract the
+        named helper pins against a whole-tree ``jax.device_get``
+        (concurrent shard fetch of the entire model) creeping in."""
+        self.net.params = _chunked_device_get(self.params)
+        self.net.state = _chunked_device_get(self.state)
+        self.net.opt_state = _chunked_device_get(self.opt_state)
         self.net._rng = jax.device_get(self._rng)
         self.net.iteration = self.iteration
         self.net.epoch = self.epoch

@@ -108,6 +108,19 @@ def zero1_sharding(mesh: Mesh, sharding: NamedSharding, leaf, axis="data"):
     return sharding
 
 
+def slab_sharding(mesh: Mesh, sharding: NamedSharding) -> NamedSharding:
+    """Sharding for a ``[L, ...block]`` stacked slab built from one block
+    leaf's sharding: the block spec shifts one dim right and the leading
+    stack axis stays UNSHARDED — a ``lax.scan`` over the slab slices that
+    axis, so it must be whole on every device while the within-block dims
+    keep their 1/N layout (the ZeRO-3 streamed-gather step,
+    data_parallel._streamed_loss; the stacked-trunk discipline of
+    parallel/pipeline.py, where the leading axis shards over 'stage'
+    instead because there the BLOCKS are distributed, not scanned)."""
+    spec = tuple(sharding.spec) if sharding.spec else ()
+    return NamedSharding(mesh, P(None, *spec))
+
+
 def opt_shardings_like(opt_state, params, p_shards, replicated_sharding):
     """Sharding pytree for an updater-state tree: every entry structured
     like the params tree (Adam m/v, Nesterov momenta, ...) takes the

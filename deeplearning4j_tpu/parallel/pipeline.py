@@ -39,6 +39,19 @@ from deeplearning4j_tpu.nn import updaters as U
 from deeplearning4j_tpu.nn.conf import inputs as I
 
 
+def stack_blocks(blocks):
+    """Stack per-block param trees into ONE slab pytree with a leading
+    block axis — the stacked-slab discipline every scanned or pipelined
+    trunk rides: PipelineParallelLM / ComposedParallelLM shard the
+    leading axis ``P('stage')`` (each device owns a contiguous run of
+    blocks), while the ZeRO-3 streamed step
+    (data_parallel._streamed_loss) keeps it whole and scans it, sharding
+    the WITHIN-block dims ``P('data')`` instead (mesh.slab_sharding).
+    Same pytree, two orthogonal axes over it — which is exactly why the
+    two tiers compose on one mesh."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+
+
 def _stage_fn_of(block, remat=False):
     """Shared stage body: scan a device's stacked block slab over an
     activation. ``block`` is a layer object (``apply(params, {}, x)``) or a
@@ -372,7 +385,7 @@ class PipelineParallelLM:
         it = I.RecurrentType(self.d_model, self.seq_len)
         embed_p = self.embed.init(ke, I.RecurrentType(1, self.seq_len))
         blocks = [self.block.init(k, it) for k in kb]
-        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+        stacked = stack_blocks(blocks)
         head_p = {
             "W": jax.random.normal(kh, (self.d_model, self.vocab_size),
                                    jnp.float32) / np.sqrt(self.d_model),

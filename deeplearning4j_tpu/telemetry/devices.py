@@ -36,6 +36,7 @@ _lock = threading.Lock()
 _cache_sizes = {}        # (site, id(fn)) -> last observed jit cache size
 _mem_unsupported = False  # latched: this backend has no memory_stats()
 _train_bytes = {}        # site -> last note_train_tree_bytes snapshot
+_step_peak = {}          # site -> last note_step_peak_bytes snapshot
 
 
 def reset():
@@ -45,6 +46,7 @@ def reset():
     with _lock:
         _cache_sizes.clear()
         _train_bytes.clear()
+        _step_peak.clear()
         _mem_unsupported = False
 
 
@@ -196,12 +198,72 @@ def note_train_tree_bytes(params=None, opt_state=None, site="trainer"):
     return snap
 
 
-def train_memory_summary():
-    """{site: {param_bytes: {logical, per_device}, opt_state_bytes: ...}}
-    — the last note_train_tree_bytes snapshot per site, registry-
-    independent (for /health next to memory_summary)."""
+def step_peak_stats(compiled):
+    """The compiled executable's XLA memory ledger as a plain dict —
+    ``compiled.memory_analysis()`` (CompiledMemoryStats) read into
+    ``{temp_bytes, argument_bytes, output_bytes, alias_bytes,
+    peak_bytes}`` — or None when this backend/executable has no analysis
+    (deserialized warm-manifest executables on some jax releases).
+
+    ``temp`` is XLA's scratch allocation for the step — under the ZeRO
+    layouts this is where the gathered params live, so it is THE
+    within-step number the steady-state ``tree_shard_bytes`` gauges
+    cannot see (a whole-tree fsdp gather parks the full params here; the
+    streamed tier parks one block). ``peak`` approximates the step's
+    device footprint as arguments + outputs + temp − aliased (donated
+    buffers counted once)."""
+    try:
+        ma = compiled.memory_analysis()
+        out = {f"{k}_bytes": int(getattr(ma, f"{k}_size_in_bytes"))
+               for k in ("temp", "argument", "output", "alias")}
+    except Exception:
+        return None
+    out["peak_bytes"] = (out["temp_bytes"] + out["argument_bytes"]
+                         + out["output_bytes"] - out["alias_bytes"])
+    return out
+
+
+def note_step_peak_bytes(site, compiled, layout="default"):
+    """Export a step executable's memory ledger into
+    ``step_peak_bytes{site, layout, component}`` gauges plus the
+    registry-independent snapshot ``train_memory_summary`` folds in under
+    ``step_peak_bytes`` (and /health shows next to the steady-state
+    ledger). Called from ``compile_cache.aot_compile`` for every
+    AOT-compiled executable and from
+    ``ParallelTrainer.step_memory_analysis``. Returns the stats dict or
+    None (no analysis on this backend — nothing recorded)."""
+    stats = compiled if isinstance(compiled, dict) \
+        else step_peak_stats(compiled)
+    if stats is None:
+        return None
+    snap = dict(stats, layout=str(layout))
     with _lock:
-        return {k: dict(v) for k, v in _train_bytes.items()}
+        _step_peak[site] = snap
+    reg = _registry.get_registry()
+    if reg.enabled:
+        g = reg.gauge("step_peak_bytes",
+                      "XLA memory ledger of a compiled step executable "
+                      "(memory_analysis), labeled by site, storage "
+                      "layout and component (temp = scratch incl. "
+                      "gathered params; peak = argument + output + temp "
+                      "- alias) — the WITHIN-step HBM the steady-state "
+                      "param/opt gauges cannot see")
+        for comp in ("temp", "argument", "output", "alias", "peak"):
+            g.set(float(stats[f"{comp}_bytes"]), site=site,
+                  layout=str(layout), component=comp)
+    return stats
+
+
+def train_memory_summary():
+    """{site: {param_bytes: {logical, per_device}, opt_state_bytes: ...,
+    step_peak_bytes: {temp_bytes, ..., layout}}} — the last
+    note_train_tree_bytes / note_step_peak_bytes snapshots per site,
+    registry-independent (for /health next to memory_summary)."""
+    with _lock:
+        out = {k: dict(v) for k, v in _train_bytes.items()}
+        for site, snap in _step_peak.items():
+            out.setdefault(site, {})["step_peak_bytes"] = dict(snap)
+    return out
 
 
 def note_jit_cache(site, fn):

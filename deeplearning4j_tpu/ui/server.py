@@ -318,9 +318,13 @@ def _health_payload():
             "watchdog": watchdog,
             "recompiles": recompiles,
             "memory": _devices.memory_summary(),
-            # the HBM ledger of the training job's persistent trees:
-            # per_device vs logical bytes shows the realized 1/N of a
-            # ZeRO-1/FSDP layout (PROFILE.md "Reading the HBM ledger")
+            # the HBM ledger of the training job's persistent trees
+            # (per_device vs logical bytes = the realized 1/N of a
+            # ZeRO-1/FSDP layout) PLUS the per-site step_peak_bytes
+            # ledger from compiled.memory_analysis() — the WITHIN-step
+            # number the steady-state gauges cannot see, which the
+            # fsdp_stream tier exists to shrink (PROFILE.md "Reading the
+            # HBM ledger" §4)
             "train_memory": _devices.train_memory_summary(),
             # the cold-start tax, realized: persistent-cache dir, warm-
             # manifest hit/miss counts, time-to-first-step/request gauges

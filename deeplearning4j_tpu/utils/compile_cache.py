@@ -498,6 +498,7 @@ def aot_compile(jitted, *args, manifest=None, kind="jit", signature=None,
     if manifest is not None:
         ex = manifest.load_executable(kind, sig)
         if ex is not None:
+            _note_step_peak(kind, ex)
             return ex, "manifest"
     with warnings.catch_warnings():
         # donated buffers rarely match an output shape; the warning is
@@ -507,7 +508,22 @@ def aot_compile(jitted, *args, manifest=None, kind="jit", signature=None,
         ex = jitted.lower(*args).compile()
     if manifest is not None and serialize_back:
         manifest.put(kind, sig, ex)
+    _note_step_peak(kind, ex)
     return ex, "compile"
+
+
+def _note_step_peak(kind, ex):
+    """Every executable through the blessed compile site exports its XLA
+    memory ledger into the ``step_peak_bytes`` gauges (site ``aot:<kind>``)
+    — step-peak observability rides the compile path for free. Best
+    effort: deserialized executables without memory_analysis record
+    nothing, and telemetry failures never fail a compile."""
+    try:
+        from deeplearning4j_tpu.telemetry import devices as _devices
+        base = str(kind).split(":", 1)[0]
+        _devices.note_step_peak_bytes(f"aot:{base}", ex, layout=kind)
+    except Exception:
+        pass
 
 
 def attach_if_matches(net, manifest, context):

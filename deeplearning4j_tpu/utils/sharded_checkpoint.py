@@ -56,7 +56,9 @@ def restore_sharded(path, like):
 def _trainer_tree(trainer):
     """Everything a resume needs: params, optimizer state, MUTABLE layer
     state (BatchNorm running stats), the step RNG (so dropout keys continue
-    from step N+1, not replay from step 1), and the iteration counter."""
+    from step N+1, not replay from step 1), and the iteration + epoch
+    counters (epoch rode only the single-process zip before — a resumed
+    multi-epoch fit restarted its epoch listeners from 0)."""
     tree = {"params": trainer.params, "opt_state": trainer.opt_state,
             "iteration": jax.numpy.asarray(trainer.iteration)}
     state = getattr(trainer, "state", None)
@@ -65,6 +67,9 @@ def _trainer_tree(trainer):
     rng = getattr(trainer, "_rng", None)
     if rng is not None:
         tree["rng"] = rng
+    epoch = getattr(trainer, "epoch", None)
+    if epoch is not None:
+        tree["epoch"] = jax.numpy.asarray(int(epoch))
     return tree
 
 
@@ -109,13 +114,29 @@ def restore_trainer(path, trainer):
 
     The layout is the DESTINATION trainer's policy, never the file's:
     orbax restores each array into the template's sharding, so a
-    checkpoint written by a replicated trainer resumes into a ZeRO-1 or
-    FSDP one (and back) with the arrays landing directly in the new
-    layout — no gather-to-host hop (tests/test_zero.py pins the full
-    cross-layout matrix bit-exact)."""
+    checkpoint written by a replicated trainer resumes into a ZeRO-1,
+    FSDP or FSDP_STREAM one (and back) with the arrays landing directly
+    in the new layout — no gather-to-host hop (tests/test_zero.py pins
+    the full cross-layout matrix bit-exact; the streamed tier stores the
+    SAME per-leaf zero1 layout as fsdp, so the template is identical and
+    only the step differs)."""
     if trainer.params is None:
         trainer.init()
-    tree = restore_sharded(path, _trainer_tree(trainer))
+    template = _trainer_tree(trainer)
+    if "epoch" in template:
+        # pre-ISSUE-14 checkpoints have no epoch entry: probe the
+        # checkpoint's OWN key set (orbax metadata — no array reads)
+        # rather than retrying a failed restore without the key, which
+        # would silently drop the counter on any transient first-attempt
+        # error
+        try:
+            meta = _checkpointer().metadata(os.path.abspath(str(path)))
+            has_epoch = meta is None or "epoch" in meta
+        except Exception:
+            has_epoch = True   # unprobeable: keep the full template
+        if not has_epoch:
+            template.pop("epoch")
+    tree = restore_sharded(path, template)
     trainer.params = tree["params"]
     trainer.opt_state = tree["opt_state"]
     trainer.iteration = int(tree["iteration"])
@@ -123,6 +144,8 @@ def restore_trainer(path, trainer):
         trainer.state = tree["state"]
     if "rng" in tree:
         trainer._rng = tree["rng"]
+    if "epoch" in tree:
+        trainer.epoch = int(tree["epoch"])
     _restore_extras(path, trainer)
     # refresh the HBM ledger gauges: a resume is a new process whose
     # /health should show the restored layout's realized bytes

@@ -8,12 +8,22 @@ jitter ±15-30%, so steps/s is recorded in the A/B row but not gated):
     ideal 1/N saving vs the replicated leg (with the bench's divisible
     layer dims it is exactly 1/N; the slack covers future layer edits
     that add a non-divisible leaf without silently killing the gate);
-  * the FSDP leg must shard the params themselves the same way;
+  * the FSDP and FSDP_STREAM legs must shard the params themselves the
+    same way;
+  * the STREAMED leg's analyzed step-peak bytes
+    (`compiled.memory_analysis()`) must sit strictly below plain fsdp at
+    the same batch — per-block gather-use-discard inside the scan body
+    vs the whole-tree gather at entry; temp bytes (where the gathered
+    params live) must shrink too;
   * every leg compiles its step exactly once and recompiles ZERO times
     across epochs — the sharded layouts add no shape churn;
-  * zero1/fsdp params must match the replicated leg's (the layouts are
-    re-expressions of the same math, bit-exact on CPU — tests pin ==0,
-    the gate allows float-print slack).
+  * zero1/fsdp/fsdp_stream params must match the replicated leg's (the
+    layouts are re-expressions of the same math, bit-exact on CPU —
+    tests pin ==0, the gate allows float-print slack);
+  * the composed DP×TP×PP leg must match its DP-only reference ≤1e-6
+    (per-step losses AND end params), its ragged bucketed fit must be
+    bit-exact vs manually padded steps, and its masked engine must have
+    compiled once (bucketing = one signature, zero recompiles).
 
 Usage: check_zero.py BENCH_JSONL [min_ratio_frac]
 Exit 0 when the record passes, 1 with a reason otherwise.
@@ -44,7 +54,7 @@ def main():
         print("check_zero: no zero_sharded_update_ab record found")
         return 1
     legs = rec.get("legs") or {}
-    missing = {"replicated", "zero1", "fsdp"} - set(legs)
+    missing = {"replicated", "zero1", "fsdp", "fsdp_stream"} - set(legs)
     if missing:
         print(f"check_zero: legs missing from the record: {sorted(missing)}")
         return 1
@@ -63,14 +73,35 @@ def main():
                   f"{opt_ratio:.2f} < {want:.2f} (n_devices={n}) — the "
                   "sharded layout is not actually sharding")
             return 1
-        par_ratio = (legs["replicated"]["param_bytes_per_device"]
-                     / max(legs["fsdp"]["param_bytes_per_device"], 1))
-        if par_ratio < want:
-            print(f"check_zero: fsdp per-device param bytes ratio "
-                  f"{par_ratio:.2f} < {want:.2f} (n_devices={n})")
+        par_ratios = {}
+        for pm in ("fsdp", "fsdp_stream"):
+            par_ratios[pm] = (legs["replicated"]["param_bytes_per_device"]
+                              / max(legs[pm]["param_bytes_per_device"], 1))
+            if par_ratios[pm] < want:
+                print(f"check_zero: {pm} per-device param bytes ratio "
+                      f"{par_ratios[pm]:.2f} < {want:.2f} (n_devices={n})")
+                return 1
+        print(f"check_zero: opt bytes ratio {opt_ratio:.2f}, param bytes "
+              f"ratio fsdp {par_ratios['fsdp']:.2f} / fsdp_stream "
+              f"{par_ratios['fsdp_stream']:.2f} (ideal {n})")
+        # the streamed tier's whole claim: within-step peak strictly
+        # below the whole-tree-gather fsdp step at the same batch
+        peak_f = (legs["fsdp"].get("step_peak") or {})
+        peak_s = (legs["fsdp_stream"].get("step_peak") or {})
+        if not peak_f or not peak_s:
+            print("check_zero: step_peak missing on the fsdp/fsdp_stream "
+                  "legs — memory_analysis must be exported on this backend")
             return 1
-        print(f"check_zero: opt bytes ratio {opt_ratio:.2f}, fsdp param "
-              f"bytes ratio {par_ratio:.2f} (ideal {n})")
+        for comp in ("peak_bytes", "temp_bytes"):
+            if not peak_s[comp] < peak_f[comp]:
+                print(f"check_zero: fsdp_stream {comp} {peak_s[comp]} not "
+                      f"below fsdp {peak_f[comp]} — the per-block gather "
+                      "is not actually streaming")
+                return 1
+        print(f"check_zero: stream step-peak {peak_s['peak_bytes']} < "
+              f"fsdp {peak_f['peak_bytes']} "
+              f"(x{peak_f['peak_bytes'] / max(peak_s['peak_bytes'], 1):.2f}"
+              f"; temp {peak_s['temp_bytes']} < {peak_f['temp_bytes']})")
     for mode, leg in legs.items():
         # compiles ≤ 2: the warm-up fill (jax re-traces the step once on
         # its second call under a flipped trace context — pre-existing,
@@ -91,10 +122,34 @@ def main():
                   f"replicated leg by {diff} — the layouts must be "
                   "re-expressions of the same math")
             return 1
+    comp = rec.get("composed") or {}
+    if comp.get("skipped"):
+        # bench records the skip on sub-8-device live topologies; the
+        # tier-1 gate always pins 8 devices, so a skip HERE still fails
+        # — but as what it is, not as a phantom parity violation
+        print(f"check_zero: composed DP×TP×PP leg did not run "
+              f"({comp['skipped']}) — the gate needs the 8-device mesh")
+        return 1
+    for key, bound in (("max_loss_diff_vs_dp", 1e-6),
+                       ("max_param_diff_vs_dp", 1e-6),
+                       ("ragged_pad_param_diff", 0.0)):
+        v = comp.get(key)
+        if v is None or not (float(v) <= bound):
+            print(f"check_zero: composed DP×TP×PP leg {key}={v} exceeds "
+                  f"{bound} — the composed path must match the DP-only "
+                  "reference")
+            return 1
+    if comp.get("masked_compiles", 99) > 2:
+        print(f"check_zero: composed masked engine compiled "
+              f"{comp.get('masked_compiles')} times — bucketing must hold "
+              "one signature")
+        return 1
     print("check_zero: PASS "
           f"(zero1 {legs['zero1']['steps_per_sec']} steps/s vs replicated "
           f"{legs['replicated']['steps_per_sec']}, fsdp "
-          f"{legs['fsdp']['steps_per_sec']})")
+          f"{legs['fsdp']['steps_per_sec']}, fsdp_stream "
+          f"{legs['fsdp_stream']['steps_per_sec']}; composed parity "
+          f"{comp['max_param_diff_vs_dp']:.2e})")
     return 0
 
 

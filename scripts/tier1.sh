@@ -102,14 +102,18 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
   || { echo "tier1: cold-start smoke FAILED (warm restart recompiled,"
        echo "tier1: or a leg crashed)"; exit 1; }
 
-# Stage 6: ZeRO sharded-weight-update smoke (ISSUE 10) — the A/B row:
-# replicated vs zero1 vs fsdp layouts of the same data-parallel fit on an
-# 8-device CPU mesh (XLA_FLAGS pins the device count; the other stages
-# run single-device and don't want it). scripts/check_zero.py gates on
-# COUNTERS AND BYTES, never wall time: per-device opt_state (and fsdp
-# param) bytes must realize the 1/N sharding, each leg compiles once
-# with zero recompiles, and the sharded legs' params match the
-# replicated leg's. steps/s lands in the record, ungated.
+# Stage 6: ZeRO sharded-weight-update smoke (ISSUES 10+14) — the A/B row:
+# replicated vs zero1 vs fsdp vs fsdp_stream layouts of the same
+# data-parallel fit on an 8-device CPU mesh (XLA_FLAGS pins the device
+# count; the other stages run single-device and don't want it), plus the
+# DP×TP×PP composed-parity leg (2×2×2 ComposedTrainer vs the DP-only
+# reference). scripts/check_zero.py gates on COUNTERS AND BYTES, never
+# wall time: per-device opt_state (and fsdp/fsdp_stream param) bytes must
+# realize the 1/N sharding, the streamed leg's analyzed step-peak bytes
+# (memory_analysis) sit strictly below plain fsdp, each leg compiles once
+# with zero recompiles, the sharded legs' params match the replicated
+# leg's, and the composed leg matches its DP-only reference ≤1e-6 with a
+# bit-exact ragged bucketed fit. steps/s lands in the record, ungated.
 echo "== zero sharded-update smoke =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -212,3 +212,106 @@ class TestComposedZero1:
         restore_trainer(path, lm2)
         np.testing.assert_allclose(float(lm2.step(ids, labels)), a,
                                    rtol=1e-6)
+
+
+class TestComposedTrainer:
+    """ISSUE 14: the DP×TP×PP trainer facade — one MeshSpec, microbatches
+    riding the bucketing/pad_batch machinery, parity against the DP-only
+    reference (the stage-6 bench gate runs the same comparison)."""
+
+    def _cfg(self, **kw):
+        from deeplearning4j_tpu.nn import updaters as U
+        cfg = dict(vocab_size=32, n_layers=2, d_model=16, n_heads=2,
+                   seq_len=8, n_microbatches=2,
+                   updater=U.Sgd(learning_rate=0.1))
+        cfg.update(kw)
+        return cfg
+
+    def _make(self, mesh, **kw):
+        from deeplearning4j_tpu.parallel.composed import ComposedTrainer
+        return ComposedTrainer(
+            ComposedParallelLM(mesh=mesh, **self._cfg(**kw)).init())
+
+    def test_dp_tp_pp_matches_dp_only_reference(self, eight_devices):
+        """Acceptance: the composed path == the DP-only reference ≤1e-6
+        on a 2×2×2 mesh (params AND per-step losses; Sgd so the claim is
+        about the parallel composition, not Adam-eps conditioning)."""
+        mesh_c = make_mesh(MeshSpec(data=2, model=2, seq=1, stage=2),
+                           devices=eight_devices)
+        mesh_d = make_mesh(MeshSpec(data=8, model=1, seq=1, stage=1),
+                           devices=eight_devices)
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 32, (16, 8))
+        labels = np.roll(ids, -1, axis=1)
+        tr, ref = self._make(mesh_c), self._make(mesh_d)
+        for _ in range(3):
+            lc = float(tr.step(ids, labels))
+            ld = float(ref.step(ids, labels))
+            assert abs(lc - ld) <= 1e-6
+        diffs = jax.tree_util.tree_map(
+            lambda a, b: float(np.abs(np.asarray(a)
+                                      - np.asarray(b)).max()),
+            tr.params, ref.params)
+        assert max(jax.tree_util.tree_leaves(diffs)) <= 1e-6
+
+    def test_ragged_fit_rides_bucketing_bit_exact(self, eight_devices):
+        """A ragged stream through fit() (pad_batch bucketing + masked
+        loss) steps EXACTLY like manually padded batches — and the
+        masked engine holds one signature (no recompiles)."""
+        mesh = make_mesh(MeshSpec(data=2, model=2, seq=1, stage=2),
+                         devices=eight_devices)
+        rs = np.random.RandomState(1)
+        ids = rs.randint(0, 32, (12, 8))
+        labels = np.roll(ids, -1, axis=1)
+        t_fit, t_man = self._make(mesh), self._make(mesh)
+        t_fit.fit(ids, labels, batch_size=8)
+        t_man.step(ids[:8], labels[:8], np.ones(8, np.float32))
+        m = np.zeros(8, np.float32)
+        m[:4] = 1
+        xp = np.zeros((8, 8), ids.dtype)
+        xp[:4] = ids[8:]
+        yp = np.zeros((8, 8), labels.dtype)
+        yp[:4] = labels[8:]
+        t_man.step(xp, yp, m)
+        for a, b in zip(jax.tree_util.tree_leaves(t_fit.params),
+                        jax.tree_util.tree_leaves(t_man.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert t_fit.iteration == 2
+        assert t_fit.lm._step_fn_masked._cache_size() <= 2
+
+    def test_all_ones_mask_matches_unmasked(self, eight_devices):
+        """The masked token mean with a full-validity mask scores the
+        plain mean — padding is exact, not approximate."""
+        mesh = make_mesh(MeshSpec(data=2, model=2, seq=1, stage=2),
+                         devices=eight_devices)
+        rs = np.random.RandomState(2)
+        ids = rs.randint(0, 32, (8, 8))
+        labels = np.roll(ids, -1, axis=1)
+        t_mask, t_plain = self._make(mesh), self._make(mesh)
+        lm_ = float(t_mask.step(ids, labels, np.ones(8, np.float32)))
+        lp = float(t_plain.step(ids, labels))
+        np.testing.assert_allclose(lm_, lp, rtol=1e-6)
+
+    def test_bucket_divisibility_validated(self, eight_devices):
+        mesh = make_mesh(MeshSpec(data=2, model=2, seq=1, stage=2),
+                         devices=eight_devices)
+        tr = self._make(mesh)
+        rs = np.random.RandomState(3)
+        ids = rs.randint(0, 32, (10, 8))
+        with pytest.raises(ValueError, match="not divisible"):
+            tr.fit(ids, np.roll(ids, -1, axis=1), batch_size=6)
+        # iterator inputs fix the bucket at the FIRST batch's size the
+        # pre-loop check cannot see: still a ValueError, not a raw
+        # sharding error from inside the jit
+        labels = np.roll(ids, -1, axis=1)
+        batches = [(ids[:6], labels[:6]), (ids[6:], labels[6:])]
+        with pytest.raises(ValueError, match="not divisible"):
+            self._make(mesh).fit(iter(batches))
+
+    def test_1f1b_schedule_rejected_for_masked(self, eight_devices):
+        from deeplearning4j_tpu.parallel.composed import ComposedTrainer
+        mesh = make_mesh(MeshSpec(data=2, model=2, seq=1, stage=2),
+                         devices=eight_devices)
+        with pytest.raises(ValueError, match="gpipe"):
+            ComposedTrainer(ComposedParallelLM(
+                mesh=mesh, schedule="1f1b", **self._cfg()))

@@ -1062,6 +1062,61 @@ class TestR8ShardingDiscipline:
         assert "matches no" in fs[0].message
         assert "R8" not in rule_set(src.replace('"dat"', '"data"'))
 
+    # -- the streamed-gather / stage-axis idiom (ISSUE 14): a collective
+    # with a scan-carried block index runs in the context of the function
+    # that CALLS lax.scan, so the body must sit under a mapped context
+    # whose mesh binds the axis — precise axes now propagate through the
+    # jax higher-order combinators instead of the body escaping with
+    # unknown axes
+
+    SCAN_BODY = """
+        import jax
+        import numpy as np
+        from jax import lax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()),
+                    axis_names=("data", "stage"))
+
+        def _body(h, bp):
+            nxt = lax.ppermute(h, "stage", [(0, 1)])
+            return nxt, None
+
+        def run(slab, x):
+            h, _ = lax.scan(_body, x, slab)
+            return h
+    """
+
+    def test_scan_body_collective_unmapped_fires(self):
+        # the body is ONLY ever scanned from an unmapped function: the
+        # old escaped-with-unknown-axes bailout stayed silent here
+        fs = [f for f in rules_fired(self.SCAN_BODY) if f.rule == "R8"]
+        assert len(fs) == 1
+        assert "no shard_map/pmap" in fs[0].message
+
+    def test_scan_body_under_mapped_context_silent(self):
+        # same body, but the scanning function is shard_map'd over a
+        # mesh that binds 'stage' — the streamed-gather idiom, clean
+        src = self.SCAN_BODY + """
+        piped = shard_map(run, mesh=mesh, in_specs=(P("stage"), P()),
+                          out_specs=P())
+        """
+        assert "R8" not in rule_set(src)
+
+    def test_scan_body_axis_not_on_mesh_fires(self):
+        # mapped, but the mesh does NOT bind 'stage': the body's
+        # ppermute inherits the caller's precise axes and is flagged
+        src = self.SCAN_BODY.replace(
+            'axis_names=("data", "stage")', 'axis_names=("data",)')
+        src = src + """
+        piped = shard_map(run, mesh=mesh, in_specs=(P("data"), P()),
+                          out_specs=P())
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R8"]
+        assert len(fs) == 1
+        assert "not bound" in fs[0].message
+
     def test_named_sharding_axis_checked(self):
         src = """
             from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

@@ -41,6 +41,27 @@ def _trainer(mode, mesh, seed=6, **kw):
         shard_params="fsdp" if mode == "fsdp" else None, **kw).init()
 
 
+def _stream_net(seed=6, n_in=8, hidden=64, n_out=4, depth=4):
+    """A net WITH a homogeneous trunk: entry Dense(n_in->hidden) +
+    ``depth`` identical Dense(hidden->hidden) blocks + output head —
+    the stacked-slab shape the fsdp_stream tier scans."""
+    conf = NeuralNetConfig(seed=seed, updater=U.Adam(learning_rate=0.01)) \
+        .list(L.DenseLayer(n_out=hidden, activation="tanh"),
+              *[L.DenseLayer(n_out=hidden, activation="tanh")
+                for _ in range(depth)],
+              L.OutputLayer(n_out=n_out, loss="mcxent"),
+              input_type=I.FeedForwardType(n_in))
+    return MultiLayerNetwork(conf)
+
+
+def _stream_trainer(mode, mesh, seed=6, **kw):
+    return ParallelTrainer(
+        _stream_net(seed=seed), mesh,
+        shard_optimizer_state=(mode != "replicated"),
+        shard_params=(mode if mode in ("fsdp", "fsdp_stream") else None),
+        **kw).init()
+
+
 class TestZeroDefaults:
     """shard_optimizer_state defaults ON, layout derived FROM the param
     shardings (mesh.zero1_sharding — the composed.py discipline, now one
@@ -251,6 +272,221 @@ class TestFSDP:
         assert out.shape == (16, 4)
         # counters ride along so save_bundle(net) is a complete resume unit
         assert net.iteration == 1
+
+
+class TestStreamedFSDP:
+    """Tentpole (ISSUE 14): shard_params='fsdp_stream' — the homogeneous
+    trunk scanned block-by-block, each block all-gathered INSIDE the scan
+    body and discarded; step-peak = one block, not the model."""
+
+    def test_trunk_detection(self, eight_devices):
+        from deeplearning4j_tpu.parallel.data_parallel import \
+            streamable_trunk
+        net = _stream_net()
+        params, state = net.init()
+        assert streamable_trunk(net, params, state) == (1, 5)
+        # heterogeneous net: no >=2 run of identical layers
+        net2 = _net()
+        p2, s2 = net2.init()
+        assert streamable_trunk(net2, p2, s2) is None
+        # a frozen trunk layer splits the run
+        net3 = _stream_net()
+        p3, s3 = net3.init()
+        net3.frozen_layers = (3,)
+        trunk = streamable_trunk(net3, p3, s3)
+        assert trunk is not None and trunk[1] - trunk[0] == 2
+
+    def test_unstreamable_net_raises(self, eight_devices):
+        mesh = make_mesh(MeshSpec(data=8, model=1), devices=eight_devices)
+        with pytest.raises(ValueError, match="homogeneous trunk"):
+            ParallelTrainer(_net(), mesh,
+                            shard_params="fsdp_stream").init()
+
+    def test_streamed_bit_exact_vs_replicated(self, eight_devices):
+        mesh = make_mesh(MeshSpec(data=8, model=1), devices=eight_devices)
+        x, y = _data()
+        ts = {m: _stream_trainer(m, mesh)
+              for m in ("replicated", "fsdp", "fsdp_stream")}
+        for _ in range(5):
+            losses = {m: float(t.step(x, y)) for m, t in ts.items()}
+        assert losses["fsdp_stream"] == losses["replicated"]
+        w_ref = np.asarray(ts["replicated"].params[1]["W"])
+        np.testing.assert_array_equal(
+            np.asarray(ts["fsdp_stream"].params[1]["W"]), w_ref)
+        # stored layout: trunk weights sharded P('data') between steps
+        w = ts["fsdp_stream"].params[1]["W"]
+        assert w.sharding.spec[0] == "data"
+        assert w.addressable_shards[0].data.shape[0] * 8 == w.shape[0]
+
+    def test_streamed_dropout_and_l2_bit_exact(self, eight_devices):
+        """The hard mirrors: the scan body must consume rng splits in
+        exactly apply_fn's per-layer order (dropout + per-layer split)
+        and re-add per-block penalties in original layer order — both
+        bit-exact, or the streamed tier silently trains a different
+        model."""
+        def net():
+            conf = NeuralNetConfig(seed=6,
+                                   updater=U.Adam(learning_rate=0.01)) \
+                .list(L.DenseLayer(n_out=64, activation="tanh"),
+                      *[L.DenseLayer(n_out=64, activation="tanh", l2=0.01,
+                                     dropout=0.2) for _ in range(3)],
+                      L.OutputLayer(n_out=4, loss="mcxent"),
+                      input_type=I.FeedForwardType(8))
+            return MultiLayerNetwork(conf)
+
+        mesh = make_mesh(MeshSpec(data=8, model=1), devices=eight_devices)
+        x, y = _data()
+        tr_r = ParallelTrainer(net(), mesh,
+                               shard_optimizer_state=False).init()
+        tr_s = ParallelTrainer(net(), mesh,
+                               shard_params="fsdp_stream").init()
+        assert tr_s._trunk == (1, 4)
+        for _ in range(4):
+            lr = float(tr_r.step(x, y))
+            ls = float(tr_s.step(x, y))
+        assert lr == ls
+        np.testing.assert_array_equal(np.asarray(tr_s.params[1]["W"]),
+                                      np.asarray(tr_r.params[1]["W"]))
+
+    def test_streamed_fused_k4_bit_exact(self, eight_devices):
+        """The K-step scan carries the streamed layout: a K=4 dispatch is
+        a scan-of-scans whose carry stays in the P('data') storage for
+        all K steps, bit-exact vs the K=1 replicated loop."""
+        mesh = make_mesh(MeshSpec(data=8, model=1), devices=eight_devices)
+        x, y = _data(n=64)
+        ref = _stream_trainer("replicated", mesh)
+        ref.fit(x, y, batch_size=16, epochs=2)
+        w_ref = np.asarray(ref.params[1]["W"])
+        tr = _stream_trainer("fsdp_stream", mesh)
+        tr.fit(x, y, batch_size=16, epochs=2, steps_per_dispatch=4)
+        np.testing.assert_array_equal(np.asarray(tr.params[1]["W"]),
+                                      w_ref)
+        m = tr.opt_state["m"][1]["W"]
+        assert m.sharding.spec[0] == "data"
+        assert tr.iteration == ref.iteration
+
+    def test_streamed_hlo_gathers_per_block_inside_loop(self,
+                                                        eight_devices):
+        """Acceptance: the lowered HLO has the per-block all-gather
+        INSIDE the scan's while body — the gather count is independent
+        of trunk depth and no gather is slab-shaped — while plain fsdp
+        hoists one gather PER trunk layer to step entry."""
+        import re
+        mesh = make_mesh(MeshSpec(data=8, model=1), devices=eight_devices)
+        x, y = _data()
+
+        def hlo(tr):
+            tr.step(x, y)
+            return tr._step_fn.lower(
+                tr.params, tr.state, tr.opt_state, jnp.asarray(x),
+                jnp.asarray(y), 0, tr._rng, None).compile().as_text()
+
+        def ag_shapes(txt):
+            return [tuple(int(d) for d in m.split(",") if d)
+                    for m in re.findall(
+                        r"= \S+?\[([0-9,]*)\]\S* all-gather", txt)]
+
+        txt_s = hlo(_stream_trainer("fsdp_stream", mesh))
+        txt_f = hlo(_stream_trainer("fsdp", mesh))
+        trunk_w = [s for s in ag_shapes(txt_f) if s[-2:] == (64, 64)]
+        assert len(trunk_w) >= 4            # fsdp: one gather per block
+        stream_w = [s for s in ag_shapes(txt_s) if s[-2:] == (64, 64)]
+        # streamed: a fixed number of block-shaped gathers (forward
+        # in-loop + remat backward), NOT one per trunk layer...
+        assert 1 <= len(stream_w) < 4
+        # ...and never a whole-slab [4, 64, 64] gather hoisted to entry
+        assert (4, 64, 64) not in ag_shapes(txt_s)
+        # the scan lowered to a while loop (the gather lives in its body:
+        # XLA cannot hoist a shape that depends on the loop counter)
+        assert "while" in txt_s
+
+    def test_streamed_step_peak_below_fsdp(self, eight_devices):
+        """Acceptance: compiled.memory_analysis() step-peak for
+        fsdp_stream strictly below plain fsdp at the same batch, and the
+        ledger lands in train_memory_summary / the gauges."""
+        from deeplearning4j_tpu import telemetry
+        from deeplearning4j_tpu.telemetry import devices as _devices
+        telemetry.reset()
+        mesh = make_mesh(MeshSpec(data=8, model=1), devices=eight_devices)
+        x, y = _data()
+        stats = {}
+        for m in ("replicated", "fsdp", "fsdp_stream"):
+            tr = _stream_trainer(m, mesh)
+            stats[m] = tr.step_memory_analysis(x, y)
+        if stats["fsdp"] is None:
+            pytest.skip("backend has no memory_analysis")
+        assert stats["fsdp_stream"]["temp_bytes"] \
+            < stats["fsdp"]["temp_bytes"]
+        assert stats["fsdp_stream"]["peak_bytes"] \
+            < stats["fsdp"]["peak_bytes"] \
+            < stats["replicated"]["peak_bytes"]
+        snap = _devices.train_memory_summary()["parallel_trainer"]
+        assert snap["step_peak_bytes"]["layout"] == "fsdp_stream"
+        assert snap["step_peak_bytes"]["peak_bytes"] \
+            == stats["fsdp_stream"]["peak_bytes"]
+        telemetry.reset()
+        assert "parallel_trainer" not in _devices.train_memory_summary()
+
+    def test_step_peak_gauges_emitted(self, eight_devices):
+        from deeplearning4j_tpu import telemetry
+        mesh = make_mesh(MeshSpec(data=8, model=1), devices=eight_devices)
+        x, y = _data()
+        telemetry.reset()
+        reg = telemetry.get_registry()
+        was = reg.enabled
+        reg.enabled = True
+        try:
+            tr = _stream_trainer("fsdp_stream", mesh)
+            stats = tr.step_memory_analysis(x, y)
+            if stats is None:
+                pytest.skip("backend has no memory_analysis")
+            g = reg.get("step_peak_bytes")
+            assert g is not None
+            vals = {ls["component"]: g.value(**ls)
+                    for ls in g.labelsets()
+                    if ls.get("site") == "parallel_trainer"
+                    and ls.get("layout") == "fsdp_stream"}
+            assert vals["peak"] == stats["peak_bytes"]
+            assert vals["temp"] == stats["temp_bytes"]
+        finally:
+            reg.enabled = was
+            telemetry.reset()
+
+    def test_aot_compile_exports_step_peak(self):
+        """Every executable through the blessed compile site exports its
+        ledger (site aot:<kind base>) — serving/fused AOT compiles get
+        step-peak observability for free."""
+        import jax
+        from deeplearning4j_tpu import telemetry
+        from deeplearning4j_tpu.telemetry import devices as _devices
+        from deeplearning4j_tpu.utils import compile_cache as _cc
+        telemetry.reset()
+        fn = jax.jit(lambda a: a * 2.0)
+        ex, src = _cc.aot_compile(fn, jnp.ones((4, 4)),
+                                  kind="probe:smoke")
+        assert src == "compile"
+        snap = _devices.train_memory_summary().get("aot:probe", {})
+        got = snap.get("step_peak_bytes")
+        if got is not None:               # backend-dependent
+            assert got["layout"] == "probe:smoke"
+            assert got["output_bytes"] >= 4 * 4 * 4
+        telemetry.reset()
+
+    def test_sync_to_net_gathers_full_copy_streamed(self, eight_devices):
+        """The chunked fit-end gather (satellite): a streamed trainer's
+        sync_to_net still lands a complete host copy, counters included,
+        namedtuple/dict/list containers preserved."""
+        mesh = make_mesh(MeshSpec(data=8, model=1), devices=eight_devices)
+        tr = _stream_trainer("fsdp_stream", mesh)
+        x, y = _data()
+        tr.step(x, y)
+        net = tr.sync_to_net()
+        assert np.asarray(net.params[1]["W"]).shape == (64, 64)
+        assert isinstance(net.opt_state, dict)
+        assert np.asarray(net.opt_state["m"][1]["W"]).shape == (64, 64)
+        assert net.iteration == 1
+        out = net.output(x)
+        assert out.shape == (16, 4)
 
 
 class TestZeroHLO:
@@ -487,6 +723,76 @@ class TestCheckpointLayoutRoundTrips:
             assert m.sharding.spec[0] == "data"
         if dst == "fsdp":
             assert tr2.params[0]["W"].sharding.spec[0] == "data"
+        loss_resumed = float(np.asarray(tr2.step(x, y)))
+        assert loss_resumed == loss_next
+
+    @pytest.mark.parametrize("src,dst", [("replicated", "fsdp_stream"),
+                                         ("fsdp_stream", "replicated"),
+                                         ("fsdp", "fsdp_stream"),
+                                         ("fsdp_stream", "zero1")])
+    def test_cross_layout_resume_streamed(self, tmp_path, eight_devices,
+                                          src, dst):
+        """Satellite: the matrix extended to the streamed tier — same
+        per-leaf storage layout as fsdp, only the step differs, so
+        restore_trainer's layout-free template covers it unchanged."""
+        from deeplearning4j_tpu.utils.sharded_checkpoint import (
+            restore_trainer, save_trainer)
+        mesh = make_mesh(MeshSpec(data=8, model=1), devices=eight_devices)
+        x, y = _data()
+        tr = _stream_trainer(src, mesh, seed=23)
+        self._fit_some(tr, x, y)
+        path = str(tmp_path / f"{src}_to_{dst}")
+        save_trainer(path, tr)
+        loss_next = float(np.asarray(tr.step(x, y)))
+
+        tr2 = _stream_trainer(dst, mesh, seed=23)
+        restore_trainer(path, tr2)
+        assert tr2.iteration == 3
+        if dst in ("fsdp", "fsdp_stream"):
+            assert tr2.params[1]["W"].sharding.spec[0] == "data"
+        loss_resumed = float(np.asarray(tr2.step(x, y)))
+        assert loss_resumed == loss_next
+
+    def test_epoch_rides_the_sharded_checkpoint(self, tmp_path,
+                                                eight_devices):
+        """Satellite fix en route: the epoch counter resumes (it rode
+        only the single-process zip before — a restored multi-epoch fit
+        restarted its epoch listeners from 0)."""
+        from deeplearning4j_tpu.utils.sharded_checkpoint import (
+            restore_trainer, save_trainer)
+        mesh = make_mesh(MeshSpec(data=8, model=1), devices=eight_devices)
+        x, y = _data()
+        tr = _trainer("zero1", mesh, seed=24)
+        tr.fit(x, y, batch_size=8, epochs=3)
+        assert tr.epoch == 3
+        path = str(tmp_path / "epoch_ride")
+        save_trainer(path, tr)
+        tr2 = _trainer("fsdp", mesh, seed=24)
+        restore_trainer(path, tr2)
+        assert tr2.epoch == 3
+        assert tr2.iteration == tr.iteration
+
+    def test_bundle_round_trip_into_streamed_trainer(self, tmp_path,
+                                                     eight_devices):
+        """Single-process zip path for the streamed tier: sync_to_net ->
+        save_bundle -> load_bundle -> adopt_net_state into an
+        fsdp_stream trainer; the resumed step matches the uninterrupted
+        one."""
+        from deeplearning4j_tpu.utils.serialization import (load_bundle,
+                                                            save_bundle)
+        mesh = make_mesh(MeshSpec(data=8, model=1), devices=eight_devices)
+        x, y = _data()
+        tr = _stream_trainer("fsdp", mesh, seed=25)
+        self._fit_some(tr, x, y)
+        path = str(tmp_path / "stream_bundle.zip")
+        save_bundle(tr.sync_to_net(), path)
+        loss_next = float(np.asarray(tr.step(x, y)))
+
+        bundle = load_bundle(path)
+        tr2 = ParallelTrainer(bundle.net, mesh,
+                              shard_params="fsdp_stream").adopt_net_state()
+        assert tr2.iteration == 3
+        assert tr2.params[1]["W"].sharding.spec[0] == "data"
         loss_resumed = float(np.asarray(tr2.step(x, y)))
         assert loss_resumed == loss_next
 
