@@ -1178,6 +1178,119 @@ def bench_continuous():
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_hostfleet():
+    """Elastic multi-host training under injected host death (ISSUE 15):
+    a TrainingFleetSupervisor runs N training processes (one per
+    simulated host, each with its own local device mesh and the zero1/
+    fsdp sharded update) to a fixed round count, checkpointing a
+    layout-free bundle at every round boundary. Three legs, one record:
+
+    * CLEAN — N hosts, no faults: every host's final state digest must
+      agree, zero recompiles, the snapshot->registry serving handoff
+      probe <= 1e-6;
+    * KILL — one host SIGKILLed mid-round; the wedged generation is torn
+      down, re-formed at N-1 with the bundle RESHARDED into the smaller
+      topology, and the finished run must be digest-EXACT with a
+      fault-free reference fleet on that same final topology resuming
+      from the same rollback bundle (the post-recovery snapshot also
+      serves, probe-checked);
+    * RESPAWN — same kill, but the generation re-forms at full size N:
+      the final digest must equal the CLEAN leg's exactly (the clean run
+      IS the fault-free reference on that topology).
+
+    scripts/check_hostfleet.py gates on COUNTERS AND DIGEST PARITY
+    (every death/generation/rollback counted, zero recompiles within a
+    generation, no uncounted losses) — never wall time on CPU. The
+    cross-host transport on this backend is the host-mediated round
+    averaging (jax 0.4.37's CPU client cannot execute multi-process
+    computations); jax.distributed join/teardown per generation is real
+    either way, and the gspmd in-step path is an accelerator-window
+    claim. One BENCH JSON record."""
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.hostfleet import TrainingFleetSupervisor
+
+    telemetry.enable()
+    n_hosts, rounds, disp = 3, 4, 2
+    local_devices, shard = 2, "fsdp"
+    kill_after_round = 1
+    workroot = tempfile.mkdtemp(prefix="hostfleet_bench_")
+
+    def trim(res, wall):
+        return {k: res[k] for k in
+                ("digests", "iterations", "final_world", "final_generation",
+                 "mode", "layout", "serving_probe_diff", "step_recompiles",
+                 "tally", "generations", "chaos_kills",
+                 "worker_counters")} | {"wall_s": round(wall, 1)}
+
+    def leg(tag, *, world=n_hosts, respawn=False, kill=False,
+            seed_bundle=None, serve=False):
+        wd = os.path.join(workroot, tag)
+        os.makedirs(wd, exist_ok=True)
+        if seed_bundle is not None:
+            shutil.copyfile(seed_bundle, os.path.join(wd, "bundle.zip"))
+        t0 = time.perf_counter()
+        sup = TrainingFleetSupervisor(
+            world, workdir=wd, total_rounds=rounds,
+            dispatches_per_round=disp, local_devices=local_devices,
+            shard_params=shard, respawn=respawn, round_timeout_s=60,
+            spawn_timeout_s=180,
+            round_sleep_s=0.3 if kill else 0.0, serve_registry=serve)
+        sup.start()
+        try:
+            if kill:
+                # land the SIGKILL mid-round: host 0 has reported round
+                # `kill_after_round` (its line lands AFTER the bundle
+                # write, so the rollback target exists), the victim is
+                # inside the next round, and the survivors wedge at that
+                # round's exchange
+                sup.wait_for_round(kill_after_round, timeout=180, host=0)
+                sup.kill_host(world - 1)
+            res = sup.wait(timeout=280)
+        finally:
+            sup.stop()
+        return trim(res, time.perf_counter() - t0)
+
+    try:
+        clean = leg("clean", serve=True)
+        kill = leg("kill", kill=True, serve=True)
+        rb = kill["generations"][0].get("rollback_bundle")
+        ref = (leg("kill_ref", world=n_hosts - 1, seed_bundle=rb)
+               if rb else None)
+        respawn = leg("respawn", respawn=True, kill=True)
+
+        def agree(d):
+            return len(set(d)) == 1
+
+        parity = {
+            "clean_hosts_agree": agree(clean["digests"]),
+            "kill_hosts_agree": agree(kill["digests"]),
+            "respawn_hosts_agree": agree(respawn["digests"]),
+            "kill_vs_ref": (ref is not None
+                            and kill["digests"][0] == ref["digests"][0]),
+            "respawn_vs_clean":
+                respawn["digests"][0] == clean["digests"][0],
+        }
+
+        return {"metric": "hostfleet_elastic", "unit": "steps",
+                "value": kill["iterations"][0],
+                "vs_baseline": None,  # net-new tier: no reference analog
+                "hosts": n_hosts, "rounds": rounds,
+                "dispatches_per_round": disp,
+                "local_devices_per_host": local_devices, "layout": shard,
+                "killed_after_round": kill_after_round,
+                "clean": clean, "kill": kill, "kill_ref": ref,
+                "respawn": respawn, "parity": parity,
+                "counters": {name: telemetry.series_map(name) for name in (
+                    "hostfleet_generations_total",
+                    "hostfleet_rollback_rounds_total",
+                    "distributed_hosts_alive")}}
+    finally:
+        shutil.rmtree(workroot, ignore_errors=True)
+
+
 def bench_trace_overhead(reps=8):
     """Causal-tracing overhead on the fused step path: the same fused CPU
     fit measured with span/trace recording OFF and ON in adjacent
@@ -1668,7 +1781,7 @@ CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
            "serving": bench_serving, "trace_overhead": bench_trace_overhead,
            "coldstart": bench_coldstart, "zero": bench_zero,
            "kernels": bench_kernels, "fleet": bench_fleet,
-           "continuous": bench_continuous}
+           "continuous": bench_continuous, "hostfleet": bench_hostfleet}
 DEFAULT_ORDER = ["lenet", "resnet50", "lstm", "word2vec", "parallel",
                  "transformer", "longcontext", "fused", "serving", "zero"]
 

@@ -171,22 +171,12 @@ def main(argv=None):
         serving_probe_diff = float(np.max(np.abs(served - direct)))
         registry.unregister("continuous")
 
-    reg = telemetry.get_registry()
-
-    def series(name):
-        m = reg.get(name)
-        if m is None:
-            return {}
-        return {("|".join(f"{k}={v}"
-                          for k, v in sorted(s["labels"].items())) or ""):
-                s["value"] for s in m.snapshot()["series"]}
-
     _emit({"continuous_done": True,
            "digest": chaos.state_digest(net),
            "iteration": int(net.iteration),
            "summary": summary,
            "serving_probe_diff": serving_probe_diff,
-           "counters": {name: series(name) for name in (
+           "counters": {name: telemetry.series_map(name) for name in (
                "continuous_rounds_total", "continuous_rollback_total",
                "continuous_rolled_back_steps_total",
                "continuous_dropped_total", "continuous_snapshots_total",

@@ -61,8 +61,61 @@ tree_map = jax.tree_util.tree_map
 # multi-host runtime (replaces Spark cluster + Aeron transport)
 # ----------------------------------------------------------------------
 
+#: cached ``str(jax.process_index())`` for metric labels; reset whenever
+#: the process joins or leaves a jax.distributed generation (the index is
+#: only meaningful within one)
+_HOST_LABEL = None
+
+
+def _host_label():
+    global _HOST_LABEL
+    if _HOST_LABEL is None:
+        try:
+            _HOST_LABEL = str(jax.process_index())
+        except Exception:  # noqa: BLE001 — backend not up yet
+            _HOST_LABEL = "0"
+    return _HOST_LABEL
+
+
+def _init_counter():
+    return _tm.get_registry().counter(
+        "distributed_init_total",
+        "jax.distributed coordinator joins, by outcome (ok = joined, "
+        "retried = one connect attempt failed and was retried with "
+        "backoff, failed = the retry budget ran out)")
+
+
+def _probe_coordinator(address, deadline_s):
+    """TCP-probe the coordinator before handing the address to
+    jax.distributed: on jax 0.4.37 a client whose RegisterTask RPC never
+    answers dies by a C++ ``LOG(FATAL)`` (SIGABRT) that no Python
+    ``except`` can see — so the common failure (coordinator dead, port
+    unreachable, generation torn down) is converted HERE into a
+    catchable, counted, retryable error. A listener that accepts TCP but
+    is not a coordination service still reaches jax's own (bounded)
+    ``initialization_timeout`` path."""
+    import socket as _socket
+
+    host, _, port = str(address).rpartition(":")
+    deadline = time.monotonic() + max(float(deadline_s), 0.2)
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            with _socket.create_connection((host or "127.0.0.1", int(port)),
+                                           timeout=1.0):
+                return
+        except OSError as e:
+            last = e
+            time.sleep(0.2)
+    raise RuntimeError(
+        f"jax.distributed coordinator {address} unreachable after "
+        f"{deadline_s}s: {last}")
+
+
 def initialize_distributed(coordinator_address=None, num_processes=None,
-                           process_id=None, local_device_ids=None):
+                           process_id=None, local_device_ids=None, *,
+                           initialization_timeout=None, connect_retries=0,
+                           retry_backoff_s=1.0):
     """Join the jax.distributed multi-host runtime.
 
     Reference analog: SharedTrainingMaster.java:469's
@@ -70,15 +123,88 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
     after this, ``jax.devices()`` spans all hosts and every collective in the
     masters below rides ICI/DCN transparently. No-op (returns False) when no
     coordinator is given and the job is single-process.
+
+    Hardened for the elastic tier (ISSUE 15): ``initialization_timeout``
+    bounds the coordinator connect (jax's default is 300 s — an elastic
+    supervisor re-forming generations wants seconds), and a failed connect
+    retries up to ``connect_retries`` times with exponential backoff
+    (``retry_backoff_s * 2**attempt``), every outcome counted in
+    ``distributed_init_total{outcome=ok|retried|failed}`` so a worker that
+    cannot join is a fast, observable failure instead of an uncounted
+    5-minute hang. Partial state from a failed attempt is torn down via
+    :func:`shutdown_distributed` before the next try.
     """
     if coordinator_address is None and (num_processes is None
                                         or num_processes <= 1):
         return False
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id,
-                               local_device_ids=local_device_ids)
-    return True
+    global _HOST_LABEL
+    reg = _tm.get_registry()
+    counter = _init_counter()
+    budget = (None if initialization_timeout is None
+              else float(initialization_timeout))
+    for attempt in range(int(connect_retries) + 1):
+        kw = {}
+        if budget is not None:
+            kw["initialization_timeout"] = int(budget)
+        try:
+            if coordinator_address is not None and process_id not in (None,
+                                                                      0):
+                # process 0 BINDS the coordinator; everyone else probes
+                # it first (see _probe_coordinator: the fatal-abort path
+                # this converts into a retryable Python error). The probe
+                # SPENDS from the same per-attempt budget — what it used
+                # waiting for the port comes off jax's own timeout, so
+                # one initialization_timeout bounds one whole attempt
+                t_probe = time.monotonic()
+                _probe_coordinator(coordinator_address,
+                                   budget if budget is not None else 10.0)
+                if budget is not None:
+                    kw["initialization_timeout"] = max(
+                        2, int(round(budget
+                                     - (time.monotonic() - t_probe))))
+            jax.distributed.initialize(coordinator_address=coordinator_address,
+                                       num_processes=num_processes,
+                                       process_id=process_id,
+                                       local_device_ids=local_device_ids,
+                                       **kw)
+        except Exception:  # noqa: BLE001 — connect/timeout; retry or raise
+            shutdown_distributed()  # clear partial client state for a rejoin
+            if attempt >= int(connect_retries):
+                if reg.enabled:
+                    counter.inc(outcome="failed")
+                raise
+            if reg.enabled:
+                counter.inc(outcome="retried")
+            time.sleep(float(retry_backoff_s) * (2 ** attempt))
+        else:
+            if reg.enabled:
+                counter.inc(outcome="ok")
+            _HOST_LABEL = None  # process_index is generation-scoped
+            return True
+
+
+def shutdown_distributed():
+    """Leave the jax.distributed runtime so this process can join a NEW
+    generation (the elastic supervisor re-forms at a new world size with
+    a fresh coordinator). Returns True when a live runtime was shut down,
+    False when there was nothing to leave. Never raises: teardown rides
+    failure paths where a half-initialized client is exactly what is
+    being cleaned up."""
+    global _HOST_LABEL
+    _HOST_LABEL = None
+    try:
+        from jax._src import distributed as _dist
+        state = _dist.global_state
+        if (getattr(state, "client", None) is None
+                and getattr(state, "service", None) is None):
+            return False
+    except Exception:  # noqa: BLE001 — internals moved; try the public API
+        pass
+    try:
+        jax.distributed.shutdown()
+        return True
+    except Exception:  # noqa: BLE001 — nothing initialized
+        return False
 
 
 # ----------------------------------------------------------------------
@@ -99,15 +225,19 @@ class TrainingMaster:
     @staticmethod
     def _round_metrics():
         """(registry, round_hist, rounds_counter) — per-round sync/averaging
-        time series shared by every master, split by a ``master`` label."""
+        time series shared by every master, split by ``master`` and ``host``
+        labels (host = ``jax.process_index()``: without it, multi-process
+        rounds collapse every host into one series on ``/metrics``)."""
         reg = _tm.get_registry()
         return (reg,
                 reg.histogram(
                     "distributed_round_seconds",
                     "wall time of one distributed round (local steps + "
-                    "parameter/gradient exchange), labeled by master"),
+                    "parameter/gradient exchange), labeled by master and "
+                    "host"),
                 reg.counter("distributed_rounds_total",
-                            "distributed rounds executed, labeled by master"))
+                            "distributed rounds executed, labeled by master "
+                            "and host"))
 
     @staticmethod
     def _worker_health_rollup(wh, master, step):
@@ -125,20 +255,22 @@ class TrainingMaster:
         with _tm.span("distributed.worker_rollup", master=master):
             vals = jax.device_get(wh)
             reg = _tm.get_registry()
+            host = _host_label()
             g_nf = reg.gauge("distributed_worker_nonfinite",
                              "1 when this worker's last round saw NaN/Inf, "
-                             "labeled by master and worker")
+                             "labeled by master, host and worker")
             norm_key = "grad_norm" if "grad_norm" in vals else "param_norm"
             g_norm = reg.gauge(f"distributed_worker_{norm_key}",
                                f"per-worker {norm_key.replace('_', ' ')} "
-                               "at the last exchange, labeled by master "
-                               "and worker")
+                               "at the last exchange, labeled by master, "
+                               "host and worker")
             flags = np.asarray(vals["nonfinite"]).reshape(-1)
             norms = np.asarray(vals[norm_key]).reshape(-1)
             for w in range(len(flags)):
-                g_nf.set(1.0 if flags[w] else 0.0, master=master,
+                g_nf.set(1.0 if flags[w] else 0.0, master=master, host=host,
                          worker=str(w))
-                g_norm.set(float(norms[w]), master=master, worker=str(w))
+                g_norm.set(float(norms[w]), master=master, host=host,
+                           worker=str(w))
             bad = [int(w) for w in np.nonzero(flags)[0]]
         if bad:
             _health.get_monitor().note_anomaly(
@@ -369,8 +501,10 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                             jax.block_until_ready(loss)  # graftlint: disable=R1 -- deliberate, telemetry-gated: the round span must cover the collective, not just its dispatch
                     if reg.enabled:
                         round_h.observe(time.perf_counter() - t_round,
-                                        master="parameter_averaging")
-                        rounds_c.inc(master="parameter_averaging")
+                                        master="parameter_averaging",
+                                        host=_host_label())
+                        rounds_c.inc(master="parameter_averaging",
+                                     host=_host_label())
                     if self._built_with_health:
                         self._worker_health_rollup(out[4],
                                                    "parameter_averaging",
@@ -596,8 +730,8 @@ class SharedTrainingMaster(TrainingMaster):
                             jax.block_until_ready(loss)  # graftlint: disable=R1 -- deliberate, telemetry-gated: the round span must cover the all-reduce, not just its dispatch
                     if reg.enabled:
                         round_h.observe(time.perf_counter() - t_round,
-                                        master="shared")
-                        rounds_c.inc(master="shared")
+                                        master="shared", host=_host_label())
+                        rounds_c.inc(master="shared", host=_host_label())
                     if self._built_with_health:
                         self._worker_health_rollup(out[6], "shared", it)
                 if tctx is not None:

@@ -59,6 +59,7 @@ from deeplearning4j_tpu.telemetry.tracectx import TraceContext
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
            "DEFAULT_BUCKETS", "get_registry", "get_tracer", "span",
            "write_jsonl", "enable", "disable", "enabled", "reset",
+           "series_map",
            "health", "devices", "flight", "scorepipe", "ScorePipeline",
            "NumericsError", "tracectx", "TraceContext"]
 
@@ -94,6 +95,19 @@ def reset():
     # lazy import — utils.compile_cache imports telemetry lazily back
     from deeplearning4j_tpu.utils import compile_cache as _cc
     _cc.reset_marks()
+
+
+def series_map(name):
+    """``{"label=value|label2=value2": value}`` flattening of one metric's
+    series (``""`` keys an unlabeled series; ``{}`` when the metric does
+    not exist) — the wire form subprocess workers and bench legs embed in
+    their JSON records and the check scripts key on. ONE definition so
+    the string format the gates parse cannot drift per emit site."""
+    m = get_registry().get(name)
+    if m is None:
+        return {}
+    return {("|".join(f"{k}={v}" for k, v in sorted(s["labels"].items()))
+             or ""): s["value"] for s in m.snapshot()["series"]}
 
 
 def train_metrics():

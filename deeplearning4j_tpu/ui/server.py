@@ -314,9 +314,16 @@ def _health_payload():
         status = "sick"
     rec = _flight.get_recorder()
     ring = rec.snapshot()
+    from deeplearning4j_tpu import telemetry as _reg_mod
+    g_hosts = _reg_mod.get_registry().get("distributed_hosts_alive")
     return {"status": status,
             "watchdog": watchdog,
             "recompiles": recompiles,
+            # elastic multi-host training (hostfleet tier): how many
+            # training hosts the supervisor currently believes are alive
+            # (None when no supervisor runs in this process)
+            "distributed": {"hosts_alive": (None if g_hosts is None
+                                            else g_hosts.value())},
             "memory": _devices.memory_summary(),
             # the HBM ledger of the training job's persistent trees
             # (per_device vs logical bytes = the realized 1/N of a

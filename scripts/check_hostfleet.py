@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""tier1.sh hostfleet gate: parse a `bench.py hostfleet` JSONL stream and
+fail unless the elastic multi-host contracts held. Counter- and
+digest-based, NEVER wall time (CPU legs jitter; the claims under test are
+exact):
+
+* CLEAN leg: one generation, zero deaths/rollbacks, every host's final
+  state digest identical, zero step recompiles, serving probe <= 1e-6;
+* KILL leg: exactly one counted host death, >= 1 counted rollback round,
+  the job re-formed at N-1 and finished there, and its digest EXACTLY
+  equals a fault-free reference fleet on that same final topology
+  resuming from the same rollback bundle — rollback + reshard, not a
+  restart. The POST-RECOVERY snapshot still serves (probe <= 1e-6);
+* RESPAWN leg: the kill re-forms at full size N and the final digest
+  EXACTLY equals the clean leg's (the clean run is the fault-free
+  reference on that topology);
+* accounting: hostfleet_generations_total carries every transition
+  (host_death / respawn / clean), every worker joined jax.distributed
+  with a counted ok (no failed), and nothing wedged — the record's
+  existence is itself the no-hang proof (every supervisor wait is
+  deadline-bounded by the round watchdog).
+
+Usage: check_hostfleet.py <jsonl-file>
+"""
+
+import json
+import sys
+
+TOL = 1e-6
+
+
+def main(argv):
+    path = argv[1]
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    recs = [r for r in rows
+            if str(r.get("metric", "")).startswith("hostfleet")]
+    if not recs:
+        print("check_hostfleet: no hostfleet record in", path)
+        return 1
+    rec = recs[-1]
+    if "FAILED" in rec.get("metric", ""):
+        print("check_hostfleet: bench leg failed:", rec.get("error"))
+        return 1
+    errors = []
+    hosts = rec.get("hosts")
+    parity = rec.get("parity") or {}
+
+    def tally(leg, key):
+        return ((rec.get(leg) or {}).get("tally") or {}).get(key, -1)
+
+    def leg_err(leg, msg):
+        errors.append(f"{leg}: {msg}")
+
+    # ---- per-leg shape + counted transitions --------------------------
+    for leg, deaths, respawns, world in (
+            ("clean", 0, 0, hosts),
+            ("kill", 1, 0, hosts - 1),
+            ("kill_ref", 0, 0, hosts - 1),
+            ("respawn", 0, 1, hosts)):
+        doc = rec.get(leg)
+        if not doc:
+            leg_err(leg, "leg missing from the record")
+            continue
+        if tally(leg, "host_death") != deaths:
+            leg_err(leg, f"host deaths {tally(leg, 'host_death')}, "
+                         f"expected {deaths}")
+        if tally(leg, "respawn") != respawns:
+            leg_err(leg, f"respawn transitions {tally(leg, 'respawn')}, "
+                         f"expected {respawns}")
+        if tally(leg, "clean") != 1:
+            leg_err(leg, "did not end with one counted clean generation: "
+                         f"{doc.get('tally')}")
+        if doc.get("final_world") != world:
+            leg_err(leg, f"finished at world {doc.get('final_world')}, "
+                         f"expected {world}")
+        if len(set(doc.get("digests") or ["?"])) != 1 \
+                or len(doc.get("digests") or []) != world:
+            leg_err(leg, f"hosts disagree on the final state: "
+                         f"{doc.get('digests')}")
+        if any(doc.get("step_recompiles") or [1]):
+            leg_err(leg, "a host recompiled its step within a generation: "
+                         f"{doc.get('step_recompiles')}")
+        faulted = leg in ("kill", "respawn")
+        if (tally(leg, "rollback_rounds") >= 1) != faulted:
+            leg_err(leg, f"rollback rounds {tally(leg, 'rollback_rounds')} "
+                         f"(expected {'>=1' if faulted else '0'})")
+        # every multi-process generation joined jax.distributed, counted
+        wc = doc.get("worker_counters") or {}
+        if world > 1 and not wc:
+            leg_err(leg, "no worker counters in the record (the "
+                         "distributed-init gate has nothing to bite on)")
+        for proc, counters in wc.items():
+            init = (counters or {}).get("distributed_init_total") or {}
+            if any("failed" in k and v for k, v in init.items()):
+                leg_err(leg, f"host {proc} counted a failed "
+                             f"distributed init: {init}")
+            if world > 1 and not init.get("outcome=ok"):
+                leg_err(leg, f"host {proc} never counted a successful "
+                             f"jax.distributed join: {init}")
+
+    # ---- the headline: digest parity across the fault ------------------
+    if not parity.get("kill_vs_ref"):
+        errors.append(
+            "KILL leg != fault-free reference on the final (N-1) topology "
+            "resuming from the same bundle: rollback+reshard was NOT "
+            "bit-exact "
+            f"(kill={((rec.get('kill') or {}).get('digests') or ['?'])[0]} "
+            f"ref={((rec.get('kill_ref') or {}).get('digests') or ['?'])[0]})")
+    if not parity.get("respawn_vs_clean"):
+        errors.append(
+            "RESPAWN leg != clean run on the same topology: "
+            "kill->reform->restore->resume was NOT bit-exact")
+
+    # ---- post-recovery serving handoff ---------------------------------
+    for leg in ("clean", "kill"):
+        probe = (rec.get(leg) or {}).get("serving_probe_diff")
+        if probe is None or not (probe <= TOL):  # NaN fails the <=
+            leg_err(leg, f"snapshot->registry serving probe diverged: "
+                         f"{probe}")
+
+    # ---- registry counters carried every transition --------------------
+    gens = rec.get("counters", {}).get("hostfleet_generations_total", {})
+    expect = {"reason=clean": 4, "reason=host_death": 1, "reason=respawn": 1}
+    for label, n in expect.items():
+        if gens.get(label, 0) != n:
+            errors.append(f"hostfleet_generations_total[{label}] = "
+                          f"{gens.get(label, 0)}, expected {n} "
+                          f"(all series: {gens})")
+    rb = rec.get("counters", {}).get("hostfleet_rollback_rounds_total", {})
+    if sum(rb.values()) < 2:
+        errors.append(f"rollback rounds not on the books: {rb}")
+
+    if errors:
+        print("check_hostfleet: FAILED")
+        for e in errors:
+            print("  -", e)
+        return 1
+    kill = rec.get("kill") or {}
+    print("check_hostfleet: ok — host death became rollback+reshard "
+          f"({hosts}->{kill.get('final_world')} hosts, "
+          f"{tally('kill', 'rollback_rounds')} rollback round(s), digest "
+          f"parity exact vs the {kill.get('final_world')}-host reference, "
+          f"respawn leg == clean leg, post-recovery serving probe "
+          f"{kill.get('serving_probe_diff')})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -33,7 +33,7 @@ timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python -m pytest tests/test_serving.py tests/test_fused.py \
   tests/test_streaming.py tests/test_parallel.py tests/test_native.py \
   tests/test_ui.py tests/test_sanitizer.py tests/test_fleet.py \
-  tests/test_continuous.py \
+  tests/test_continuous.py tests/test_hostfleet.py \
   -q -m 'not slow' \
   -p no:cacheprovider -p no:xdist -p no:randomly || {
     echo "tier1: graftsan stage FAILED"; exit 1; }
@@ -183,5 +183,27 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
   || { echo "tier1: continuous chaos smoke FAILED (rollback/resume not"
        echo "tier1: bit-exact, a fault went uncounted, ingest went"
        echo "tier1: fatal, or the SIGTERM dump/resume path broke)"; exit 1; }
+
+# Stage 10: elastic multi-host training chaos smoke
+# (deeplearning4j_tpu/hostfleet, ISSUE 15) — N REAL training processes
+# under the TrainingFleetSupervisor: clean leg, kill-one-host leg (SIGKILL
+# mid-round -> round watchdog/teardown -> re-form jax.distributed at N-1
+# -> restore the layout-free bundle RESHARDED into the new topology ->
+# resume -> serve), and a respawn leg re-forming at full size.
+# scripts/check_hostfleet.py gates on COUNTERS AND DIGEST PARITY (faulted
+# runs digest-EXACT vs fault-free references on the same final topology,
+# every death/generation/rollback counted, zero recompiles within a
+# generation, post-recovery serving probe <=1e-6) — never wall time on
+# CPU.
+echo "== hostfleet elastic-training chaos smoke =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
+  timeout -k 10 300 python bench.py hostfleet \
+  > /tmp/_hostfleet.jsonl \
+  && tee -a BENCH_smoke.json < /tmp/_hostfleet.jsonl > /dev/null \
+  && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python scripts/check_hostfleet.py /tmp/_hostfleet.jsonl \
+  || { echo "tier1: hostfleet smoke FAILED (recovery not digest-exact,"
+       echo "tier1: a death/rollback went uncounted, a generation"
+       echo "tier1: recompiled, or the fleet wedged)"; exit 1; }
 
 exit $rc

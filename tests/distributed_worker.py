@@ -1,11 +1,22 @@
 """2-process jax.distributed worker used by test_distributed_multiprocess.py.
 
 Usage: python distributed_worker.py <process_id> <num_processes> <coord_port>
+           [init_timeout_s] [init_retries]
 
 Each process owns ONE local CPU device; jax.distributed joins them into a
 2-device global mesh and SharedTrainingMaster's psum rides the cross-process
 collective transport — the multi-host execution path the reference exercises
 via local-mode Spark clusters (BaseSparkTest.java:89).
+
+Failure protocol (ISSUE 15 satellite): an init that cannot reach the
+coordinator exits ``procutil.INIT_FAILED_RC`` with ONE JSON error line
+(carrying the ``distributed_init_total`` outcome counters) instead of
+hanging into the spawner's 300 s communicate timeout; a backend that
+joined the runtime but cannot EXECUTE multi-process computations (jax
+0.4.37's CPU client) reports ``{"gspmd_unsupported": true}`` and exits 0
+so the spawner can skip instead of fail — the hostfleet tier's host-
+mediated exchange is the CPU-preflight path for real cross-process
+training.
 """
 
 import json
@@ -22,10 +33,30 @@ import jax  # noqa: E402
 
 def main():
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    timeout_s = int(sys.argv[4]) if len(sys.argv) > 4 else 60
+    retries = int(sys.argv[5]) if len(sys.argv) > 5 else 0
+    from deeplearning4j_tpu import telemetry
     from deeplearning4j_tpu.parallel.distributed import (
         SharedTrainingMaster, initialize_distributed)
-    assert initialize_distributed(coordinator_address=f"127.0.0.1:{port}",
-                                  num_processes=nproc, process_id=pid)
+
+    telemetry.enable()
+
+    def init_series():
+        # the shared wire form ("outcome=ok": n) every worker/bench emit
+        # site uses — one flattening definition (telemetry.series_map)
+        return telemetry.series_map("distributed_init_total")
+
+    try:
+        assert initialize_distributed(
+            coordinator_address=f"127.0.0.1:{port}", num_processes=nproc,
+            process_id=pid, initialization_timeout=timeout_s,
+            connect_retries=retries)
+    except Exception as e:  # noqa: BLE001 — distinct rc + one JSON line
+        print(json.dumps({"error": str(e)[:500], "stage": "init",
+                          "process": pid,
+                          "distributed_init_total": init_series()}),
+              flush=True)
+        sys.exit(procutil.INIT_FAILED_RC)
     assert len(jax.local_devices()) == 1
     assert len(jax.devices()) == nproc, jax.devices()
 
@@ -50,7 +81,18 @@ def main():
     mesh = Mesh(np.array(jax.devices()), ("data",))
     master = SharedTrainingMaster(mesh, batch_size_per_worker=8,
                                   threshold=None)  # exact psum mode
-    loss = master.execute_training(net, x, y, epochs=3)
+    try:
+        loss = master.execute_training(net, x, y, epochs=3)
+    except Exception as e:  # noqa: BLE001 — classify, don't wedge/crash raw
+        if "Multiprocess computations aren't implemented" in str(e):
+            # the runtime joined fine; the BACKEND can't execute a
+            # cross-process computation (jax 0.4.37 CPU client) — a
+            # clean, machine-readable skip, not a failure
+            print(json.dumps({"gspmd_unsupported": True, "process": pid,
+                              "n_devices": len(jax.devices()),
+                              "init": init_series()}), flush=True)
+            return
+        raise
 
     leaves = jax.tree_util.tree_leaves(net.params)
     checksum = float(sum(np.abs(np.asarray(l)).sum() for l in leaves))

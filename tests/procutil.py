@@ -27,6 +27,11 @@ import sys
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
+#: distinct exit code a worker uses when joining jax.distributed failed —
+#: the spawner asserts the rc + one JSON error line instead of diagnosing
+#: a 300 s communicate_all timeout (ISSUE 15 satellite)
+INIT_FAILED_RC = 13
+
 
 def free_port():
     """An ephemeral localhost port (for coordinators that cannot bind

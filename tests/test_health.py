@@ -375,9 +375,9 @@ class TestDistributedRollup:
         DistributedMultiLayer(_mlp(), master).fit(x, y, epochs=1)
         reg = telemetry.get_registry()
         assert reg.get("distributed_worker_param_norm").value(
-            master="parameter_averaging", worker="0") > 0
+            master="parameter_averaging", host="0", worker="0") > 0
         assert reg.get("distributed_worker_nonfinite").value(
-            master="parameter_averaging", worker="0") == 0
+            master="parameter_averaging", host="0", worker="0") == 0
         assert health.get_monitor().nonfinite_steps == 0
 
     def test_shared_master_nan_rollup(self, flight_dir):
@@ -399,8 +399,10 @@ class TestDistributedRollup:
         assert kinds == {"distributed_nonfinite"}
         assert mon.anomalies[0]["workers"] == [0]
         reg = telemetry.get_registry()
+        # host label (ISSUE 15): multi-process rounds must not collapse
+        # every host into one series — single-process reads host="0"
         assert reg.get("distributed_worker_grad_norm").labelsets() == [
-            {"master": "shared", "worker": "0"}]
+            {"host": "0", "master": "shared", "worker": "0"}]
 
     def test_master_caches_both_watchdog_variants(self):
         # toggling the watchdog between calls must not re-pay the

@@ -1,0 +1,211 @@
+"""Hostfleet tier unit tests: the exchange rendezvous, the supervisor's
+generation machinery over REAL worker subprocesses, the hardened
+jax.distributed helpers, and the /health surface.
+
+The chaos acceptance story (SIGKILL mid-round -> watchdog/teardown ->
+re-form at the new world size -> reshard+resume -> digest parity) lives
+in tests/test_hostfleet_process.py; here are the pieces it composes.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.hostfleet import (ExchangeClient, ExchangeError,
+                                          ExchangeServer,
+                                          TrainingFleetSupervisor)
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    telemetry.reset()
+    telemetry.disable()
+    yield
+    telemetry.reset()
+    telemetry.disable()
+
+
+# ----------------------------------------------------------------------
+# exchange: the host-mediated round-boundary allreduce
+# ----------------------------------------------------------------------
+
+class TestExchange:
+    def test_mean_is_deterministic_and_pid_ordered(self):
+        srv = ExchangeServer(2, round_timeout_s=20)
+        try:
+            a = [np.array([1.0, 3.0], np.float32), np.array([7], np.int64)]
+            b = [np.array([3.0, 5.0], np.float32), np.array([9], np.int64)]
+            out = {}
+
+            def run(pid, leaves):
+                c = ExchangeClient(srv.port, pid, timeout_s=20)
+                try:
+                    out[pid] = c.allreduce_mean(0, leaves)
+                finally:
+                    c.close()
+
+            # pid 1 contributes FIRST: the reply must still be the
+            # pid-ordered reduction (arrival order cannot change bits)
+            t1 = threading.Thread(target=run, args=(1, b))
+            t1.start()
+            time.sleep(0.1)
+            run(0, a)
+            t1.join(timeout=20)
+            for pid in (0, 1):
+                got = out[pid]
+                np.testing.assert_array_equal(
+                    got[0], np.array([2.0, 4.0], np.float32))
+                # non-float leaves take the lowest pid's value
+                np.testing.assert_array_equal(got[1], np.array([7]))
+            assert srv.rounds_completed == 1
+            assert srv.last_round == 0
+        finally:
+            srv.close()
+
+    def test_missing_contributor_is_bounded_not_a_hang(self):
+        srv = ExchangeServer(2, round_timeout_s=0.5)
+        try:
+            c = ExchangeClient(srv.port, 0, timeout_s=0.5)
+            t0 = time.monotonic()
+            with pytest.raises(ExchangeError, match="incomplete|reply"):
+                c.allreduce_mean(0, [np.zeros(2, np.float32)])
+            assert time.monotonic() - t0 < 10
+            c.close()
+        finally:
+            srv.close()
+
+    def test_server_close_releases_waiters(self):
+        srv = ExchangeServer(2, round_timeout_s=30)
+        c = ExchangeClient(srv.port, 0, timeout_s=30)
+        errs = []
+
+        def waiter():
+            try:
+                c.allreduce_mean(0, [np.zeros(1, np.float32)])
+            except ExchangeError as e:
+                errs.append(e)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.3)
+        srv.close()  # generation teardown mid-round
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert errs, "waiter must surface the teardown as ExchangeError"
+        c.close()
+
+
+# ----------------------------------------------------------------------
+# hardened jax.distributed helpers
+# ----------------------------------------------------------------------
+
+class TestInitHardening:
+    def test_single_process_is_a_noop(self):
+        from deeplearning4j_tpu.parallel.distributed import (
+            initialize_distributed)
+        assert initialize_distributed() is False
+        assert initialize_distributed(num_processes=1) is False
+
+    def test_shutdown_without_init_is_safe(self):
+        from deeplearning4j_tpu.parallel.distributed import (
+            shutdown_distributed)
+        assert shutdown_distributed() is False
+
+    def test_unreachable_coordinator_fails_counted_not_fatal(self):
+        """The connect probe converts the C++ fatal-abort path into a
+        catchable error, counted retried/failed — in-process (no jax
+        client is ever constructed for a dead coordinator)."""
+        import procutil
+        from deeplearning4j_tpu.parallel.distributed import (
+            initialize_distributed)
+        telemetry.enable()
+        port = procutil.free_port()  # nothing listens here
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="unreachable"):
+            initialize_distributed(
+                coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+                process_id=1, initialization_timeout=1, connect_retries=1,
+                retry_backoff_s=0.1)
+        assert time.monotonic() - t0 < 30
+        c = telemetry.get_registry().get("distributed_init_total")
+        series = {ls["outcome"]: c.value(**ls) for ls in c.labelsets()}
+        assert series.get("retried") == 1
+        assert series.get("failed") == 1
+
+
+# ----------------------------------------------------------------------
+# supervisor: one clean generation over real worker subprocesses
+# ----------------------------------------------------------------------
+
+class TestSupervisor:
+    def test_clean_two_host_run_agrees_and_counts(self, tmp_path):
+        telemetry.enable()
+        sup = TrainingFleetSupervisor(
+            2, workdir=str(tmp_path / "job"), total_rounds=2,
+            dispatches_per_round=1, round_timeout_s=60)
+        sup.start()
+        try:
+            res = sup.wait(timeout=180)
+        finally:
+            sup.stop()
+        assert res["final_world"] == 2
+        assert len(set(res["digests"])) == 1  # hosts agree bit-for-bit
+        assert res["iterations"] == [2, 2]
+        assert res["tally"]["clean"] == 1
+        assert res["tally"]["host_death"] == 0
+        assert res["tally"]["rollback_rounds"] == 0
+        assert res["step_recompiles"] == [0, 0]
+        # every worker joined jax.distributed with a counted ok
+        for counters in res["worker_counters"].values():
+            assert counters["distributed_init_total"].get(
+                "outcome=ok", 0) >= 1
+        reg = telemetry.get_registry()
+        assert reg.get("hostfleet_generations_total").value(
+            reason="clean") == 1
+        # the gauge drops to 0 once the job is over (stop() ran)
+        assert reg.get("distributed_hosts_alive").value() == 0
+
+    def test_serve_update_hook_fans_snapshots(self, tmp_path):
+        """The supervisor-side handoff seam (registry_updater /
+        fleet_updater contract): every published snapshot path reaches
+        the hook; a failing hook is counted, never fatal."""
+        telemetry.enable()
+        got, boom = [], [True]
+
+        def hook(path):
+            got.append(path)
+            if boom[0]:
+                boom[0] = False
+                raise RuntimeError("serving lag")
+
+        sup = TrainingFleetSupervisor(
+            2, workdir=str(tmp_path / "job"), total_rounds=2,
+            dispatches_per_round=1, round_timeout_s=60, serve_update=hook)
+        sup.start()
+        try:
+            res = sup.wait(timeout=180)
+        finally:
+            sup.stop()
+        assert len(got) == 2  # one handoff per round snapshot
+        assert res["tally"]["serve_updates_error"] == 1
+        assert res["tally"]["serve_updates_ok"] == 1
+        assert res["tally"]["clean"] == 1
+
+
+# ----------------------------------------------------------------------
+# /health carries the fleet gauge
+# ----------------------------------------------------------------------
+
+def test_health_payload_carries_hosts_alive():
+    from deeplearning4j_tpu.ui.server import _health_payload
+    payload = _health_payload()
+    # no supervisor ran in this process (or it already stopped): the key
+    # is present either way — None before the gauge ever existed
+    assert payload["distributed"]["hosts_alive"] in (None, 0.0)
+    telemetry.enable()
+    g = telemetry.get_registry().gauge("distributed_hosts_alive", "test")
+    g.set(3)
+    assert _health_payload()["distributed"] == {"hosts_alive": 3.0}

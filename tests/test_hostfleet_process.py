@@ -1,0 +1,120 @@
+"""Hostfleet chaos tests: REAL training subprocesses, real faults.
+
+The acceptance claim end to end (ISSUE 15): a training host SIGKILLed
+mid-round wedges the survivors' round exchange; the supervisor detects it
+(exit fast-path or round watchdog), tears the generation down, re-forms
+at the new world size, restores the last good layout-free bundle
+RESHARDED into the new topology, and resumes — digest-EXACT with a
+fault-free run on that same final topology, every transition counted. A
+SIGSTOPped host (alive but silent — the corpse the supervisor cannot
+poll) exercises the watchdog deadline path of the same story.
+"""
+
+import os
+import shutil
+import signal
+
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.hostfleet import TrainingFleetSupervisor
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    telemetry.reset()
+    telemetry.disable()
+    yield
+    telemetry.reset()
+    telemetry.disable()
+
+
+def _run(workdir, *, world=2, rounds=3, respawn=False, kill_sig=None,
+         kill_after=0, round_timeout_s=60.0, round_sleep_s=0.0,
+         seed_bundle=None):
+    os.makedirs(workdir, exist_ok=True)
+    if seed_bundle is not None:
+        shutil.copyfile(seed_bundle, os.path.join(workdir, "bundle.zip"))
+    sup = TrainingFleetSupervisor(
+        world, workdir=workdir, total_rounds=rounds,
+        dispatches_per_round=1, respawn=respawn,
+        round_timeout_s=round_timeout_s, round_sleep_s=round_sleep_s)
+    sup.start()
+    try:
+        if kill_sig is not None:
+            # wait on HOST 0's round line: it is emitted AFTER host 0
+            # wrote the round's bundle, so the rollback target exists
+            # before the chaos lands
+            sup.wait_for_round(kill_after, timeout=150, host=0)
+            sup.kill_host(world - 1, sig=kill_sig)
+        return sup.wait(timeout=280)
+    finally:
+        sup.stop()
+
+
+@pytest.mark.slow  # the tier-1 stage-10 bench gate proves this claim on
+#                    every run (3 hosts + reference leg); the marked test
+#                    is the debuggable single-claim repro
+def test_sigkill_becomes_rollback_reshard_digest_exact(tmp_path):
+    """Kill one of two hosts mid-round: the job finishes at world 1 from
+    the rollback bundle, digest-exact with a fault-free 1-host fleet
+    resuming from that same bundle — a rollback+reshard, not a restart."""
+    telemetry.enable()
+    res = _run(str(tmp_path / "chaos"), kill_sig=signal.SIGKILL,
+               round_sleep_s=0.3)
+    assert res["final_world"] == 1
+    assert res["tally"]["host_death"] == 1
+    assert res["tally"]["clean"] == 1
+    assert res["tally"]["rollback_rounds"] >= 1
+    assert res["iterations"] == [3]
+    gen0 = res["generations"][0]
+    assert gen0["reason"] == "host_death"
+    assert gen0["resumable"] is True
+
+    # fault-free reference ON THE FINAL TOPOLOGY from the same bundle
+    ref = _run(str(tmp_path / "ref"), world=1,
+               seed_bundle=gen0["rollback_bundle"])
+    assert ref["tally"]["host_death"] == 0
+    assert res["digests"][0] == ref["digests"][0], \
+        "recovery was not bit-exact with the fault-free reference"
+
+    reg = telemetry.get_registry()
+    assert reg.get("hostfleet_generations_total").value(
+        reason="host_death") == 1
+    assert sum(s["value"] for s in reg.get(
+        "hostfleet_rollback_rounds_total").snapshot()["series"]) >= 1
+
+
+@pytest.mark.slow  # covered by the stage-10 respawn leg every tier-1 run
+def test_respawn_reform_at_full_size_matches_clean_run(tmp_path):
+    """respawn=True re-forms at FULL size after the death; the final
+    digest must equal a clean run's on the same topology (the clean run
+    IS the fault-free reference)."""
+    telemetry.enable()
+    clean = _run(str(tmp_path / "clean"))
+    res = _run(str(tmp_path / "resp"), respawn=True,
+               kill_sig=signal.SIGKILL, round_sleep_s=0.3)
+    assert res["final_world"] == 2
+    assert res["tally"]["respawn"] == 1
+    assert len(set(res["digests"])) == 1
+    assert res["digests"][0] == clean["digests"][0], \
+        "kill->respawn->restore->resume diverged from the clean run"
+
+
+def test_sigstop_wedge_is_caught_by_the_round_watchdog(tmp_path):
+    """SIGSTOP leaves the process ALIVE but silent — no exit for the
+    fast path to poll, the survivors wedged in the round exchange. The
+    round watchdog (heartbeats + exchange progress + the line clock)
+    must bound it: teardown, re-form, finish. Never a hang."""
+    telemetry.enable()
+    res = _run(str(tmp_path / "stall"), kill_sig=signal.SIGSTOP,
+               round_timeout_s=6.0, round_sleep_s=0.2)
+    assert res["final_world"] == 1
+    assert res["tally"]["host_death"] == 1
+    assert res["tally"]["clean"] == 1
+    assert res["iterations"] == [3]
+    # the death was detected without a corpse: either the watchdog
+    # deadline fired, or the stalled exchange surfaced on a survivor —
+    # both are the bounded path, neither is a 300 s wedge
+    detail = res["generations"][0]["detail"]
+    assert ("watchdog_stall" in detail) or ("host_exit" in detail)

@@ -480,9 +480,9 @@ class TestInstrumentedStack:
         x, y = _xy(32)
         DistributedMultiLayer(_mlp(), master).fit(x, y, epochs=1)
         h = fresh.get("distributed_round_seconds")
-        assert h.count(master="parameter_averaging") == 2
+        assert h.count(master="parameter_averaging", host="0") == 2
         assert fresh.get("distributed_rounds_total").value(
-            master="parameter_averaging") == 2
+            master="parameter_averaging", host="0") == 2
 
 
 # ----------------------------------------------------------------------

@@ -753,6 +753,83 @@ class TestCheckpointLayoutRoundTrips:
         loss_resumed = float(np.asarray(tr2.step(x, y)))
         assert loss_resumed == loss_next
 
+    @pytest.mark.parametrize("src_world,dst_world,src,dst",
+                             [(8, 4, "zero1", "fsdp"),
+                              (4, 8, "fsdp", "zero1"),
+                              (8, 2, "fsdp", "fsdp")])
+    def test_cross_world_size_resume_bit_exact(self, tmp_path,
+                                               eight_devices, src_world,
+                                               dst_world, src, dst):
+        """ISSUE 15 satellite: the elastic path's single-process proof —
+        a checkpoint saved by a world-size-N sharded trainer (8 devices =
+        "2 hosts x 4") restores into a world-size-M one (4 devices = "1
+        host"), every leaf BIT-EXACT and landing directly in the new 1/M
+        layout: the world size is the destination trainer's policy, never
+        the file's. This is the restore the hostfleet supervisor leans on
+        when a generation re-forms at N-1."""
+        from deeplearning4j_tpu.utils.sharded_checkpoint import (
+            restore_trainer, save_trainer)
+        mesh_src = make_mesh(MeshSpec(data=src_world),
+                             devices=eight_devices[:src_world])
+        mesh_dst = make_mesh(MeshSpec(data=dst_world),
+                             devices=eight_devices[:dst_world])
+        x, y = _data()  # n=16: divisible by every world size crossed here
+        tr = _trainer(src, mesh_src, seed=41)
+        self._fit_some(tr, x, y)
+        path = str(tmp_path / f"w{src_world}_{src}_to_w{dst_world}_{dst}")
+        save_trainer(path, tr)
+        host = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: np.asarray(jax.device_get(a)), t)
+        src_params, src_opt = host(tr.params), host(tr.opt_state)
+
+        tr2 = _trainer(dst, mesh_dst, seed=41)
+        restore_trainer(path, tr2)
+        assert tr2.iteration == 3
+        for a, b in zip(jax.tree_util.tree_leaves(src_params),
+                        jax.tree_util.tree_leaves(host(tr2.params))):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(jax.tree_util.tree_leaves(src_opt),
+                        jax.tree_util.tree_leaves(host(tr2.opt_state))):
+            np.testing.assert_array_equal(a, b)
+        # restored arrays live in the DESTINATION world's layout
+        m = tr2.opt_state["m"][0]["W"]
+        assert m.sharding.spec[0] == "data"
+        assert len(m.sharding.device_set) == dst_world
+        if dst == "fsdp":
+            assert len(tr2.params[0]["W"].sharding.device_set) == dst_world
+        # and the resumed step dispatches on the new topology
+        assert np.isfinite(float(np.asarray(tr2.step(x, y))))
+
+    def test_bundle_reshards_across_world_sizes(self, tmp_path,
+                                                eight_devices):
+        """The hostfleet recovery artifact exactly: a layout-free
+        save_bundle zip written after training at world 8 adopts into a
+        world-4 FSDP trainer — params/opt re-placed in the smaller
+        world's 1/4 layout, counters and RNG chain intact, bit-exact."""
+        from deeplearning4j_tpu.utils.serialization import (load_bundle,
+                                                            save_bundle)
+        mesh8 = make_mesh(MeshSpec(data=8), devices=eight_devices)
+        mesh4 = make_mesh(MeshSpec(data=4), devices=eight_devices[:4])
+        x, y = _data()
+        tr = _trainer("fsdp", mesh8, seed=42)
+        self._fit_some(tr, x, y)
+        path = str(tmp_path / "world_cross_bundle.zip")
+        save_bundle(tr.sync_to_net(), path)
+        src_leaves = [np.asarray(l) for l in
+                      jax.tree_util.tree_leaves(tr.net.params)]
+
+        bundle = load_bundle(path)
+        tr2 = ParallelTrainer(bundle.net, mesh4,
+                              shard_params="fsdp").adopt_net_state()
+        assert tr2.iteration == 3
+        assert len(tr2.params[0]["W"].sharding.device_set) == 4
+        for a, b in zip(src_leaves,
+                        jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                            lambda l: np.asarray(jax.device_get(l)),
+                            tr2.params))):
+            np.testing.assert_array_equal(a, b)
+        assert np.isfinite(float(np.asarray(tr2.step(x, y))))
+
     def test_epoch_rides_the_sharded_checkpoint(self, tmp_path,
                                                 eight_devices):
         """Satellite fix en route: the epoch counter resumes (it rode
