@@ -1007,6 +1007,169 @@ def bench_fleet(duration_s=1.2, probe_s=0.35):
             shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_cluster_obs(n_requests=12):
+    """Cluster observability plane end to end (ISSUE 16): a REAL
+    2-worker fleet with telemetry on BOTH sides of the wire. Four legs,
+    one record, all gated STRUCTURALLY by scripts/check_cluster_obs.py
+    (never wall time; the tracing-cost claim rides the existing
+    trace_overhead stage's <=5% gate):
+
+    * TRACE — a routed request's ring doc must hold ONE trace spanning
+      admission→dispatch→worker-device→resolve: the worker process's
+      serving.queue_wait/serving.device_exec spans grafted under the
+      dispatching fleet.attempt with every parent link resolvable;
+    * FEDERATE — ``/metrics?federate=1`` semantics via
+      router.federated_metrics(): every live worker's counters under
+      stable instance labels, and the federated per-instance values of
+      ``serving_model_requests_total`` summing to the same total as
+      per-member individual scrapes;
+    * TIMELINE — router.timeline_sources() merged into one time-aligned
+      view naming the router and both worker instances;
+    * DEAD MEMBER — SIGKILL w0, federate again: the corpse is a COUNTED
+      scrape error (federate_scrape_total{outcome=error}) inside a
+      bounded wall, never a hang."""
+    import shutil
+    import signal
+    import tempfile
+
+    import numpy as np
+
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.fleet import FleetRouter, FleetSupervisor
+    from deeplearning4j_tpu.fleet.supervisor import default_worker_env
+    from deeplearning4j_tpu.nn import layers as L
+    from deeplearning4j_tpu.nn import updaters as U
+    from deeplearning4j_tpu.nn.conf import inputs as I
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.telemetry import federate as _fed
+    from deeplearning4j_tpu.telemetry import timeline as _tl
+    from deeplearning4j_tpu.telemetry import tracectx as _tracectx
+    from deeplearning4j_tpu.utils.serialization import save_model
+
+    telemetry.enable()
+    hidden = 128 if _preflight() else 256
+    conf = NeuralNetConfig(seed=5, updater=U.Sgd(learning_rate=0.1)).list(
+        L.DenseLayer(n_out=hidden, activation="relu"),
+        L.OutputLayer(n_out=10, loss="mcxent"),
+        input_type=I.FeedForwardType(32))
+    net = MultiLayerNetwork(conf)
+    net.init()
+    workdir = tempfile.mkdtemp(prefix="cluster_obs_bench_")
+    sup = router = None
+    try:
+        ckpt = os.path.join(workdir, "ckpt.zip")
+        save_model(net, ckpt)
+        # workers must trace too: the wire-propagated half of the story
+        env = default_worker_env()
+        env["DL4J_TPU_TELEMETRY"] = "1"
+        # long probe interval: the dead-member leg needs the corpse to
+        # still be a federation target when we scrape it
+        sup = FleetSupervisor(2, model_path=ckpt, buckets=[1], env=env,
+                              probe_interval_s=5.0, max_missed_probes=5)
+        # a 2-row dispatch window makes least-outstanding spread a burst
+        # across BOTH workers (one big window would coalesce the whole
+        # burst into a single chunk to w0 and leave w1 uncounted)
+        router = FleetRouter(name="default", request_timeout_s=30.0,
+                             max_inflight_rows=2, max_dispatch_rows=2)
+        sup.attach(router)
+        sup.start()
+        xs = np.random.RandomState(0).rand(8, 32).astype(np.float32)
+
+        # --- TRACE leg ------------------------------------------------
+        futs = [router.submit(xs[i % 8], deadline_s=30.0)
+                for i in range(n_requests)]
+        for f in futs:
+            f.get(timeout=30)
+        # the LAST future: the ring keeps the most recent 8 docs per
+        # name, so an early trace may have been evicted by the burst
+        doc = None
+        for docs in _tracectx.get_ring().snapshot().values():
+            for d in docs:
+                if d.get("trace_id") == futs[-1].trace_id:
+                    doc = d
+        spans = (doc or {}).get("spans") or []
+        names = [s.get("name") for s in spans]
+        by_id = {s.get("span_id"): s for s in spans}
+        wroot = next((s for s in spans
+                      if s.get("name") == "fleet.worker_submit"), None)
+        trace_leg = {
+            "trace_id": futs[-1].trace_id,
+            "n_spans": len(spans),
+            "span_names": sorted(set(names)),
+            "has_attempt": "fleet.attempt" in names,
+            "has_remote_device_exec": "serving.device_exec" in names,
+            "has_remote_queue_wait": "serving.queue_wait" in names,
+            "remote_instance": ((wroot or {}).get("args") or {}
+                                ).get("instance"),
+            "parents_resolve": all(
+                s.get("parent_id") in by_id for s in spans
+                if s.get("parent_id") is not None)}
+
+        # --- FEDERATE leg ---------------------------------------------
+        metric = "serving_model_requests_total"
+
+        def metric_sum(snap):
+            m = snap.get(metric) or {}
+            return sum(s.get("value") or 0 for s in m.get("series") or ())
+
+        per_member = {wid: metric_sum(_fed.member_snapshot(
+            addr + "/metrics", timeout_s=5.0))
+            for wid, addr in router.endpoints()}
+        fed = router.federated_metrics(timeout_s=5.0)
+        by_inst = {}
+        for s in (fed["metrics"].get(metric) or {}).get("series") or ():
+            inst = s["labels"].get("instance")
+            by_inst[inst] = by_inst.get(inst, 0) + (s.get("value") or 0)
+        fed_leg = {"metric": metric, "per_member": per_member,
+                   "federated_by_instance": by_inst,
+                   "per_member_total": sum(per_member.values()),
+                   "federated_total": sum(by_inst.values()),
+                   "members": {i: m["ok"]
+                               for i, m in fed["members"].items()},
+                   "scrapes": fed["scrapes"]}
+
+        # --- TIMELINE leg ---------------------------------------------
+        merged = _tl.merge(router.timeline_sources(timeout_s=5.0))
+        timeline_leg = {"instances": merged["instances"],
+                        "n_traces": merged["n_traces"]}
+
+        # --- DEAD MEMBER leg ------------------------------------------
+        pid = sup.kill_worker("w0", sig=signal.SIGKILL)
+        deadline = time.perf_counter() + 10
+        while time.perf_counter() < deadline:
+            try:
+                os.kill(pid, 0)
+                time.sleep(0.05)
+            except OSError:
+                break  # the corpse is real; connections now refuse
+        t0 = time.perf_counter()
+        fed2 = router.federated_metrics(timeout_s=2.0)
+        wall = time.perf_counter() - t0
+        dead_leg = {"killed": "w0", "wall_s": round(wall, 2),
+                    "bounded": wall < 10.0,
+                    "members": {i: m["ok"]
+                                for i, m in fed2["members"].items()},
+                    "scrapes": fed2["scrapes"]}
+
+        return {"metric": "cluster_obs", "value": n_requests,
+                "unit": "requests",
+                "vs_baseline": None,  # net-new plane: no reference analog
+                "workers": 2, "hidden": hidden,
+                "trace": trace_leg, "federation": fed_leg,
+                "timeline": timeline_leg, "dead_member": dead_leg,
+                "counters": {"federate_scrape_total":
+                             telemetry.series_map("federate_scrape_total")}}
+    finally:
+        try:
+            if router is not None:
+                router.stop()
+            if sup is not None:
+                sup.stop()
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_continuous():
     """The continuous-learning loop under injected faults (ISSUE 13):
     a REAL runner subprocess trains from a live pubsub stream while the
@@ -1781,7 +1944,8 @@ CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
            "serving": bench_serving, "trace_overhead": bench_trace_overhead,
            "coldstart": bench_coldstart, "zero": bench_zero,
            "kernels": bench_kernels, "fleet": bench_fleet,
-           "continuous": bench_continuous, "hostfleet": bench_hostfleet}
+           "continuous": bench_continuous, "hostfleet": bench_hostfleet,
+           "cluster_obs": bench_cluster_obs}
 DEFAULT_ORDER = ["lenet", "resnet50", "lstm", "word2vec", "parallel",
                  "transformer", "longcontext", "fused", "serving", "zero"]
 

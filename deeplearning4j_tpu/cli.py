@@ -283,16 +283,28 @@ def _build_parser():
                     help="scrape a running server's /traces endpoint "
                          "(e.g. http://127.0.0.1:9000/traces) instead of "
                          "the local ring")
-    tc.add_argument("--file",
-                    help="read traces from a JSON file: a /traces "
-                         "payload, a raw ring snapshot, or a "
-                         "flight-recorder dump (its 'traces' key)")
+    tc.add_argument("--file", action="append", metavar="PATH",
+                    help="read traces from JSON file(s) — a /traces "
+                         "payload, a raw ring snapshot, a flight-recorder "
+                         "dump (its 'traces' key) — or a DIRECTORY of "
+                         "dumps (a dead generation's postmortem). "
+                         "Repeatable; every source merges into one view")
     tc.add_argument("--name",
                     help="only this root-span name (e.g. serving.request)")
     tc.add_argument("--trace-id",
                     help="print the timeline of this trace id (the id a "
                          "/metrics exemplar or BENCH worst_trace_id "
                          "points at)")
+    tc.add_argument("--cluster", action="store_true",
+                    help="merge every source (--file/--url, or the live "
+                         "cluster providers when neither is given) into "
+                         "ONE time-aligned timeline: per-instance trace "
+                         "rows, per-host round clocks, and the stalled "
+                         "host of a dead hostfleet generation")
+    tc.add_argument("--chrome", metavar="PATH",
+                    help="with --cluster: also write the merged timeline "
+                         "as a Chrome trace-event file (chrome://tracing "
+                         "/ Perfetto)")
     tc.add_argument("--json", action="store_true",
                     help="raw JSON passthrough instead of the timeline")
 
@@ -991,14 +1003,17 @@ def _load_trace_rings(args):
     """{root name: [trace docs]} from --file / --url / the local ring.
     Accepts the three shapes traces travel in: a /traces payload
     ({"traces": {...}}), a raw ring snapshot ({name: [...]}), or a
-    flight-recorder dump carrying a "traces" key."""
+    flight-recorder dump carrying a "traces" key. ``--file`` repeats and
+    accepts directories of dumps; every source's rings merge."""
     import json
 
     if args.file:
-        with open(args.file) as f:
-            doc = json.load(f)
-        rings = doc.get("traces", doc) if isinstance(doc, dict) else {}
-        return {k: v for k, v in rings.items() if isinstance(v, list)}
+        from deeplearning4j_tpu.telemetry import timeline as _tl
+        rings = {}
+        for src in _tl.load_paths(args.file):
+            for name, docs in src["rings"].items():
+                rings.setdefault(name, []).extend(docs)
+        return rings
     if args.url:
         import urllib.request
         with urllib.request.urlopen(args.url, timeout=10) as r:
@@ -1053,11 +1068,65 @@ def _print_trace_timeline(doc):
         print(line)
 
 
+def _cmd_traces_cluster(args):
+    """``traces --cluster``: one time-aligned timeline over every source
+    — a directory of a dead generation's dumps, multiple --file scrapes,
+    or the live cluster providers — ending with the per-host round
+    clocks and the stalled host (the postmortem's first question)."""
+    import json
+
+    from deeplearning4j_tpu.telemetry import timeline as _tl
+
+    if args.file:
+        merged = _tl.merge(_tl.load_paths(args.file))
+    elif args.url:
+        import urllib.request
+        with urllib.request.urlopen(args.url, timeout=10) as r:
+            doc = json.loads(r.read().decode())
+        src = _tl._source_from_doc(doc, args.url)
+        merged = _tl.merge([src] if src is not None else [])
+    else:
+        merged = _tl.cluster_snapshot()
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(_tl.to_chrome(merged), f)
+        print(f"chrome trace written to {args.chrome}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(merged, indent=1, default=str))
+        return 0
+    print(f"cluster timeline: {merged['n_traces']} trace(s) across "
+          f"{len(merged['instances'])} instance(s)")
+    base = merged.get("t0_unix")
+    for t in merged["traces"]:
+        if args.name and t["name"] != args.name:
+            continue
+        rel = ("?" if (t["t0_unix"] is None or base is None)
+               else f"{t['t0_unix'] - base:+.3f}s")
+        dur = t.get("duration_s")
+        dtxt = "?" if dur is None else f"{1e3 * dur:.3f} ms"
+        line = f"  {rel:>10}  {t['instance']}  {t['name']}  {dtxt}"
+        if t.get("status") not in (None, "ok"):
+            line += f" [{t['status']}]"
+        print(line)
+    if merged["hosts"]:
+        print()
+        for inst in sorted(merged["hosts"]):
+            h = merged["hosts"][inst]
+            print(f"host {inst}: last round {h['last_round']}")
+        if merged.get("stalled") is not None:
+            h = merged["hosts"][merged["stalled"]]
+            print(f"stalled: {merged['stalled']} — round clock stopped "
+                  f"at round {h['last_round']} while peers advanced")
+    return 0
+
+
 def _cmd_traces(args):
     """The gauge->exemplar->timeline landing: `traces --trace-id <id>`
     renders the causal story a p99 exemplar points at."""
     import json
 
+    if args.cluster:
+        return _cmd_traces_cluster(args)
     rings = _load_trace_rings(args)
     if args.name:
         rings = {args.name: rings.get(args.name, [])}

@@ -393,6 +393,7 @@ class StepDriver:
         self._src = None     # persistent fused source (owns a prefetcher)
         self._it = None      # current epoch iterator
         self._tctx = None    # last dispatch's trace (exception cleanup)
+        self.profile = None  # armed ProfileSchedule (profile_round)
         self.last_score = None
 
     # -- epoch plumbing -------------------------------------------------
@@ -437,12 +438,33 @@ class StepDriver:
 
     # -- rounds ---------------------------------------------------------
 
+    def profile_round(self, rounds_from_now, logdir, force=None):
+        """Arm a windowed ``jax.profiler`` capture around the n-th future
+        :meth:`run_round` (``rounds_from_now=1`` is the next one): exactly
+        that round runs inside a profiler session writing to ``logdir``.
+        Guarded no-op off-TPU (telemetry/profiling.py) — the idle cost is
+        one attribute check per round, and the PR 8 span annotations only
+        land on the device timeline while the window is open."""
+        from deeplearning4j_tpu.telemetry import profiling as _profiling
+        if self.profile is None:
+            self.profile = _profiling.ProfileSchedule()
+        self.profile.arm(rounds_from_now, logdir, force=force)
+        return self.profile
+
     def run_round(self, k_dispatches=None):
         """Consume up to ``k_dispatches`` dispatches from the current
         epoch (starting one if none is open; ``None`` = run to epoch
         end). Returns a :class:`RoundResult`; the score pipeline and
         health monitor may each hold one pending entry afterwards — call
-        :meth:`sync` (or :meth:`checkpoint`) to resolve them."""
+        :meth:`sync` (or :meth:`checkpoint`) to resolve them. An armed
+        :meth:`profile_round` schedule brackets exactly its round in a
+        profiler window."""
+        if self.profile is not None and self.profile.armed:
+            with self.profile.window():
+                return self._run_round(k_dispatches)
+        return self._run_round(k_dispatches)
+
+    def _run_round(self, k_dispatches=None):
         if self._it is None:
             self.start_epoch()
         rr = RoundResult()

@@ -152,7 +152,9 @@ def main(argv=None):
                 time.sleep(args.round_sleep_s)
         trainer.on_round = on_round
 
-    _emit({"continuous_ready": True, "pid": os.getpid()})
+    from deeplearning4j_tpu.telemetry import timeline as _timeline
+    _emit({"continuous_ready": True, "pid": os.getpid(),
+           "clock": _timeline.clock_pair()})
     try:
         summary = trainer.run(max_rounds=args.max_rounds,
                               until_steps=args.until_steps)

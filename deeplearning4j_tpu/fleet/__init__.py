@@ -59,12 +59,37 @@ _front = {"router": None, "supervisor": None}
 def set_default_front(router=None, supervisor=None):
     """Register the process-default fleet front — the router/supervisor
     pair the UIServer's ``/fleet`` endpoint reports on (the ``fleet``
-    CLI verb calls this)."""
+    CLI verb calls this). Registering a router also plugs the fleet into
+    the cluster observability plane: its workers become federated
+    ``/metrics?federate=1`` targets and ``/traces?cluster=1`` timeline
+    sources."""
+    from deeplearning4j_tpu.telemetry import federate as _federate
+    from deeplearning4j_tpu.telemetry import timeline as _timeline
     with _front_lock:
         if router is not None:
             _front["router"] = router
         if supervisor is not None:
             _front["supervisor"] = supervisor
+    if router is not None:
+        _federate.register_target_provider(_front_metric_targets)
+        _timeline.register_source_provider(_front_timeline_sources)
+
+
+def _front_metric_targets():
+    """Federation targets of the default front's workers."""
+    router, _sup = get_default_front()
+    if router is None:
+        return []
+    return [(wid, addr + "/metrics") for wid, addr in router.endpoints()]
+
+
+def _front_timeline_sources():
+    """Cluster-timeline sources of the default front (the router's own
+    ring is the UIServer process's 'local' source already)."""
+    router, _sup = get_default_front()
+    if router is None:
+        return []
+    return router.timeline_sources(include_local=False)
 
 
 def get_default_front():
@@ -77,9 +102,13 @@ def get_default_front():
 def reset():
     """Drop the process-default front (tests). Does NOT stop the router
     or supervisor — ownership stays with whoever built them."""
+    from deeplearning4j_tpu.telemetry import federate as _federate
+    from deeplearning4j_tpu.telemetry import timeline as _timeline
     with _front_lock:
         _front["router"] = None
         _front["supervisor"] = None
+    _federate.unregister_target_provider(_front_metric_targets)
+    _timeline.unregister_source_provider(_front_timeline_sources)
 
 
 def fleet_status(probe=False):

@@ -29,6 +29,7 @@ worker ids, so per-worker metric labels stay bounded across respawns.
 from __future__ import annotations
 
 import json
+import os
 import queue
 import threading
 import time
@@ -38,10 +39,14 @@ import urllib.request
 import numpy as np
 
 from deeplearning4j_tpu import telemetry as _tm
+from deeplearning4j_tpu.fleet.worker import (PARENT_SPAN_HEADER,
+                                             TRACE_ID_HEADER)
 from deeplearning4j_tpu.serving.engine import (InferenceFuture,
                                                ServingOverloaded,
                                                ServingShutdown, _as_input,
                                                _overloaded)
+from deeplearning4j_tpu.telemetry import timeline as _timeline
+from deeplearning4j_tpu.telemetry import tracectx as _tracectx
 
 
 class _Worker:
@@ -68,16 +73,18 @@ class _Worker:
                 "last_error": self.last_error}
 
 
-def _http_json(url, payload=None, timeout=10.0):
+def _http_json(url, payload=None, timeout=10.0, headers=None):
     """One JSON round trip. Returns (status_code, doc); raises OSError
     family (URLError / ConnectionError / timeout) when the worker is
-    unreachable — the caller's failover signal."""
+    unreachable — the caller's failover signal. ``headers``: extra
+    request headers (the trace-propagation pair rides here)."""
     if payload is None:
-        req = urllib.request.Request(url)
+        req = urllib.request.Request(url, headers=dict(headers or {}))
     else:
         req = urllib.request.Request(
             url, data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json",
+                     **(headers or {})})
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.status, json.loads(r.read().decode())
@@ -267,6 +274,12 @@ class FleetRouter:
             item = _tree_map(lambda a: a[None], item)
         rows = 1 if nrows is None else nrows
         fut = InferenceFuture()
+        # the fleet-level causal trace roots HERE: dispatch attempts and
+        # the worker-side device spans (grafted from the /submit response)
+        # all hang under this one trace id. Tracing off: None, a branch.
+        tctx = _tracectx.maybe_start("fleet.request", model=self.name)
+        if tctx is not None:
+            fut.trace_id = tctx.trace_id
         now = time.perf_counter()
         if deadline_s is None:
             deadline_s = self.default_deadline_s
@@ -285,10 +298,15 @@ class FleetRouter:
             if self._reg.enabled:
                 self._m_shed.inc(model=self.name, reason="queue_full")
                 self._m_requests.inc(outcome="shed_queue_full")
+            if tctx is not None:
+                tctx.add_span("fleet.shed", now, time.perf_counter(),
+                              reason="queue_full")
+                tctx.finish(status="shed")
             raise _overloaded(
                 f"fleet {self.name!r}: admission queue full "
                 f"({self.max_queue} pending)", "queue_full")
-        self._queue.put((item, fut, now, deadline, nrows))
+        self._queue.put((item, fut, now, deadline,
+                         None if tctx is None else tctx.handoff(), nrows))
         if self._stop.is_set():
             # raced stop(): its drain may already be done — fail
             # stragglers rather than hang their waiters
@@ -307,7 +325,7 @@ class FleetRouter:
     def _take(self, block=True, timeout=None):
         item = self._queue.get(block=block, timeout=timeout)
         with self._lock:
-            self._pending_rows -= item[4] or 1
+            self._pending_rows -= item[5] or 1
         return item
 
     def _drain(self):
@@ -319,7 +337,7 @@ class FleetRouter:
         cap = min(self.max_dispatch_rows, self.max_inflight_rows)
 
         def rows(b):
-            return sum(it[4] or 1 for it in b)
+            return sum(it[5] or 1 for it in b)
         try:
             batch = [self._take(timeout=0.05)]
         except queue.Empty:
@@ -344,7 +362,13 @@ class FleetRouter:
         """Terminal counted shed for a batch of entries — the 'never
         silently dropped' contract's third leg."""
         err = _overloaded(exc_msg, reason)
-        for _x, fut, _t, _dl, _n in entries:
+        now = time.perf_counter()
+        for _x, fut, _t, _dl, tctx, _n in entries:
+            if tctx is not None:
+                # close the trace BEFORE waking the waiter: a shed is a
+                # terminal outcome worth ringing (the overload p99 story)
+                tctx.add_span("fleet.shed", now, now, reason=reason)
+                tctx.finish(status="shed")
             if not fut.done():
                 fut._set_error(err)
         n = len(entries)
@@ -406,7 +430,7 @@ class FleetRouter:
             now = time.perf_counter()
             live = []
             for entry in batch:
-                _x, fut, t_sub, deadline, _n = entry
+                _x, fut, t_sub, deadline, _tc, _n = entry
                 if deadline is not None and now > deadline:
                     self._shed([entry], "deadline",
                                f"fleet {self.name!r}: deadline exceeded "
@@ -424,7 +448,7 @@ class FleetRouter:
             # alone via _pick_worker's idle exception)
             chunk, chunk_rows = [], 0
             for entry in live:
-                r = entry[4] or 1
+                r = entry[5] or 1
                 if chunk and chunk_rows + r > self.max_inflight_rows:
                     self._dispatch(chunk)
                     chunk, chunk_rows = [], 0
@@ -433,15 +457,39 @@ class FleetRouter:
             if chunk:
                 self._dispatch(chunk)
 
+    def _note_attempt(self, entries, wid, attempt, outcome, t0,
+                      graft_doc=None, offset_s=0.0, **args):
+        """Stamp one dispatch attempt as a child span on EVERY member
+        trace — retries/failovers ride the SAME trace as numbered
+        attempt spans, and a 200's worker-side trace doc grafts in under
+        its attempt, giving the ring one admission→dispatch→worker-device
+        →resolve story per request."""
+        t1 = time.perf_counter()
+        for _x, _f, _t, _dl, tctx, _n in entries:
+            if tctx is None:
+                continue
+            span = tctx.add_span("fleet.attempt", t0, t1, worker=wid,
+                                 attempt=attempt, outcome=outcome, **args)
+            if graft_doc is not None:
+                tctx.trace.graft(graft_doc, span["span_id"],
+                                 offset_s=offset_s, instance=wid)
+
     def _dispatch(self, entries):
         """Ship one assembled batch, retrying across workers. Exits with
         every entry's future resolved (answer / shed / error)."""
-        rows = sum(e[4] or 1 for e in entries)
+        rows = sum(e[5] or 1 for e in entries)
         xs = _tree_map(lambda *leaves: np.concatenate(leaves),
                        *[e[0] for e in entries])
         # the batch's effective deadline is its EARLIEST member's
         deadlines = [e[3] for e in entries if e[3] is not None]
         deadline = min(deadlines) if deadlines else None
+        t_disp = time.perf_counter()
+        for _x, _f, t_sub, _dl, tctx, _n in entries:
+            if tctx is not None:
+                # fleet-level queue wait, distinct from the worker-side
+                # serving.queue_wait that grafts in after dispatch
+                tctx.add_span("fleet.queue_wait", t_sub, t_disp)
+        attempt = 0
         tried = set()
         t_wait0 = time.perf_counter()
         while True:
@@ -480,6 +528,9 @@ class FleetRouter:
                 # window full / mid-respawn: wait briefly for capacity
                 time.sleep(0.005)
                 continue
+            attempt += 1
+            t_att = time.perf_counter()
+            sent_unix = time.time()
             try:
                 payload = {"rows": _tree_map(lambda a: a.tolist(), xs)}
                 if remaining is not None:
@@ -487,8 +538,16 @@ class FleetRouter:
                 timeout = self.request_timeout_s
                 if remaining is not None:
                     timeout = min(timeout, remaining + 5.0)
+                # ONE trace carrier per wire hop: the worker roots a
+                # single remote-parented trace under the first entry's
+                # identity, and the returned doc grafts into EVERY
+                # member's trace (the batch is one device-side event)
+                lead = entries[0][4]
+                headers = (None if lead is None else
+                           {TRACE_ID_HEADER: lead.trace_id,
+                            PARENT_SPAN_HEADER: str(lead.span_id)})
                 code, doc = _http_json(w.address + "/submit", payload,
-                                       timeout=timeout)
+                                       timeout=timeout, headers=headers)
             except Exception as e:  # noqa: BLE001 — connection failure
                 # the failover leg: worker unreachable mid-request
                 self._release(w, rows)
@@ -498,18 +557,32 @@ class FleetRouter:
                 if self._reg.enabled:
                     self._m_retry.inc(worker=w.wid)
                     self._m_dispatch.inc(worker=w.wid, result="error")
+                self._note_attempt(entries, w.wid, attempt, "error",
+                                   t_att, error=str(e)[:120])
                 continue  # idempotent replay onto the next-best worker
+            recv_unix = time.time()
             self._release(w, rows)
             with self._lock:
                 w.dispatched += 1
             if code == 200:
                 if self._reg.enabled:
                     self._m_dispatch.inc(worker=w.wid, result="ok")
+                # clock offset from THIS round trip (NTP single sample,
+                # clamped to 0 inside the RTT uncertainty) re-anchors the
+                # worker's span timestamps onto our timeline
+                offset_s, _unc = _timeline.estimate_offset(
+                    (doc.get("clock") or {}).get("unix"),
+                    sent_unix, recv_unix)
+                self._note_attempt(entries, w.wid, attempt, "ok", t_att,
+                                   graft_doc=doc.get("trace"),
+                                   offset_s=offset_s)
                 self._resolve(entries, doc)
                 return
             if code == 429:
                 if self._reg.enabled:
                     self._m_dispatch.inc(worker=w.wid, result="shed")
+                self._note_attempt(entries, w.wid, attempt, "shed",
+                                   t_att, reason=doc.get("reason"))
                 if doc.get("reason") == "deadline":
                     # stale everywhere — retrying cannot help
                     self._shed(entries, "deadline",
@@ -535,12 +608,16 @@ class FleetRouter:
                 if self._reg.enabled:
                     self._m_retry.inc(worker=w.wid)
                     self._m_dispatch.inc(worker=w.wid, result="error")
+                self._note_attempt(entries, w.wid, attempt, "shutdown",
+                                   t_att)
                 continue
             # 4xx/5xx: a real error answer — the request itself is bad
             # or the model failed; replaying identical bytes would fail
             # identically, so propagate (counted, never silent)
             if self._reg.enabled:
                 self._m_dispatch.inc(worker=w.wid, result="error")
+            self._note_attempt(entries, w.wid, attempt, "error", t_att,
+                               code=code)
             self._fail_entries(entries, RuntimeError(
                 f"fleet worker {w.wid} answered {code}: "
                 f"{doc.get('error', doc)}"))
@@ -563,26 +640,33 @@ class FleetRouter:
         done = time.perf_counter()
         off = 0
         lats = []
-        for _x, fut, t_sub, _dl, n in entries:
+        for _x, fut, t_sub, _dl, tctx, n in entries:
             width = n or 1
             y = _tree_map(
                 lambda a: (a[off:off + width] if n is not None
                            else a[off]), outputs)
             off += width
-            fut.latency_s = done - t_sub
-            fut._set(y)
             lats.append(done - t_sub)
+            if tctx is not None:
+                tctx.add_span("fleet.resolve", done, time.perf_counter())
+                tctx.finish()
+            fut.latency_s = done - t_sub
+            # resolve LAST: a waiter that wakes here must see a COMPLETE
+            # trace in the ring (same discipline as the engine's worker)
+            fut._set(y)
         # accounting is in REQUESTS (submit calls) everywhere, so
         # submitted == served + shed_* + errors balances for batched
         # submits too; rows ride separately as served_rows
         self._count("served", len(entries))
-        self._count("served_rows", sum(e[4] or 1 for e in entries))
+        self._count("served_rows", sum(e[5] or 1 for e in entries))
         self._note_latencies(lats)
         if self._reg.enabled:
             self._m_requests.inc(len(entries), outcome="served")
 
     def _fail_entries(self, entries, err, count_key="errors"):
-        for _x, fut, _t, _dl, _n in entries:
+        for _x, fut, _t, _dl, tctx, _n in entries:
+            if tctx is not None:
+                tctx.finish(status="error")
             if not fut.done():
                 fut._set_error(err)
         self._count(count_key, len(entries))
@@ -595,9 +679,12 @@ class FleetRouter:
             f"request")
         while True:
             try:
-                _x, fut, _t, _dl, _n = self._take(block=False)
+                _x, fut, _t, _dl, tctx, _n = self._take(block=False)
             except queue.Empty:
                 break
+            if tctx is not None:
+                # never completed its causal story — don't ring it
+                tctx.abandon()
             if not fut.done():
                 fut._set_error(err)
                 self._count("errors")
@@ -662,6 +749,54 @@ class FleetRouter:
                for i, (wid, _addr) in enumerate(eps)}
         alive = sum(1 for doc in out.values() if doc.get("ok"))
         return {"workers": out, "alive": alive, "total": len(out)}
+
+    def federated_metrics(self, timeout_s=None):
+        """One scrape for the whole fleet: every worker's ``/metrics``
+        merged under stable ``instance`` labels (the worker ids the
+        supervisor keeps across respawns). Dead members are counted
+        (``federate_scrape_total{outcome="error"}``), never a hang —
+        the aggregation semantics of telemetry.federate."""
+        from deeplearning4j_tpu.telemetry import federate as _fed
+        targets = [(wid, addr + "/metrics")
+                   for wid, addr in self.endpoints()]
+        return _fed.federate(
+            targets, timeout_s=timeout_s or self.probe_timeout_s)
+
+    def timeline_sources(self, timeout_s=None, include_local=True):
+        """Per-process timeline sources for the cluster merge: this
+        router's own ring plus every worker's ``/traces`` scrape, each
+        worker's clock offset estimated from ITS scrape round trip. A
+        dead worker simply contributes no source (the merge proceeds —
+        its last traces still arrive via flight dumps postmortem)."""
+        timeout = timeout_s or self.probe_timeout_s
+        eps = self.endpoints()
+        slots = [None] * len(eps)
+
+        def scrape(i, wid, addr):
+            sent = time.time()
+            try:
+                _code, doc = _http_json(addr + "/traces", timeout=timeout)
+            except Exception:  # noqa: BLE001 — dead member, no source
+                return
+            off, _unc = _timeline.estimate_offset(
+                (doc.get("clock") or {}).get("unix"), sent, time.time())
+            slots[i] = _timeline.source(wid, doc.get("traces") or {},
+                                        clock_offset_s=off)
+
+        threads = [threading.Thread(target=scrape, args=(i, wid, addr),
+                                    daemon=True)
+                   for i, (wid, addr) in enumerate(eps)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout + 1.0)
+        sources = []
+        if include_local:
+            sources.append(_timeline.source(
+                f"router:pid{os.getpid()}",
+                _tracectx.get_ring().snapshot()))
+        sources.extend(s for s in slots if s is not None)
+        return sources
 
     def latency_percentiles(self):
         with self._lock:

@@ -68,6 +68,11 @@ class _WorkerProc:
         self.ready = threading.Event()
         self.ready_doc = None
         self.ready_at = None  # monotonic time the ready line landed
+        #: the worker's monotonic+epoch clock pair off its ready line and
+        #: the offset (its clock minus ours) estimated at receipt — the
+        #: clock-alignment seed the cluster timeline re-anchors with
+        self.clock = None
+        self.clock_offset_s = 0.0
         self.missed = 0
         self.last_health = None
         self.out_ring = deque(maxlen=50)
@@ -82,6 +87,8 @@ class _WorkerProc:
                 "pid": self.proc.pid, "port": self.port,
                 "alive": self.proc.poll() is None,
                 "missed_probes": self.missed,
+                "clock": self.clock,
+                "clock_offset_s": self.clock_offset_s,
                 "last_health": self.last_health}
 
 
@@ -194,6 +201,18 @@ class FleetSupervisor:
                     if doc.get("fleet_worker_ready"):
                         w.ready_doc = doc
                         w.port = int(doc["port"])
+                        w.clock = doc.get("clock")
+                        if w.clock:
+                            # the stamp happened within the pipe latency
+                            # of now: offset clamps to 0 on a shared
+                            # clock (same host), keeps a real skew
+                            from deeplearning4j_tpu.telemetry import (
+                                timeline as _timeline)
+                            recv = time.time()
+                            w.clock_offset_s, _ = \
+                                _timeline.estimate_offset(
+                                    w.clock.get("unix"), recv - 0.25,
+                                    recv)
                         w.ready.set()
             proc.stdout.close()
 

@@ -45,6 +45,14 @@ import numpy as np
 from deeplearning4j_tpu.serving.engine import (ServingOverloaded,
                                                ServingShutdown,
                                                shed_reason)
+from deeplearning4j_tpu.telemetry import timeline as _timeline
+from deeplearning4j_tpu.telemetry import tracectx as _tracectx
+
+#: the trace-propagation headers the router stamps on /submit (Dapper
+#: style: the worker ADOPTS the router's trace id and parents its root
+#: under the router's attempt span)
+TRACE_ID_HEADER = "X-DL4J-Trace-Id"
+PARENT_SPAN_HEADER = "X-DL4J-Parent-Span"
 
 
 def _tree_to_jsonable(y):
@@ -108,6 +116,21 @@ class FleetWorker:
                     self._json(worker.health())
                 elif self.path.startswith("/stats"):
                     self._json(worker.engine.stats())
+                elif self.path.startswith("/metrics"):
+                    # the federation scrape: full registry snapshot (kind
+                    # + help + series) so the aggregator can re-render
+                    # OpenMetrics with an added instance label, plus the
+                    # clock pair for per-scrape offset estimation
+                    self._json(worker.metrics())
+                elif self.path.startswith("/traces"):
+                    # the timeline scrape: this process's slow-trace ring
+                    # in the flight-dump 'traces' shape timeline.load_file
+                    # and the cluster merge both accept
+                    self._json({"worker_id": worker.worker_id,
+                                "pid": os.getpid(),
+                                "clock": _timeline.clock_pair(),
+                                "traces":
+                                    _tracectx.get_ring().snapshot()})
                 else:
                     self._json({"error": f"unknown path {self.path!r}"},
                                code=404)
@@ -134,33 +157,59 @@ class FleetWorker:
                                code=404)
 
             def _submit(self, doc):
+                # wire-propagated tracing: adopt the router's trace id so
+                # the device-side spans (queue_wait, device_exec, ...)
+                # land on ONE trace spanning both processes; the doc rides
+                # the response for the router to graft into its ring
+                rctx = _tracectx.maybe_start_remote(
+                    "fleet.worker_submit",
+                    self.headers.get(TRACE_ID_HEADER),
+                    self.headers.get(PARENT_SPAN_HEADER),
+                    worker=worker.worker_id)
                 try:
                     rows = _rows_from_json(doc["rows"])
                     deadline_ms = doc.get("deadline_ms")
                     fut = worker.engine.submit(
                         rows, batched=True,
                         deadline_s=(None if deadline_ms is None
-                                    else deadline_ms / 1e3))
+                                    else deadline_ms / 1e3),
+                        tctx=rctx)
                     y = fut.get(timeout=doc.get("timeout_s", 60))
-                    self._json({"outputs": _tree_to_jsonable(y),
-                                "worker_id": worker.worker_id,
-                                "latency_ms": (
-                                    None if fut.latency_s is None
-                                    else round(1e3 * fut.latency_s, 3))})
+                    resp = {"outputs": _tree_to_jsonable(y),
+                            "worker_id": worker.worker_id,
+                            "latency_ms": (
+                                None if fut.latency_s is None
+                                else round(1e3 * fut.latency_s, 3))}
+                    if rctx is not None:
+                        # the engine finished the trace BEFORE resolving
+                        # the future, so the doc here is complete; the
+                        # clock pair lets the router align our timestamps
+                        resp["trace"] = rctx.trace.to_doc()
+                        resp["clock"] = _timeline.clock_pair()
+                    self._json(resp)
                 except ServingOverloaded as e:
                     # shed, not error: the front retries or counts it
                     # (structured reason — never sniffed from message
                     # text, which embeds the free-form model name)
+                    if rctx is not None:
+                        rctx.finish(status="shed")  # idempotent: the
+                        #   engine already closed admission/deadline sheds
                     self._json({"error": "shed",
                                 "reason": shed_reason(e) or "queue_full",
                                 "worker_id": worker.worker_id}, code=429)
                 except ServingShutdown as e:
+                    if rctx is not None:
+                        rctx.abandon()
                     self._json({"error": "shutdown", "detail": str(e),
                                 "worker_id": worker.worker_id}, code=503)
                 except (KeyError, ValueError, TypeError) as e:
+                    if rctx is not None:
+                        rctx.finish(status="error")
                     self._json({"error": f"bad submit: {e}",
                                 "worker_id": worker.worker_id}, code=400)
                 except Exception as e:  # noqa: BLE001 — wire boundary
+                    if rctx is not None:
+                        rctx.finish(status="error")
                     self._json({"error": f"{type(e).__name__}: {e}",
                                 "worker_id": worker.worker_id}, code=500)
 
@@ -217,6 +266,15 @@ class FleetWorker:
                     "swaps": self._swaps,
                     "aot": self.engine.stats()["aot"]}
 
+    def metrics(self):
+        """The /metrics payload the ``federate()`` aggregator scrapes:
+        the full registry snapshot (kind/help/series — a superset of the
+        ``series_map`` wire form) plus this process's clock pair."""
+        from deeplearning4j_tpu.telemetry import get_registry
+        return {"worker_id": self.worker_id, "pid": os.getpid(),
+                "clock": _timeline.clock_pair(),
+                "metrics": get_registry().snapshot()}
+
     def health(self):
         """The /health payload: liveness + the engine's export hook
         (stats, compile-cache events, recompile counters) — what the
@@ -237,7 +295,11 @@ class FleetWorker:
                 "pid": os.getpid(), "port": self.port,
                 "model": self.engine.name, "buckets": stats["buckets"],
                 "warmup_s": stats["warmup_s"], "aot": stats["aot"],
-                "compile_cache_events": _cc.event_counts()}
+                "compile_cache_events": _cc.event_counts(),
+                # clock-alignment seed: the spawner pairs this with its
+                # receipt time to place this process on the cluster
+                # timeline (ISSUE 16)
+                "clock": _timeline.clock_pair()}
 
 
 def _build_parser():

@@ -46,6 +46,9 @@ from collections import deque
 from deeplearning4j_tpu import telemetry as _tm
 from deeplearning4j_tpu.fleet.supervisor import default_worker_env
 from deeplearning4j_tpu.hostfleet.exchange import ExchangeServer
+from deeplearning4j_tpu.telemetry import federate as _federate
+from deeplearning4j_tpu.telemetry import timeline as _timeline
+from deeplearning4j_tpu.telemetry import tracectx as _tracectx
 
 __all__ = ["TrainingFleetSupervisor"]
 
@@ -73,13 +76,32 @@ class _HostProc:
         self.last_round = -1
         self.out_ring = deque(maxlen=80)
         self.err_ring = deque(maxlen=80)
+        # cluster-observability state: the ready line's clock pair seeds
+        # this host's clock-offset estimate; hostfleet.round trace docs
+        # ride the round lines into this ring (the postmortem source)
+        self.clock = None
+        self.clock_offset_s = 0.0
+        self.round_traces = deque(maxlen=16)
 
     def snapshot(self):
         return {"host": self.idx, "generation": self.generation,
                 "pid": self.proc.pid, "alive": self.proc.poll() is None,
                 "ready": self.ready.is_set(), "last_round": self.last_round,
                 "done": self.done_doc is not None,
-                "error": self.error_doc}
+                "error": self.error_doc,
+                "clock_offset_s": self.clock_offset_s}
+
+    def timeline_source(self):
+        """This host's traces as a cluster-timeline source (None while
+        it has produced no round traces)."""
+        if not self.round_traces:
+            return None
+        return _timeline.source(
+            f"gen{self.generation}:host{self.idx}",
+            {"hostfleet.round": list(self.round_traces)},
+            clock_offset_s=self.clock_offset_s,
+            meta={"host": self.idx, "generation": self.generation,
+                  "pid": self.proc.pid})
 
 
 class _Generation:
@@ -266,9 +288,27 @@ class TrainingFleetSupervisor:
                 continue
             if doc.get("hostfleet_ready"):
                 p.ready_doc = doc
+                clk = doc.get("clock")
+                if isinstance(clk, dict) and clk.get("unix") is not None:
+                    # the pair was stamped within pipe latency of this
+                    # read — bound the sample by a pessimistic window;
+                    # same-host clocks clamp to offset 0 inside it
+                    recv = time.time()
+                    p.clock = clk
+                    p.clock_offset_s, _ = _timeline.estimate_offset(
+                        clk["unix"], recv - 0.25, recv)
                 p.ready.set()
             elif "round" in doc and "snapshot" not in doc:
                 p.last_round = max(p.last_round, int(doc["round"]))
+                tr = doc.get("trace")
+                if isinstance(tr, dict):
+                    # the round's hostfleet.round trace rides the line:
+                    # keep it for the postmortem timeline and offer it to
+                    # the local ring so /traces (and the merged cluster
+                    # view) shows which host stalled a generation
+                    p.round_traces.append(tr)
+                    if self._reg.enabled:
+                        _tracectx.get_ring().offer(tr)
             elif "snapshot" in doc:
                 with self._lock:
                     self._last_snapshot_round = max(
@@ -307,6 +347,12 @@ class TrainingFleetSupervisor:
 
     def start(self):
         os.makedirs(self.workdir, exist_ok=True)
+        # plug this job into the cluster observability plane: member
+        # counters federate into /metrics?federate=1, member round
+        # traces into /traces?cluster=1 (bound methods compare equal,
+        # so re-registration stays idempotent)
+        _federate.register_target_provider(self.federate_targets)
+        _timeline.register_source_provider(self.timeline_sources)
         gen = self._spawn_generation(self.n_hosts,
                                      resume=os.path.exists(self.bundle))
         with self._lock:
@@ -316,6 +362,30 @@ class TrainingFleetSupervisor:
                                          daemon=True)
         self._monitor.start()
         return self
+
+    def federate_targets(self):
+        """Hostfleet members run no HTTP server — their counters arrive
+        on done lines in the ``series_map`` wire form; re-shape those
+        into registry snapshots for the federated scrape (a host that
+        has not finished yet simply contributes no target)."""
+        with self._lock:
+            gen = self._gen
+        targets = []
+        for p in (gen.procs if gen is not None else []):
+            counters = (p.done_doc or {}).get("counters")
+            if counters:
+                targets.append(
+                    (f"gen{p.generation}:host{p.idx}",
+                     _federate.snapshot_from_series_maps(counters)))
+        return targets
+
+    def timeline_sources(self):
+        """Cluster-timeline sources for the live generation's hosts."""
+        with self._lock:
+            gen = self._gen
+        return [s for s in (p.timeline_source()
+                            for p in (gen.procs if gen is not None else []))
+                if s is not None]
 
     def _monitor_loop(self):
         while not self._stop.wait(timeout=self.poll_interval_s):
@@ -400,6 +470,7 @@ class TrainingFleetSupervisor:
         if self._reg.enabled:
             self._g_alive.set(alive)
         self._teardown(gen)
+        postmortem = self._dump_postmortem(gen, detail)
         with self._lock:
             snapshot_round = self._last_snapshot_round
         resumable = os.path.exists(self.bundle)
@@ -414,7 +485,8 @@ class TrainingFleetSupervisor:
                  "reason": reason, "detail": detail,
                  "rounds_completed": gen.max_round() + 1,
                  "resumed_from_round": snapshot_round + 1,
-                 "rollback_rounds": lost, "resumable": resumable}
+                 "rollback_rounds": lost, "resumable": resumable,
+                 "postmortem": postmortem}
         if resumable:
             # preserve the exact restore artifact for reference legs /
             # postmortems (the live bundle keeps moving after resume)
@@ -441,6 +513,33 @@ class TrainingFleetSupervisor:
         with self._lock:
             self._gen = fresh
         return True
+
+    def _dump_postmortem(self, gen, detail):
+        """Write each host's round traces + clock offset to
+        ``<workdir>/postmortem_gen<N>/host<i>.json`` — the directory
+        ``traces --cluster`` merges to identify the dead host's last
+        round after the generation's processes are gone. Best-effort:
+        a failed write never blocks the re-form."""
+        pm_dir = os.path.join(self.workdir, f"postmortem_gen{gen.gen_id}")
+        wrote = False
+        for p in gen.procs:
+            if not p.round_traces:
+                continue
+            doc = {"reason": detail, "host": p.idx,
+                   "generation": gen.gen_id, "pid": p.proc.pid,
+                   "instance": f"gen{gen.gen_id}:host{p.idx}",
+                   "clock": p.clock, "clock_offset_s": p.clock_offset_s,
+                   "dumped_at": time.time(),
+                   "traces": {"hostfleet.round": list(p.round_traces)}}
+            try:
+                os.makedirs(pm_dir, exist_ok=True)
+                with open(os.path.join(pm_dir, f"host{p.idx}.json"),
+                          "w") as f:
+                    json.dump(doc, f)
+                wrote = True
+            except OSError:
+                continue
+        return pm_dir if wrote else None
 
     def _fail(self, msg):
         with self._lock:
@@ -541,6 +640,8 @@ class TrainingFleetSupervisor:
 
     def stop(self):
         self._stop.set()
+        _federate.unregister_target_provider(self.federate_targets)
+        _timeline.unregister_source_provider(self.timeline_sources)
         if self._monitor is not None:
             self._monitor.join(timeout=10)
             self._monitor = None

@@ -10,9 +10,14 @@ sharded update over this host's local device mesh and a cross-host
 exchange at every round boundary. Line protocol on stdout (the
 supervisor's contract):
 
-* ready: ``{"hostfleet_ready": true, "process": i, "generation": g, ...}``
-* round: ``{"round": r, "iteration": n, "process": i}`` after each
-  completed round (exchange + heartbeat + snapshot done);
+* ready: ``{"hostfleet_ready": true, "process": i, "generation": g,
+  "clock": {mono, unix}, ...}`` — the clock pair seeds the supervisor's
+  per-host clock-offset estimate (cluster timeline alignment);
+* round: ``{"round": r, "iteration": n, "process": i, "trace": doc}``
+  after each completed round (exchange + heartbeat + snapshot done) —
+  the ``hostfleet.round`` trace doc (steps/exchange/heartbeat/checkpoint
+  child spans) rides the line so the supervisor's ring shows which host
+  stalled a generation;
 * snapshot (process 0): ``{"snapshot": path, "round": r}``;
 * done:  ``{"hostfleet_done": true, "digest": ..., "counters": ...}`` —
   digests are ``continuous.chaos.state_digest``, so the harness asserts
@@ -157,9 +162,18 @@ def main(argv=None):
                    help="process 0: hot-swap an in-process ModelRegistry "
                         "from every published snapshot (the snapshot -> "
                         "serving handoff, measured post-recovery)")
+    p.add_argument("--profile-round", type=int, default=None,
+                   help="capture a jax.profiler window around exactly the "
+                        "n-th round this process runs (1 = the first; "
+                        "no-op off-TPU unless DL4J_TPU_PROFILE_FORCE=1)")
+    p.add_argument("--profile-dir", default=None,
+                   help="xprof logdir root for --profile-round (default "
+                        "<heartbeat-dir>/profile/host<i>)")
     args = p.parse_args(argv)
 
     from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.telemetry import timeline as _timeline
+    from deeplearning4j_tpu.telemetry import tracectx as _tracectx
     telemetry.enable()
 
     from deeplearning4j_tpu.parallel.distributed import (
@@ -253,6 +267,11 @@ def main(argv=None):
     driver = StepDriver(trainer, factory,
                         engine=_ShardedPlainEngine(trainer),
                         instrumented=False)
+    if args.profile_round is not None:
+        driver.profile_round(
+            args.profile_round,
+            args.profile_dir or os.path.join(args.heartbeat_dir,
+                                             "profile", f"host{me}"))
 
     registry = None
     serve_update = None
@@ -281,18 +300,30 @@ def main(argv=None):
            "mode": mode, "resumed": bool(args.resume),
            "start_round": start_round,
            "local_devices": len(jax.local_devices()),
-           "layout": trainer.layout})
+           "layout": trainer.layout,
+           "clock": _timeline.clock_pair()})
 
     cache_sizes = []
     try:
         for rnd in range(start_round, args.total_rounds):
+            # one causal trace per round: steps/exchange/heartbeat/
+            # checkpoint as child spans, the doc riding the round line —
+            # the supervisor's merged timeline shows which host stalled
+            tctx = _tracectx.maybe_start("hostfleet.round", round=rnd,
+                                         process=me,
+                                         generation=args.generation)
+            t_steps = time.perf_counter()
             driver.run_round(D)
             driver.sync()
+            if tctx is not None:
+                tctx.add_span("hostfleet.steps", t_steps,
+                              time.perf_counter(), dispatches=D)
             if args.round_sleep_s:
                 time.sleep(args.round_sleep_s)
             # only hosts with a consumer pay the device->host transfer:
             # the exchange (hostavg) or the bundle write (process 0);
             # gspmd peers still dispatch the replicating collective
+            t_exch = time.perf_counter()
             host_net = host_sync(fetch=(client is not None or me == 0))
             if client is not None:
                 leaves, treedef = _host_tree(host_net)
@@ -305,21 +336,36 @@ def main(argv=None):
                 # identical shapes/shardings, so the cached jitted step
                 # re-dispatches with ZERO recompiles (gated below)
                 trainer.adopt_net_state()
+            if tctx is not None:
+                tctx.add_span("hostfleet.exchange", t_exch,
+                              time.perf_counter(), mode=mode)
             if trainer._step_fn is not None:
                 cache_sizes.append(trainer._step_fn._cache_size())
+            t_hb = time.perf_counter()
             _atomic_write(hb_path, json.dumps(
                 {"round": rnd, "iteration": int(trainer.iteration),
                  "ts": time.time()}))
+            if tctx is not None:
+                tctx.add_span("hostfleet.heartbeat", t_hb,
+                              time.perf_counter())
             if me == 0:
+                t_ck = time.perf_counter()
                 tmp = args.bundle + ".tmp"
                 save_bundle(host_net, tmp)
                 os.replace(tmp, args.bundle)  # a resume never sees a
                 #                               half-written bundle
+                if tctx is not None:
+                    tctx.add_span("hostfleet.checkpoint", t_ck,
+                                  time.perf_counter())
                 _emit({"snapshot": args.bundle, "round": rnd})
                 if serve_update is not None:
                     serve_update(args.bundle)
-            _emit({"round": rnd, "iteration": int(trainer.iteration),
-                   "process": me})
+            line = {"round": rnd, "iteration": int(trainer.iteration),
+                    "process": me}
+            if tctx is not None:
+                tctx.finish()
+                line["trace"] = tctx.trace.to_doc()
+            _emit(line)
     except ExchangeError as e:
         _emit({"hostfleet_error": str(e)[:500], "stage": "exchange",
                "process": me, "generation": args.generation})

@@ -617,6 +617,19 @@ class ParallelTrainer:
         self.iteration += 1
         return loss
 
+    def profile_round(self, rounds_from_now, logdir, force=None):
+        """Arm a windowed ``jax.profiler`` capture around the n-th future
+        fit round (one epoch of the driver loop; ``rounds_from_now=1`` is
+        the next). No-op off-TPU — see telemetry/profiling.py and
+        PROFILE.md. The armed schedule is handed to the StepDriver the
+        next :meth:`fit` builds."""
+        from deeplearning4j_tpu.telemetry import profiling as _profiling
+        sched = getattr(self, "_profile_schedule", None)
+        if sched is None:
+            sched = self._profile_schedule = _profiling.ProfileSchedule()
+        sched.arm(rounds_from_now, logdir, force=force)
+        return sched
+
     def fit(self, x, y=None, *, epochs=1, batch_size=None, mask=None,
             steps_per_dispatch=1):
         """Train on arrays, an (x, y) pair, OR any DataSetIterator (the
@@ -663,6 +676,7 @@ class ParallelTrainer:
         drv = StepDriver(self, lambda: iter_batches(x, y, batch_size, mask),
                          engine=_ShardedPlainEngine(self),
                          instrumented=False)
+        drv.profile = getattr(self, "_profile_schedule", None)
         self._run_epochs(drv, epochs, data_size)
         if self.examples_dropped:
             warnings.warn(f"ParallelTrainer.fit dropped "
@@ -754,6 +768,7 @@ class ParallelTrainer:
         eng.batch_size = batch_size
         drv = StepDriver(self, lambda: iter_batches(x, y, batch_size, mask),
                          engine=eng, instrumented=False)
+        drv.profile = getattr(self, "_profile_schedule", None)
         try:
             self._run_epochs(drv, epochs, data_size)
         finally:

@@ -696,7 +696,7 @@ class ServingEngine:
             self._m_requests.inc(n, model=self.name, outcome="served_direct")
         return out
 
-    def submit(self, x, deadline_s=None, *, batched=False):
+    def submit(self, x, deadline_s=None, *, batched=False, tctx=None):
         """Queue ONE example (or, with ``batched=True``, one MULTI-example
         batch — leading axis = examples); returns ONE
         :class:`InferenceFuture`. A batched future resolves to the stacked
@@ -711,6 +711,11 @@ class ServingEngine:
         (``ServingOverloaded``, counted per model) rather than letting the
         backlog grow without bound; ``deadline_s`` (or the engine default)
         sheds it later if it goes stale while queued.
+
+        ``tctx``: an already-rooted TraceContext to adopt instead of
+        starting a fresh ``serving.request`` — the fleet worker passes
+        its remote-parented context here so the device-side spans land
+        on the ROUTER's trace (wire-propagated tracing).
         """
         if self._stop.is_set():
             raise ServingShutdown(
@@ -719,7 +724,8 @@ class ServingEngine:
         # the request's causal trace starts HERE: the root span is the
         # submit->resolve window, and the drain thread attaches via the
         # handoff carried in the queue tuple. Tracing off: None, a branch.
-        tctx = _tracectx.maybe_start("serving.request", model=self.name)
+        if tctx is None:
+            tctx = _tracectx.maybe_start("serving.request", model=self.name)
         if tctx is not None:
             fut.trace_id = tctx.trace_id
         now = time.perf_counter()
@@ -865,10 +871,6 @@ class ServingEngine:
                     # stale request: shed it instead of spending a forward
                     # on an answer nobody is waiting for (deadline-aware
                     # load shedding)
-                    fut._set_error(_overloaded(
-                        f"model {self.name!r}: deadline exceeded while "
-                        f"queued ({1e3 * (now - t_sub):.1f} ms)",
-                        "deadline"))
                     self._count("shed_deadline")
                     if self._reg.enabled:
                         self._m_shed.inc(model=self.name, reason="deadline")
@@ -879,6 +881,13 @@ class ServingEngine:
                         tctx.add_span("serving.shed", now, now,
                                       reason="deadline")
                         tctx.finish(status="shed")
+                    # error LAST: a waiter that wakes on the future must
+                    # see a COMPLETE trace (the fleet worker ships the
+                    # doc back on the wire right after fut.get())
+                    fut._set_error(_overloaded(
+                        f"model {self.name!r}: deadline exceeded while "
+                        f"queued ({1e3 * (now - t_sub):.1f} ms)",
+                        "deadline"))
                     continue
                 live.append(item)
             if self._reg.enabled:
@@ -918,8 +927,6 @@ class ServingEngine:
                         lambda a: (a[off:off + width] if n is not None
                                    else a[off]), ys)
                     off += width
-                    fut.latency_s = done - t_sub
-                    fut._set(y)
                     lats.append(done - t_sub)
                     ctxs.append(tctx)
                     if tctx is not None:
@@ -929,14 +936,19 @@ class ServingEngine:
                         tctx.add_span("serving.resolve", done,
                                       time.perf_counter())
                         tctx.finish()
+                    fut.latency_s = done - t_sub
+                    # resolve LAST: a waiter that wakes here must see a
+                    # COMPLETE trace (the fleet worker reads the doc and
+                    # ships it back on the wire right after fut.get())
+                    fut._set(y)
                 self._count("served", n_rows)
                 self._note_latencies(lats, outcome="served", ctxs=ctxs)
             except Exception as e:  # noqa: BLE001 — propagate to waiters
                 for _, fut, _t, _dl, tctx, _n in live:
-                    if not fut.done():
-                        fut._set_error(e)
                     if tctx is not None:
                         tctx.finish(status="error")
+                    if not fut.done():
+                        fut._set_error(e)
                 self._count("errors", len(live))
                 if self._reg.enabled:
                     self._m_requests.inc(len(live), model=self.name,

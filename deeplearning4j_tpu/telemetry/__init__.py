@@ -27,6 +27,14 @@ PerformanceListener / BaseStatsListener / OpProfiler (SURVEY.md §5):
   auto-dumps JSON on watchdog anomaly, uncaught fit exception, or SIGTERM
   (``flight.install_signal_handler()``); pretty-print with the
   ``flightrec`` CLI verb.
+* ``federate`` — cluster metrics federation: scrape every member's
+  ``/metrics``, merge series under stable ``instance`` labels, count
+  dead members instead of hanging (``/metrics?federate=1``).
+* ``timeline`` — cluster timeline: clock-pair offset estimation + the
+  merge of per-process trace rings/flight dumps into one time-aligned
+  view (``/traces?cluster=1``, ``traces --cluster``).
+* ``profiling`` — windowed ``jax.profiler`` capture around exactly one
+  round (``profile_round``; guarded no-op off-TPU).
 * ``reset()`` — drop all recorded state across the subsystem (tests).
 
 Off by default; switch on per process with ``DL4J_TPU_TELEMETRY=1`` or at
@@ -50,8 +58,9 @@ from deeplearning4j_tpu.telemetry.registry import (DEFAULT_BUCKETS, Counter,
                                                    MetricsRegistry,
                                                    get_registry, write_jsonl)
 from deeplearning4j_tpu.telemetry.tracing import Tracer, get_tracer, span
-from deeplearning4j_tpu.telemetry import (devices, flight, health,
-                                          scorepipe, tracectx)
+from deeplearning4j_tpu.telemetry import (devices, federate, flight, health,
+                                          profiling, scorepipe, timeline,
+                                          tracectx)
 from deeplearning4j_tpu.telemetry.health import NumericsError
 from deeplearning4j_tpu.telemetry.scorepipe import ScorePipeline
 from deeplearning4j_tpu.telemetry.tracectx import TraceContext
@@ -61,7 +70,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
            "write_jsonl", "enable", "disable", "enabled", "reset",
            "series_map",
            "health", "devices", "flight", "scorepipe", "ScorePipeline",
-           "NumericsError", "tracectx", "TraceContext"]
+           "NumericsError", "tracectx", "TraceContext",
+           "federate", "timeline", "profiling"]
 
 
 def enable():
@@ -91,6 +101,8 @@ def reset():
     flight.get_recorder().clear()
     tracectx.get_ring().clear()
     tracectx.reset_open_count()
+    timeline.clear_source_providers()
+    federate.clear_target_providers()
     # once-per-process cold-start gauges (time_to_first_step/request):
     # lazy import — utils.compile_cache imports telemetry lazily back
     from deeplearning4j_tpu.utils import compile_cache as _cc

@@ -102,6 +102,13 @@ class FlightRecorder:
         doc = {"reason": reason, "pid": os.getpid(),
                "dumped_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
                "n_records": len(recs)}
+        try:
+            # clock pair: lets the cluster-timeline merge place this
+            # process's traces on the shared wall clock postmortem
+            from deeplearning4j_tpu.telemetry import timeline as _timeline
+            doc["clock"] = _timeline.clock_pair()
+        except Exception:
+            pass
         if extra:
             doc.update(extra)
         doc["records"] = recs

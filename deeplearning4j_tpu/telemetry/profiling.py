@@ -1,0 +1,109 @@
+"""Windowed ``jax.profiler`` capture: one round, one xprof trace.
+
+The live-TPU window agenda (ROADMAP open item 1) needs device profiles
+of EXACTLY one training round, captured programmatically — an always-on
+profiler would perturb the steady state it is measuring, and the PR 8
+tracing annotations only cost anything while a profiler session is
+active, so the capture window is also the only window that pays for
+them. :func:`profile_window` wraps a block in
+``jax.profiler.start_trace``/``stop_trace`` and is a guarded NO-OP
+off-TPU (CPU tier-1 runs never start a session; force with
+``DL4J_TPU_PROFILE_FORCE=1`` or ``force=True`` — jax's CPU profiler
+works, it is just not the default because every tier-1 leg would
+otherwise write trace directories).
+
+Drivers expose this as ``profile_round(n)`` (StepDriver /
+ParallelTrainer) and ``--profile-round`` (hostfleet worker): arm once,
+the n-th round from now runs inside the window, the xprof dump lands
+under the logdir. See PROFILE.md for the reading recipe.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+__all__ = ["profile_window", "profiling_available", "ProfileSchedule"]
+
+#: escape hatch for CPU tests/benches of the capture plumbing itself
+FORCE_ENV = "DL4J_TPU_PROFILE_FORCE"
+
+
+def profiling_available(force=None):
+    """Whether :func:`profile_window` would actually capture: on a TPU
+    backend, or forced (env/flag) on any backend."""
+    if force is None:
+        force = os.environ.get(FORCE_ENV, "") == "1"
+    if force:
+        return True
+    try:
+        import jax
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001 — no backend = nothing to profile
+        return False
+
+
+@contextlib.contextmanager
+def profile_window(logdir, force=None):
+    """Run the block under a programmatic profiler session writing to
+    ``logdir``. Yields True when a session is actually active (the PR 8
+    span annotations land on the device timeline only then), False for
+    the off-TPU no-op — zero cost, no directory created."""
+    if not profiling_available(force):
+        yield False
+        return
+    import jax
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield True
+    finally:
+        jax.profiler.stop_trace()
+
+
+class ProfileSchedule:
+    """Arm-once capture schedule: ``arm(n, logdir)`` marks the n-th
+    future round; the driver brackets each round in ``window(round)``
+    and exactly the armed one runs inside a profiler session. Keeps the
+    driver's round loop branch-cheap (one attribute check when idle)."""
+
+    __slots__ = ("_at", "_logdir", "_force", "captured")
+
+    def __init__(self):
+        self._at = None
+        self._logdir = None
+        self._force = None
+        #: logdirs of completed captures (the CLI/bench read this back)
+        self.captured = []
+
+    def arm(self, rounds_from_now, logdir, force=None):
+        if rounds_from_now < 1:
+            raise ValueError("profile_round arms a FUTURE round "
+                             f"(got {rounds_from_now})")
+        self._at = int(rounds_from_now)
+        self._logdir = str(logdir)
+        self._force = force
+
+    @property
+    def armed(self):
+        return self._at is not None
+
+    @contextlib.contextmanager
+    def window(self, *, tag=None):
+        """Bracket ONE round; counts down the armed schedule and opens
+        the profiler window on the round it reaches zero."""
+        if self._at is None:
+            yield False
+            return
+        self._at -= 1
+        if self._at > 0:
+            yield False
+            return
+        logdir, force = self._logdir, self._force
+        if tag:
+            logdir = os.path.join(logdir, str(tag))
+        self._at, self._logdir, self._force = None, None, None
+        with profile_window(logdir, force=force) as active:
+            yield active
+        if active:
+            self.captured.append(logdir)

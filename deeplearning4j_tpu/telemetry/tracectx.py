@@ -57,9 +57,9 @@ import time
 from deeplearning4j_tpu.telemetry import registry as _registry
 
 __all__ = ["TraceContext", "Trace", "SlowTraceRing", "start_trace",
-           "maybe_start", "attach", "current", "current_trace_id",
-           "get_ring", "set_enabled", "enabled", "open_trace_count",
-           "reset_open_count"]
+           "maybe_start", "maybe_start_remote", "attach", "current",
+           "current_trace_id", "get_ring", "set_enabled", "enabled",
+           "open_trace_count", "reset_open_count"]
 
 # the contextvar carrying the active TraceContext. Imported lazily by
 # nothing and read only behind enabled-gates — the disabled step path
@@ -145,10 +145,14 @@ class Trace:
     only while tracing is on, and graftsan's ``watch_rmw`` needs the
     mutable layout."""
 
-    def __init__(self, name, args=None):
+    def __init__(self, name, args=None, trace_id=None):
         self._lock = threading.Lock()
         self.name = name
-        self.trace_id = _new_trace_id()
+        # a remote-parented trace ADOPTS the originating process's id (the
+        # fleet worker's spans must land in the ROUTER's trace, matched by
+        # id when the response carries them back over the wire)
+        self.trace_id = _new_trace_id() if trace_id is None \
+            else str(trace_id)
         self.args = dict(args) if args else {}
         self.t0 = time.perf_counter()
         self.wall_t0 = time.time()
@@ -180,6 +184,54 @@ class Trace:
         with self._lock:
             self.spans.append(doc)
         return doc
+
+    def graft(self, remote_doc, parent_id, offset_s=0.0, instance=None):
+        """Splice another PROCESS's trace doc into this trace, parented
+        under ``parent_id`` (the cross-wire merge: the fleet worker
+        returns its span timings in the /submit response and the router
+        grafts them under that attempt's span, so ONE trace spans
+        admission→dispatch→worker-device→resolve).
+
+        Every remote span gets a fresh span id from this trace — remote
+        processes allocate their own 1..N sequence, which would collide —
+        with internal parent links preserved; the remote root re-parents
+        under ``parent_id``. Timestamps re-anchor through the remote
+        doc's ``t0_unix`` wall clock (minus the estimated inter-process
+        clock ``offset_s``); a doc without the anchor keeps its own
+        relative times. Returns the remote root's new span id (None when
+        the doc carries no spans)."""
+        spans = [s for s in (remote_doc or {}).get("spans") or ()
+                 if isinstance(s, dict)]
+        if not spans:
+            return None
+        base_unix = remote_doc.get("t0_unix")
+        idmap = {s.get("span_id"): self.next_span_id() for s in spans}
+        root_new = None
+        grafted = []
+        for s in spans:
+            new = dict(s)
+            new["span_id"] = idmap[s.get("span_id")]
+            pid = s.get("parent_id")
+            if pid in idmap:
+                new["parent_id"] = idmap[pid]
+            else:
+                new["parent_id"] = parent_id
+                if root_new is None:
+                    root_new = new["span_id"]
+                args = dict(new.get("args") or {})
+                if instance is not None:
+                    args["instance"] = instance
+                args.setdefault("remote_trace", remote_doc.get("name"))
+                new["args"] = args
+            if base_unix is not None and s.get("t0_s") is not None:
+                # remote-relative -> wall -> local-relative (offset_s is
+                # remote_clock - local_clock, so subtract it)
+                wall = base_unix + float(s["t0_s"]) - float(offset_s)
+                new["t0_s"] = round(wall - self.wall_t0, 9)
+            grafted.append(new)
+        with self._lock:
+            self.spans.extend(grafted)
+        return root_new
 
     def _close(self, status):
         """Mark finished (idempotent); returns True on the first close."""
@@ -307,6 +359,22 @@ def maybe_start(name, **args):
     if not _enabled:
         return None
     return start_trace(name, **args)
+
+
+def maybe_start_remote(name, trace_id, parent_span_id=None, **args):
+    """Open a trace that ADOPTS a remote caller's trace id (the wire
+    side of cross-process tracing: the fleet worker roots its local
+    spans under the router's identity, ships ``trace.to_doc()`` back in
+    the response, and the router grafts it under the dispatching attempt
+    span). ``parent_span_id`` — the caller-side span the remote work
+    hangs under — is recorded on the trace for the merge; gated like
+    :func:`maybe_start`."""
+    if not _enabled or not trace_id:
+        return None
+    if parent_span_id is not None:
+        args = dict(args, remote_parent=parent_span_id)
+    return TraceContext(Trace(name, args, trace_id=trace_id),
+                        ROOT_SPAN_ID)
 
 
 def current():

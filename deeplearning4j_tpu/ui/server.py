@@ -15,7 +15,11 @@ HTTP server with a self-contained HTML page (inline SVG charts) —
     GET  /traces                     -> slow-trace flight ring JSON (the N
                                         slowest complete causal traces per
                                         root span; ?name= / ?trace_id=
-                                        filter — see telemetry/tracectx)
+                                        filter — see telemetry/tracectx;
+                                        ?cluster=1 merges every registered
+                                        member's ring onto one time-aligned
+                                        timeline, ?format=chrome as trace
+                                        events — telemetry/timeline)
     GET  /train/sessions             -> session ids
     GET  /train/overview?session=s   -> score curve + timing (JSON)
     GET  /train/model?session=s      -> per-param norms over time (JSON)
@@ -108,8 +112,26 @@ class UIServer:
                     # lines are ONLY legal in openmetrics-text — a classic
                     # 0.0.4 parser would reject the line and drop the
                     # whole scrape the moment tracing stamped one.
+                    # ?federate=1: ONE scrape for the whole cluster —
+                    # local registry + every registered member's
+                    # /metrics, merged under stable instance labels; a
+                    # dead member is counted, never a hang
+                    # (telemetry/federate.py). ?format=json returns the
+                    # structured federation doc (members + scrape
+                    # outcomes) instead of the exposition text.
                     from deeplearning4j_tpu import telemetry
-                    body = telemetry.get_registry().to_prometheus().encode()
+                    if q.get("federate", ["0"])[0] not in ("0", "",
+                                                           "false"):
+                        from deeplearning4j_tpu.telemetry import (
+                            federate as _fed)
+                        fed = _fed.federate_default()
+                        if q.get("format", [""])[0] == "json":
+                            self._json(fed)
+                            return
+                        body = _fed.merged_to_prometheus(fed).encode()
+                    else:
+                        body = (telemetry.get_registry().to_prometheus()
+                                .encode())
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "application/openmetrics-text; "
@@ -149,8 +171,23 @@ class UIServer:
                     # — the place a /metrics exemplar's trace_id resolves
                     # to a full submit->resolve timeline. ?name= filters
                     # one root; ?trace_id= returns a single trace doc.
+                    # ?cluster=1: the time-aligned CLUSTER timeline —
+                    # this process's ring merged with every registered
+                    # member source on one wall clock
+                    # (telemetry/timeline.py); ?format=chrome returns
+                    # the chrome://tracing event form.
                     from deeplearning4j_tpu.telemetry import (
                         tracectx as _tracectx)
+                    if q.get("cluster", ["0"])[0] not in ("0", "",
+                                                          "false"):
+                        from deeplearning4j_tpu.telemetry import (
+                            timeline as _tl)
+                        merged = _tl.cluster_snapshot()
+                        if q.get("format", [""])[0] == "chrome":
+                            self._json(_tl.to_chrome(merged))
+                        else:
+                            self._json(merged)
+                        return
                     ring = _tracectx.get_ring()
                     tid = q.get("trace_id", [None])[0]
                     if tid:

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""tier1.sh cluster-observability gate: parse a `bench.py cluster_obs`
+JSONL stream and fail unless the observability plane held its
+contracts. STRUCTURAL and counter-based, NEVER wall time (the tracing
+cost claim is the trace_overhead stage's <=5% gate, not this one):
+
+* wire-propagated tracing: the routed request's ring doc is ONE trace —
+  it contains the remote worker's ``serving.device_exec`` AND
+  ``serving.queue_wait`` spans grafted under a ``fleet.attempt``, names
+  its instance, and every parent link resolves inside the doc;
+* federation: every live member scraped ok under a stable instance
+  label, and the federated per-instance values of
+  ``serving_model_requests_total`` sum to the per-member scrape total
+  (federation merges, it never invents or drops a count);
+* timeline: the merged view names the router and BOTH workers;
+* dead member: the killed worker is a COUNTED scrape error
+  (``federate_scrape_total{outcome=error}`` > 0) while a live member
+  still scrapes ok — counted, bounded, never a hang.
+
+Usage: check_cluster_obs.py <jsonl-file>
+"""
+
+import json
+import sys
+
+
+def main(argv):
+    path = argv[1]
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    recs = [r for r in rows
+            if str(r.get("metric", "")).startswith("cluster_obs")]
+    if not recs:
+        print("check_cluster_obs: no cluster_obs record in", path)
+        return 1
+    rec = recs[-1]
+    if "FAILED" in rec.get("metric", ""):
+        print("check_cluster_obs: bench leg failed:", rec.get("error"))
+        return 1
+    errors = []
+
+    tr = rec.get("trace", {})
+    if not tr.get("has_remote_device_exec"):
+        errors.append(f"router trace holds no worker-side "
+                      f"serving.device_exec: spans={tr.get('span_names')}")
+    if not tr.get("has_remote_queue_wait"):
+        errors.append(f"router trace holds no worker-side "
+                      f"serving.queue_wait: spans={tr.get('span_names')}")
+    if not tr.get("has_attempt"):
+        errors.append("router trace has no fleet.attempt span")
+    if not tr.get("parents_resolve"):
+        errors.append("grafted spans left dangling parent ids — the "
+                      "remote subtree did not re-parent into the trace")
+    if not tr.get("remote_instance"):
+        errors.append(f"grafted worker root names no instance: {tr}")
+
+    fed = rec.get("federation", {})
+    not_ok = [i for i, ok in (fed.get("members") or {}).items() if not ok]
+    if not_ok:
+        errors.append(f"live members failed the federated scrape: "
+                      f"{not_ok}")
+    by_inst = fed.get("federated_by_instance") or {}
+    if len(by_inst) < 2:
+        errors.append(f"federation saw <2 worker instances for "
+                      f"{fed.get('metric')}: {by_inst}")
+    if fed.get("federated_total") != fed.get("per_member_total"):
+        errors.append(
+            f"federated sum != per-member sums for {fed.get('metric')}: "
+            f"{fed.get('federated_total')} vs "
+            f"{fed.get('per_member_total')} ({by_inst} vs "
+            f"{fed.get('per_member')})")
+    if fed.get("per_member_total", 0) <= 0:
+        errors.append(f"workers served but counted nothing: {fed}")
+
+    tl = rec.get("timeline", {})
+    if len(tl.get("instances") or []) < 3:  # router + both workers
+        errors.append(f"merged timeline names {tl.get('instances')} — "
+                      f"expected the router and both workers")
+    if not tl.get("n_traces"):
+        errors.append("merged timeline is empty")
+
+    dead = rec.get("dead_member", {})
+    if (dead.get("scrapes") or {}).get("error", 0) < 1:
+        errors.append(f"dead member was not counted as a scrape error: "
+                      f"{dead}")
+    if (dead.get("scrapes") or {}).get("ok", 0) < 1:
+        errors.append(f"no live member survived the dead-member scrape: "
+                      f"{dead}")
+    if not dead.get("bounded"):
+        errors.append(f"dead-member federation was not bounded: {dead}")
+    smap = (rec.get("counters") or {}).get("federate_scrape_total") or {}
+    if not any("outcome=error" in k and v > 0 for k, v in smap.items()):
+        errors.append(f"federate_scrape_total counted no error outcome: "
+                      f"{smap}")
+
+    print(f"cluster_obs: trace {tr.get('n_spans')} spans "
+          f"(remote instance {tr.get('remote_instance')}), federation "
+          f"{fed.get('metric')}={fed.get('federated_total')} across "
+          f"{sorted(by_inst)}, timeline {tl.get('n_traces')} trace(s) "
+          f"over {len(tl.get('instances') or [])} instance(s), dead "
+          f"member scrapes={dead.get('scrapes')}")
+    for e in errors:
+        print("check_cluster_obs FAIL:", e)
+    if not errors:
+        print("check_cluster_obs: one trace per request across the "
+              "wire, federation sums exact, dead member counted — held")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

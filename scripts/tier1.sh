@@ -206,4 +206,25 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
        echo "tier1: a death/rollback went uncounted, a generation"
        echo "tier1: recompiled, or the fleet wedged)"; exit 1; }
 
+# Stage 11: cluster-observability smoke (telemetry federation/timeline +
+# fleet wire tracing, ISSUE 16) — a REAL 2-worker fleet with telemetry on
+# both sides of the wire: one routed request must yield ONE trace whose
+# ring doc contains the worker process's serving.queue_wait/device_exec
+# spans grafted under the dispatching attempt; /metrics?federate=1
+# semantics (per-instance federated sums == per-member scrape sums); the
+# merged cluster timeline names router + both workers; a SIGKILLed member
+# is a COUNTED scrape error, never a hang. scripts/check_cluster_obs.py
+# gates STRUCTURALLY (span graph, counter sums, scrape outcomes) — never
+# wall time; the tracing-cost claim rides stage 4's <=5% gate.
+echo "== cluster-observability smoke =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
+  timeout -k 10 300 python bench.py cluster_obs \
+  > /tmp/_cluster_obs.jsonl \
+  && tee -a BENCH_smoke.json < /tmp/_cluster_obs.jsonl > /dev/null \
+  && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python scripts/check_cluster_obs.py /tmp/_cluster_obs.jsonl \
+  || { echo "tier1: cluster-observability smoke FAILED (the router trace"
+       echo "tier1: lost the worker-side spans, federation sums drifted,"
+       echo "tier1: or a dead member hung/went uncounted)"; exit 1; }
+
 exit $rc

@@ -98,3 +98,13 @@ def last_json_line(text):
     """The last JSON object printed on a worker's stdout (workers print
     ONE machine-readable result/ready line last)."""
     return json.loads(text.strip().splitlines()[-1])
+
+
+def ready_clock(doc):
+    """The ``{mono, unix}`` clock pair a worker stamps on its ready line
+    (the cluster-timeline alignment seed). Returns None for ready lines
+    that predate the clock pair — old lines still parse."""
+    clk = (doc or {}).get("clock")
+    if isinstance(clk, dict) and clk.get("unix") is not None:
+        return clk
+    return None

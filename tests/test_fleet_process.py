@@ -136,6 +136,102 @@ def test_chaos_sigkill_worker_midsweep(tmp_path):
         sup.stop()
 
 
+def _traced_fleet(tmp_path, n_workers):
+    """Supervisor + router with telemetry ON in this process AND in the
+    spawned workers (DL4J_TPU_TELEMETRY=1 rides the scrubbed env) — the
+    wire-propagated-tracing fixture."""
+    from deeplearning4j_tpu.utils.serialization import save_model
+    ckpt = str(tmp_path / "ckpt.zip")
+    save_model(_net(), ckpt)
+    telemetry.enable()
+    sup = FleetSupervisor(n_workers, model_path=ckpt, buckets=[1],
+                          env=procutil.scrubbed_env(DL4J_TPU_TELEMETRY="1"),
+                          probe_interval_s=5.0, max_missed_probes=5)
+    router = FleetRouter(name="default", request_timeout_s=30.0)
+    sup.attach(router)
+    return sup, router
+
+
+def _ring_doc(trace_id):
+    for docs in telemetry.tracectx.get_ring().snapshot().values():
+        for doc in docs:
+            if doc.get("trace_id") == trace_id:
+                return doc
+    raise AssertionError(f"trace {trace_id} not in the local ring")
+
+
+def test_cross_process_trace_parenting(tmp_path):
+    """ONE trace spans admission→dispatch→worker-device→resolve: the
+    router's ring doc for a served request contains the WORKER process's
+    serving.queue_wait and serving.device_exec spans, re-parented under
+    the dispatching attempt span with resolvable parent links."""
+    sup, router = _traced_fleet(tmp_path, 1)
+    x = np.random.RandomState(1).rand(6).astype(np.float32)
+    try:
+        sup.start()
+        fut = router.submit(x)
+        fut.get(timeout=30)
+        doc = _ring_doc(fut.trace_id)
+        names = [s["name"] for s in doc["spans"]]
+        # router-side story...
+        assert "fleet.queue_wait" in names
+        assert "fleet.attempt" in names and "fleet.resolve" in names
+        # ...and the worker-side spans, shipped back over the wire
+        assert "fleet.worker_submit" in names
+        assert "serving.queue_wait" in names
+        assert "serving.device_exec" in names
+        # the grafted worker root names its instance
+        wroot = next(s for s in doc["spans"]
+                     if s["name"] == "fleet.worker_submit")
+        assert wroot["args"]["instance"] == "w0", wroot
+        # every parent link resolves INSIDE the one doc (no dangling
+        # remote span ids), and device_exec descends from the attempt
+        by_id = {s["span_id"]: s for s in doc["spans"]}
+        assert all(s["parent_id"] in by_id for s in doc["spans"]
+                   if s.get("parent_id") is not None)
+        s = next(s for s in doc["spans"]
+                 if s["name"] == "serving.device_exec")
+        chain = []
+        while s is not None:
+            chain.append(s["name"])
+            s = by_id.get(s.get("parent_id"))
+        assert "fleet.attempt" in chain, chain
+    finally:
+        router.stop()
+        sup.stop()
+
+
+def test_failover_replays_on_the_same_trace(tmp_path):
+    """A failover is a second numbered attempt child on the SAME trace:
+    kill w0, submit — attempt 1 errors against the corpse, a later
+    attempt succeeds on w1, and the one ring doc tells the whole story
+    (including the survivor's grafted device spans)."""
+    sup, router = _traced_fleet(tmp_path, 2)
+    x = np.random.RandomState(2).rand(6).astype(np.float32)
+    try:
+        sup.start()
+        # long probe interval (fixture): the router still believes w0
+        # alive when we submit, so first-seen-wins picks the corpse
+        sup.kill_worker("w0", sig=signal.SIGKILL)
+        time.sleep(0.2)  # let the SIGKILL land before the dispatch
+        fut = router.submit(x)
+        fut.get(timeout=30)
+        doc = _ring_doc(fut.trace_id)
+        attempts = {s["args"]["attempt"]: s["args"]
+                    for s in doc["spans"] if s["name"] == "fleet.attempt"}
+        assert len(attempts) >= 2, attempts
+        last = max(attempts)
+        assert attempts[1]["outcome"] == "error", attempts
+        assert attempts[last]["outcome"] == "ok", attempts
+        assert attempts[1]["worker"] != attempts[last]["worker"]
+        # the successful attempt grafted the survivor's device spans
+        names = [s["name"] for s in doc["spans"]]
+        assert "serving.device_exec" in names
+    finally:
+        router.stop()
+        sup.stop()
+
+
 def test_worker_ready_line_via_procutil(tmp_path):
     """The bare worker wire contract, driven exactly like the supervisor
     drives it but through procutil's spawn/communicate plumbing."""
@@ -153,6 +249,11 @@ def test_worker_ready_line_via_procutil(tmp_path):
         doc = procutil.last_json_line(line)
         assert doc["fleet_worker_ready"] and doc["worker_id"] == "wx"
         assert doc["port"] > 0  # port=0 in, real bound port out
+        # the clock pair rides the ready line (timeline alignment seed);
+        # a pre-clock ready line parses to None, not an error
+        clk = procutil.ready_clock(doc)
+        assert clk is not None and clk["unix"] > 0 and "mono" in clk
+        assert procutil.ready_clock({"fleet_worker_ready": True}) is None
         import urllib.request
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{doc['port']}/health",
