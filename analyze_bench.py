@@ -1,19 +1,52 @@
-"""Summarize BENCH_TPU_MEASURED.json into the round-4 A/B tables.
+"""Summarize BENCH artifacts and report cross-run regressions.
 
-Run after a live-window `bash measure_r4.sh` (or anytime): groups the
-persisted records by config and prints the remat x fused ResNet50 matrix,
-the LSTM H-sweep / masked A/Bs, and the headline-vs-north-star status.
+Two jobs, one loader:
 
-    python analyze_bench.py [path]
+* ``python analyze_bench.py [path]`` — the round-4 A/B tables over
+  BENCH_TPU_MEASURED.json (remat x fused ResNet50 matrix, LSTM sweeps,
+  headline-vs-north-star status), unchanged;
+* ``python analyze_bench.py --regressions [paths...]`` — the cross-run
+  regression reporter: every BENCH_*.json stream in the repo (JSONL
+  appended run over run by tier1.sh, plus the measured cache) is loaded,
+  records are aligned per config/variant IN FILE ORDER, and the latest
+  record of each series is compared against the median of its
+  predecessors. A headline drifting past ``--tolerance`` percent in the
+  bad direction (direction inferred from the unit: ms/seconds regress
+  UP, throughput regresses DOWN; goodput fractions regress DOWN) is
+  flagged; ``--gate`` turns flags into a nonzero exit so a perf
+  regression fails the run the same way a broken test does. Cached and
+  failed records never count; preflight and live records never mix
+  (they live in different variant series).
 """
 
+import argparse
+import glob
 import json
+import os
 import sys
+
+#: record fields that distinguish A/B variants of one config (mirrors
+#: bench.py's _VARIANT_FIELDS; duplicated here so the analyzer stays a
+#: zero-import host tool usable away from the repo)
+VARIANT_FIELDS = ("batch", "hw", "remat", "fused_conv", "hidden", "masked",
+                  "seq", "fused_kernel", "d_model", "n_layers",
+                  "fused_attention", "vocab", "dim", "n_chips",
+                  "flash_block", "preflight", "device")
+
+#: units where a LARGER value is the regression (latencies, walls)
+LOWER_IS_BETTER_UNITS = ("ms", "s/iter", "seconds", "sec/")
 
 
 def load(path="BENCH_TPU_MEASURED.json"):
+    """Records from one artifact: a JSON doc with results[], a JSON
+    list, or a JSONL stream (BENCH_smoke.json) — event lines and
+    non-record lines are dropped either way."""
     with open(path) as f:
-        data = json.load(f)
+        text = f.read()
+    try:
+        data = json.loads(text)
+    except ValueError:
+        data = [json.loads(ln) for ln in text.splitlines() if _is_json(ln)]
     if isinstance(data, dict):
         recs = data.get("results") or data.get("records") or []
     else:
@@ -23,11 +56,151 @@ def load(path="BENCH_TPU_MEASURED.json"):
     return [r for r in recs if isinstance(r, dict)]
 
 
+def _is_json(line):
+    line = line.strip()
+    if not line or not line.startswith("{"):
+        return False
+    try:
+        json.loads(line)
+        return True
+    except ValueError:
+        return False
+
+
 def fmt(v):
     return "-" if v is None else (f"{v:.4g}" if isinstance(v, float) else v)
 
 
-def main(path):
+# ---- cross-run regression reporting ------------------------------------
+
+def series_key(rec):
+    """One comparable series: config + every variant field the record
+    carries. Records that differ in shape/preflight/device never
+    compare against each other."""
+    return (rec.get("config") or rec.get("metric"),) + tuple(
+        (f, str(rec.get(f))) for f in VARIANT_FIELDS if f in rec)
+
+
+def _usable(rec):
+    return (rec.get("config") or rec.get("metric")) \
+        and "FAILED" not in str(rec.get("metric", "")) \
+        and not rec.get("cached") \
+        and isinstance(rec.get("value"), (int, float))
+
+
+def _headlines(rec):
+    """{name: (value, higher_is_better)} of the record's gateable
+    numbers."""
+    out = {}
+    unit = str(rec.get("unit") or "")
+    lower = any(u in unit for u in LOWER_IS_BETTER_UNITS)
+    out["value"] = (float(rec["value"]), not lower)
+    if isinstance(rec.get("mfu"), (int, float)):
+        out["mfu"] = (float(rec["mfu"]), True)
+    gp = rec.get("goodput")
+    if isinstance(gp, dict) and \
+            isinstance(gp.get("goodput_fraction"), (int, float)) \
+            and gp.get("steps"):
+        # only windows that saw real steps: a serving-only config's
+        # all-idle ledger is not a trainer regression signal
+        out["goodput_fraction"] = (float(gp["goodput_fraction"]), True)
+    return out
+
+
+def _median(vals):
+    vals = sorted(vals)
+    n = len(vals)
+    return vals[n // 2] if n % 2 else 0.5 * (vals[n // 2 - 1] + vals[n // 2])
+
+
+def regressions(paths, tolerance_pct=25.0):
+    """Align records per series across ``paths`` (file order = run
+    order) and compare each series' LATEST record against the median of
+    its predecessors. Returns (flags, summaries): flags are dicts for
+    every headline drifting past tolerance in the bad direction,
+    summaries describe every series with >= 2 comparable records."""
+    by_series = {}
+    for path in paths:
+        try:
+            recs = load(path)
+        except (OSError, ValueError):
+            continue
+        for rec in recs:
+            if _usable(rec):
+                by_series.setdefault(series_key(rec), []).append(rec)
+    flags, summaries = [], []
+    for key, recs in sorted(by_series.items()):
+        if len(recs) < 2:
+            continue
+        latest, history = recs[-1], recs[:-1]
+        for name, (cur, higher_better) in _headlines(latest).items():
+            hist_vals = [h[0] for h in
+                         (_headlines(r).get(name) for r in history)
+                         if h is not None]
+            if not hist_vals:
+                continue
+            base = _median(hist_vals)
+            if base == 0:
+                continue
+            delta_pct = 100.0 * (cur - base) / abs(base)
+            regressed = (delta_pct < -tolerance_pct if higher_better
+                         else delta_pct > tolerance_pct)
+            row = {"config": key[0], "series": key, "headline": name,
+                   "baseline": base, "latest": cur,
+                   "delta_pct": round(delta_pct, 1),
+                   "n_prior_runs": len(hist_vals),
+                   "higher_is_better": higher_better,
+                   "regressed": regressed}
+            summaries.append(row)
+            if regressed:
+                flags.append(row)
+    return flags, summaries
+
+
+def report_regressions(paths, tolerance_pct=25.0, gate=False):
+    flags, summaries = regressions(paths, tolerance_pct)
+    if not summaries:
+        print("analyze_bench: no series with >= 2 comparable records "
+              f"across {len(paths)} artifact(s) — nothing to compare")
+        return 0
+    print(f"== cross-run regression report ({len(paths)} artifact(s), "
+          f"tolerance {tolerance_pct:g}%) ==")
+    print(f"{'config':>14} {'headline':>18} {'baseline':>10} "
+          f"{'latest':>10} {'delta%':>8} {'runs':>5}  verdict")
+    for row in summaries:
+        verdict = "REGRESSED" if row["regressed"] else "ok"
+        print(f"{str(row['config']):>14} {row['headline']:>18} "
+              f"{fmt(row['baseline']):>10} {fmt(row['latest']):>10} "
+              f"{row['delta_pct']:>+8.1f} {row['n_prior_runs']:>5}  "
+              f"{verdict}")
+    if flags:
+        print(f"\n{len(flags)} headline(s) regressed past "
+              f"{tolerance_pct:g}%:")
+        for row in flags:
+            direction = "down" if row["higher_is_better"] else "up"
+            print(f"  {row['config']}.{row['headline']}: "
+                  f"{fmt(row['baseline'])} -> {fmt(row['latest'])} "
+                  f"({row['delta_pct']:+.1f}%, bad direction: {direction})")
+    else:
+        print("\nno regressions past tolerance")
+    return 1 if (gate and flags) else 0
+
+
+def default_artifacts():
+    """Every BENCH_*.json next to this script, measured cache last so
+    live-TPU records form the series tail only where they belong."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(p for p in glob.glob(os.path.join(here, "BENCH_*.json"))
+                   if not p.endswith("BENCH_TPU_MEASURED.json"))
+    measured = os.path.join(here, "BENCH_TPU_MEASURED.json")
+    if os.path.exists(measured):
+        paths.append(measured)
+    return paths
+
+
+# ---- the round-4 A/B tables (unchanged behavior) -----------------------
+
+def tables(path):
     recs = load(path)
     print(f"{len(recs)} records from {path}\n")
 
@@ -78,5 +251,27 @@ def main(path):
                   f"cached={r.get('cached', False)}")
 
 
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("paths", nargs="*",
+                   help="artifacts to analyze (default: the measured "
+                        "cache for tables; every BENCH_*.json for "
+                        "--regressions)")
+    p.add_argument("--regressions", action="store_true",
+                   help="cross-run regression report instead of tables")
+    p.add_argument("--gate", action="store_true",
+                   help="exit 1 when any headline regressed past "
+                        "tolerance (implies --regressions)")
+    p.add_argument("--tolerance", type=float, default=25.0,
+                   help="regression tolerance band, percent (default 25)")
+    args = p.parse_args(argv)
+    if args.regressions or args.gate:
+        paths = args.paths or default_artifacts()
+        return report_regressions(paths, tolerance_pct=args.tolerance,
+                                  gate=args.gate)
+    tables(args.paths[0] if args.paths else "BENCH_TPU_MEASURED.json")
+    return 0
+
+
 if __name__ == "__main__":
-    main(sys.argv[1] if len(sys.argv) > 1 else "BENCH_TPU_MEASURED.json")
+    sys.exit(main())

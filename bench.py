@@ -1170,6 +1170,119 @@ def bench_cluster_obs(n_requests=12):
             shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_slo_goodput():
+    """SLO engine + goodput ledger end to end (ISSUE 17). Three legs,
+    one record, all gated STRUCTURALLY by scripts/check_slo.py (never
+    wall time):
+
+    * INERT — the default ruleset evaluated repeatedly over the live
+      registry with nothing injected: ZERO firing rules (a healthy
+      process must not page anyone);
+    * LEDGER — a real fit through the instrumented StepDriver with the
+      goodput window rebased around exactly it: the six wall-clock
+      categories must sum to the observed window (±5% gate), steps > 0;
+    * STORM — a deterministic injected shed storm (serving_shed_total /
+      serving_model_requests_total incremented directly, the engine
+      evaluated on an explicit synthetic clock spanning the rule
+      window): ``serving_shed_ratio`` walks ok -> firing, the
+      transition lands in ``slo_alerts_total{rule,state}``, and a
+      flight-recorder dump written mid-storm carries an ``slo`` section
+      naming the burning rule — the SIGTERM-postmortem path, driven
+      deterministically."""
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.continuous import chaos
+    from deeplearning4j_tpu.continuous.driver import StepDriver
+    from deeplearning4j_tpu.telemetry import flight as _flight
+    from deeplearning4j_tpu.telemetry import goodput as _goodput
+    from deeplearning4j_tpu.telemetry import slo as _slo
+
+    telemetry.enable()
+    reg = telemetry.get_registry()
+    engine = _slo.get_engine()
+    engine.clear()
+    # the storm is injected, so the clock can be synthetic too: explicit
+    # `now` values make every delta window deterministic regardless of
+    # how fast this bench actually runs
+    t0 = 1000.0
+
+    # the storm's counters must EXIST (zero-valued) before the first
+    # sample: the delta discipline ignores a series' first appearance,
+    # so a series born mid-storm would contribute nothing that interval
+    shed = reg.counter("serving_shed_total",
+                       "load-shed requests per model and reason "
+                       "(queue_full / deadline / shutdown)")
+    req = reg.counter("serving_model_requests_total",
+                      "requests by model and outcome (submitted/served/"
+                      "shed_queue_full/shed_deadline/error)")
+    shed.inc(0, model="slo_bench", reason="queue_full")
+    req.inc(0, model="slo_bench", outcome="submitted")
+
+    # --- INERT leg ----------------------------------------------------
+    for i in range(3):
+        engine.evaluate(now=t0 + 30.0 * i)
+    st = engine.status()
+    alerts0 = telemetry.series_map("slo_alerts_total")
+    inert_leg = {"evaluations": st["evaluations"],
+                 "firing": st["firing"], "warning": st["warning"],
+                 "rules": len(st["rules"]),
+                 "alerts_total": alerts0}
+
+    # --- LEDGER leg ---------------------------------------------------
+    iters = 12 if _preflight() else 60
+    net = chaos.smoke_net(seed=11)
+    net.init()
+    batches = chaos.gen_batches(77, iters, batch=16)
+    driver = StepDriver(net, lambda: ((x, y, None) for x, y in batches))
+    ledger = _goodput.get_ledger()
+    ledger.start()  # rebase the window around exactly this fit
+    driver.run_round(None)  # whole epoch: iters instrumented steps
+    driver.sync()
+    ledger.note("exchange", 0.0015)  # the noted path, deterministically
+    goodput_leg = ledger.snapshot()
+
+    # --- STORM leg ----------------------------------------------------
+    # 60 sheds / 120 submissions between samples: ratio 0.5 >= fire 0.20
+    # with the denominator far past min_den — unambiguous, not marginal
+    req.inc(120, model="slo_bench", outcome="submitted")
+    shed.inc(60, model="slo_bench", reason="queue_full")
+    engine.evaluate(now=t0 + 90.0)
+    storm_status = engine.status()
+    alerts1 = telemetry.series_map("slo_alerts_total")
+    dump_path = _flight.get_recorder().dump("bench_slo_storm")
+    dump_slo = None
+    if dump_path:
+        with open(dump_path) as f:
+            dump_slo = json.load(f).get("slo")
+    # recovery: healthy traffic (submissions, zero sheds) after the
+    # window slides past the storm — state walks back to ok, and THAT
+    # transition is counted too (without fresh denominator traffic the
+    # rule would correctly HOLD firing: no data is not good news)
+    req.inc(100, model="slo_bench", outcome="submitted")
+    engine.evaluate(now=t0 + 400.0)
+    recovered = engine.state("serving_shed_ratio")
+    alerts2 = telemetry.series_map("slo_alerts_total")
+
+    return {"metric": "slo_goodput", "value": len(engine.rules),
+            "unit": "rules",
+            "vs_baseline": None,  # net-new plane: no reference analog
+            "inert": inert_leg,
+            "goodput": goodput_leg,
+            "fit_iters": iters,
+            "storm": {"rule": "serving_shed_ratio",
+                      "state": "firing" if "serving_shed_ratio"
+                               in storm_status["firing"] else
+                               engine.state("serving_shed_ratio"),
+                      "firing": storm_status["firing"],
+                      "value": next(
+                          (r["value"] for r in storm_status["rules"]
+                           if r["name"] == "serving_shed_ratio"), None),
+                      "recovered_state": recovered,
+                      "flight_dump": dump_path,
+                      "flight_dump_slo": dump_slo},
+            "alerts_before": alerts0, "alerts_after_storm": alerts1,
+            "alerts_after_recovery": alerts2}
+
+
 def bench_continuous():
     """The continuous-learning loop under injected faults (ISSUE 13):
     a REAL runner subprocess trains from a live pubsub stream while the
@@ -1945,7 +2058,8 @@ CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
            "coldstart": bench_coldstart, "zero": bench_zero,
            "kernels": bench_kernels, "fleet": bench_fleet,
            "continuous": bench_continuous, "hostfleet": bench_hostfleet,
-           "cluster_obs": bench_cluster_obs}
+           "cluster_obs": bench_cluster_obs,
+           "slo_goodput": bench_slo_goodput}
 DEFAULT_ORDER = ["lenet", "resnet50", "lstm", "word2vec", "parallel",
                  "transformer", "longcontext", "fused", "serving", "zero"]
 
@@ -2135,11 +2249,26 @@ def _attach_observability(rec):
                               "anomalies")}
     except Exception:
         pass
+    try:
+        # the goodput block rides EVERY record (ISSUE 17): where the
+        # config's wall clock went, next to its samples/sec. The window
+        # was rebased at config start (_run_config_inprocess).
+        from deeplearning4j_tpu.telemetry import goodput as _goodput
+        rec.setdefault("goodput", _goodput.get_ledger().snapshot())
+    except Exception:
+        pass
     return rec
 
 
 def _run_config_inprocess(n, device):
     t0 = time.perf_counter()
+    try:
+        # per-config goodput window: the record's goodput block describes
+        # THIS config's wall clock, not the whole sweep's
+        from deeplearning4j_tpu.telemetry import goodput as _goodput
+        _goodput.get_ledger().start()
+    except Exception:
+        pass
     try:
         rec = CONFIGS[n]()
         rec.update(config=n, device=device, preflight=_preflight(),

@@ -274,6 +274,28 @@ def _build_parser():
                          "the MERGED graph — orders only runtime saw "
                          "compose with orders only the code declares")
 
+    sl = sub.add_parser(
+        "slo",
+        help="SLO engine verdicts (telemetry/slo.py): evaluate the "
+             "default ruleset over the local registry, or read a "
+             "running server's /slo endpoint, and print every rule's "
+             "ok|warning|firing state")
+    sl.add_argument("--url",
+                    help="read this /slo endpoint (e.g. "
+                         "http://127.0.0.1:9000/slo — append ?federate=1 "
+                         "for the cluster-wide evaluation) instead of "
+                         "evaluating the local registry")
+    sl.add_argument("--samples", type=int, default=2,
+                    help="local mode: evaluation passes (rates need >=2 "
+                         "samples spanning time; default 2)")
+    sl.add_argument("--interval", type=float, default=2.0,
+                    help="local mode: seconds between passes (default 2)")
+    sl.add_argument("--gate", action="store_true",
+                    help="exit nonzero when any rule is firing "
+                         "(scriptable health check)")
+    sl.add_argument("--json", action="store_true",
+                    help="raw status JSON instead of the table")
+
     tc = sub.add_parser(
         "traces",
         help="inspect the slow-trace flight ring (telemetry/tracectx.py): "
@@ -999,6 +1021,56 @@ def _lint_san_report(args, paths, root):
     return 1 if bad else 0
 
 
+def _cmd_slo(args):
+    """The metrics plane's verdict, on the command line: which rules
+    are burning, and by how much (`slo --gate` scripts it)."""
+    import json
+    import time
+
+    if args.url:
+        import urllib.request
+        with urllib.request.urlopen(args.url, timeout=10) as r:
+            status = json.loads(r.read().decode())
+    else:
+        from deeplearning4j_tpu import telemetry
+        reg = telemetry.get_registry()
+        if not any(m["series"] for m in reg.snapshot().values()):
+            print("note: local registry is empty (each process has its "
+                  "own); run instrumented work in THIS process, or read "
+                  "a live server with --url http://host:port/slo",
+                  file=sys.stderr)
+        engine = telemetry.slo.get_engine()
+        status = engine.evaluate()
+        for _ in range(max(args.samples - 1, 0)):
+            time.sleep(max(args.interval, 0.0))
+            status = engine.evaluate()
+    if args.json:
+        print(json.dumps(status, indent=1, default=str))
+    else:
+        rules = status.get("rules", [])
+        w_name = max([len(r["name"]) for r in rules] + [4])
+        print(f"{'rule'.ljust(w_name)}  state    value        bound  "
+              f"kind        metric")
+        for r in rules:
+            v = r.get("value")
+            if isinstance(v, dict):  # burn_rate: short/long pair
+                vtxt = "/".join(f"{x:.3g}" for x in v.values())
+            else:
+                vtxt = "-" if v is None else f"{v:.4g}"
+            bound = f"{'<=' if r.get('op') == 'lt' else '>='}" \
+                    f"{r.get('fire'):g}"
+            print(f"{r['name'].ljust(w_name)}  {r['state']:<7}  "
+                  f"{vtxt:<11}  {bound:<5}  {r['kind']:<10}  "
+                  f"{r['metric']}")
+        firing = status.get("firing", [])
+        warning = status.get("warning", [])
+        print(f"firing: {firing or 'none'}  warning: {warning or 'none'} "
+              f" ({status.get('evaluations')} evaluation(s))")
+    if args.gate and status.get("firing"):
+        return 1
+    return 0
+
+
 def _load_trace_rings(args):
     """{root name: [trace docs]} from --file / --url / the local ring.
     Accepts the three shapes traces travel in: a /traces payload
@@ -1249,6 +1321,8 @@ def main(argv=None):
         return _cmd_flightrec(args)
     if args.command == "traces":
         return _cmd_traces(args)
+    if args.command == "slo":
+        return _cmd_slo(args)
     if args.command == "lint":
         return _cmd_lint(args)
     return 1

@@ -390,6 +390,11 @@ class StepDriver:
             self._frec = _flight.get_recorder()
             self._emitter = _tm.scorepipe.StepRecordEmitter(
                 net, step_h, etl_h, iters_c, score_g, self._frec)
+            if reg.enabled:
+                # open the goodput window with the first instrumented
+                # driver: every fit loop gets the wall-clock ledger
+                # (compute/etl/idle split on /health) without wiring
+                _tm.goodput.get_ledger().ensure_started()
         self._src = None     # persistent fused source (owns a prefetcher)
         self._it = None      # current epoch iterator
         self._tctx = None    # last dispatch's trace (exception cleanup)
@@ -643,11 +648,18 @@ class StepDriver:
         between rounds. ``restore`` of the result is bit-exact."""
         from deeplearning4j_tpu.utils import serialization as _ser
         self.sync()
+        t0 = time.perf_counter()
         # the step loop holds device trees; a checkpoint is a DELIBERATE
         # host sync between rounds, not a hidden per-step one
         net = self.engine.to_host()
-        return _ser.save_bundle(net, path, buckets=buckets,
-                                save_updater=save_updater)
+        out = _ser.save_bundle(net, path, buckets=buckets,
+                               save_updater=save_updater)
+        if self.instrumented:
+            # checkpoint seconds are wall clock the step loop did not
+            # compute in — the goodput ledger's `checkpoint` category
+            _tm.goodput.get_ledger().note(
+                "checkpoint", time.perf_counter() - t0)
+        return out
 
     def restore(self, path_or_bundle):
         """Roll back / resume: abandon anything in flight, then re-arm

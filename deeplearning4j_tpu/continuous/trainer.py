@@ -44,7 +44,9 @@ import queue
 import numpy as np
 
 from deeplearning4j_tpu import telemetry as _tm
+from deeplearning4j_tpu.telemetry import goodput as _goodput
 from deeplearning4j_tpu.telemetry import health as _health
+from deeplearning4j_tpu.telemetry import slo as _slo
 from deeplearning4j_tpu.continuous.driver import StepDriver
 from deeplearning4j_tpu.datasets.iterator import (AsyncDataSetIterator,
                                                   DataSet, DataSetIterator)
@@ -255,21 +257,28 @@ class ContinuousTrainer:
     # -- snapshots -------------------------------------------------------
 
     def _sick_since_gate(self):
+        sick = False
         hm = self._hm
-        if not hm.active:
-            return False
-        seen = hm.nonfinite_steps
-        # two conditions, both required: new anomalies since the last
-        # gate (a sick ROUND), or the most recently resolved record still
-        # carries nonfinite flags (a sick STATE — without this, a run
-        # whose anomalies stopped incrementing would republish NaN
-        # params the moment the delta went quiet)
-        last = hm.last or {}
-        sick = ((self._anoms_at_gate is not None
-                 and seen > self._anoms_at_gate)
-                or bool(last.get("loss_nonfinite"))
-                or bool(last.get("grad_nonfinite")))
-        self._anoms_at_gate = seen
+        if hm.active:
+            seen = hm.nonfinite_steps
+            # two conditions, both required: new anomalies since the last
+            # gate (a sick ROUND), or the most recently resolved record
+            # still carries nonfinite flags (a sick STATE — without this,
+            # a run whose anomalies stopped incrementing would republish
+            # NaN params the moment the delta went quiet)
+            last = hm.last or {}
+            sick = ((self._anoms_at_gate is not None
+                     and seen > self._anoms_at_gate)
+                    or bool(last.get("loss_nonfinite"))
+                    or bool(last.get("grad_nonfinite")))
+            self._anoms_at_gate = seen
+        if not sick:
+            # the SLO engine's verdict joins the gate: a FIRING
+            # gate-tagged rule (numerics anomalies, step-time
+            # regression, recompile storm) blocks publication the same
+            # counted skipped_sick way. Default-on-but-inert: no engine
+            # running, or every rule ok, changes nothing.
+            sick = bool(_slo.firing_gate_rules())
         return sick
 
     def snapshot(self):
@@ -328,6 +337,13 @@ class ContinuousTrainer:
         lost = max(0, it_before - self.net.iteration)
         if lost and self._reg.enabled:
             self._m_rolled_steps.inc(lost)
+            # reclassify the undone steps' wall clock in the goodput
+            # ledger: trained-then-discarded seconds are rollback_lost,
+            # not compute (estimated as lost steps x mean step time)
+            h = self._reg.get("train_step_seconds")
+            if h is not None and h.count():
+                _goodput.get_ledger().note(
+                    "rollback_lost", lost * h.sum() / h.count())
         # the gate counter moves on: the anomaly that caused this
         # rollback is handled, the next snapshot may publish
         self._anoms_at_gate = self._hm.nonfinite_steps

@@ -806,6 +806,36 @@ class FleetRouter:
         return (float(np.percentile(recent, 50)),
                 float(np.percentile(recent, 99)))
 
+    def slo_snapshot(self):
+        """The hedging-policy seam: per-worker burn signals (outstanding
+        rows = the queue_wait pressure a request would join, liveness,
+        dispatch/failure history) + the front's live shed ratio + the
+        SLO engine's serving-tagged verdicts, one read-only doc. Inert
+        today — a future hedge policy decides 'queued behind a slow
+        member' vs 'the model is just slow' from exactly these signals
+        (the per-attempt queue_wait/device_exec spans PR 16 grafts give
+        the per-request version; this is the steady-state one)."""
+        from deeplearning4j_tpu.telemetry import slo as _slo
+        with self._lock:
+            counts = dict(self._counts)
+            workers = {w.wid: {"alive": w.alive,
+                               "outstanding": w.outstanding,
+                               "dispatched": w.dispatched,
+                               "failures": w.failures}
+                       for w in self._workers.values()}
+            pending = self._pending_rows
+        submitted = counts.get("submitted", 0)
+        shed = sum(v for k, v in counts.items() if k.startswith("shed_"))
+        p50, p99 = self.latency_percentiles()
+        return {"model": self.name,
+                "queue_depth": pending,
+                "submitted": submitted,
+                "shed": shed,
+                "shed_ratio": (shed / submitted) if submitted else 0.0,
+                "latency_s": {"p50": p50, "p99": p99},
+                "workers": workers,
+                "alerts": _slo.alerts(tag="serving")}
+
     def stats(self):
         """The fleet front's status payload (rides /fleet)."""
         with self._lock:

@@ -568,6 +568,8 @@ class TrainingFleetSupervisor:
             "step_recompiles": [d.get("step_recompiles") for d in dones],
             "worker_counters": {d["process"]: d.get("counters")
                                 for d in dones},
+            "worker_goodput": {d["process"]: d.get("goodput")
+                               for d in dones},
             "generations": list(self.generations),
             "tally": dict(self.tally),
             "chaos_kills": list(self.chaos_kills),

@@ -172,6 +172,7 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.telemetry import goodput as _goodput
     from deeplearning4j_tpu.telemetry import timeline as _timeline
     from deeplearning4j_tpu.telemetry import tracectx as _tracectx
     telemetry.enable()
@@ -303,6 +304,10 @@ def main(argv=None):
            "layout": trainer.layout,
            "clock": _timeline.clock_pair()})
 
+    # the worker's StepDriver is uninstrumented (no train_step_seconds
+    # observes on fleet hosts), so the goodput ledger is fed from the
+    # round edges the trace spans already time — window = the round loop
+    ledger = _goodput.get_ledger().start()
     cache_sizes = []
     try:
         for rnd in range(start_round, args.total_rounds):
@@ -315,6 +320,8 @@ def main(argv=None):
             t_steps = time.perf_counter()
             driver.run_round(D)
             driver.sync()
+            ledger.note("compute", time.perf_counter() - t_steps)
+            ledger.note_tokens(D * args.batch)
             if tctx is not None:
                 tctx.add_span("hostfleet.steps", t_steps,
                               time.perf_counter(), dispatches=D)
@@ -336,6 +343,7 @@ def main(argv=None):
                 # identical shapes/shardings, so the cached jitted step
                 # re-dispatches with ZERO recompiles (gated below)
                 trainer.adopt_net_state()
+            ledger.note("exchange", time.perf_counter() - t_exch)
             if tctx is not None:
                 tctx.add_span("hostfleet.exchange", t_exch,
                               time.perf_counter(), mode=mode)
@@ -354,6 +362,7 @@ def main(argv=None):
                 save_bundle(host_net, tmp)
                 os.replace(tmp, args.bundle)  # a resume never sees a
                 #                               half-written bundle
+                ledger.note("checkpoint", time.perf_counter() - t_ck)
                 if tctx is not None:
                     tctx.add_span("hostfleet.checkpoint", t_ck,
                                   time.perf_counter())
@@ -400,6 +409,7 @@ def main(argv=None):
            "start_round": start_round,
            "serving_probe_diff": serving_probe_diff,
            "step_recompiles": int(recompiles),
+           "goodput": ledger.snapshot(),
            "counters": {name: telemetry.series_map(name) for name in (
                "distributed_init_total", "recompiles_total",
                "compiles_total")}})

@@ -30,6 +30,17 @@ PerformanceListener / BaseStatsListener / OpProfiler (SURVEY.md §5):
 * ``federate`` — cluster metrics federation: scrape every member's
   ``/metrics``, merge series under stable ``instance`` labels, count
   dead members instead of hanging (``/metrics?federate=1``).
+* ``slo`` — the verdict layer over the series: declarative SloRules
+  (windowed rate/ratio/threshold, multi-window burn rate, EWMA drift)
+  evaluated over the local registry or a federated scrape, alert state
+  ok|warning|firing counted into ``slo_alerts_total{rule,state}``
+  (``/slo`` endpoint, ``slo`` CLI verb, flight dumps name burning
+  rules).
+* ``goodput`` — the wall-clock goodput ledger: every second of a run
+  classified compute|etl_stall|exchange|checkpoint|rollback_lost|idle
+  from the instruments the fit loops already emit, plus tokens/s and
+  an MFU estimate (``/health`` under ``goodput``, the hostfleet
+  done-line, every bench record).
 * ``timeline`` — cluster timeline: clock-pair offset estimation + the
   merge of per-process trace rings/flight dumps into one time-aligned
   view (``/traces?cluster=1``, ``traces --cluster``).
@@ -58,9 +69,9 @@ from deeplearning4j_tpu.telemetry.registry import (DEFAULT_BUCKETS, Counter,
                                                    MetricsRegistry,
                                                    get_registry, write_jsonl)
 from deeplearning4j_tpu.telemetry.tracing import Tracer, get_tracer, span
-from deeplearning4j_tpu.telemetry import (devices, federate, flight, health,
-                                          profiling, scorepipe, timeline,
-                                          tracectx)
+from deeplearning4j_tpu.telemetry import (devices, federate, flight, goodput,
+                                          health, profiling, scorepipe, slo,
+                                          timeline, tracectx)
 from deeplearning4j_tpu.telemetry.health import NumericsError
 from deeplearning4j_tpu.telemetry.scorepipe import ScorePipeline
 from deeplearning4j_tpu.telemetry.tracectx import TraceContext
@@ -71,7 +82,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
            "series_map",
            "health", "devices", "flight", "scorepipe", "ScorePipeline",
            "NumericsError", "tracectx", "TraceContext",
-           "federate", "timeline", "profiling"]
+           "federate", "timeline", "profiling", "slo", "goodput"]
 
 
 def enable():
@@ -103,6 +114,8 @@ def reset():
     tracectx.reset_open_count()
     timeline.clear_source_providers()
     federate.clear_target_providers()
+    slo.reset()
+    goodput.reset()
     # once-per-process cold-start gauges (time_to_first_step/request):
     # lazy import — utils.compile_cache imports telemetry lazily back
     from deeplearning4j_tpu.utils import compile_cache as _cc

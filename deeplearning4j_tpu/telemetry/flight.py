@@ -109,6 +109,17 @@ class FlightRecorder:
             doc["clock"] = _timeline.clock_pair()
         except Exception:
             pass
+        for key, provider in list(_dump_sections.items()):
+            try:
+                # registered analysis sections ride every dump — e.g. the
+                # SLO engine names the rules burning when the process died
+                # (telemetry/slo.py). Defensive like the clock/trace
+                # sections: a broken provider must never mask the dump.
+                section = provider()
+                if section is not None:
+                    doc[key] = section
+            except Exception:
+                pass
         if extra:
             doc.update(extra)
         doc["records"] = recs
@@ -129,6 +140,22 @@ class FlightRecorder:
         with self._lock:
             self.dumps.append(path)
         return path
+
+
+#: {key: zero-arg provider} of extra sections every dump carries; a
+#: provider returning None contributes nothing (see dump()). Providers
+#: read live state at dump time, so registration is once-per-process.
+_dump_sections = {}
+
+
+def register_dump_section(key, provider):
+    """Attach a named analysis section to every future dump (idempotent
+    per key — the latest provider wins)."""
+    _dump_sections[str(key)] = provider
+
+
+def unregister_dump_section(key):
+    _dump_sections.pop(str(key), None)
 
 
 _recorder = FlightRecorder()

@@ -12,6 +12,11 @@ HTTP server with a self-contained HTML page (inline SVG charts) —
     GET  /serving                    -> serving-tier status JSON (per-model
                                         queue depth, p50/p99, shed counts,
                                         AOT bucket coverage)
+    GET  /slo                        -> SLO engine verdicts: every rule's
+                                        ok|warning|firing state, evaluated
+                                        now (?federate=1 evaluates over the
+                                        federated cluster scrape instead of
+                                        the local registry)
     GET  /traces                     -> slow-trace flight ring JSON (the N
                                         slowest complete causal traces per
                                         root span; ?name= / ?trace_id=
@@ -146,6 +151,23 @@ class UIServer:
                     # sick, and why" endpoint next to the raw /metrics)
                     self._json(_health_payload())
                     return
+                if url.path == "/slo":
+                    # the verdict layer (telemetry/slo.py): evaluate the
+                    # process-default engine's rules NOW over the local
+                    # registry (?federate=1: over the federated merge of
+                    # every registered member — one rule set, the whole
+                    # cluster's series) and serve the per-rule
+                    # ok|warning|firing states.
+                    from deeplearning4j_tpu.telemetry import slo as _slo
+                    engine = _slo.get_engine()
+                    if q.get("federate", ["0"])[0] not in ("0", "",
+                                                           "false"):
+                        from deeplearning4j_tpu.telemetry import (
+                            federate as _fed)
+                        self._json(engine.evaluate(_fed.federate_default()))
+                    else:
+                        self._json(engine.evaluate())
+                    return
                 if url.path == "/serving":
                     # serving-tier status: per-model queue depth, SLO
                     # percentiles, shed counts, AOT bucket coverage — the
@@ -279,6 +301,7 @@ class UIServer:
 
     _KNOWN_PATHS = frozenset((
         "/", "/metrics", "/health", "/serving", "/fleet", "/traces",
+        "/slo",
         "/train",
         "/train/overview.html",
         "/train/sessions", "/train/overview", "/train/model",
@@ -338,6 +361,7 @@ def _health_payload():
     devices.RECOMPILE_STORM_THRESHOLD), else ``ok``."""
     from deeplearning4j_tpu.telemetry import devices as _devices
     from deeplearning4j_tpu.telemetry import flight as _flight
+    from deeplearning4j_tpu.telemetry import goodput as _goodput
     from deeplearning4j_tpu.telemetry import health as _tm_health
     from deeplearning4j_tpu.utils import compile_cache as _cc
 
@@ -373,6 +397,10 @@ def _health_payload():
             # the cold-start tax, realized: persistent-cache dir, warm-
             # manifest hit/miss counts, time-to-first-step/request gauges
             "compile_cache": _cc.status(),
+            # the wall-clock goodput ledger (telemetry/goodput.py):
+            # where this run's seconds went — {"active": False} until a
+            # fit loop opens the window
+            "goodput": _goodput.get_ledger().snapshot(),
             "flight": {"records": len(ring),
                        "last_step": ring[-1].get("step") if ring else None,
                        "dumps": list(rec.dumps)}}

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""tier1.sh SLO/goodput gate: parse a `bench.py slo_goodput` JSONL
+stream and fail unless the verdict plane held its contracts.
+STRUCTURAL and counter-based, NEVER wall time:
+
+* inert: the default ruleset evaluated over a healthy process fired
+  NOTHING (and counted nothing into ``slo_alerts_total``);
+* storm: the injected shed storm drove ``serving_shed_ratio`` from ok
+  to firing, the transition is a counted series in
+  ``slo_alerts_total{rule,state}``, and the flight dump written
+  mid-storm carries an ``slo`` section naming the burning rule;
+* recovery: healthy traffic after the storm walked the rule back to
+  ok, and the alert counters stayed MONOTONE through every snapshot
+  (before <= after-storm <= after-recovery, per series);
+* goodput: the ledger's six categories sum to the observed window
+  within 5%, with real fitted steps and nonzero compute.
+
+Usage: check_slo.py <jsonl-file>
+"""
+
+import json
+import sys
+
+CATEGORIES = ("compute", "etl_stall", "exchange", "checkpoint",
+              "rollback_lost", "idle")
+
+
+def _monotone(before, after):
+    """Every series in ``before`` is present and non-decreasing in
+    ``after`` (counters only go up across snapshots)."""
+    return [k for k, v in before.items() if after.get(k, -1.0) < v]
+
+
+def main(argv):
+    path = argv[1]
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    recs = [r for r in rows
+            if str(r.get("metric", "")).startswith("slo_goodput")]
+    if not recs:
+        print("check_slo: no slo_goodput record in", path)
+        return 1
+    rec = recs[-1]
+    if "FAILED" in rec.get("metric", ""):
+        print("check_slo: bench leg failed:", rec.get("error"))
+        return 1
+    errors = []
+
+    inert = rec.get("inert", {})
+    if inert.get("firing"):
+        errors.append(f"healthy process fired rules: {inert['firing']}")
+    if inert.get("alerts_total"):
+        errors.append(f"healthy evaluations counted alert transitions: "
+                      f"{inert['alerts_total']}")
+    if (inert.get("rules") or 0) < 8:
+        errors.append(f"default ruleset shrank: {inert.get('rules')} "
+                      f"rules evaluated (expected >= 8)")
+
+    storm = rec.get("storm", {})
+    if storm.get("state") != "firing":
+        errors.append(f"shed storm did not fire serving_shed_ratio: "
+                      f"state={storm.get('state')}")
+    if "serving_shed_ratio" not in (storm.get("firing") or []):
+        errors.append(f"serving_shed_ratio missing from the firing set: "
+                      f"{storm.get('firing')}")
+    after = rec.get("alerts_after_storm") or {}
+    fired_key = "rule=serving_shed_ratio|state=firing"
+    if after.get(fired_key, 0) < 1:
+        errors.append(f"the ok->firing transition was not counted in "
+                      f"slo_alerts_total: {after}")
+    dump_slo = storm.get("flight_dump_slo")
+    if not dump_slo:
+        errors.append("flight dump carried no slo section "
+                      f"(dump={storm.get('flight_dump')})")
+    elif "serving_shed_ratio" not in (dump_slo.get("firing") or []):
+        errors.append(f"flight dump's slo section does not name the "
+                      f"burning rule: {dump_slo}")
+    if storm.get("recovered_state") != "ok":
+        errors.append(f"rule did not recover to ok on healthy traffic: "
+                      f"{storm.get('recovered_state')}")
+    final = rec.get("alerts_after_recovery") or {}
+    if final.get("rule=serving_shed_ratio|state=ok", 0) < 1:
+        errors.append(f"the firing->ok recovery was not counted: {final}")
+    for a, b, name in ((rec.get("alerts_before") or {}, after,
+                        "before->storm"),
+                       (after, final, "storm->recovery")):
+        bad = _monotone(a, b)
+        if bad:
+            errors.append(f"slo_alerts_total went backwards across "
+                          f"{name} for series {bad}")
+
+    gp = rec.get("goodput") or {}
+    if not gp.get("active"):
+        errors.append(f"goodput ledger was not active: {gp}")
+    else:
+        window = gp.get("window_s") or 0.0
+        seconds = gp.get("seconds") or {}
+        missing = [c for c in CATEGORIES if c not in seconds]
+        if missing:
+            errors.append(f"goodput ledger lost categories: {missing}")
+        total = sum(seconds.get(c, 0.0) for c in CATEGORIES)
+        if window <= 0:
+            errors.append(f"goodput window is empty: {gp}")
+        elif abs(total - window) > 0.05 * window:
+            errors.append(f"goodput categories sum to {total:.4f}s over "
+                          f"a {window:.4f}s window (>5% apart)")
+        if (gp.get("steps") or 0) < 1:
+            errors.append(f"goodput window saw no fitted steps: {gp}")
+        if seconds.get("compute", 0.0) <= 0:
+            errors.append(f"a real fit attributed zero compute: {gp}")
+
+    print(f"slo_goodput: {inert.get('rules')} rules inert-clean, storm "
+          f"ratio={storm.get('value')} -> {storm.get('state')} (recovered "
+          f"{storm.get('recovered_state')}), goodput "
+          f"{gp.get('goodput_fraction')} compute over "
+          f"{gp.get('window_s'):.3f}s / {gp.get('steps')} steps"
+          if gp.get("active") else f"slo_goodput: ledger inactive: {gp}")
+    for e in errors:
+        print("check_slo FAIL:", e)
+    if not errors:
+        print("check_slo: zero false alarms, injected storm fired and "
+              "recovered counted, ledger sums to the window — held")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -227,4 +227,25 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
        echo "tier1: lost the worker-side spans, federation sums drifted,"
        echo "tier1: or a dead member hung/went uncounted)"; exit 1; }
 
+# Stage 12: SLO-engine + goodput-ledger smoke (telemetry/slo +
+# telemetry/goodput, ISSUE 17) — the metrics plane turned into verdicts:
+# the default ruleset must stay SILENT over a healthy process (zero
+# firing rules, zero alert transitions), a deterministic injected shed
+# storm must walk serving_shed_ratio ok -> firing -> (on healthy
+# traffic) ok with every transition counted MONOTONE in
+# slo_alerts_total, a flight dump written mid-storm must name the
+# burning rule, and the goodput ledger's six wall-clock categories over
+# a real instrumented fit must sum to the observed window within 5%.
+# scripts/check_slo.py gates STRUCTURALLY — never wall time.
+echo "== slo-engine + goodput smoke =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
+  timeout -k 10 300 python bench.py slo_goodput \
+  > /tmp/_slo_goodput.jsonl \
+  && tee -a BENCH_smoke.json < /tmp/_slo_goodput.jsonl > /dev/null \
+  && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python scripts/check_slo.py /tmp/_slo_goodput.jsonl \
+  || { echo "tier1: slo/goodput smoke FAILED (a healthy run fired, the"
+       echo "tier1: injected storm did not, a transition went uncounted,"
+       echo "tier1: or the goodput ledger lost wall-clock seconds)"; exit 1; }
+
 exit $rc

@@ -124,6 +124,36 @@ def test_default_targets_skip_broken_provider():
     assert federate.default_targets(include_local=False) == []
 
 
+def test_federated_slo_dead_member_neither_fires_nor_masks():
+    # the SLO engine evaluated over the federated merge (ISSUE 17): a
+    # dead member degrades to counted scrape errors upstream and its
+    # vanished series contribute nothing — the rule neither fires on
+    # the dropout nor goes blind to a real burn on the survivors
+    telemetry.enable()
+    from deeplearning4j_tpu.telemetry import slo
+    eng = slo.SloEngine(rules=[
+        slo.SloRule("errs", "rate", "errors_total",
+                    fire=1.0, window_s=60.0)])
+    dead = f"http://127.0.0.1:{procutil.free_port()}/metrics"
+    fed = federate.federate([("live", _snap(errors_total=100)),
+                             ("dead", dead)], timeout_s=1.0)
+    eng.evaluate(fed, now=0.0)
+    fed = federate.federate([("live", _snap(errors_total=100)),
+                             ("dead", dead)], timeout_s=1.0)
+    eng.evaluate(fed, now=30.0)
+    # bad twin: the dead member did NOT fire the rule...
+    assert eng.state("errs") == "ok"
+    # ...and its failures are the counted federate path, not silence
+    smap = telemetry.series_map("federate_scrape_total")
+    assert smap.get("instance=dead|outcome=error") == 2
+    # good twin: a real +400 burn on the LIVE member still fires right
+    # through the flapping peer
+    fed = federate.federate([("live", _snap(errors_total=500)),
+                             ("dead", dead)], timeout_s=1.0)
+    eng.evaluate(fed, now=60.0)
+    assert eng.state("errs") == "firing"
+
+
 # ---- cluster timeline --------------------------------------------------
 
 def _round_doc(rnd, t0_unix, dur=0.5):

@@ -1,0 +1,436 @@
+"""SLO engine + goodput ledger (ISSUE 17): declarative rules turned
+into counted ok|warning|firing verdicts (rate / ratio / threshold /
+multi-window burn / EWMA drift, the dead-member delta discipline, the
+flight-dump postmortem section, the inert seam), the wall-clock goodput
+ledger whose categories sum to the window by construction, the
+ContinuousTrainer snapshot gate consulting the verdicts, and the /slo
++ ``slo`` CLI surfaces."""
+
+import http.server
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.telemetry import goodput, slo
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    telemetry.reset()
+    telemetry.disable()
+    yield
+    telemetry.reset()
+    telemetry.disable()
+
+
+def _snap(**counters):
+    return {name: {"kind": "counter", "help": "",
+                   "series": [{"labels": {}, "value": v}]}
+            for name, v in counters.items()}
+
+
+def _lsnap(name, series):
+    """{labels-dict-tuple: value} -> one labeled-counter metric doc."""
+    return {name: {"kind": "counter", "help": "",
+                   "series": [{"labels": dict(lbl), "value": v}
+                              for lbl, v in series]}}
+
+
+def _hsnap(name, total, count):
+    return {name: {"kind": "histogram", "help": "",
+                   "series": [{"labels": {},
+                               "value": {"buckets": {}, "sum": total,
+                                         "count": count}}]}}
+
+
+# ---- rule predicates ---------------------------------------------------
+
+def test_rate_rule_fires_and_recovers_counted():
+    telemetry.enable()
+    rule = slo.SloRule("errs", "rate", "errors_total",
+                       fire=1.0, warn=0.5, window_s=60.0)
+    eng = slo.SloEngine(rules=[rule])
+    # one sample: no delta yet -> insufficient data, state held, nothing
+    # counted
+    eng.evaluate(_snap(errors_total=0), now=0.0)
+    assert eng.state("errs") == "ok"
+    assert telemetry.series_map("slo_alerts_total") == {}
+    # 120 errors in 60s: 2/s >= fire -> ok -> firing, counted
+    st = eng.evaluate(_snap(errors_total=120), now=60.0)
+    assert eng.state("errs") == "firing"
+    assert st["firing"] == ["errs"]
+    # flat counter for the next window: rate 0 -> recovery, counted too
+    eng.evaluate(_snap(errors_total=120), now=120.0)
+    assert eng.state("errs") == "ok"
+    smap = telemetry.series_map("slo_alerts_total")
+    assert smap.get("rule=errs|state=firing") == 1
+    assert smap.get("rule=errs|state=ok") == 1
+    assert telemetry.series_map("slo_rule_state") == {"rule=errs": 0.0}
+
+
+def test_ratio_rule_min_den_suppresses_thin_traffic():
+    rule = slo.SloRule("shed", "ratio", "shed_total",
+                       den_metric="req_total", fire=0.2,
+                       window_s=300.0, min_den=10.0)
+    eng = slo.SloEngine(rules=[rule])
+    eng.evaluate(_snap(shed_total=0, req_total=0), now=0.0)
+    # 1 shed of 2 requests is a 0.5 ratio on NOISE: below min_den the
+    # rule abstains rather than paging on two requests
+    eng.evaluate(_snap(shed_total=1, req_total=2), now=60.0)
+    assert eng.state("shed") == "ok"
+    # real traffic at the same ratio fires
+    st = eng.evaluate(_snap(shed_total=21, req_total=42), now=120.0)
+    assert eng.state("shed") == "firing"
+    assert st["rules"][0]["value"] == pytest.approx(0.5)
+
+
+def test_threshold_rules_both_directions():
+    high = slo.SloRule("depth_high", "threshold", "queue_depth", fire=5.0)
+    low = slo.SloRule("workers_low", "threshold", "workers_alive",
+                      fire=1.0, op="lt")
+    eng = slo.SloEngine(rules=[high, low])
+    eng.evaluate(_snap(queue_depth=7, workers_alive=4), now=0.0)
+    assert eng.state("depth_high") == "firing"  # 7 >= 5
+    assert eng.state("workers_low") == "ok"     # 4 > 1
+    eng.evaluate(_snap(queue_depth=2, workers_alive=0), now=30.0)
+    assert eng.state("depth_high") == "ok"
+    assert eng.state("workers_low") == "firing"  # 0 <= 1
+
+
+def test_burn_rate_brief_spike_holds_sustained_burn_fires():
+    rule = slo.SloRule("burn", "burn_rate", "drops_total", fire=1.0,
+                       short_window_s=60.0, long_window_s=600.0)
+    eng = slo.SloEngine(rules=[rule])
+    for i in range(21):  # a quiet first 600s, sampled every 30s
+        eng.evaluate(_snap(drops_total=0), now=30.0 * i)
+    # a single +100 spike: the SHORT window burns (>1/s) but the LONG
+    # window does not (100/600s) -> stays ok, no page for a blip
+    eng.evaluate(_snap(drops_total=100), now=630.0)
+    assert eng.state("burn") == "ok"
+    val = eng.status()["rules"][0]["value"]
+    assert val["short"] >= 1.0 and val["long"] < 1.0
+    # the burn SUSTAINS: +100 every 30s until both windows exceed
+    total = 100
+    for i in range(1, 11):
+        total += 100
+        eng.evaluate(_snap(drops_total=total), now=630.0 + 30.0 * i)
+    assert eng.state("burn") == "firing"
+    val = eng.status()["rules"][0]["value"]
+    assert val["short"] >= 1.0 and val["long"] >= 1.0
+
+
+def test_ewma_drift_fires_on_step_time_regression():
+    rule = slo.SloRule("step_drift", "ewma_drift", "step_seconds",
+                       fire=1.5, warn=1.25, min_intervals=5)
+    eng = slo.SloEngine(rules=[rule])
+    # 5 intervals at a steady 10ms mean: fast == slow, drift 1.0
+    for i in range(6):
+        eng.evaluate(_hsnap("step_seconds", 0.01 * i, i), now=30.0 * i)
+    assert eng.state("step_drift") == "ok"
+    assert eng.status()["rules"][0]["value"] == pytest.approx(1.0)
+    # one interval at 30ms: fast EWMA jumps 3x faster than slow ->
+    # ratio 0.016/0.0106 = 1.509 >= fire
+    eng.evaluate(_hsnap("step_seconds", 0.08, 6), now=180.0)
+    assert eng.state("step_drift") == "firing"
+    assert eng.status()["rules"][0]["value"] == pytest.approx(1.509, abs=1e-2)
+
+
+# ---- the dead-member / counter-reset delta discipline ------------------
+
+def test_dead_member_and_reset_never_fire_or_mask():
+    rule = slo.SloRule("errs", "rate", "errors_total",
+                       fire=1.0, window_s=60.0)
+    eng = slo.SloEngine(rules=[rule])
+
+    def doc(a, b=None):
+        series = [({"instance": "a"}.items(), a)]
+        if b is not None:
+            series.append(({"instance": "b"}.items(), b))
+        return _lsnap("errors_total", series)
+
+    eng.evaluate(doc(100, 50), now=0.0)
+    # b vanishes (dead member): its 50 must not become a negative or a
+    # spike — nothing contributes, rate 0
+    eng.evaluate(doc(100), now=30.0)
+    assert eng.state("errs") == "ok"
+    # b rejoins carrying its LIFETIME total: a new-series appearance
+    # contributes nothing either
+    eng.evaluate(doc(100, 5000), now=60.0)
+    assert eng.state("errs") == "ok"
+    # but a real burn on the surviving member still fires: +400 on a in
+    # 30s is not masked by the flapping peer
+    eng.evaluate(doc(500, 5000), now=90.0)
+    assert eng.state("errs") == "firing"
+    # a counter RESET (restart: cur < prev) is a skipped interval, and
+    # with no other delta the window decays back to ok
+    eng.evaluate(doc(20, 5000), now=150.0)
+    assert eng.state("errs") == "ok"
+
+
+def test_insufficient_data_holds_firing_state():
+    rule = slo.SloRule("shed", "ratio", "shed_total",
+                       den_metric="req_total", fire=0.2,
+                       window_s=60.0, min_den=10.0)
+    eng = slo.SloEngine(rules=[rule])
+    eng.evaluate(_snap(shed_total=0, req_total=0), now=0.0)
+    eng.evaluate(_snap(shed_total=21, req_total=42), now=60.0)
+    assert eng.state("shed") == "firing"
+    # traffic stops entirely: denominator delta 0 < min_den -> the rule
+    # abstains and HOLDS firing ("no data" is not good news)
+    eng.evaluate(_snap(shed_total=21, req_total=42), now=120.0)
+    assert eng.state("shed") == "firing"
+
+
+# ---- default ruleset / process seams -----------------------------------
+
+def test_default_rules_inert_on_healthy_process():
+    telemetry.enable()
+    eng = slo.SloEngine()  # default_rules() over the live local registry
+    assert len(eng.rules) >= 8
+    for i in range(3):
+        st = eng.evaluate(now=30.0 * i)
+    assert st["firing"] == [] and st["warning"] == []
+    assert telemetry.series_map("slo_alerts_total") == {}
+
+
+def test_duplicate_rule_names_rejected():
+    r = slo.SloRule("x", "rate", "m_total", fire=1.0)
+    with pytest.raises(ValueError):
+        slo.SloEngine(rules=[r, slo.SloRule("x", "rate", "n_total",
+                                            fire=1.0)])
+    with pytest.raises(ValueError):
+        slo.SloRule("bad", "percentile", "m_total", fire=1.0)
+    with pytest.raises(ValueError):
+        slo.SloRule("bad", "ratio", "m_total", fire=1.0)  # no den_metric
+
+
+def test_inert_seam_consults_without_waking_the_engine():
+    # the embed-everywhere queries must not instantiate an engine:
+    # nothing evaluates until something turns the SLO plane on
+    assert slo.alerts() == {"firing": [], "warning": []}
+    assert slo.firing_gate_rules() == []
+    assert slo._default_engine is None
+
+
+def test_flight_dump_names_burning_rule(tmp_path):
+    telemetry.enable()
+    from deeplearning4j_tpu.telemetry import flight
+    eng = slo.get_engine()  # registers the dump section
+    flight.get_recorder().note(step=1, wall_ms=3.0)
+    den = [({"outcome": "submitted"}.items(), 0)]
+    eng.evaluate(dict(_snap(serving_shed_total=0),
+                      **_lsnap("serving_model_requests_total", den)),
+                 now=0.0)
+    den = [({"outcome": "submitted"}.items(), 120)]
+    eng.evaluate(dict(_snap(serving_shed_total=60),
+                      **_lsnap("serving_model_requests_total", den)),
+                 now=60.0)
+    assert eng.state("serving_shed_ratio") == "firing"
+    path = flight.get_recorder().dump("test_storm",
+                                      path=str(tmp_path / "dump.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    # the postmortem names the burning rule without any live process
+    assert "serving_shed_ratio" in doc["slo"]["firing"]
+    named = [r["name"] for r in doc["slo"]["rules"]]
+    assert "serving_shed_ratio" in named
+
+
+# ---- decision seams: trainer gate + fleet router -----------------------
+
+def test_trainer_snapshot_gate_skips_on_firing_slo(tmp_path):
+    telemetry.enable()
+    from deeplearning4j_tpu.continuous import chaos
+    from deeplearning4j_tpu.continuous.trainer import ContinuousTrainer
+    tr = ContinuousTrainer(chaos.smoke_net(), list(chaos.gen_batches(3, 2)),
+                           snapshot_path=str(tmp_path / "s.zip"))
+    try:
+        eng = slo.get_engine()
+        eng.evaluate(_snap(train_numerics_anomalies_total=0), now=0.0)
+        eng.evaluate(_snap(train_numerics_anomalies_total=5), now=60.0)
+        assert "numerics_anomalies" in slo.firing_gate_rules()
+        # a firing gate-tagged rule blocks publication, counted
+        assert tr.snapshot() is None
+        smap = telemetry.series_map("continuous_snapshots_total")
+        assert smap.get("verdict=skipped_sick") == 1
+    finally:
+        tr.close()
+
+
+def test_fleet_router_slo_snapshot_inert():
+    telemetry.enable()
+    from deeplearning4j_tpu.fleet.router import FleetRouter
+    router = FleetRouter(name="m")
+    try:
+        doc = router.slo_snapshot()
+    finally:
+        router.stop()
+    assert doc["model"] == "m"
+    for key in ("queue_depth", "submitted", "shed", "shed_ratio",
+                "latency_s", "workers", "alerts"):
+        assert key in doc
+    # no engine was started: the alerts block is the inert-empty shape
+    assert doc["alerts"] == {"firing": [], "warning": []}
+
+
+# ---- goodput ledger ----------------------------------------------------
+
+def test_goodput_inactive_and_note_guards():
+    led = goodput.GoodputLedger()
+    assert led.snapshot() == {"active": False}
+    led.note("exchange", 1.0)  # window closed: silently dropped
+    led.note_tokens(100)
+    assert led.snapshot() == {"active": False}
+    with pytest.raises(ValueError):
+        led.note("idle", 1.0)  # derived category, never noted
+
+
+def test_goodput_categories_sum_to_window():
+    telemetry.enable()
+    led = goodput.GoodputLedger().start(now=100.0)
+    _, step_h, etl_h, _, _ = telemetry.train_metrics()
+    for _ in range(3):
+        step_h.observe(0.5)
+    etl_h.observe(0.2)
+    led.note("exchange", 1.0)
+    led.note("checkpoint", 0.5)
+    led.note_tokens(800)
+    snap = led.snapshot(now=110.0)
+    assert snap["active"] and snap["steps"] == 3
+    sec = snap["seconds"]
+    assert sec["compute"] == pytest.approx(1.5)
+    assert sec["etl_stall"] == pytest.approx(0.2)
+    assert sec["exchange"] == pytest.approx(1.0)
+    assert sec["checkpoint"] == pytest.approx(0.5)
+    assert sec["rollback_lost"] == 0.0
+    assert sec["idle"] == pytest.approx(6.8)
+    assert sum(sec.values()) == pytest.approx(snap["window_s"])
+    assert snap["goodput_fraction"] == pytest.approx(0.15)
+    assert snap["tokens_per_s"] == pytest.approx(80.0)
+    # noted seconds are ALSO counters the SLO engine can rule on
+    smap = telemetry.series_map("goodput_seconds_total")
+    assert smap.get("category=exchange") == pytest.approx(1.0)
+    assert smap.get("category=checkpoint") == pytest.approx(0.5)
+
+
+def test_goodput_rollback_clamps_against_compute():
+    telemetry.enable()
+    led = goodput.GoodputLedger().start(now=0.0)
+    _, step_h, _, _, _ = telemetry.train_metrics()
+    step_h.observe(1.5)
+    # a rollback estimate larger than the window's compute must not go
+    # negative: everything computed is lost, no more
+    led.note("rollback_lost", 99.0)
+    sec = led.snapshot(now=10.0)["seconds"]
+    assert sec["rollback_lost"] == pytest.approx(1.5)
+    assert sec["compute"] == 0.0
+    assert sum(sec.values()) == pytest.approx(10.0)
+
+
+def test_goodput_noted_compute_for_uninstrumented_loops():
+    # the hostfleet worker's StepDriver is uninstrumented: it notes its
+    # round-edge timers directly and they ADD to the histogram deltas
+    telemetry.enable()
+    led = goodput.GoodputLedger().start(now=0.0)
+    led.note("compute", 2.0)
+    led.note("etl_stall", 0.5)
+    sec = led.snapshot(now=10.0)["seconds"]
+    assert sec["compute"] == pytest.approx(2.0)
+    assert sec["etl_stall"] == pytest.approx(0.5)
+
+
+def test_goodput_mfu_and_rebase():
+    telemetry.enable()
+    led = goodput.GoodputLedger().start(now=0.0)
+    _, step_h, _, _, _ = telemetry.train_metrics()
+    for _ in range(3):
+        step_h.observe(0.1)
+    led.set_flops_per_step(1e9)
+    led.set_peak_flops(1e12)
+    snap = led.snapshot(now=10.0)
+    assert snap["mfu"] == pytest.approx(3e-4)  # 3e9 / (10s * 1e12)
+    # start() REBASES: the new window carries nothing across
+    led.start(now=50.0)
+    snap = led.snapshot(now=60.0)
+    assert snap["steps"] == 0
+    assert snap["seconds"]["compute"] == 0.0
+    assert snap["seconds"]["idle"] == pytest.approx(10.0)
+
+
+def test_goodput_real_fit_sums_within_tolerance():
+    # the tier-1 gate's ±5% contract on a real (tiny) instrumented fit:
+    # the driver's etl and step spans are disjoint, idle absorbs the rest
+    telemetry.enable()
+    from deeplearning4j_tpu.continuous import chaos
+    from deeplearning4j_tpu.continuous.driver import StepDriver
+    batches = list(chaos.gen_batches(7, 4, batch=8))
+    net = chaos.smoke_net()
+    net.init()
+    led = goodput.get_ledger().start()
+    driver = StepDriver(net, lambda: ((x, y, None) for x, y in batches))
+    driver.run_round(None)
+    driver.sync()
+    snap = led.snapshot()
+    assert snap["active"] and snap["steps"] == 4
+    sec = snap["seconds"]
+    assert sec["compute"] > 0
+    total = sum(sec.values())
+    assert abs(total - snap["window_s"]) <= 0.05 * snap["window_s"]
+
+
+# ---- surfaces: /slo, /health, CLI --------------------------------------
+
+def test_ui_serves_slo_and_goodput():
+    telemetry.enable()
+    from deeplearning4j_tpu.ui.server import UIServer
+    server = UIServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/slo", timeout=10) as r:
+            st = json.loads(r.read().decode())
+        assert st["firing"] == []
+        assert {r["name"] for r in st["rules"]} >= {
+            "serving_shed_ratio", "numerics_anomalies",
+            "step_time_regression"}
+        with urllib.request.urlopen(f"{base}/health", timeout=10) as r:
+            health = json.loads(r.read().decode())
+        assert "goodput" in health
+        assert health["goodput"] == {"active": False}
+    finally:
+        server.stop()
+
+
+def test_cli_slo_local_json_and_url_gate():
+    telemetry.enable()
+    from deeplearning4j_tpu.cli import main
+    assert main(["slo", "--samples", "1", "--json"]) == 0
+
+    # --gate against a canned firing /slo payload exits nonzero (local
+    # mode would re-evaluate on the real clock and clear the state)
+    payload = json.dumps({"rules": [], "warning": [],
+                          "firing": ["serving_shed_ratio"],
+                          "evaluations": 2}).encode()
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *args):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/slo"
+        assert main(["slo", "--url", url, "--gate", "--json"]) == 1
+        assert main(["slo", "--url", url, "--json"]) == 0
+    finally:
+        srv.shutdown()
