@@ -104,6 +104,17 @@ def _headlines(rec):
         # only windows that saw real steps: a serving-only config's
         # all-idle ledger is not a trainer regression signal
         out["goodput_fraction"] = (float(gp["goodput_fraction"]), True)
+    fleet = rec.get("fleet")
+    if isinstance(fleet, dict):
+        # the demand plane's externally-measured numbers: the probe's
+        # wire-path p50 (lower is better) and the usage ledger's served
+        # rows (a shrinking ledger on the same legs means lost demand
+        # accounting, not a faster run)
+        if isinstance(fleet.get("probe_latency_p50_ms"), (int, float)):
+            out["probe_latency_p50_ms"] = (
+                float(fleet["probe_latency_p50_ms"]), False)
+        if isinstance(fleet.get("ledger_rows"), (int, float)):
+            out["usage_ledger_rows"] = (float(fleet["ledger_rows"]), True)
     return out
 
 

@@ -1283,6 +1283,212 @@ def bench_slo_goodput():
             "alerts_after_recovery": alerts2}
 
 
+def bench_demand_obs():
+    """Demand observability end to end (ISSUE 18). Three legs, one
+    record, all gated STRUCTURALLY by scripts/check_demand.py (counters,
+    ledger balance and parity — never wall time):
+
+    * HISTORY — a real fit sampled into a MetricsHistory ring on a
+      synthetic clock, persisted as atomic JSONL segments, and
+      ``rate_over`` checked against the live SLO delta discipline fed
+      the SAME sample points (the <=1e-6 parity acceptance);
+    * FLEET — a REAL 2-worker fleet left ORGANICALLY IDLE while a
+      FleetProber canaries it through the router wire path: probe_total
+      advances while every unlabeled organic series stays exactly zero
+      (the isolation acceptance), then tenant-labeled organic traffic
+      runs and the per-model usage ledger (worker /usage, folded by
+      router.health()) must balance EXACTLY against the router's
+      served_rows;
+    * STORM — a wrong-answer canary (pinned reference deliberately
+      off) driven against an in-process engine on a synthetic clock:
+      ``probe_failure_ratio`` walks ok -> firing -> ok with both
+      transitions counted in ``slo_alerts_total``."""
+    import shutil
+    import statistics
+    import tempfile
+
+    import numpy as np
+
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.continuous import chaos
+    from deeplearning4j_tpu.continuous.driver import StepDriver
+    from deeplearning4j_tpu.fleet import (FleetProber, FleetRouter,
+                                          FleetSupervisor)
+    from deeplearning4j_tpu.fleet.supervisor import default_worker_env
+    from deeplearning4j_tpu.serving import ServingEngine
+    from deeplearning4j_tpu.telemetry import slo as _slo
+    from deeplearning4j_tpu.telemetry.history import MetricsHistory, load_dir
+    from deeplearning4j_tpu.utils.serialization import save_model
+
+    telemetry.enable()
+    reg = telemetry.get_registry()
+    workdir = tempfile.mkdtemp(prefix="demand_obs_bench_")
+    sup = router = None
+    try:
+        # --- HISTORY leg ----------------------------------------------
+        hist_dir = os.path.join(workdir, "history")
+        store = MetricsHistory(history_dir=hist_dir, segment_samples=2,
+                               max_segments=16)
+        live = _slo._DeltaTrack(keep_s=3600.0)
+        metric = "train_iterations_total"
+        iters = 8 if _preflight() else 24
+        net = chaos.smoke_net(seed=21)
+        net.init()
+        batches = chaos.gen_batches(33, iters, batch=16)
+        driver = StepDriver(net, lambda: ((x, y, None) for x, y in batches))
+        t0 = 1000.0
+        store.sample_now(now=t0)
+        live.sample(t0, _slo._select(reg.snapshot(), metric, {}))
+        for i in range(4):
+            driver.run_round(max(iters // 4, 1))
+            t = t0 + 30.0 * (i + 1)
+            store.sample_now(now=t)
+            live.sample(t, _slo._select(reg.snapshot(), metric, {}))
+        driver.sync()
+        store.flush()
+        t_end = t0 + 30.0 * 4
+        parity = {}
+        for window in (60.0, 120.0):
+            want = live.rate(window, t_end)
+            got = store.rate_over(metric, window, now=t_end)
+            parity[f"{window:g}s"] = {
+                "live": want, "history": got,
+                "abs_err": (None if want is None or got is None
+                            else abs(got - want))}
+        reloaded, corrupt = load_dir(hist_dir)
+        history_leg = {
+            "metric": metric, "samples": len(store.samples()),
+            "segments": len(store.segment_paths()),
+            "reloaded_samples": len(reloaded), "corrupt": corrupt,
+            "rate_parity": parity,
+            "history_counters": {
+                "history_samples_total":
+                    telemetry.series_map("history_samples_total"),
+                "history_segment_total":
+                    telemetry.series_map("history_segment_total")}}
+
+        # --- FLEET leg ------------------------------------------------
+        hidden = 64 if _preflight() else 128
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.nn import updaters as U
+        from deeplearning4j_tpu.nn.conf import inputs as I
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = NeuralNetConfig(seed=5,
+                               updater=U.Sgd(learning_rate=0.1)).list(
+            L.DenseLayer(n_out=hidden, activation="relu"),
+            L.OutputLayer(n_out=10, loss="mcxent"),
+            input_type=I.FeedForwardType(32))
+        fnet = MultiLayerNetwork(conf)
+        fnet.init()
+        ckpt = os.path.join(workdir, "ckpt.zip")
+        save_model(fnet, ckpt)
+        env = default_worker_env()
+        env["DL4J_TPU_TELEMETRY"] = "1"
+        sup = FleetSupervisor(2, model_path=ckpt, buckets=[1], env=env,
+                              probe_interval_s=5.0, max_missed_probes=5)
+        router = FleetRouter(name="demand", request_timeout_s=30.0)
+        sup.attach(router)
+        sup.start()
+        xs = np.random.RandomState(0).rand(8, 32).astype(np.float32)
+        # pinned references from the LOCAL net: the wire carries float32
+        # exactly, so a correct fleet answers within 1e-6
+        refs = np.asarray(fnet.output(xs))
+        # the organic-facing series this process holds BEFORE any probe
+        canaries = [{"name": f"c{i}", "x": xs[i], "expect": refs[i],
+                     "model": "demand"} for i in range(2)]
+        prober = FleetProber(router, canaries, tol=1e-6, timeout_s=20.0)
+        rounds = 3
+        lat_ms = []
+        for _ in range(rounds):
+            for r in prober.probe_once():
+                if r["latency_ms"] is not None:
+                    lat_ms.append(r["latency_ms"])
+        idle_fleet_series = telemetry.series_map("fleet_requests_total")
+        idle_probe_total = telemetry.series_map("probe_total")
+        # now ORGANIC traffic, tenant-attributed — after the idle check
+        futs = [router.submit(xs[i % 8], deadline_s=30.0,
+                              tenant=("acme" if i % 2 else "zenith"))
+                for i in range(8)]
+        for f in futs:
+            f.get(timeout=30)
+        served_rows = router.stats()["requests"]["served_rows"]
+        health = router.health()
+        usage_fold = health.get("usage") or {}
+        fleet_leg = {
+            "rounds": rounds, "probes": prober.status()["probes"],
+            "probe_ok": prober.status()["ok"],
+            "idle_fleet_requests_total": idle_fleet_series,
+            "idle_probe_total": idle_probe_total,
+            "organic_requests": 8,
+            "served_rows": served_rows,
+            "usage_by_model": usage_fold,
+            # the workers serve the checkpoint under THEIR model name;
+            # the balance is per model, and this fleet serves exactly one
+            "ledger_rows": sum((m or {}).get("rows") or 0
+                               for m in usage_fold.values()),
+            "fleet_requests_total":
+                telemetry.series_map("fleet_requests_total"),
+            "probe_total": telemetry.series_map("probe_total"),
+            "probe_latency_p50_ms": (statistics.median(lat_ms)
+                                     if lat_ms else None)}
+
+        # --- STORM leg ------------------------------------------------
+        engine = ServingEngine(fnet, name="storm", input_spec=(32,),
+                               buckets=[1], batch_window_s=0.0).start()
+        slo_engine = _slo.SloEngine(rules=_slo.default_rules(),
+                                    registry=reg)
+        x0 = xs[0]
+        good = refs[0]
+        ok_prober = FleetProber(engine, [{"x": x0, "expect": good,
+                                          "model": "storm"}], tol=1e-6,
+                                timeout_s=20.0)
+        bad_prober = FleetProber(engine, [{"x": x0, "expect": good + 1.0,
+                                           "model": "storm"}], tol=1e-6,
+                                 timeout_s=20.0)
+        ts = 5000.0
+        states = []
+
+        def drive(p, n, t):
+            for _ in range(n):
+                p.probe_once()
+            st = slo_engine.evaluate(now=t)
+            return {r["name"]: r for r in st["rules"]}[
+                "probe_failure_ratio"]
+
+        r0 = drive(ok_prober, 4, ts)            # healthy baseline
+        states.append(r0["state"])
+        r1 = drive(ok_prober, 4, ts + 60.0)
+        states.append(r1["state"])
+        r2 = drive(bad_prober, 8, ts + 120.0)   # the wrong-answer storm
+        states.append(r2["state"])
+        r3 = drive(ok_prober, 8, ts + 180.0)    # recovery
+        r4 = drive(ok_prober, 8, ts + 400.0)    # window slides past storm
+        states.extend([r3["state"], r4["state"]])
+        engine.stop()
+        storm_leg = {"rule": "probe_failure_ratio", "states": states,
+                     "storm_value": r2["value"],
+                     "alerts_total": telemetry.series_map(
+                         "slo_alerts_total")}
+
+        return {"metric": "demand_obs",
+                "value": fleet_leg["probe_latency_p50_ms"], "unit": "ms",
+                "vs_baseline": None,  # net-new plane: no reference analog
+                "workers": 2, "hidden": hidden, "fit_iters": iters,
+                "history": history_leg, "fleet": fleet_leg,
+                "storm": storm_leg,
+                "usage_rows_total":
+                    telemetry.series_map("usage_rows_total")}
+    finally:
+        try:
+            if router is not None:
+                router.stop()
+            if sup is not None:
+                sup.stop()
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_continuous():
     """The continuous-learning loop under injected faults (ISSUE 13):
     a REAL runner subprocess trains from a live pubsub stream while the
@@ -2059,7 +2265,8 @@ CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
            "kernels": bench_kernels, "fleet": bench_fleet,
            "continuous": bench_continuous, "hostfleet": bench_hostfleet,
            "cluster_obs": bench_cluster_obs,
-           "slo_goodput": bench_slo_goodput}
+           "slo_goodput": bench_slo_goodput,
+           "demand_obs": bench_demand_obs}
 DEFAULT_ORDER = ["lenet", "resnet50", "lstm", "word2vec", "parallel",
                  "transformer", "longcontext", "fused", "serving", "zero"]
 

@@ -285,6 +285,12 @@ def _build_parser():
                          "http://127.0.0.1:9000/slo — append ?federate=1 "
                          "for the cluster-wide evaluation) instead of "
                          "evaluating the local registry")
+    sl.add_argument("--history", metavar="PATH",
+                    help="replay a metrics-history dir (or one segment "
+                         "file) through the engine before evaluating — "
+                         "judge the minutes BEFORE a dump/restart, not "
+                         "just the instant of death (the flightrec "
+                         "'history' section names the dir)")
     sl.add_argument("--samples", type=int, default=2,
                     help="local mode: evaluation passes (rates need >=2 "
                          "samples spanning time; default 2)")
@@ -1040,10 +1046,30 @@ def _cmd_slo(args):
                   "a live server with --url http://host:port/slo",
                   file=sys.stderr)
         engine = telemetry.slo.get_engine()
-        status = engine.evaluate()
-        for _ in range(max(args.samples - 1, 0)):
-            time.sleep(max(args.interval, 0.0))
+        if getattr(args, "history", None):
+            # postmortem replay: judge the persisted minutes, not this
+            # (possibly freshly-restarted, empty) process's instant. The
+            # samples carry their own unix clocks, so mixing in live
+            # monotonic-clock passes would corrupt the delta windows —
+            # with --history the replay IS the evaluation.
+            from deeplearning4j_tpu.telemetry import history as _history
+            samples, corrupt = _history.load_dir(args.history)
+            if not samples:
+                print(f"slo --history: no samples under {args.history} "
+                      f"({corrupt} corrupt segment(s))", file=sys.stderr)
+                return 1
+            status = None
+            for s in samples:
+                status = engine.evaluate(metrics=s["metrics"], now=s["t"])
+            span_s = samples[-1]["t"] - samples[0]["t"]
+            print(f"slo --history: replayed {len(samples)} sample(s) "
+                  f"spanning {span_s:.0f}s ({corrupt} corrupt segment(s) "
+                  f"skipped)", file=sys.stderr)
+        else:
             status = engine.evaluate()
+            for _ in range(max(args.samples - 1, 0)):
+                time.sleep(max(args.interval, 0.0))
+                status = engine.evaluate()
     if args.json:
         print(json.dumps(status, indent=1, default=str))
     else:
@@ -1261,6 +1287,15 @@ def _cmd_flightrec(args):
         print(f"error: {doc['error']}")
     if doc.get("anomaly"):
         print(f"anomaly: {doc['anomaly']}")
+    hist = doc.get("history")
+    if hist:
+        # where to find the minutes BEFORE this dump: the persisted
+        # metrics-history segments replay with `slo --history <dir>`
+        print(f"history: {hist.get('samples', 0)} sample(s) in ring, "
+              f"{hist.get('segments', 0)} segment(s) persisted"
+              + (f" under {hist['dir']} (replay: slo --history "
+                 f"{hist['dir']})" if hist.get("dir") else
+                 " (persistence off: no history dir configured)"))
     show = recs[-args.last:] if args.last else recs
 
     def _fmt(v):

@@ -43,12 +43,13 @@ from __future__ import annotations
 
 import threading
 
+from deeplearning4j_tpu.fleet.prober import FleetProber
 from deeplearning4j_tpu.fleet.router import FleetRouter
 from deeplearning4j_tpu.fleet.supervisor import (FleetSupervisor,
                                                  default_worker_env)
 from deeplearning4j_tpu.fleet.worker import FleetWorker
 
-__all__ = ["FleetRouter", "FleetSupervisor", "FleetWorker",
+__all__ = ["FleetProber", "FleetRouter", "FleetSupervisor", "FleetWorker",
            "default_worker_env", "fleet_status", "get_default_front",
            "reset", "set_default_front"]
 
@@ -129,4 +130,10 @@ def fleet_status(probe=False):
             out["health"] = router.health()
     if supervisor is not None:
         out["workers"] = supervisor.status()
+    from deeplearning4j_tpu.fleet import prober as _prober
+    probe_status = _prober.status()
+    if probe_status is not None:
+        # the synthetic-monitoring verdicts ride /fleet so one read
+        # answers "is the fleet up AND answering correctly"
+        out["prober"] = probe_status
     return out

@@ -39,12 +39,13 @@ import urllib.request
 import numpy as np
 
 from deeplearning4j_tpu import telemetry as _tm
-from deeplearning4j_tpu.fleet.worker import (PARENT_SPAN_HEADER,
+from deeplearning4j_tpu.fleet.worker import (ORIGIN_HEADER,
+                                             PARENT_SPAN_HEADER,
                                              TRACE_ID_HEADER)
 from deeplearning4j_tpu.serving.engine import (InferenceFuture,
                                                ServingOverloaded,
                                                ServingShutdown, _as_input,
-                                               _overloaded)
+                                               _origin_labels, _overloaded)
 from deeplearning4j_tpu.telemetry import timeline as _timeline
 from deeplearning4j_tpu.telemetry import tracectx as _tracectx
 
@@ -244,14 +245,25 @@ class FleetRouter:
 
     # ---- request path ----
 
-    def submit(self, x, deadline_s=None, *, batched=False):
+    def submit(self, x, deadline_s=None, *, batched=False, tenant=None,
+               origin=None):
         """Queue one example (or one multi-example batch with
         ``batched=True``); returns an :class:`InferenceFuture`. Admission
         bounds queued EXAMPLES exactly like the engine's submit: a full
-        front sheds here rather than queueing without bound."""
+        front sheds here rather than queueing without bound.
+
+        ``tenant``/``origin`` ride the wire to the worker engine
+        (demand attribution / synthetic-traffic marking): a probe-origin
+        request counts into origin-labeled series (excluded by every
+        default SLO rule) and never enters the front's rolling p50/p99
+        ring; a tenant feeds the per-tenant usage ledger worker-side."""
         if self._stop.is_set():
             raise ServingShutdown(
                 f"fleet router {self.name!r} is stopped")
+        meta = None
+        if tenant is not None or origin is not None:
+            meta = {"tenant": tenant, "origin": origin}
+        olab = {"origin": str(origin)} if origin else {}
         item = _as_input(x)
         if batched:
             dims = {(int(np.shape(l)[0]) if np.ndim(l) else -1)
@@ -286,7 +298,7 @@ class FleetRouter:
         deadline = None if deadline_s is None else now + deadline_s
         self._count("submitted")
         if self._reg.enabled:
-            self._m_requests.inc(outcome="submitted")
+            self._m_requests.inc(outcome="submitted", **olab)
         with self._lock:
             if self._pending_rows + rows > self.max_queue:
                 full = True
@@ -296,8 +308,9 @@ class FleetRouter:
         if full:
             self._count("shed_queue_full")
             if self._reg.enabled:
-                self._m_shed.inc(model=self.name, reason="queue_full")
-                self._m_requests.inc(outcome="shed_queue_full")
+                self._m_shed.inc(model=self.name, reason="queue_full",
+                                 **olab)
+                self._m_requests.inc(outcome="shed_queue_full", **olab)
             if tctx is not None:
                 tctx.add_span("fleet.shed", now, time.perf_counter(),
                               reason="queue_full")
@@ -306,7 +319,8 @@ class FleetRouter:
                 f"fleet {self.name!r}: admission queue full "
                 f"({self.max_queue} pending)", "queue_full")
         self._queue.put((item, fut, now, deadline,
-                         None if tctx is None else tctx.handoff(), nrows))
+                         None if tctx is None else tctx.handoff(), nrows,
+                         meta))
         if self._stop.is_set():
             # raced stop(): its drain may already be done — fail
             # stragglers rather than hang their waiters
@@ -363,7 +377,7 @@ class FleetRouter:
         silently dropped' contract's third leg."""
         err = _overloaded(exc_msg, reason)
         now = time.perf_counter()
-        for _x, fut, _t, _dl, tctx, _n in entries:
+        for _x, fut, _t, _dl, tctx, _n, _meta in entries:
             if tctx is not None:
                 # close the trace BEFORE waking the waiter: a shed is a
                 # terminal outcome worth ringing (the overload p99 story)
@@ -372,19 +386,22 @@ class FleetRouter:
             if not fut.done():
                 fut._set_error(err)
         n = len(entries)
-        self._count(f"shed_{reason}" if reason in
-                    ("queue_full", "deadline", "no_worker") else
-                    "shed_worker", n)
+        count_key = (f"shed_{reason}" if reason in
+                     ("queue_full", "deadline", "no_worker") else
+                     "shed_worker")
+        self._count(count_key, n)
         if self._reg.enabled:
             metric_reason = {"no_worker": "no_worker",
                              "deadline": "deadline",
                              "queue_full": "queue_full"}.get(reason,
                                                             "worker_shed")
-            self._m_shed.inc(n, model=self.name, reason=metric_reason)
-            self._m_requests.inc(n, outcome=f"shed_{reason}"
-                                 if reason in ("queue_full", "deadline",
-                                               "no_worker")
-                                 else "shed_worker")
+            # per entry, not bulk: synthetic entries shed into their own
+            # origin-labeled series (organic shed SLIs stay untouched)
+            for e in entries:
+                olab = _origin_labels(e[6])
+                self._m_shed.inc(model=self.name, reason=metric_reason,
+                                 **olab)
+                self._m_requests.inc(outcome=count_key, **olab)
 
     def _pick_worker(self, rows, exclude):
         """Least-outstanding live worker whose in-flight window has room
@@ -430,7 +447,7 @@ class FleetRouter:
             now = time.perf_counter()
             live = []
             for entry in batch:
-                _x, fut, t_sub, deadline, _tc, _n = entry
+                _x, fut, t_sub, deadline, _tc, _n, _meta = entry
                 if deadline is not None and now > deadline:
                     self._shed([entry], "deadline",
                                f"fleet {self.name!r}: deadline exceeded "
@@ -446,12 +463,19 @@ class FleetRouter:
             # keeps the co-drained single-row entries from becoming its
             # hostages (an indivisible over-window entry still ships
             # alone via _pick_worker's idle exception)
-            chunk, chunk_rows = [], 0
+            chunk, chunk_rows, chunk_meta = [], 0, None
             for entry in live:
                 r = entry[5] or 1
-                if chunk and chunk_rows + r > self.max_inflight_rows:
+                # one wire payload carries ONE (tenant, origin) pair, so
+                # a chunk must be meta-uniform: co-drained entries with a
+                # different attribution start a fresh chunk rather than
+                # inherit the lead entry's identity
+                if chunk and (chunk_rows + r > self.max_inflight_rows
+                              or entry[6] != chunk_meta):
                     self._dispatch(chunk)
                     chunk, chunk_rows = [], 0
+                if not chunk:
+                    chunk_meta = entry[6]
                 chunk.append(entry)
                 chunk_rows += r
             if chunk:
@@ -465,7 +489,7 @@ class FleetRouter:
         its attempt, giving the ring one admission→dispatch→worker-device
         →resolve story per request."""
         t1 = time.perf_counter()
-        for _x, _f, _t, _dl, tctx, _n in entries:
+        for _x, _f, _t, _dl, tctx, _n, _meta in entries:
             if tctx is None:
                 continue
             span = tctx.add_span("fleet.attempt", t0, t1, worker=wid,
@@ -484,7 +508,7 @@ class FleetRouter:
         deadlines = [e[3] for e in entries if e[3] is not None]
         deadline = min(deadlines) if deadlines else None
         t_disp = time.perf_counter()
-        for _x, _f, t_sub, _dl, tctx, _n in entries:
+        for _x, _f, t_sub, _dl, tctx, _n, _meta in entries:
             if tctx is not None:
                 # fleet-level queue wait, distinct from the worker-side
                 # serving.queue_wait that grafts in after dispatch
@@ -535,6 +559,13 @@ class FleetRouter:
                 payload = {"rows": _tree_map(lambda a: a.tolist(), xs)}
                 if remaining is not None:
                     payload["deadline_ms"] = max(1e3 * remaining, 1.0)
+                # demand attribution rides the payload (chunks are
+                # meta-uniform, so the lead entry speaks for the batch)
+                meta = entries[0][6] or {}
+                if meta.get("tenant") is not None:
+                    payload["tenant"] = meta["tenant"]
+                if meta.get("origin") is not None:
+                    payload["origin"] = meta["origin"]
                 timeout = self.request_timeout_s
                 if remaining is not None:
                     timeout = min(timeout, remaining + 5.0)
@@ -639,14 +670,15 @@ class FleetRouter:
             outputs = np.asarray(outputs)
         done = time.perf_counter()
         off = 0
-        lats = []
-        for _x, fut, t_sub, _dl, tctx, n in entries:
+        lats, origins = [], []
+        for _x, fut, t_sub, _dl, tctx, n, meta in entries:
             width = n or 1
             y = _tree_map(
                 lambda a: (a[off:off + width] if n is not None
                            else a[off]), outputs)
             off += width
             lats.append(done - t_sub)
+            origins.append((meta or {}).get("origin"))
             if tctx is not None:
                 tctx.add_span("fleet.resolve", done, time.perf_counter())
                 tctx.finish()
@@ -659,19 +691,22 @@ class FleetRouter:
         # submits too; rows ride separately as served_rows
         self._count("served", len(entries))
         self._count("served_rows", sum(e[5] or 1 for e in entries))
-        self._note_latencies(lats)
+        self._note_latencies(lats, origins=origins)
         if self._reg.enabled:
-            self._m_requests.inc(len(entries), outcome="served")
+            for e in entries:
+                self._m_requests.inc(outcome="served",
+                                     **_origin_labels(e[6]))
 
     def _fail_entries(self, entries, err, count_key="errors"):
-        for _x, fut, _t, _dl, tctx, _n in entries:
+        for _x, fut, _t, _dl, tctx, _n, meta in entries:
             if tctx is not None:
                 tctx.finish(status="error")
             if not fut.done():
                 fut._set_error(err)
+            if self._reg.enabled:
+                self._m_requests.inc(outcome="error",
+                                     **_origin_labels(meta))
         self._count(count_key, len(entries))
-        if self._reg.enabled:
-            self._m_requests.inc(len(entries), outcome="error")
 
     def _fail_pending(self):
         err = ServingShutdown(
@@ -679,7 +714,7 @@ class FleetRouter:
             f"request")
         while True:
             try:
-                _x, fut, _t, _dl, tctx, _n = self._take(block=False)
+                _x, fut, _t, _dl, tctx, _n, _meta = self._take(block=False)
             except queue.Empty:
                 break
             if tctx is not None:
@@ -695,16 +730,24 @@ class FleetRouter:
         with self._lock:
             self._counts[key] = self._counts.get(key, 0) + n
 
-    def _note_latencies(self, lats):
+    def _note_latencies(self, lats, origins=None):
+        """Synthetic requests (``origins`` aligned with ``lats``) observe
+        into origin-labeled histogram series but never enter the rolling
+        p50/p99 ring — same isolation discipline as the engine's."""
+        organic = [dt for i, dt in enumerate(lats)
+                   if not (origins and origins[i])]
         with self._lock:
-            self._recent_latencies.extend(lats)
+            self._recent_latencies.extend(organic)
             del self._recent_latencies[:-512]
             recent = list(self._recent_latencies)
         if self._reg.enabled:
-            for dt in lats:
-                self._m_latency.observe(dt)
-            self._m_p50.set(float(np.percentile(recent, 50)))
-            self._m_p99.set(float(np.percentile(recent, 99)))
+            for i, dt in enumerate(lats):
+                self._m_latency.observe(
+                    dt, **({"origin": str(origins[i])}
+                           if origins and origins[i] else {}))
+            if recent:
+                self._m_p50.set(float(np.percentile(recent, 50)))
+                self._m_p99.set(float(np.percentile(recent, 99)))
 
     # ---- lifecycle / status ----
 
@@ -723,14 +766,18 @@ class FleetRouter:
         one per worker — this runs inside the UIServer's single-threaded
         /fleet?probe=1 handler). A healthy answer revives a worker the
         router had written off; an unreachable one is marked dead and
-        appears with ``ok: false``."""
+        appears with ``ok: false``. Probes are stamped ``origin=probe``
+        on the wire, so worker-side accounting never mistakes them for
+        organic traffic; each worker's usage-ledger slice is folded into
+        a per-model ``usage`` aggregate (the fleet-wide demand signal)."""
         eps = self.endpoints()
         slots = [None] * len(eps)
 
         def probe(i, wid, addr):
             try:
                 _code, doc = _http_json(addr + "/health",
-                                        timeout=self.probe_timeout_s)
+                                        timeout=self.probe_timeout_s,
+                                        headers={ORIGIN_HEADER: "probe"})
                 slots[i] = doc  # each thread owns exactly slot i
                 self.mark_alive(wid)
             except Exception as e:  # noqa: BLE001 — probe failure
@@ -748,7 +795,13 @@ class FleetRouter:
                      else {"ok": False, "error": "probe hung"})
                for i, (wid, _addr) in enumerate(eps)}
         alive = sum(1 for doc in out.values() if doc.get("ok"))
-        return {"workers": out, "alive": alive, "total": len(out)}
+        usage = {}
+        for doc in out.values():
+            model = (doc.get("stats") or {}).get("model")
+            if model and isinstance(doc.get("usage"), dict):
+                _merge_usage(usage.setdefault(model, {}), doc["usage"])
+        return {"workers": out, "alive": alive, "total": len(out),
+                "usage": usage}
 
     def federated_metrics(self, timeout_s=None):
         """One scrape for the whole fleet: every worker's ``/metrics``
@@ -854,6 +907,19 @@ class FleetRouter:
                 "p50": None if p50 is None else round(1e3 * p50, 3),
                 "p99": None if p99 is None else round(1e3 * p99, 3)},
         }
+
+
+def _merge_usage(dst, src):
+    """Fold one worker's usage-ledger slice (numeric fields + a
+    ``tenants`` breakdown) into the fleet aggregate, in place."""
+    for k, v in src.items():
+        if isinstance(v, (int, float)):
+            dst[k] = dst.get(k, 0) + v
+        elif k == "tenants" and isinstance(v, dict):
+            tenants = dst.setdefault("tenants", {})
+            for tenant, fields in v.items():
+                _merge_usage(tenants.setdefault(tenant, {}), fields)
+    return dst
 
 
 def _leaves(tree):

@@ -35,6 +35,7 @@ from collections import deque
 
 from deeplearning4j_tpu import telemetry as _tm
 from deeplearning4j_tpu.fleet.router import _http_json
+from deeplearning4j_tpu.fleet.worker import ORIGIN_HEADER as _ORIGIN_HEADER
 
 
 def default_worker_env():
@@ -303,8 +304,11 @@ class FleetSupervisor:
         if w.address is None:
             return False
         try:
+            # stamped synthetic: the worker counts this GET into its
+            # origin=probe series, never the organic ones
             _code, doc = _http_json(w.address + "/health",
-                                    timeout=self.probe_timeout_s)
+                                    timeout=self.probe_timeout_s,
+                                    headers={_ORIGIN_HEADER: "probe"})
             w.last_health = doc
             return bool(doc.get("ok"))
         except Exception:  # noqa: BLE001 — probe failure IS the signal

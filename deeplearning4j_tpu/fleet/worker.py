@@ -8,6 +8,7 @@ this tier; cross-host fronts terminate here too):
     POST /submit    {"rows": [...], "deadline_ms": f} -> {"outputs": [...]}
     GET  /health    liveness + engine stats + compile-cache counters
     GET  /stats     the engine's /serving stats payload
+    GET  /usage     per-model/per-tenant usage ledger (metering)
     POST /swap      {"model_path": p} -> warm-then-atomic hot swap
     POST /shutdown  clean stop (engine drained, waiters failed promptly)
 
@@ -53,6 +54,11 @@ from deeplearning4j_tpu.telemetry import tracectx as _tracectx
 #: under the router's attempt span)
 TRACE_ID_HEADER = "X-DL4J-Trace-Id"
 PARENT_SPAN_HEADER = "X-DL4J-Parent-Span"
+#: synthetic-traffic marker: router/supervisor health probes and the
+#: prober's canaries stamp this so every wire hop counts them into
+#: origin-labeled series (which the default SLO rules exclude) instead
+#: of the organic ones
+ORIGIN_HEADER = "X-DL4J-Origin"
 
 
 def _tree_to_jsonable(y):
@@ -85,6 +91,12 @@ class FleetWorker:
         self._t0 = time.time()
         self._swap_lock = threading.Lock()
         self._swaps = 0
+        from deeplearning4j_tpu.telemetry import get_registry
+        self._reg = get_registry()
+        self._m_http = self._reg.counter(
+            "fleet_worker_http_total",
+            "worker HTTP GETs by path and origin (health-check probes "
+            "carry origin=probe, so wire-level SLIs can exclude them)")
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -112,10 +124,15 @@ class FleetWorker:
                 return doc
 
             def do_GET(self):
+                worker._count_get(self.path,
+                                  self.headers.get(ORIGIN_HEADER))
                 if self.path.startswith("/health"):
                     self._json(worker.health())
                 elif self.path.startswith("/stats"):
                     self._json(worker.engine.stats())
+                elif self.path.startswith("/usage"):
+                    # the per-model/per-tenant usage ledger (metering)
+                    self._json(worker.usage())
                 elif self.path.startswith("/metrics"):
                     # the federation scrape: full registry snapshot (kind
                     # + help + series) so the aggregator can re-render
@@ -173,7 +190,13 @@ class FleetWorker:
                         rows, batched=True,
                         deadline_s=(None if deadline_ms is None
                                     else deadline_ms / 1e3),
-                        tctx=rctx)
+                        tctx=rctx,
+                        # demand attribution rides the payload (header as
+                        # origin fallback): tenant feeds the usage ledger,
+                        # origin=probe keeps canaries out of organic SLIs
+                        tenant=doc.get("tenant"),
+                        origin=(doc.get("origin")
+                                or self.headers.get(ORIGIN_HEADER)))
                     y = fut.get(timeout=doc.get("timeout_s", 60))
                     resp = {"outputs": _tree_to_jsonable(y),
                             "worker_id": worker.worker_id,
@@ -265,6 +288,23 @@ class FleetWorker:
             return {"ok": True, "worker_id": self.worker_id,
                     "swaps": self._swaps,
                     "aot": self.engine.stats()["aot"]}
+
+    def _count_get(self, path, origin):
+        """Wire-level GET accounting: probes carry their origin label,
+        organic GETs keep the unlabeled series."""
+        if self._reg.enabled:
+            root = "/" + (path.lstrip("/").split("?")[0].split("/")[0]
+                          or "")
+            self._m_http.inc(path=root,
+                             **({"origin": str(origin)} if origin else {}))
+
+    def usage(self):
+        """The /usage payload: this process's per-model/per-tenant usage
+        ledger (serving/metering.py) — what fleet /health aggregation
+        folds up into the offered-load-per-model signal."""
+        from deeplearning4j_tpu.serving import metering as _metering
+        return {"worker_id": self.worker_id, "pid": os.getpid(),
+                "usage": _metering.get_meter().usage()}
 
     def metrics(self):
         """The /metrics payload the ``federate()`` aggregator scrapes:

@@ -43,6 +43,7 @@ import numpy as np
 from deeplearning4j_tpu import telemetry as _tm
 from deeplearning4j_tpu.telemetry import tracectx as _tracectx
 from deeplearning4j_tpu.datasets.iterator import BucketRegistry
+from deeplearning4j_tpu.serving import metering as _metering
 from deeplearning4j_tpu.utils import compile_cache as _cc
 
 #: fill-ratio buckets: eighths of the padded bucket (shared with
@@ -76,6 +77,14 @@ def shed_reason(exc):
         if r is not None:
             return r
     return None
+
+
+def _origin_labels(meta):
+    """Metric labels for a queue entry's request meta: synthetic traffic
+    gets ``origin=...`` series (which every default SLO rule excludes);
+    organic traffic keeps the unlabeled series it always had."""
+    origin = (meta or {}).get("origin")
+    return {"origin": str(origin)} if origin else {}
 
 
 class ServingShutdown(RuntimeError):
@@ -597,7 +606,7 @@ class ServingEngine:
             f"request")
         while True:
             try:
-                _, fut, _t, _dl, tctx, _n = self._take(block=False)
+                _, fut, _t, _dl, tctx, _n, _meta = self._take(block=False)
             except queue.Empty:
                 break
             if not fut.done():
@@ -696,7 +705,8 @@ class ServingEngine:
             self._m_requests.inc(n, model=self.name, outcome="served_direct")
         return out
 
-    def submit(self, x, deadline_s=None, *, batched=False, tctx=None):
+    def submit(self, x, deadline_s=None, *, batched=False, tctx=None,
+               tenant=None, origin=None):
         """Queue ONE example (or, with ``batched=True``, one MULTI-example
         batch — leading axis = examples); returns ONE
         :class:`InferenceFuture`. A batched future resolves to the stacked
@@ -716,10 +726,23 @@ class ServingEngine:
         starting a fresh ``serving.request`` — the fleet worker passes
         its remote-parented context here so the device-side spans land
         on the ROUTER's trace (wire-propagated tracing).
+
+        ``tenant`` attributes the request in the usage ledger
+        (serving/metering.py); ``origin="probe"`` marks synthetic
+        traffic — its counter series carry an ``origin`` label (which
+        every default SLO rule excludes) and it never enters the rolling
+        p50/p99 latency ring, so organic SLIs stay untouched by canaries
+        and health checks. Probe traffic IS still metered: device time
+        is device time, and the usage ledger must balance against router
+        row accounting exactly.
         """
         if self._stop.is_set():
             raise ServingShutdown(
                 f"serving engine {self.name!r} is stopped")
+        meta = None
+        if tenant is not None or origin is not None:
+            meta = {"tenant": tenant, "origin": origin}
+        olab = {"origin": str(origin)} if origin else {}
         fut = InferenceFuture()
         # the request's causal trace starts HERE: the root span is the
         # submit->resolve window, and the drain thread attaches via the
@@ -734,7 +757,8 @@ class ServingEngine:
         deadline = None if deadline_s is None else now + deadline_s
         self._count("submitted")
         if self._reg.enabled:
-            self._m_requests.inc(model=self.name, outcome="submitted")
+            self._m_requests.inc(model=self.name, outcome="submitted",
+                                 **olab)
         try:
             # _as_input, not plain asarray: x may be the dict multi-input
             # form (ComputationGraph) the warmup spec and output() support.
@@ -793,7 +817,7 @@ class ServingEngine:
             try:
                 self._queue.put_nowait((item, fut, now, deadline,
                                         None if tctx is None
-                                        else tctx.handoff(), nrows))
+                                        else tctx.handoff(), nrows, meta))
             except queue.Full:
                 with self._lock:
                     self._pending_rows -= rows
@@ -801,9 +825,10 @@ class ServingEngine:
         except queue.Full:
             self._count("shed_queue_full")
             if self._reg.enabled:
-                self._m_shed.inc(model=self.name, reason="queue_full")
+                self._m_shed.inc(model=self.name, reason="queue_full",
+                                 **olab)
                 self._m_requests.inc(model=self.name,
-                                     outcome="shed_queue_full")
+                                     outcome="shed_queue_full", **olab)
             if tctx is not None:
                 # shed decision as a child span, then the trace completes
                 # (a shed IS an end-to-end outcome worth ringing: the p99
@@ -866,16 +891,19 @@ class ServingEngine:
             now = time.perf_counter()
             live = []
             for item in batch:
-                _x, fut, t_sub, deadline, tctx, _n = item
+                _x, fut, t_sub, deadline, tctx, _n, meta = item
+                olab = _origin_labels(meta)
                 if deadline is not None and now > deadline:
                     # stale request: shed it instead of spending a forward
                     # on an answer nobody is waiting for (deadline-aware
                     # load shedding)
                     self._count("shed_deadline")
                     if self._reg.enabled:
-                        self._m_shed.inc(model=self.name, reason="deadline")
+                        self._m_shed.inc(model=self.name, reason="deadline",
+                                         **olab)
                         self._m_requests.inc(model=self.name,
-                                             outcome="shed_deadline")
+                                             outcome="shed_deadline",
+                                             **olab)
                     if tctx is not None:
                         tctx.add_span("serving.queue_wait", t_sub, now)
                         tctx.add_span("serving.shed", now, now,
@@ -916,19 +944,36 @@ class ServingEngine:
                         phases.append(("serving.assemble", t_asm,
                                        time.perf_counter(),
                                        {"size": n_rows}))
+                    t_fwd = time.perf_counter()
                     ys = self._fwd(xs, _phases=phases)  # one atomic
                     #                                     model snapshot
                 done = time.perf_counter()
+                device_s = done - t_fwd
+                flops = _metering.estimate_flops(
+                    self._param_count(), self._padded_rows(n_rows))
+                meter = _metering.get_meter()
                 _cc.note_first_request()
-                lats, ctxs, off = [], [], 0
-                for _, fut, t_sub, _dl, tctx, n in live:
+                lats, ctxs, origins, off = [], [], [], 0
+                for x_in, fut, t_sub, _dl, tctx, n, meta in live:
                     width = n or 1
+                    # the usage ledger: every served row is attributed
+                    # (probe traffic included — device time is device
+                    # time), device wall and FLOPs prorated by rows
+                    meter.record(
+                        self.name, rows=width,
+                        tokens=sum(int(np.size(l)) for l in
+                                   jax.tree_util.tree_leaves(x_in)),
+                        queue_s=now - t_sub,
+                        device_s=device_s * width / n_rows,
+                        flops=flops * width / n_rows,
+                        tenant=(meta or {}).get("tenant"))
                     y = jax.tree_util.tree_map(
                         lambda a: (a[off:off + width] if n is not None
                                    else a[off]), ys)
                     off += width
                     lats.append(done - t_sub)
                     ctxs.append(tctx)
+                    origins.append((meta or {}).get("origin"))
                     if tctx is not None:
                         tctx.add_span("serving.queue_wait", t_sub, now)
                         for nm, a, b, kw in phases:
@@ -942,44 +987,78 @@ class ServingEngine:
                     # ships it back on the wire right after fut.get())
                     fut._set(y)
                 self._count("served", n_rows)
-                self._note_latencies(lats, outcome="served", ctxs=ctxs)
+                self._note_latencies(lats, outcome="served", ctxs=ctxs,
+                                     origins=origins)
             except Exception as e:  # noqa: BLE001 — propagate to waiters
-                for _, fut, _t, _dl, tctx, _n in live:
+                for _, fut, _t, _dl, tctx, _n, meta in live:
                     if tctx is not None:
                         tctx.finish(status="error")
                     if not fut.done():
                         fut._set_error(e)
+                    if self._reg.enabled:
+                        self._m_requests.inc(model=self.name,
+                                             outcome="error",
+                                             **_origin_labels(meta))
                 self._count("errors", len(live))
-                if self._reg.enabled:
-                    self._m_requests.inc(len(live), model=self.name,
-                                         outcome="error")
 
     def _count(self, key, n=1):
         with self._lock:
             self._counts[key] += n
 
-    def _note_latencies(self, lats, outcome=None, ctxs=None):
+    def _note_latencies(self, lats, outcome=None, ctxs=None, origins=None):
         """Record request latencies into the rolling SLO ring and refresh
         the p50/p99 gauges; with ``outcome`` each also counts into the
         per-model requests counter (the direct path counts its examples
         separately, so it passes None). ``ctxs`` (aligned with ``lats``)
         attaches each request's trace context around its observation, so
         the latency histogram's tail bucket carries that request's
-        exemplar — the p99 gauge links to a concrete trace."""
+        exemplar — the p99 gauge links to a concrete trace. ``origins``
+        (aligned) marks synthetic requests: they observe into origin-
+        labeled histogram series but NEVER enter the rolling ring or the
+        p50/p99 gauges — a canary storm cannot move an organic SLI."""
+        organic = [dt for i, dt in enumerate(lats)
+                   if not (origins and origins[i])]
         with self._lock:
-            self._recent_latencies.extend(lats)
+            self._recent_latencies.extend(organic)
             del self._recent_latencies[:-512]
             recent = list(self._recent_latencies)
         if self._reg.enabled:
             for i, dt in enumerate(lats):
+                olab = ({"origin": str(origins[i])}
+                        if origins and origins[i] else {})
                 with _tracectx.attach(ctxs[i] if ctxs else None):
-                    self._m_latency.observe(dt, model=self.name)
+                    self._m_latency.observe(dt, model=self.name, **olab)
                 if outcome is not None:
-                    self._m_requests.inc(model=self.name, outcome=outcome)
-            self._m_p50.set(float(np.percentile(recent, 50)),
-                            model=self.name)
-            self._m_p99.set(float(np.percentile(recent, 99)),
-                            model=self.name)
+                    self._m_requests.inc(model=self.name, outcome=outcome,
+                                         **olab)
+            if recent:
+                self._m_p50.set(float(np.percentile(recent, 50)),
+                                model=self.name)
+                self._m_p99.set(float(np.percentile(recent, 99)),
+                                model=self.name)
+
+    def _param_count(self):
+        """Parameter count of the CURRENTLY served forward (recomputed
+        cheaply per batch so a hot swap re-prices FLOPs); 0 when the net
+        doesn't expose params — metering degrades to zero-FLOPs rows,
+        never an error on the serving path."""
+        try:
+            return sum(int(np.size(l)) for l in
+                       jax.tree_util.tree_leaves(self._fwd.net.params))
+        except Exception:
+            return 0
+
+    def _padded_rows(self, n_rows):
+        """Rows the device actually ran for an ``n_rows`` host batch:
+        the same chunk-by-largest-bucket walk BucketedForward takes,
+        each chunk charged at its padded bucket size (padding burns the
+        device all the same — FLOPs attribution must price it)."""
+        step = self._fwd.buckets.max
+        padded = 0
+        for i in range(0, int(n_rows), step):
+            padded += self._fwd.buckets.bucket_for(
+                min(step, int(n_rows) - i))
+        return padded
 
     # ---- status ----
 
@@ -989,11 +1068,14 @@ class ServingEngine:
         the compile-cache events and recompile counters a supervisor
         needs to counter-assert "this worker warm-started and is not
         compiling on the request path" without reaching into the
-        process."""
+        process, plus this model's slice of the usage ledger (the
+        per-model demand signal fleet /health aggregation folds up)."""
         from deeplearning4j_tpu.telemetry import devices as _devices
+        usage = _metering.get_meter().usage()["models"].get(self.name)
         return {"stats": self.stats(),
                 "compile_cache_events": _cc.event_counts(),
-                "recompiles": _devices.recompile_counts()}
+                "recompiles": _devices.recompile_counts(),
+                "usage": usage}
 
     def latency_percentiles(self):
         """(p50_s, p99_s) over the recent-latency ring, or (None, None)."""

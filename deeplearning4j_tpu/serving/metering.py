@@ -1,0 +1,152 @@
+"""Per-model / per-tenant usage metering: who is consuming the device.
+
+The serving tier already counts *outcomes* (submitted/served/shed); what
+elasticity needs is attributed *consumption*: how many rows, input
+tokens, queue-seconds, device-exec seconds, and estimated FLOPs each
+model (and each tenant, when the optional ``tenant`` field rides the
+submit path router→worker→engine) actually burned. :class:`UsageMeter`
+is that ledger.
+
+Two views of the same numbers, recorded atomically per forward:
+
+* an in-process ledger dict (always on, survives ``telemetry.disable``)
+  whose per-model row totals balance EXACTLY against the router's
+  ``served_rows`` accounting — the invariant ``scripts/check_demand.py``
+  gates on. Synthetic ``origin=probe`` traffic IS metered (device time
+  is device time; exclusion from SLIs happens at the metric-label layer,
+  not here) so the two sides of the ledger see the same rows;
+* ``usage_*_total{model,tenant}`` counters in the MetricsRegistry, so
+  the federation/history/SLO planes can rate and window attribution
+  like any other series.
+
+The ledger serves on the worker/UI ``/usage`` endpoint and is folded
+into fleet ``/health`` aggregation — the offered-load-per-model signal
+the ROADMAP's elasticity item keys on.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from deeplearning4j_tpu.telemetry import registry as _registry
+
+#: ledger label for unattributed traffic (no tenant field on submit)
+NO_TENANT = "-"
+
+_FIELDS = ("rows", "tokens", "queue_seconds", "device_seconds", "flops")
+
+
+class UsageMeter:
+    """Accumulate per-(model, tenant) usage; export ledger + counters."""
+
+    def __init__(self, registry=None):
+        self._reg = registry or _registry.get_registry()
+        self._lock = threading.Lock()
+        self._ledger = {}  # (model, tenant) -> {field: total}
+        self._m = {
+            "rows": self._reg.counter(
+                "usage_rows_total",
+                "rows served per model and tenant (balances exactly "
+                "against router served_rows)"),
+            "tokens": self._reg.counter(
+                "usage_tokens_total",
+                "input elements consumed per model and tenant"),
+            "queue_seconds": self._reg.counter(
+                "usage_queue_seconds_total",
+                "seconds requests spent queued per model and tenant"),
+            "device_seconds": self._reg.counter(
+                "usage_device_seconds_total",
+                "device-exec seconds attributed per model and tenant "
+                "(forward wall prorated by rows)"),
+            "flops": self._reg.counter(
+                "usage_flops_total",
+                "estimated forward FLOPs per model and tenant "
+                "(2 * params * padded rows, prorated)"),
+        }
+
+    def record(self, model, *, rows=0, tokens=0, queue_s=0.0,
+               device_s=0.0, flops=0.0, tenant=None):
+        """One request's consumption. Negative clock skew is clamped —
+        the ledger is monotone by construction."""
+        model = str(model)
+        tenant = NO_TENANT if tenant is None else str(tenant)
+        vals = {"rows": max(int(rows), 0),
+                "tokens": max(int(tokens), 0),
+                "queue_seconds": max(float(queue_s), 0.0),
+                "device_seconds": max(float(device_s), 0.0),
+                "flops": max(float(flops), 0.0)}
+        with self._lock:
+            row = self._ledger.setdefault(  # graftlint: disable=R6 -- setdefault runs under self._lock
+                (model, tenant), {f: 0.0 for f in _FIELDS})
+            for f in _FIELDS:
+                row[f] += vals[f]
+        if self._reg.enabled:
+            for f in _FIELDS:
+                if vals[f]:
+                    self._m[f].inc(vals[f], model=model, tenant=tenant)
+
+    def usage(self):
+        """The /usage payload: per-model totals with a per-tenant
+        breakdown, plus the grand totals."""
+        with self._lock:
+            items = [(k, dict(v)) for k, v in self._ledger.items()]
+        models = {}
+        totals = {f: 0.0 for f in _FIELDS}
+        for (model, tenant), vals in sorted(items):
+            m = models.setdefault(model, {f: 0.0 for f in _FIELDS})
+            m.setdefault("tenants", {})
+            m["tenants"][tenant] = {f: _num(vals[f]) for f in _FIELDS}
+            for f in _FIELDS:
+                m[f] += vals[f]
+                totals[f] += vals[f]
+        for m in models.values():
+            for f in _FIELDS:
+                m[f] = _num(m[f])
+        return {"models": models,
+                "totals": {f: _num(totals[f]) for f in _FIELDS}}
+
+    def rows_for(self, model):
+        """Total metered rows for one model (the ledger-balance probe)."""
+        with self._lock:
+            return int(sum(v["rows"] for (m, _t), v in self._ledger.items()
+                           if m == str(model)))
+
+    def clear(self):
+        with self._lock:
+            self._ledger.clear()
+
+
+def _num(v):
+    """Integral floats print as ints in JSON (rows/tokens are counts)."""
+    return int(v) if float(v).is_integer() else float(v)
+
+
+def estimate_flops(param_count, padded_rows):
+    """Dense-forward estimate from the registered shapes: 2 FLOPs per
+    parameter per padded row (multiply + add). Deliberately crude — a
+    ranking signal for attribution, not a performance model; padding is
+    charged because padding burns the device all the same."""
+    return 2.0 * float(param_count) * float(padded_rows)
+
+
+# ---- process-default meter ----
+
+_default = None
+_default_lock = threading.Lock()
+
+
+def get_meter():
+    """Process-default meter, created on first use (every ServingEngine
+    records into it, so one process = one ledger)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = UsageMeter()
+        return _default
+
+
+def reset():
+    """Drop the process-default meter (telemetry.reset())."""
+    global _default
+    with _default_lock:
+        _default = None

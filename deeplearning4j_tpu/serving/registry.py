@@ -68,9 +68,11 @@ class ModelRegistry:
         with self._lock:
             return sorted(self._engines)
 
-    def submit(self, name, x, deadline_s=None, *, batched=False):
+    def submit(self, name, x, deadline_s=None, *, batched=False,
+               tenant=None, origin=None):
         return self.engine(name).submit(x, deadline_s=deadline_s,
-                                        batched=batched)
+                                        batched=batched, tenant=tenant,
+                                        origin=origin)
 
     def output(self, name, x):
         return self.engine(name).output(x)

@@ -70,8 +70,8 @@ from deeplearning4j_tpu.telemetry.registry import (DEFAULT_BUCKETS, Counter,
                                                    get_registry, write_jsonl)
 from deeplearning4j_tpu.telemetry.tracing import Tracer, get_tracer, span
 from deeplearning4j_tpu.telemetry import (devices, federate, flight, goodput,
-                                          health, profiling, scorepipe, slo,
-                                          timeline, tracectx)
+                                          health, history, profiling,
+                                          scorepipe, slo, timeline, tracectx)
 from deeplearning4j_tpu.telemetry.health import NumericsError
 from deeplearning4j_tpu.telemetry.scorepipe import ScorePipeline
 from deeplearning4j_tpu.telemetry.tracectx import TraceContext
@@ -82,7 +82,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
            "series_map",
            "health", "devices", "flight", "scorepipe", "ScorePipeline",
            "NumericsError", "tracectx", "TraceContext",
-           "federate", "timeline", "profiling", "slo", "goodput"]
+           "federate", "timeline", "profiling", "slo", "goodput",
+           "history"]
 
 
 def enable():
@@ -116,6 +117,13 @@ def reset():
     federate.clear_target_providers()
     slo.reset()
     goodput.reset()
+    history.reset()
+    # demand plane (usage ledger, prober): lazy imports — these modules
+    # import telemetry back (same pattern as compile_cache)
+    from deeplearning4j_tpu.serving import metering as _metering
+    _metering.reset()
+    from deeplearning4j_tpu.fleet import prober as _prober
+    _prober.reset()
     # once-per-process cold-start gauges (time_to_first_step/request):
     # lazy import — utils.compile_cache imports telemetry lazily back
     from deeplearning4j_tpu.utils import compile_cache as _cc

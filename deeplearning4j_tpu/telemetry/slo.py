@@ -38,8 +38,11 @@ names which rules were burning when the process died.
 
 ``default_rules()`` covers the counters the system already emits:
 serving shed ratio, fleet failover rate, continuous staleness burn,
-hostfleet rollback rounds, recompile storms, numerics anomalies, and
-step-time / ETL-stall EWMA regression. All default-on-but-inert: a
+hostfleet rollback rounds, recompile storms, numerics anomalies,
+step-time / ETL-stall EWMA regression, and synthetic-probe failure
+ratio (every organic rule excludes ``origin=probe`` series, so
+health checks and canaries can never fire a serving SLI). All
+default-on-but-inert: a
 healthy run fires nothing and nothing changes behavior until a rule
 fires (the ContinuousTrainer snapshot gate and future hedging policies
 consult ``firing()`` / tag queries).
@@ -67,13 +70,19 @@ class SloRule:
     or ``"lt"`` for bounds that alarm downward. ``field`` picks the value
     from histogram series (``sum`` or ``count``); scalar series ignore
     it. ``tags`` let decision seams query subsets (the trainer's snapshot
-    gate keys on ``"gate"``)."""
+    gate keys on ``"gate"``). ``exclude_labels`` drops series matching
+    any given pair before the predicate ever sees them; the default
+    ``{"origin": "probe"}`` keeps synthetic prober/health-check traffic
+    out of every organic rule (a rule that explicitly selects
+    ``origin=probe`` in ``labels`` is exempt from that key — selection
+    wins over exclusion)."""
 
     def __init__(self, name, kind, metric, *, fire, warn=None, labels=None,
                  window_s=300.0, short_window_s=60.0, long_window_s=600.0,
                  den_metric=None, den_labels=None, min_den=1.0,
                  op="gt", alpha_fast=0.3, alpha_slow=0.03,
-                 min_intervals=3, field="sum", tags=(), help=""):
+                 min_intervals=3, field="sum", tags=(), help="",
+                 exclude_labels=None):
         if kind not in _KINDS:
             raise ValueError(f"unknown SloRule kind {kind!r}; "
                              f"one of {_KINDS}")
@@ -101,6 +110,14 @@ class SloRule:
         self.field = field
         self.tags = tuple(tags)
         self.help = help
+        if exclude_labels is None:
+            exclude_labels = {"origin": "probe"}
+        # a key the rule explicitly selects on can't also be excluded
+        self.exclude_labels = {k: v for k, v in dict(exclude_labels).items()
+                               if k not in self.labels}
+        self.den_exclude_labels = {
+            k: v for k, v in dict(exclude_labels).items()
+            if k not in self.den_labels}
 
     def describe(self):
         d = {"name": self.name, "kind": self.kind, "metric": self.metric,
@@ -108,6 +125,8 @@ class SloRule:
              "tags": list(self.tags)}
         if self.labels:
             d["labels"] = dict(self.labels)
+        if self.exclude_labels:
+            d["exclude_labels"] = dict(self.exclude_labels)
         if self.kind == "ratio":
             d["den_metric"] = self.den_metric
         if self.kind == "burn_rate":
@@ -130,10 +149,12 @@ def _series_value(value, field):
         return None
 
 
-def _select(metrics, metric, labels, field="sum"):
+def _select(metrics, metric, labels, field="sum", exclude=None):
     """{series-key: value} of every series of ``metric`` whose labels
     include all ``labels`` pairs. Missing metric -> {} (an interval the
-    trackers simply skip)."""
+    trackers simply skip). ``exclude`` drops series matching any given
+    pair — how synthetic ``origin=probe`` traffic stays out of organic
+    SLIs."""
     doc = metrics.get(metric)
     if not isinstance(doc, dict):
         return {}
@@ -141,6 +162,9 @@ def _select(metrics, metric, labels, field="sum"):
     for s in doc.get("series", ()):
         slabels = s.get("labels") or {}
         if any(str(slabels.get(k)) != str(v) for k, v in labels.items()):
+            continue
+        if exclude and any(str(slabels.get(k)) == str(v)
+                           for k, v in exclude.items()):
             continue
         v = _series_value(s.get("value"), field)
         if v is None:
@@ -311,7 +335,8 @@ class SloEngine:
     def _eval_rule(self, rule, metrics, now):
         """Predicate -> level (0/1/2), or None for insufficient data."""
         if rule.kind == "threshold":
-            cur = _select(metrics, rule.metric, rule.labels, rule.field)
+            cur = _select(metrics, rule.metric, rule.labels, rule.field,
+                          rule.exclude_labels)
             if not cur:
                 return None
             value = sum(cur.values())
@@ -320,8 +345,10 @@ class SloEngine:
         if rule.kind == "ewma_drift":
             tr = self._tracks.setdefault(rule.name, _EwmaTrack())  # graftlint: disable=R6 -- _eval_rule runs only under evaluate()'s `with self._lock`
             tr.sample(now,
-                      _select(metrics, rule.metric, rule.labels, "sum"),
-                      _select(metrics, rule.metric, rule.labels, "count"),
+                      _select(metrics, rule.metric, rule.labels, "sum",
+                              rule.exclude_labels),
+                      _select(metrics, rule.metric, rule.labels, "count",
+                              rule.exclude_labels),
                       rule.alpha_fast, rule.alpha_slow)
             value = tr.drift(rule.min_intervals)
             if value is None:
@@ -334,9 +361,10 @@ class SloEngine:
             den = self._tracks.setdefault(  # graftlint: disable=R6 -- _eval_rule runs only under evaluate()'s `with self._lock`
                 (rule.name, "den"), _DeltaTrack())
             num.sample(now, _select(metrics, rule.metric, rule.labels,
-                                    rule.field))
+                                    rule.field, rule.exclude_labels))
             den.sample(now, _select(metrics, rule.den_metric,
-                                    rule.den_labels, rule.field))
+                                    rule.den_labels, rule.field,
+                                    rule.den_exclude_labels))
             dn = num.delta(rule.window_s, now)
             dd = den.delta(rule.window_s, now)
             if dn is None or dd is None or dd < rule.min_den:
@@ -348,7 +376,7 @@ class SloEngine:
         tr = self._tracks.setdefault(rule.name, _DeltaTrack(  # graftlint: disable=R6 -- _eval_rule runs only under evaluate()'s `with self._lock`
             keep_s=max(2 * rule.long_window_s, 2 * rule.window_s)))
         tr.sample(now, _select(metrics, rule.metric, rule.labels,
-                               rule.field))
+                               rule.field, rule.exclude_labels))
         if rule.kind == "rate":
             value = tr.rate(rule.window_s, now)
             if value is None:
@@ -529,6 +557,14 @@ def default_rules():
             tags=("train", "regression"),
             help="fast-vs-slow EWMA of mean host-side batch assembly "
                  "time — the input pipeline decaying under the step"),
+        SloRule(
+            "probe_failure_ratio", "ratio", "probe_bad_total",
+            den_metric="probe_total",
+            warn=0.05, fire=0.5, window_s=120.0, min_den=3.0,
+            tags=("probe", "fleet", "gate"),
+            help="failed synthetic canaries per probe — the fleet judged "
+                 "from OUTSIDE: fires on wrong answers, unreachable "
+                 "workers, or shed canaries even at zero organic load"),
     ]
 
 

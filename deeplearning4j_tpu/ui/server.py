@@ -157,16 +157,74 @@ class UIServer:
                     # registry (?federate=1: over the federated merge of
                     # every registered member — one rule set, the whole
                     # cluster's series) and serve the per-rule
-                    # ok|warning|firing states.
+                    # ok|warning|firing states. ?history=1 first replays
+                    # the process-default history store through the
+                    # engine (oldest-first), so burn-rate windows are
+                    # judged over retained samples a fresh process never
+                    # lived through; the response carries the history
+                    # dir layout for postmortem replay.
                     from deeplearning4j_tpu.telemetry import slo as _slo
                     engine = _slo.get_engine()
+                    history_info = None
+                    if q.get("history", ["0"])[0] not in ("0", "",
+                                                          "false"):
+                        from deeplearning4j_tpu.telemetry import (
+                            history as _history)
+                        store = _history.get_history()
+                        replayed = store.replay_into(engine)
+                        history_info = dict(store.describe(),
+                                            replayed=replayed)
                     if q.get("federate", ["0"])[0] not in ("0", "",
                                                            "false"):
                         from deeplearning4j_tpu.telemetry import (
                             federate as _fed)
-                        self._json(engine.evaluate(_fed.federate_default()))
+                        out = engine.evaluate(_fed.federate_default())
                     else:
-                        self._json(engine.evaluate())
+                        out = engine.evaluate()
+                    if history_info is not None:
+                        out["history"] = history_info
+                    self._json(out)
+                    return
+                if url.path == "/query":
+                    # the metrics-history range query
+                    # (telemetry/history.py): ?series=metric{k=v,...}
+                    # with optional t0/t1 bounds returns retained
+                    # [t, value] points; &window=SECONDS adds the
+                    # counter-aware rate_over verdict (per-series delta
+                    # discipline — a reset can never fake a negative
+                    # rate). No series: the store's layout/status doc.
+                    from deeplearning4j_tpu.telemetry import (
+                        history as _history)
+                    store = _history.get_history()
+                    series = q.get("series", [None])[0]
+                    if not series:
+                        self._json(store.describe())
+                        return
+                    try:
+                        t0 = q.get("t0", [None])[0]
+                        t1 = q.get("t1", [None])[0]
+                        out = {"series": series,
+                               "points": store.query(
+                                   series,
+                                   None if t0 is None else float(t0),
+                                   None if t1 is None else float(t1))}
+                        window = q.get("window", [None])[0]
+                        if window is not None:
+                            out["window_s"] = float(window)
+                            out["rate_per_s"] = store.rate_over(
+                                series, float(window))
+                        self._json(out)
+                    except ValueError as e:
+                        self._json({"error": str(e)}, code=400)
+                    return
+                if url.path == "/usage":
+                    # the per-model/per-tenant usage ledger
+                    # (serving/metering.py): rows, tokens, queue/device
+                    # seconds, estimated FLOPs — the offered-load
+                    # attribution elasticity keys on
+                    from deeplearning4j_tpu.serving import (
+                        metering as _metering)
+                    self._json(_metering.get_meter().usage())
                     return
                 if url.path == "/serving":
                     # serving-tier status: per-model queue depth, SLO
@@ -301,7 +359,7 @@ class UIServer:
 
     _KNOWN_PATHS = frozenset((
         "/", "/metrics", "/health", "/serving", "/fleet", "/traces",
-        "/slo",
+        "/slo", "/query", "/usage",
         "/train",
         "/train/overview.html",
         "/train/sessions", "/train/overview", "/train/model",

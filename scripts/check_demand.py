@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""tier1.sh demand-observability gate: parse a `bench.py demand_obs`
+JSONL stream and fail unless the demand plane held its contracts.
+STRUCTURAL — counters, ledger balance and parity — NEVER wall time:
+
+* history: samples persisted as segments, reloaded without corruption,
+  and ``rate_over`` agrees with the live SLO delta discipline to
+  <= 1e-6 on every checked window;
+* isolation: on the ORGANICALLY IDLE fleet, probe_total advanced while
+  every UNLABELED (organic) fleet request series stayed exactly zero —
+  synthetic monitoring must not manufacture demand;
+* ledger: the per-model usage rows folded from worker ``/usage`` equal
+  the router's ``served_rows`` EXACTLY (probe and tenant traffic both
+  accounted, nothing double- or un-counted);
+* storm: the wrong-answer canary walked ``probe_failure_ratio``
+  ok -> firing -> ok, with BOTH transitions counted in
+  ``slo_alerts_total``.
+
+Usage: check_demand.py <jsonl-file>
+"""
+
+import json
+import sys
+
+PARITY_TOL = 1e-6
+
+
+def main(argv):
+    path = argv[1]
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    recs = [r for r in rows
+            if str(r.get("metric", "")).startswith("demand_obs")]
+    if not recs:
+        print("check_demand: no demand_obs record in", path)
+        return 1
+    rec = recs[-1]
+    if "FAILED" in rec.get("metric", ""):
+        print("check_demand: bench leg failed:", rec.get("error"))
+        return 1
+    errors = []
+
+    # --- history: persistence + parity --------------------------------
+    hist = rec.get("history") or {}
+    if (hist.get("samples") or 0) < 2:
+        errors.append(f"history ring held too few samples: {hist}")
+    if (hist.get("segments") or 0) < 1:
+        errors.append(f"no history segments persisted: {hist}")
+    if hist.get("reloaded_samples") != hist.get("samples"):
+        errors.append(
+            f"persistence round trip lost samples: wrote "
+            f"{hist.get('samples')}, reloaded "
+            f"{hist.get('reloaded_samples')}")
+    if (hist.get("corrupt") or 0) != 0:
+        errors.append(f"clean segments read back corrupt: {hist}")
+    parity = hist.get("rate_parity") or {}
+    if not parity:
+        errors.append("no rate_over parity windows recorded")
+    for window, p in parity.items():
+        err = p.get("abs_err")
+        if err is None:
+            errors.append(f"rate parity window {window} has no value "
+                          f"(live={p.get('live')}, "
+                          f"history={p.get('history')})")
+        elif err > PARITY_TOL:
+            errors.append(f"rate_over disagrees with the live delta "
+                          f"discipline over {window}: |err|={err} "
+                          f"> {PARITY_TOL}")
+
+    # --- isolation: probes advanced, organic series stayed zero -------
+    fleet = rec.get("fleet") or {}
+    probe_sum = sum((fleet.get("idle_probe_total") or {}).values())
+    if probe_sum <= 0:
+        errors.append(f"prober advanced nothing on the idle fleet: "
+                      f"{fleet.get('idle_probe_total')}")
+    idle = fleet.get("idle_fleet_requests_total") or {}
+    organic = {k: v for k, v in idle.items()
+               if "origin=probe" not in k and v != 0}
+    if organic:
+        errors.append(f"synthetic probing moved ORGANIC fleet series on "
+                      f"an idle fleet: {organic}")
+    if not any("origin=probe" in k and v > 0 for k, v in idle.items()):
+        errors.append(f"probe traffic left no origin=probe fleet "
+                      f"series: {idle}")
+    probes = fleet.get("probes") or {}
+    bad = {n: p.get("verdict") for n, p in probes.items()
+           if p.get("verdict") != "ok"}
+    if bad:
+        errors.append(f"canaries against a healthy fleet were not ok: "
+                      f"{bad}")
+
+    # --- ledger: usage rows == served_rows, exactly -------------------
+    served = fleet.get("served_rows")
+    ledger = fleet.get("ledger_rows")
+    if served is None or ledger is None:
+        errors.append(f"ledger legs missing: served_rows={served}, "
+                      f"ledger_rows={ledger}")
+    elif served != ledger:
+        errors.append(f"usage ledger does not balance: worker /usage "
+                      f"rows={ledger} != router served_rows={served}")
+    if (fleet.get("served_rows") or 0) <= 0:
+        errors.append("fleet served no rows — the balance check proved "
+                      "nothing")
+
+    # --- storm: probe_failure_ratio ok -> firing -> ok ----------------
+    storm = rec.get("storm") or {}
+    states = storm.get("states") or []
+    if not states or states[0] != "ok":
+        errors.append(f"probe rule did not start ok: {states}")
+    if "firing" not in states:
+        errors.append(f"wrong-answer canary never fired "
+                      f"probe_failure_ratio: {states} "
+                      f"(value={storm.get('storm_value')})")
+    if not states or states[-1] != "ok":
+        errors.append(f"probe rule did not recover to ok: {states}")
+    alerts = storm.get("alerts_total") or {}
+    if alerts.get("rule=probe_failure_ratio|state=firing", 0) < 1:
+        errors.append(f"the ok->firing transition was not counted in "
+                      f"slo_alerts_total: {alerts}")
+    if alerts.get("rule=probe_failure_ratio|state=ok", 0) < 1:
+        errors.append(f"the firing->ok recovery was not counted in "
+                      f"slo_alerts_total: {alerts}")
+
+    print(f"demand_obs: {hist.get('samples')} history samples / "
+          f"{hist.get('segments')} segments, parity windows "
+          f"{sorted(parity)} clean; idle-fleet probes={probe_sum:g} with "
+          f"organic series zero; ledger {ledger} == served {served}; "
+          f"storm walked {states}")
+    for e in errors:
+        print("check_demand FAIL:", e)
+    if not errors:
+        print("check_demand: history parity exact, probe isolation held, "
+              "usage ledger balances, probe gate fired and recovered "
+              "counted — held")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -34,6 +34,7 @@ timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   tests/test_streaming.py tests/test_parallel.py tests/test_native.py \
   tests/test_ui.py tests/test_sanitizer.py tests/test_fleet.py \
   tests/test_continuous.py tests/test_hostfleet.py \
+  tests/test_demand.py \
   -q -m 'not slow' \
   -p no:cacheprovider -p no:xdist -p no:randomly || {
     echo "tier1: graftsan stage FAILED"; exit 1; }
@@ -247,5 +248,29 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
   || { echo "tier1: slo/goodput smoke FAILED (a healthy run fired, the"
        echo "tier1: injected storm did not, a transition went uncounted,"
        echo "tier1: or the goodput ledger lost wall-clock seconds)"; exit 1; }
+
+# Stage 13: demand-observability smoke (telemetry/history +
+# serving/metering + fleet/prober, ISSUE 18) — the demand plane end to
+# end: a real fit sampled into the metrics-history ring and persisted as
+# atomic segments with rate_over parity <=1e-6 against the live SLO
+# delta discipline; a REAL 2-worker fleet left organically idle while a
+# synthetic prober canaries it through the router wire path (probe_total
+# advances, every unlabeled organic series stays exactly zero); the
+# per-model usage ledger folded from worker /usage must balance EXACTLY
+# against the router's served_rows; and a wrong-answer canary must walk
+# probe_failure_ratio ok -> firing -> ok with both transitions counted.
+# scripts/check_demand.py gates STRUCTURALLY (counters, ledger balance,
+# parity) — never wall time.
+echo "== demand-observability smoke =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
+  timeout -k 10 300 python bench.py demand_obs \
+  > /tmp/_demand_obs.jsonl \
+  && tee -a BENCH_smoke.json < /tmp/_demand_obs.jsonl > /dev/null \
+  && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python scripts/check_demand.py /tmp/_demand_obs.jsonl \
+  || { echo "tier1: demand-observability smoke FAILED (history parity"
+       echo "tier1: drifted, probe traffic leaked into organic series,"
+       echo "tier1: the usage ledger did not balance, or the probe gate"
+       echo "tier1: never fired/recovered)"; exit 1; }
 
 exit $rc

@@ -1,0 +1,640 @@
+"""Demand-observability tests (ISSUE 18): the metrics-history store
+(ring eviction, atomic segment persistence, corrupt-segment degradation,
+counter-reset-safe rate_over and its <=1e-6 parity with the live SLO
+delta discipline), per-model/per-tenant usage metering (the ledger
+balances EXACTLY against the router's served_rows), and the synthetic
+prober (verdicts ok/wrong_answer/unreachable, bounded waits against a
+dead fleet, and the isolation invariant: an idle fleet's ORGANIC series
+stay exactly zero while probe_total advances)."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.fleet import FleetProber, FleetRouter, FleetWorker
+from deeplearning4j_tpu.fleet import prober as prober_mod
+from deeplearning4j_tpu.nn import layers as L, updaters as U
+from deeplearning4j_tpu.nn.conf import inputs as I
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfig
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import ServingEngine, metering
+from deeplearning4j_tpu.telemetry import history, slo
+from deeplearning4j_tpu.telemetry.history import (MetricsHistory, load_dir,
+                                                  parse_series)
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    telemetry.reset()
+    telemetry.disable()
+    yield
+    telemetry.reset()
+    telemetry.disable()
+
+
+@pytest.fixture
+def fresh(_isolate):
+    telemetry.enable()
+    yield telemetry.get_registry()
+
+
+def _mlp(n_in=4, n_out=3, hidden=6, seed=7):
+    net = MultiLayerNetwork(
+        NeuralNetConfig(seed=seed, updater=U.Sgd(learning_rate=0.1)).list(
+            L.DenseLayer(n_out=hidden, activation="tanh"),
+            L.OutputLayer(n_out=n_out, loss="mcxent"),
+            input_type=I.FeedForwardType(n_in)))
+    net.init()
+    return net
+
+
+def _x(n, n_in=4, seed=0):
+    return np.random.RandomState(seed).rand(n, n_in).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# parse_series
+# ---------------------------------------------------------------------------
+
+class TestParseSeries:
+    def test_bare_and_labeled(self):
+        assert parse_series("foo") == ("foo", {})
+        assert parse_series("foo{a=1,b=x}") == ("foo", {"a": "1", "b": "x"})
+        assert parse_series(' foo{a="q"} ') == ("foo", {"a": "q"})
+
+    def test_malformed_raises(self):
+        with pytest.raises(ValueError):
+            parse_series("foo{a=1")
+        with pytest.raises(ValueError):
+            parse_series("foo{nolabel}")
+
+
+# ---------------------------------------------------------------------------
+# MetricsHistory: ring, persistence, queries
+# ---------------------------------------------------------------------------
+
+class TestHistoryStore:
+    def test_ring_eviction_is_bounded(self, fresh):
+        store = MetricsHistory(max_samples=4)
+        for i in range(10):
+            store.sample_now(now=1000.0 + i)
+        got = store.samples()
+        assert len(got) == 4
+        # oldest evicted, newest retained, time order preserved
+        assert [s["t"] for s in got] == [1006.0, 1007.0, 1008.0, 1009.0]
+        assert store.describe()["samples"] == 4
+
+    def test_segment_persistence_round_trip(self, fresh, tmp_path):
+        d = str(tmp_path / "hist")
+        c = fresh.counter("demand_test_total", "t")
+        store = MetricsHistory(history_dir=d, segment_samples=2,
+                               max_segments=8)
+        for i in range(5):
+            c.inc(3, model="m")
+            store.sample_now(now=1000.0 + 10 * i)
+        store.flush()   # the buffered 5th sample persists too
+        # 2+2+1 samples -> 3 segments, atomic (no .tmp leftovers)
+        assert len(store.segment_paths()) == 3
+        assert not [n for n in os.listdir(d) if n.endswith(".tmp")]
+        samples, corrupt = load_dir(d)
+        assert corrupt == 0
+        assert [s["t"] for s in samples] == [1000.0 + 10 * i
+                                             for i in range(5)]
+        # values survive the round trip exactly, into a fresh store
+        fresh2 = MetricsHistory()
+        loaded = fresh2.load(d)
+        assert len(loaded) == 5
+        q = fresh2.query("demand_test_total{model=m}")
+        assert q == [[1000.0 + 10 * i, 3.0 * (i + 1)] for i in range(5)]
+
+    def test_restart_resumes_segment_sequence(self, fresh, tmp_path):
+        d = str(tmp_path / "hist")
+        s1 = MetricsHistory(history_dir=d, segment_samples=1)
+        s1.sample_now(now=1.0)
+        s1.sample_now(now=2.0)
+        # a new store over the same dir must not clobber old segments
+        s2 = MetricsHistory(history_dir=d, segment_samples=1)
+        s2.sample_now(now=3.0)
+        assert len(s2.segment_paths()) == 3
+        samples, corrupt = load_dir(d)
+        assert [s["t"] for s in samples] == [1.0, 2.0, 3.0]
+
+    def test_max_segments_evicts_oldest(self, fresh, tmp_path):
+        d = str(tmp_path / "hist")
+        store = MetricsHistory(history_dir=d, segment_samples=1,
+                               max_segments=3)
+        for i in range(7):
+            store.sample_now(now=float(i))
+        paths = store.segment_paths()
+        assert len(paths) == 3
+        samples, _ = load_dir(d)
+        assert [s["t"] for s in samples] == [4.0, 5.0, 6.0]
+        evicted = telemetry.series_map("history_segment_total")
+        assert evicted.get("event=evict") == 4
+
+    def test_corrupt_segment_counted_never_fatal(self, fresh, tmp_path):
+        d = str(tmp_path / "hist")
+        store = MetricsHistory(history_dir=d, segment_samples=1)
+        store.sample_now(now=1.0)
+        store.sample_now(now=2.0)
+        paths = store.segment_paths()
+        with open(paths[0], "w") as f:
+            f.write("{torn json\n")   # a torn copy / partial write
+        samples, corrupt = load_dir(d)
+        assert corrupt == 1
+        assert [s["t"] for s in samples] == [2.0]   # good data survives
+        # the store-level load counts it on the registry
+        store2 = MetricsHistory(history_dir=d)
+        store2.load()
+        m = telemetry.series_map("history_segment_total")
+        assert m.get("event=corrupt") == 1
+
+    def test_query_skips_absent_metric_samples(self, fresh):
+        store = MetricsHistory()
+        store.sample_now(now=1.0)              # metric not born yet
+        c = fresh.counter("late_total", "t")
+        c.inc(2)
+        store.sample_now(now=2.0)
+        assert store.query("late_total") == [[2.0, 2.0]]
+        assert store.query("never_total") == []
+
+    def test_sampler_thread_runs_and_stops(self, fresh):
+        store = MetricsHistory()
+        store.start(interval_s=0.02)
+        deadline = time.time() + 5
+        while not store.samples() and time.time() < deadline:
+            time.sleep(0.01)
+        assert store.samples()
+        store.stop()
+        assert store.describe()["sampling"] is False
+
+
+# ---------------------------------------------------------------------------
+# rate_over: the counter-delta discipline over history
+# ---------------------------------------------------------------------------
+
+class TestRateOver:
+    def test_rate_matches_live_slo_deltas_exactly(self, fresh):
+        """ISSUE 18 acceptance: rate_over agrees with the live SLO
+        engine's delta tracking to <=1e-6 on the same sample points."""
+        c = fresh.counter("parity_total", "t")
+        store = MetricsHistory()
+        live = slo._DeltaTrack(keep_s=3600.0)
+        t0 = 1000.0
+        rng = np.random.RandomState(3)
+        for i in range(20):
+            c.inc(float(rng.randint(0, 50)), model="m")
+            t = t0 + 5.0 * i
+            store.sample_now(now=t)
+            live.sample(t, slo._select(fresh.snapshot(), "parity_total",
+                                       {}))
+        now = t0 + 5.0 * 19
+        for window in (10.0, 30.0, 60.0, 95.0):
+            want = live.rate(window, now)
+            got = store.rate_over("parity_total", window, now=now)
+            assert want is not None and got is not None
+            assert abs(got - want) <= 1e-6
+
+    def test_counter_reset_never_fakes_negative_rate(self, fresh):
+        """A restarted process's counter drops to zero mid-history; the
+        reset interval must contribute NOTHING (not a negative rate)."""
+        store = MetricsHistory()
+        # hand-built samples: 0,100,200, reset->5, 10
+        vals = [0.0, 100.0, 200.0, 5.0, 10.0]
+        for i, v in enumerate(vals):
+            doc = {"reset_total": {"type": "counter", "series": [
+                {"labels": {}, "value": v}]}}
+            store.sample_now(now=1000.0 + 10.0 * i, metrics=doc)
+        r = store.rate_over("reset_total", 40.0, now=1040.0)
+        assert r is not None
+        # admissible deltas: +100, +100, (reset: dropped), +5 over 40s
+        assert abs(r - (100.0 + 100.0 + 5.0) / 40.0) <= 1e-9
+        assert r >= 0.0
+
+    def test_rate_none_until_window_spanned(self, fresh):
+        store = MetricsHistory()
+        doc = {"x_total": {"type": "counter",
+                           "series": [{"labels": {}, "value": 1.0}]}}
+        store.sample_now(now=1000.0, metrics=doc)
+        assert store.rate_over("x_total", 60.0, now=1000.0) is None
+
+    def test_replay_into_engine_judges_dead_process_window(self, fresh,
+                                                           tmp_path):
+        """A fresh process replays persisted history and the SLO engine
+        fires on a storm it never lived through."""
+        d = str(tmp_path / "hist")
+        num = fresh.counter("serving_shed_total", "t")
+        den = fresh.counter("serving_model_requests_total", "t")
+        store = MetricsHistory(history_dir=d, segment_samples=4)
+        t0 = 2000.0
+        for i in range(8):
+            num.inc(30, model="m", reason="queue_full")
+            den.inc(50, model="m", outcome="submitted")
+            store.sample_now(now=t0 + 30.0 * i)
+        store.flush()
+        # ---- the "restarted process": fresh engine, fresh store ----
+        engine = slo.SloEngine(rules=slo.default_rules(),
+                               registry=fresh)
+        reader = MetricsHistory(history_dir=d)
+        samples = reader.load()
+        n = reader.replay_into(engine, samples=samples)
+        assert n == 8
+        st = engine.status()
+        by_name = {r["name"]: r for r in st["rules"]}
+        assert by_name["serving_shed_ratio"]["state"] == "firing"
+
+
+# ---------------------------------------------------------------------------
+# Usage metering: the demand ledger
+# ---------------------------------------------------------------------------
+
+class TestMetering:
+    def test_record_and_usage_shape(self, fresh):
+        m = metering.get_meter()
+        m.record("a", rows=4, tokens=16, queue_s=0.5, device_s=0.25,
+                 flops=1000.0)
+        m.record("a", rows=2, tokens=8, queue_s=0.1, device_s=0.05,
+                 flops=500.0, tenant="t1")
+        m.record("b", rows=1, tokens=4, queue_s=0.0, device_s=0.01,
+                 flops=100.0)
+        u = m.usage()
+        assert u["models"]["a"]["rows"] == 6
+        assert u["models"]["a"]["tokens"] == 24
+        assert u["models"]["a"]["tenants"]["t1"]["rows"] == 2
+        assert u["models"]["a"]["tenants"][metering.NO_TENANT]["rows"] == 4
+        assert u["totals"]["rows"] == 7
+        assert m.rows_for("a") == 6
+        # counters carry the same ledger (the federatable wire form)
+        rows = telemetry.series_map("usage_rows_total")
+        assert rows.get("model=a|tenant=t1") == 2
+        assert rows.get(f"model=a|tenant={metering.NO_TENANT}") == 4
+
+    def test_negative_clamped_and_disabled_registry_still_ledgers(self):
+        # registry disabled (autouse fixture leaves it off): the ledger
+        # still accounts — usage is billing, not telemetry
+        m = metering.get_meter()
+        m.record("a", rows=-5, tokens=3)
+        u = m.usage()
+        assert u["models"]["a"]["rows"] == 0
+        assert u["models"]["a"]["tokens"] == 3
+        assert telemetry.series_map("usage_rows_total") == {}
+
+    def test_engine_meters_served_rows_exactly(self, fresh):
+        """ISSUE 18 acceptance: usage rows balance EXACTLY against the
+        serving tier's served-row accounting, probe traffic included."""
+        eng = ServingEngine(_mlp(), name="meterme", input_spec=(4,),
+                            buckets=[1, 4], batch_window_s=0.0).start()
+        try:
+            xs = _x(6)
+            futs = [eng.submit(xs[i]) for i in range(3)]
+            futs.append(eng.submit(xs[3:5], batched=True, tenant="acme"))
+            futs.append(eng.submit(xs[5], origin="probe"))
+            for f in futs:
+                f.get(timeout=30)
+        finally:
+            eng.stop()
+        u = metering.get_meter().usage()
+        got = u["models"]["meterme"]
+        assert got["rows"] == 6
+        assert got["tenants"]["acme"]["rows"] == 2
+        assert got["tokens"] == 6 * 4     # 6 rows x 4 features
+        assert got["device_seconds"] > 0.0
+        assert got["queue_seconds"] >= 0.0
+        assert got["flops"] > 0.0
+        # engine /health embeds its own slice
+        h = eng.health()
+        assert h["usage"]["rows"] == 6
+
+    def test_flops_estimate_prorates_padding(self, fresh):
+        eng = ServingEngine(_mlp(), name="flopsy", input_spec=(4,),
+                            buckets=[8], batch_window_s=0.0).start()
+        try:
+            eng.submit(_x(1)[0]).get(timeout=30)
+        finally:
+            eng.stop()
+        u = metering.get_meter().usage()["models"]["flopsy"]
+        params = sum(int(np.size(l)) for l in _leaves(eng))
+        # 1 organic row padded to the 8-bucket: estimate charges the
+        # PADDED compute (2*params*8), all attributed to the one row
+        assert u["flops"] == int(2 * params * 8)
+
+    def test_reset_drops_ledger(self, fresh):
+        metering.get_meter().record("a", rows=1)
+        telemetry.reset()
+        assert metering.get_meter().usage()["models"] == {}
+
+
+def _leaves(eng):
+    import jax
+    return jax.tree_util.tree_leaves(eng._fwd.net.params)
+
+
+# ---------------------------------------------------------------------------
+# FleetProber: verdicts, bounded waits, isolation
+# ---------------------------------------------------------------------------
+
+class TestProber:
+    def _engine(self, name="canary"):
+        return ServingEngine(_mlp(), name=name, input_spec=(4,),
+                             buckets=[1, 4], batch_window_s=0.0).start()
+
+    def test_ok_and_wrong_answer_verdicts(self, fresh):
+        eng = self._engine()
+        try:
+            x = _x(1)[0]
+            good = np.asarray(eng.output(x[None, :]))[0]
+            prober = FleetProber(eng, [
+                {"name": "good", "x": x, "expect": good},
+                {"name": "bad", "x": x, "expect": good + 0.5},
+            ], tol=1e-6)
+            results = {r["probe"]: r for r in prober.probe_once()}
+            assert results["good"]["verdict"] == "ok"
+            assert results["good"]["latency_ms"] is not None
+            assert results["bad"]["verdict"] == "wrong_answer"
+        finally:
+            eng.stop()
+        m = telemetry.series_map("probe_total")
+        assert m.get("model=canary|verdict=ok") == 1
+        assert m.get("model=canary|verdict=wrong_answer") == 1
+        assert telemetry.series_map("probe_bad_total") == {
+            "model=canary": 1}
+        lat = telemetry.series_map("probe_latency_seconds")
+        assert lat  # latency observed for answered probes
+
+    def test_dead_fleet_is_unreachable_never_a_hang(self, fresh):
+        """ISSUE 18 acceptance: a prober pointed at a dead pool lands
+        verdict=unreachable within bounded time — it must never hang."""
+        router = FleetRouter([("w0", "http://127.0.0.1:1")],
+                             name="deadfleet", no_worker_grace_s=0.2)
+        try:
+            prober = FleetProber(
+                router, [{"x": _x(1)[0], "expect": np.zeros(3)}],
+                timeout_s=5.0)
+            t0 = time.perf_counter()
+            results = prober.probe_once()
+            assert time.perf_counter() - t0 < 20.0
+            assert results[0]["verdict"] == "unreachable"
+        finally:
+            router.stop()
+        m = telemetry.series_map("probe_total")
+        assert m.get("model=deadfleet|verdict=unreachable") == 1
+
+    def test_timeout_is_unreachable(self, fresh):
+        class _Hang:
+            name = "hang"
+
+            def submit(self, x, deadline_s=None, *, batched=False,
+                       tenant=None, origin=None):
+                class F:
+                    def get(self, timeout=None):
+                        time.sleep(min(timeout or 0.1, 0.2))
+                        raise TimeoutError("inference result not ready")
+                return F()
+
+        prober = FleetProber(_Hang(), [{"x": _x(1)[0],
+                                        "expect": np.zeros(3)}],
+                             timeout_s=0.1)
+        r = prober.probe_once()
+        assert r[0]["verdict"] == "unreachable"
+
+    def test_extra_probes_and_status(self, fresh):
+        prober = FleetProber(object(), [], extra_probes=[
+            ("alive", lambda: True),
+            ("broken", lambda: (_ for _ in ()).throw(RuntimeError("x"))),
+        ])
+        prober.probe_once()
+        st = prober.status()
+        assert st["probes"]["alive"]["verdict"] == "ok"
+        assert st["probes"]["broken"]["verdict"] == "error"
+        assert st["ok"] is False and st["rounds"] == 1
+
+    def test_loop_start_stop_and_default_reset(self, fresh):
+        eng = self._engine(name="loopy")
+        try:
+            x = _x(1)[0]
+            good = np.asarray(eng.output(x[None, :]))[0]
+            prober = FleetProber(eng, [{"x": x, "expect": good}],
+                                 interval_s=30.0)
+            prober_mod.set_default(prober)
+            prober.start()
+            deadline = time.time() + 10
+            while prober.status()["rounds"] == 0 and \
+                    time.time() < deadline:
+                time.sleep(0.02)
+            assert prober.status()["rounds"] >= 1   # first round is NOW
+            assert prober_mod.status()["ok"] is True
+            telemetry.reset()                       # stops + clears it
+            assert prober_mod.get_default() is None
+            assert not prober.running
+        finally:
+            eng.stop()
+
+    def test_probe_isolation_organic_series_stay_zero(self, fresh):
+        """ISSUE 18 acceptance: on an idle engine the prober advances
+        probe_total while every ORGANIC (unlabeled) request/latency
+        series stays exactly zero."""
+        net = _mlp()
+        eng = ServingEngine(net, name="quiet", input_spec=(4,),
+                            buckets=[1, 4], batch_window_s=0.0).start()
+        try:
+            x = _x(1)[0]
+            # the pinned reference comes from the NET, not the engine's
+            # direct path — this engine must stay perfectly idle so the
+            # organic series/rings have nothing in them
+            good = np.asarray(net.output(x[None, :]))[0]
+            telemetry.reset()   # drop the warmup-era counts
+            prober = FleetProber(eng, [{"x": x, "expect": good}])
+            for _ in range(3):
+                prober.probe_once()
+        finally:
+            eng.stop()
+        pt = telemetry.series_map("probe_total")
+        assert pt.get("model=quiet|verdict=ok") == 3
+        # pre-registered failure series exist but stayed at zero
+        assert all(v == 0 for k, v in pt.items()
+                   if k != "model=quiet|verdict=ok")
+        sub = telemetry.series_map("serving_model_requests_total")
+        # every serving series carries origin=probe; no unlabeled twin
+        for key, val in sub.items():
+            if "model=quiet" in key:
+                assert "origin=probe" in key, key
+        lat = telemetry.series_map("serving_model_latency_seconds")
+        for key in lat:
+            assert "origin=probe" in key, key
+        # the organic p50/p99 gauges never materialized
+        p = fresh.get("serving_latency_p50_seconds")
+        assert p is None or p.value(model="quiet") == 0.0
+
+    def test_probe_excluded_from_default_slo_rules(self, fresh):
+        """A prober storm of sheds must not move the organic shed SLI —
+        but the probe_failure_ratio rule sees (only) probe verdicts."""
+        num = fresh.counter("serving_shed_total", "t")
+        den = fresh.counter("serving_model_requests_total", "t")
+        pt = fresh.counter("probe_total", "t")
+        pb = fresh.counter("probe_bad_total", "t")
+        engine = slo.SloEngine(rules=slo.default_rules(), registry=fresh)
+        t0 = 1000.0
+        for i in range(5):
+            # probe-labeled sheds storm; organic traffic is healthy
+            num.inc(40, model="m", reason="deadline", origin="probe")
+            den.inc(40, model="m", outcome="submitted", origin="probe")
+            den.inc(100, model="m", outcome="submitted")
+            # and the probes themselves are failing
+            pt.inc(10, model="m", verdict="wrong_answer")
+            pb.inc(10, model="m")
+            st = engine.evaluate(now=t0 + 60.0 * i)
+        by_name = {r["name"]: r for r in st["rules"]}
+        shed = by_name["serving_shed_ratio"]
+        assert shed["state"] == "ok"            # probe storm excluded
+        assert (shed["value"] or 0.0) == 0.0
+        probe_rule = by_name["probe_failure_ratio"]
+        assert probe_rule["state"] == "firing"  # all probes bad
+        assert abs(probe_rule["value"] - 1.0) <= 1e-9
+
+    def test_probe_rule_walks_ok_firing_ok(self, fresh):
+        pt = fresh.counter("probe_total", "t")
+        pb = fresh.counter("probe_bad_total", "t")
+        engine = slo.SloEngine(rules=slo.default_rules(), registry=fresh)
+        t0 = 1000.0
+        t = [t0]
+
+        def step(n_ok, n_bad):
+            pt.inc(n_ok, model="m", verdict="ok")
+            if n_bad:
+                pt.inc(n_bad, model="m", verdict="wrong_answer")
+                pb.inc(n_bad, model="m")
+            t[0] += 60.0
+            return engine.evaluate(now=t[0])
+
+        states = []
+        for n_ok, n_bad in [(10, 0), (10, 0), (0, 10), (0, 10),
+                            (10, 0), (10, 0), (10, 0)]:
+            st = step(n_ok, n_bad)
+            states.append({r["name"]: r["state"]
+                           for r in st["rules"]}["probe_failure_ratio"])
+        assert "firing" in states
+        assert states[0] == "ok" and states[-1] == "ok"
+        alerts = telemetry.series_map("slo_alerts_total")
+        assert alerts.get("rule=probe_failure_ratio|state=firing") >= 1
+        assert alerts.get("rule=probe_failure_ratio|state=ok") >= 1
+
+
+# ---------------------------------------------------------------------------
+# Fleet wire path: origin/tenant ride the router -> worker hop
+# ---------------------------------------------------------------------------
+
+class TestFleetWirePath:
+    @pytest.fixture
+    def live(self, fresh):
+        eng = ServingEngine(_mlp(), name="wiremeter", input_spec=(4,),
+                            buckets=[1, 4], batch_window_s=0.0)
+        worker = FleetWorker(eng, worker_id="w0", port=0).start()
+        router = FleetRouter([("w0", worker.address)], name="wiremeter")
+        yield eng, worker, router
+        router.stop()
+        worker.stop()
+
+    def test_ledger_balances_against_router_served_rows(self, live):
+        """ISSUE 18 acceptance: per-model usage rows == the router's
+        served_rows, exactly — organic, tenant and probe traffic all
+        accounted, nothing double- or un-counted."""
+        eng, worker, router = live
+        xs = _x(8)
+        futs = [router.submit(xs[i]) for i in range(2)]
+        futs.append(router.submit(xs[2:5], batched=True, tenant="acme"))
+        futs.append(router.submit(xs[5], origin="probe"))
+        for f in futs:
+            f.get(timeout=30)
+        served_rows = router.stats()["requests"]["served_rows"]
+        assert served_rows == 6
+        u = metering.get_meter().usage()["models"]["wiremeter"]
+        assert u["rows"] == served_rows
+        assert u["tenants"]["acme"]["rows"] == 3
+        # worker /usage serves the same ledger over the wire
+        with urllib.request.urlopen(worker.address + "/usage",
+                                    timeout=10) as r:
+            doc = json.loads(r.read().decode())
+        assert doc["usage"]["models"]["wiremeter"]["rows"] == 6
+        # router health() folds per-worker usage keyed by model
+        h = router.health()
+        assert h["usage"]["wiremeter"]["rows"] == 6
+
+    def test_origin_and_tenant_series_ride_the_wire(self, live):
+        eng, worker, router = live
+        x = _x(1)[0]
+        router.submit(x, origin="probe").get(timeout=30)
+        router.submit(x, tenant="acme").get(timeout=30)
+        # engine-side serving series carry the origin label end-to-end
+        sub = telemetry.series_map("serving_model_requests_total")
+        probe_keys = [k for k in sub if "origin=probe" in k
+                      and "model=wiremeter" in k]
+        assert probe_keys
+        # tenant lands in the usage ledger, not the serving series
+        u = metering.get_meter().usage()["models"]["wiremeter"]
+        assert u["tenants"]["acme"]["rows"] == 1
+        # router-side series split the same way
+        rsub = telemetry.series_map("fleet_requests_total")
+        assert any("origin=probe" in k for k in rsub)
+
+    def test_health_probe_traffic_is_labeled(self, live):
+        """Satellite: router/supervisor /health probes stamp the origin
+        header so worker-side HTTP accounting separates them."""
+        eng, worker, router = live
+        router.health()
+        m = telemetry.series_map("fleet_worker_http_total")
+        assert any("origin=probe" in k and "path=/health" in k
+                   for k in m)
+
+
+# ---------------------------------------------------------------------------
+# /query, /usage, /slo?history=1 endpoints
+# ---------------------------------------------------------------------------
+
+class TestEndpoints:
+    def _get(self, port, path):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return r.status, json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode())
+
+    def test_query_usage_and_history_replay(self, fresh):
+        from deeplearning4j_tpu.ui import UIServer
+        c = fresh.counter("endpoint_total", "t")
+        store = history.get_history()
+        for i in range(4):
+            c.inc(5, model="m")
+            store.sample_now(now=1000.0 + 30.0 * i)
+        metering.get_meter().record("m", rows=7, tokens=3)
+        ui = UIServer(port=0).start()
+        try:
+            code, doc = self._get(ui.port, "/query")
+            assert code == 200 and doc["samples"] == 4
+            code, doc = self._get(
+                ui.port, "/query?series=endpoint_total{model=m}")
+            assert code == 200
+            assert doc["points"] == [[1000.0 + 30.0 * i, 5.0 * (i + 1)]
+                                     for i in range(4)]
+            code, doc = self._get(
+                ui.port,
+                "/query?series=endpoint_total&window=60")
+            assert code == 200 and doc["rate_per_s"] is not None
+            assert abs(doc["rate_per_s"] - 10.0 / 60.0) <= 1e-9
+            code, doc = self._get(ui.port, "/query?series=bad{x")
+            assert code == 400
+            code, doc = self._get(ui.port, "/usage")
+            assert code == 200
+            assert doc["models"]["m"]["rows"] == 7
+            code, doc = self._get(ui.port, "/slo?history=1")
+            assert code == 200
+            assert doc["history"]["replayed"] == 4
+            assert doc["evaluations"] >= 4
+        finally:
+            ui.stop()
