@@ -28,6 +28,14 @@ disabled" at runtime in tests; this package makes the invariants
   read-modify-write of shared attributes outside the owning lock in
   lock-bearing classes.
 
+``flow_rules`` adds the project-level dataflow rules R7-R9 (use-after-
+donate, sharding-axis mismatch, lock-order/blocking-under-lock);
+``contracts`` adds the distributed-tier string contracts R10-R13
+(wire-contract, metric-schema, blocking-call timeouts on the fleet
+paths, label-cardinality hygiene) plus ``lint --emit-schema``, which
+writes the harvested wire+metric registry to ``SCHEMA.json`` and
+``METRICS.md``.
+
 Pure stdlib (``ast`` + ``tokenize``) — importing this package never
 imports jax, so the linter runs anywhere (CI, pre-commit) without touching
 an accelerator backend.
@@ -57,8 +65,10 @@ from deeplearning4j_tpu.analysis.baseline import (apply_baseline,
                                                   save_baseline)
 from deeplearning4j_tpu.analysis import rules as _rules  # registers R1-R6
 from deeplearning4j_tpu.analysis import flow_rules as _flow  # R7-R9
+from deeplearning4j_tpu.analysis import contracts as _contracts  # R10-R13
+from deeplearning4j_tpu.analysis.contracts import build_schema
 
 __all__ = ["Finding", "LintError", "LintModule", "ProjectRule", "all_rules",
            "lint_modules", "lint_paths", "lint_source", "parse_paths",
            "apply_baseline", "default_baseline_path", "load_baseline",
-           "save_baseline"]
+           "save_baseline", "build_schema"]

@@ -47,3 +47,79 @@ def report_json(new, known, stale, stream=None):
     json.dump(doc, stream, indent=1)
     stream.write("\n")
     return doc
+
+
+# ---------------------------------------------------------------------------
+# schema artifact (lint --emit-schema)
+# ---------------------------------------------------------------------------
+
+def schema_json_text(schema):
+    """The SCHEMA.json byte content for a build_schema() dict — keys
+    sorted, newline-terminated, no timestamps, so identical source
+    always renders identical bytes (the drift check byte-compares)."""
+    return json.dumps(schema, indent=1, sort_keys=True) + "\n"
+
+
+def metrics_md_text(schema):
+    """METRICS.md: the human rendering of the same registry — the metric
+    series table first (what operators grep for a label set), then the
+    wire contract (routes, headers, response keys)."""
+    lines = [
+        "# Cluster schema — generated, do not edit",
+        "",
+        "Regenerate with `python -m deeplearning4j_tpu lint "
+        "--emit-schema`; `scripts/check_schema.py` fails CI when this "
+        "file or `SCHEMA.json` is stale. The same harvest feeds lint "
+        "rules R10 (wire contract), R11 (metric schema), and R13 "
+        "(label cardinality).",
+        "",
+        "## Metric series",
+        "",
+        "| series | type | labels | optional | pre-registered | help |",
+        "|---|---|---|---|---|---|",
+    ]
+    for name in sorted(schema["metrics"]):
+        m = schema["metrics"][name]
+        labels = ", ".join(m["labels"]) or "—"
+        opt = ", ".join(m["optional_labels"]) or "—"
+        if m["dynamic_labels"]:
+            opt = (opt + " +**" if opt != "—" else "+**")
+        pre = "yes" if m["preregistered"] else "no"
+        help_ = m["help"].replace("|", "\\|")
+        lines.append(f"| `{name}` | {m['type']} | {labels} | {opt} "
+                     f"| {pre} | {help_} |")
+    if schema.get("dynamic_metric_prefixes"):
+        lines += ["",
+                  "Dynamic series prefixes (name built at runtime): " +
+                  ", ".join(f"`{p}*`"
+                            for p in schema["dynamic_metric_prefixes"])]
+    lines += ["", "## Wire contract", "", "### Routes", "",
+              "| route | match | methods | handler sites |",
+              "|---|---|---|---|"]
+    for r in schema["wire"]["routes"]:
+        sites = ", ".join(f"`{s}`" for s in r["sites"])
+        lines.append(f"| `{r['path']}` | {r['match']} "
+                     f"| {', '.join(r['methods'])} | {sites} |")
+    lines += ["", "### Headers", ""]
+    lines += [f"- `{h}`" for h in schema["wire"]["headers"]]
+    lines += ["", "### Client call sites", "",
+              "| route | site |", "|---|---|"]
+    for c in schema["wire"]["client_calls"]:
+        lines.append(f"| `{c['route']}` | `{c['site']}` |")
+    lines += ["", "### Response-JSON keys", "",
+              ", ".join(f"`{k}`" for k in schema["wire"]["response_keys"]),
+              ""]
+    return "\n".join(lines)
+
+
+def write_schema(schema, out_dir):
+    """Write SCHEMA.json + METRICS.md under ``out_dir``; returns the two
+    paths written."""
+    import os
+    jp = os.path.join(out_dir, "SCHEMA.json")
+    mp = os.path.join(out_dir, "METRICS.md")
+    with open(jp, "w", encoding="utf-8") as fh:
+        fh.write(schema_json_text(schema))
+    with open(mp, "w", encoding="utf-8") as fh:
+        fh.write(metrics_md_text(schema))
+    return jp, mp

@@ -266,6 +266,14 @@ def _build_parser():
                          "rules need the whole tree) but only REPORT "
                          "findings whose statement touches a line changed "
                          "vs this git ref (e.g. HEAD, origin/main)")
+    ln.add_argument("--emit-schema", action="store_true",
+                    help="instead of linting, write the harvested wire+"
+                         "metric contract (routes, headers, response "
+                         "keys, metric series with label sets) to "
+                         "SCHEMA.json and METRICS.md — the same registry "
+                         "rules R10/R11/R13 enforce")
+    ln.add_argument("--schema-dir", metavar="DIR",
+                    help="where --emit-schema writes (default: repo root)")
     ln.add_argument("--san-report", metavar="JSON",
                     help="merge a graftsan runtime report (Sanitizer.dump "
                          "/ GRAFTSAN_REPORT) with the static R9 lock "
@@ -853,6 +861,18 @@ def _cmd_lint(args):
     paths = args.paths or [pkg_dir]
     rules = args.rules.split(",") if args.rules else None
 
+    if args.emit_schema:
+        mods, errors = analysis.parse_paths(paths, root=root)
+        if errors:
+            for f in errors:
+                print(f.human(), file=sys.stderr)
+            raise SystemExit("graftlint: cannot emit a schema over "
+                             "unparseable sources")
+        schema = analysis.build_schema(mods)
+        out_dir = args.schema_dir or root
+        jp, mp = reporters.write_schema(schema, out_dir)
+        print(f"graftlint: schema written: {jp}, {mp}", file=sys.stderr)
+        return 0
     if args.san_report:
         return _lint_san_report(args, paths, root)
     if args.diff and args.update_baseline:

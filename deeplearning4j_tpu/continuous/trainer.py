@@ -219,6 +219,18 @@ class ContinuousTrainer:
         self._m_serve = reg.counter(
             "continuous_serve_updates_total",
             "serving hot-swap handoffs of published snapshots, by outcome")
+        if reg.enabled:
+            # pre-register every enum series at zero (the prober idiom):
+            # the SLO delta discipline ignores a series' FIRST
+            # appearance, so a rollback/error series born mid-incident
+            # would contribute nothing for a full window
+            for outcome in ("ok", "rollback", "stream_quiet",
+                            "stream_closed"):
+                self._m_rounds.inc(0, outcome=outcome)
+            for verdict in ("published", "skipped_sick", "error"):
+                self._m_snap.inc(0, verdict=verdict)
+            for outcome in ("ok", "error"):
+                self._m_serve.inc(0, outcome=outcome)
         self._anoms_at_gate = None
 
     @staticmethod

@@ -26,9 +26,16 @@ from deeplearning4j_tpu.datasets import fetchers as _f
 
 
 def _cache_counter():
-    return _tm.get_registry().counter(
+    reg = _tm.get_registry()
+    c = reg.counter(
         "dataset_cache_requests_total",
         "dataset cache lookups, labeled outcome=hit|miss")
+    if reg.enabled:
+        # pre-register both outcome series at zero so a miss (or a hit)
+        # that never happens still charts as an explicit 0
+        for outcome in ("hit", "miss"):
+            c.inc(0, outcome=outcome)
+    return c
 
 
 class ChecksumError(RuntimeError):

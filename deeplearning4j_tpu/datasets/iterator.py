@@ -300,6 +300,12 @@ class AsyncDataSetIterator(DataSetIterator):
             "transient producer errors, by outcome (retried = one backoff "
             "attempt, recovered = a batch arrived after retries, fatal = "
             "the retry budget ran out and the error surfaced)")
+        if reg.enabled:
+            # pre-register the outcome series at zero: an ETL failure
+            # series born mid-incident is invisible to the SLO delta
+            # discipline for a full window (the prober idiom)
+            for outcome in ("retried", "recovered", "fatal"):
+                self._m_retry.inc(0, outcome=outcome)
 
     @property
     def batch_size(self):

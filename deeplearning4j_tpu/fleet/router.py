@@ -175,6 +175,14 @@ class FleetRouter:
         self._m_latency = reg.histogram(
             "fleet_request_latency_seconds",
             "fleet submit-to-resolve request latency")
+        if reg.enabled:
+            # pre-register every outcome series at zero (the prober
+            # idiom): a shed/error series born mid-storm contributes
+            # nothing to the SLO delta window it first appears in
+            for outcome in ("submitted", "served", "shed_queue_full",
+                            "shed_deadline", "shed_no_worker",
+                            "shed_worker", "error"):
+                self._m_requests.inc(0, outcome=outcome)
         self.set_endpoints(endpoints)
         for i in range(concurrency):
             t = threading.Thread(target=self._dispatch_loop,

@@ -60,6 +60,11 @@ PARENT_SPAN_HEADER = "X-DL4J-Parent-Span"
 #: of the organic ones
 ORIGIN_HEADER = "X-DL4J-Origin"
 
+#: the GET routes the wire counter buckets path labels into — an unknown
+#: or mistyped path charts as "/other" instead of minting a new metric
+#: series per distinct request string (label-cardinality hygiene, R13)
+GET_ROUTES = ("/health", "/stats", "/usage", "/metrics", "/traces")
+
 
 def _tree_to_jsonable(y):
     """Outputs as JSON-ready nested lists (dict heads for multi-output
@@ -295,6 +300,7 @@ class FleetWorker:
         if self._reg.enabled:
             root = "/" + (path.lstrip("/").split("?")[0].split("/")[0]
                           or "")
+            root = root if root in GET_ROUTES else "/other"
             self._m_http.inc(path=root,
                              **({"origin": str(origin)} if origin else {}))
 

@@ -196,6 +196,12 @@ class TrainingFleetSupervisor:
             "distributed_hosts_alive",
             "training hosts the supervisor currently believes alive "
             "(rides /health)")
+        if reg.enabled:
+            # pre-register the handoff outcome series at zero: an error
+            # series born at the first failed handoff is invisible to
+            # the SLO delta discipline for a window (the prober idiom)
+            for outcome in ("ok", "error"):
+                self._m_serve.inc(0, outcome=outcome)
 
     # ---- spawning ----
 

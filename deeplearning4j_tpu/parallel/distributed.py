@@ -78,11 +78,19 @@ def _host_label():
 
 
 def _init_counter():
-    return _tm.get_registry().counter(
+    reg = _tm.get_registry()
+    c = reg.counter(
         "distributed_init_total",
         "jax.distributed coordinator joins, by outcome (ok = joined, "
         "retried = one connect attempt failed and was retried with "
         "backoff, failed = the retry budget ran out)")
+    if reg.enabled:
+        # pre-register every outcome series at zero so a retried/failed
+        # join that never happens still charts as an explicit 0 and a
+        # failure mid-re-form lands in the SLO window it happens in
+        for outcome in ("ok", "retried", "failed"):
+            c.inc(0, outcome=outcome)
+    return c
 
 
 def _probe_coordinator(address, deadline_s):

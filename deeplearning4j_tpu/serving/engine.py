@@ -556,6 +556,13 @@ class ServingEngine:
         self._m_warm = reg.gauge(
             "serving_warmup_seconds",
             "wall seconds the AOT bucket warmup took at startup, per model")
+        if reg.enabled:
+            # pre-register every outcome series at zero (the prober
+            # idiom): a shed/error series born mid-storm contributes
+            # nothing to the SLO delta window it first appears in
+            for outcome in ("submitted", "served", "served_direct",
+                            "shed_queue_full", "shed_deadline", "error"):
+                self._m_requests.inc(0, model=self.name, outcome=outcome)
         if warmup is None:
             warmup = input_spec is not None
         if warmup:

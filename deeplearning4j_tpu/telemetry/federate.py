@@ -99,6 +99,13 @@ def federate(targets, timeout_s=2.0, instance_label="instance"):
         "federate_scrape_total",
         "federated member scrapes by outcome (ok/error) — a dead member "
         "is counted here, never a hang")
+    if reg.enabled:
+        # pre-register both outcome series per member at zero: a member
+        # that dies on its FIRST scrape must land in that delta window,
+        # not be invisible as a series birth (the prober idiom)
+        for inst, _src in targets:
+            for outcome in ("ok", "error"):
+                m_scrape.inc(0, outcome=outcome, instance=inst)
     merged = {}
     members = {}
     counts = {"ok": 0, "error": 0}

@@ -100,6 +100,12 @@ class MetricsHistory:
             "history_segment_total",
             "history segment persistence events "
             "(persist/evict/corrupt/persist_error)")
+        if self._reg.enabled:
+            # pre-register the snapshot outcome series at zero: a broken
+            # source's error series must land in the delta window it
+            # first breaks in, not be discarded as a series birth
+            for outcome in ("ok", "error"):
+                self._m_samples.inc(0, outcome=outcome)
         if history_dir:
             os.makedirs(history_dir, exist_ok=True)
             self._seg_seq = self._next_seq(history_dir)

@@ -1,0 +1,120 @@
+#!/usr/bin/env python3
+"""Cluster-schema drift gate (tier-1 stage 0).
+
+Two checks, both over the same harvest lint rules R10/R11/R13 enforce:
+
+1. **Artifact drift** — regenerate the wire+metric contract in memory
+   (``analysis.build_schema`` over the package) and byte-compare it
+   against the committed ``SCHEMA.json`` / ``METRICS.md``. A metric or
+   route added without re-running ``lint --emit-schema`` fails here, so
+   the committed artifact is always the contract at HEAD.
+2. **Out-of-package references** — ``bench.py``, ``analyze_bench.py``
+   and ``scripts/*.py`` read series by name (``series_map("...")``)
+   but are NOT linted (R1-R6 are step-path rules; these files are
+   drivers). AST-scan them for series-name literals and require each to
+   exist in the schema (or match a dynamic-name prefix) — the R11b
+   check extended to the files the linter does not walk.
+
+Pure stdlib + the analysis package (which never imports jax): safe to
+run anywhere tier-1 runs.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from deeplearning4j_tpu import analysis  # noqa: E402
+from deeplearning4j_tpu.analysis import reporters  # noqa: E402
+
+
+def regenerate():
+    pkg = os.path.join(REPO, "deeplearning4j_tpu")
+    mods, errors = analysis.parse_paths([pkg], root=REPO)
+    if errors:
+        for f in errors:
+            print(f.human(), file=sys.stderr)
+        raise SystemExit("check_schema: package does not parse")
+    return analysis.build_schema(mods)
+
+
+def check_artifacts(schema):
+    bad = []
+    for fname, text in (("SCHEMA.json", reporters.schema_json_text(schema)),
+                        ("METRICS.md", reporters.metrics_md_text(schema))):
+        path = os.path.join(REPO, fname)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                committed = fh.read()
+        except FileNotFoundError:
+            bad.append(f"{fname}: missing")
+            continue
+        if committed != text:
+            bad.append(f"{fname}: stale")
+    if bad:
+        for b in bad:
+            print(f"check_schema: {b}", file=sys.stderr)
+        print("check_schema: the committed schema artifact does not "
+              "match the source — regenerate with:\n  python -m "
+              "deeplearning4j_tpu lint --emit-schema", file=sys.stderr)
+        return False
+    return True
+
+
+def _series_refs(path):
+    with open(path, "r", encoding="utf-8") as fh:
+        try:
+            tree = ast.parse(fh.read())
+        except SyntaxError as e:
+            raise SystemExit(f"check_schema: {path} does not parse: {e}")
+    for n in ast.walk(tree):
+        if (isinstance(n, ast.Call) and n.args
+                and isinstance(n.func, (ast.Attribute, ast.Name))
+                and (n.func.attr if isinstance(n.func, ast.Attribute)
+                     else n.func.id) == "series_map"
+                and isinstance(n.args[0], ast.Constant)
+                and isinstance(n.args[0].value, str)):
+            yield n.args[0].value, n.lineno
+
+
+def check_references(schema):
+    known = set(schema["metrics"])
+    prefixes = tuple(p for p in schema["dynamic_metric_prefixes"] if p)
+    files = [os.path.join(REPO, "bench.py"),
+             os.path.join(REPO, "analyze_bench.py")]
+    sdir = os.path.join(REPO, "scripts")
+    files += sorted(os.path.join(sdir, f) for f in os.listdir(sdir)
+                    if f.endswith(".py"))
+    ok = True
+    for path in files:
+        if not os.path.exists(path):
+            continue
+        for name, line in _series_refs(path):
+            if name in known or (prefixes and name.startswith(prefixes)):
+                continue
+            rel = os.path.relpath(path, REPO)
+            print(f"check_schema: {rel}:{line}: series_map({name!r}) "
+                  "names a series no creation site produces (see "
+                  "SCHEMA.json) — the read can only ever see an empty "
+                  "map", file=sys.stderr)
+            ok = False
+    return ok
+
+
+def main():
+    schema = regenerate()
+    ok = check_artifacts(schema)
+    ok = check_references(schema) and ok
+    if not ok:
+        return 1
+    print(f"check_schema: OK — {len(schema['metrics'])} series, "
+          f"{len(schema['wire']['routes'])} routes, artifact in sync")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -11,15 +11,27 @@ set -o pipefail
 cd "$(dirname "$0")/.."
 
 # Stage 0: graftlint — the static-analysis gate (analysis/ package),
-# running the FULL rule set R1-R9 (the interprocedural dataflow rules
-# R7-R9 register alongside R1-R6; nothing to opt into). Fails on any
-# non-baselined finding AND (--strict-baseline) on stale baseline
-# entries, so graftlint.baseline.json only ever shrinks.
+# running the FULL rule set R1-R13 (the interprocedural dataflow rules
+# R7-R9 and the wire/metric contract rules R10-R13 register alongside
+# R1-R6; nothing to opt into). Fails on any non-baselined finding AND
+# (--strict-baseline) on stale baseline entries, so
+# graftlint.baseline.json only ever shrinks.
 echo "== graftlint =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python -m deeplearning4j_tpu lint --strict-baseline || {
     echo "tier1: graftlint gate FAILED (fix, suppress with justification,"
     echo "tier1: or update graftlint.baseline.json)"; exit 1; }
+
+# Stage 0 (cont.): schema drift — SCHEMA.json/METRICS.md must match a
+# fresh harvest of the wire+metric contract (lint --emit-schema), and
+# every series bench.py / analyze_bench.py / scripts/*.py read by name
+# must exist in it (R11b extended to the unlinted driver files).
+echo "== schema drift (SCHEMA.json / METRICS.md) =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python scripts/check_schema.py || {
+    echo "tier1: schema drift gate FAILED (regenerate with:"
+    echo "tier1:   python -m deeplearning4j_tpu lint --emit-schema)"
+    exit 1; }
 
 # Stage 0b: graftsan — the runtime concurrency sanitizer over the
 # threaded/donating test modules (analysis/sanitizer.py via the

@@ -1485,3 +1485,502 @@ class TestDataflowHardening:
             cli_mod, "_git_changed_lines",
             lambda ref, root: {str(p): {1}})
         assert main(["lint", str(p), "--no-baseline", "--diff", "HEAD"]) == 0
+
+
+# ----------------------------------------------------------------------
+# R10-R13: wire-contract & telemetry-schema rules (ISSUE 19)
+# ----------------------------------------------------------------------
+
+def fleet_rules_fired(src, rules=None, path="pkg/fleet/mod.py"):
+    """Lint one dedented source under a fleet-path module name (R12 only
+    gates modules whose path mentions fleet/federate)."""
+    from deeplearning4j_tpu.analysis import LintModule, lint_modules
+    mod = LintModule(textwrap.dedent(src), path=path)
+    return lint_modules([mod])
+
+
+class TestR10WireContract:
+    HANDLER = """
+        import json
+        from urllib.request import urlopen
+
+        class Handler:
+            def do_GET(self):
+                if self.path.startswith("/health"):
+                    self._send(200, {"ok": True, "pid": 1})
+                elif self.path == "/stats":
+                    self._send(200, {"stats": {}})
+
+            def do_POST(self):
+                if self.path.startswith("/submit"):
+                    self._send(200, {"outputs": []})
+    """
+
+    def test_route_typo_fires(self):
+        src = self.HANDLER + """
+        def client(addr):
+            code, doc = _http_json(addr + "/helth", {})
+            return code
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R10"]
+        assert len(fs) == 1
+        assert "/helth" in fs[0].message
+        assert "no handler serves it" in fs[0].message
+
+    def test_served_route_silent(self):
+        src = self.HANDLER + """
+        def client(addr):
+            code, doc = _http_json(addr + "/health", {})
+            code, doc = _http_json(addr + "/submit?x=1", {})
+            return code
+        """
+        assert "R10" not in {f.rule for f in rules_fired(src)}
+
+    def test_unknown_response_key_fires(self):
+        src = self.HANDLER + """
+        def client(addr):
+            code, doc = _http_json(addr + "/stats", {})
+            return doc["latency"]
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R10"]
+        assert len(fs) == 1
+        assert "'latency'" in fs[0].message
+
+    def test_emitted_response_key_silent(self):
+        src = self.HANDLER + """
+        def client(addr):
+            code, doc = _http_json(addr + "/stats", {})
+            return doc["stats"], doc.get("ok")
+        """
+        assert "R10" not in {f.rule for f in rules_fired(src)}
+
+    def test_subscript_assigned_key_counts_as_emitted(self):
+        # worker.py emits resp["trace"] = ... by subscript, not dict
+        # literal — the harvest must see it (reproduced false positive)
+        src = self.HANDLER.replace(
+            'self._send(200, {"stats": {}})',
+            'resp = {}\n'
+            '                resp["trace"] = self._trace_doc()\n'
+            '                self._send(200, resp)') + """
+        def client(addr):
+            code, doc = _http_json(addr + "/stats", {})
+            return doc.get("trace")
+        """
+        assert "R10" not in {f.rule for f in rules_fired(src)}
+
+    def test_header_drift_fires_on_minority_spelling(self):
+        src = """
+            TRACE = "X-DL4J-Trace-Id"
+
+            def stamp(headers):
+                headers["X-DL4J-Trace-Id"] = "t1"
+
+            def read(headers):
+                return headers.get("X-Dl4j-Trace-ID")
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R10"]
+        assert len(fs) == 1
+        assert "X-Dl4j-Trace-ID" in fs[0].message
+        assert "majority" in fs[0].message
+
+    def test_consistent_headers_silent(self):
+        src = """
+            TRACE = "X-DL4J-Trace-Id"
+            ORIGIN = "X-DL4J-Origin"
+
+            def stamp(headers):
+                headers[TRACE] = "t1"
+                headers[ORIGIN] = "probe"
+        """
+        assert "R10" not in {f.rule for f in rules_fired(src)}
+
+    def test_no_handlers_no_route_findings(self):
+        # a client-only module (single-file lint) has no route registry
+        # to check against — silence, not a storm of unknown routes
+        src = """
+            def client(addr):
+                code, doc = _http_json(addr + "/anything", {})
+                return doc["whatever"]
+        """
+        assert "R10" not in {f.rule for f in rules_fired(src)}
+
+
+class TestR11MetricSchema:
+    def test_disjoint_label_sets_fire(self):
+        src = """
+            import telemetry as _tm
+
+            class S:
+                def __init__(self):
+                    reg = _tm.get_registry()
+                    self._m = reg.counter("requests_total", "requests")
+
+                def a(self):
+                    self._m.inc(model="m")
+
+                def b(self):
+                    self._m.inc(worker="w")
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R11"]
+        assert len(fs) == 1
+        assert "requests_total" in fs[0].message
+        assert "must nest" in fs[0].message
+
+    def test_subset_label_sets_silent(self):
+        # the optional-label idiom (origin rides **olab sometimes) is
+        # legal: one site's keys nest inside the other's
+        src = """
+            import telemetry as _tm
+
+            class S:
+                def __init__(self):
+                    reg = _tm.get_registry()
+                    self._m = reg.counter("requests_total", "requests")
+
+                def a(self):
+                    self._m.inc(model="m")
+
+                def b(self):
+                    self._m.inc(model="m", origin="probe")
+        """
+        assert "R11" not in {f.rule for f in rules_fired(src)}
+
+    def test_referenced_but_never_created_fires(self):
+        src = """
+            import telemetry
+
+            def read():
+                return telemetry.series_map("ghost_total")
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R11"]
+        assert len(fs) == 1
+        assert "ghost_total" in fs[0].message
+
+    def test_referenced_and_created_silent(self):
+        src = """
+            import telemetry as _tm
+
+            def make(reg):
+                return reg.counter("real_total", "is real")
+
+            def read():
+                return _tm.series_map("real_total")
+        """
+        assert "R11" not in {f.rule for f in rules_fired(src)}
+
+    def test_slo_rule_reference_fires(self):
+        src = """
+            from telemetry.slo import SloRule
+
+            RULES = [SloRule("probe_fail", "ratio", "ghost_bad_total",
+                             den_metric="ghost_total")]
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R11"]
+        assert {("ghost_bad_total" in f.message or
+                 "ghost_total" in f.message) for f in fs} == {True}
+        assert len(fs) == 2
+
+    def test_prefix_dynamic_creation_satisfies_reference(self):
+        src = """
+            import telemetry as _tm
+
+            def make(reg, key):
+                return reg.gauge(f"worker_{key}", "per-worker")
+
+            def read():
+                return _tm.series_map("worker_nonfinite")
+        """
+        assert "R11" not in {f.rule for f in rules_fired(src)}
+
+    def test_fire_before_register_fires(self):
+        # the PR 18 prober bug, pre-fix shape: a verdict-labeled counter
+        # whose series only exist once the outcome first happens
+        src = """
+            import telemetry as _tm
+
+            class Prober:
+                def __init__(self):
+                    self._reg = _tm.get_registry()
+                    self._m_total = self._reg.counter(
+                        "probe_total", "probes by verdict")
+
+                def probe_once(self, verdict):
+                    self._m_total.inc(model="m", verdict=verdict)
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R11"]
+        assert len(fs) == 1
+        assert "probe_total" in fs[0].message
+        assert "pre-registered" in fs[0].message
+
+    def test_preregistered_counter_silent(self):
+        # the prober idiom post-fix: inc(0, ...) per enum value at init
+        src = """
+            import telemetry as _tm
+
+            VERDICTS = ("ok", "error")
+
+            class Prober:
+                def __init__(self):
+                    self._reg = _tm.get_registry()
+                    self._m_total = self._reg.counter(
+                        "probe_total", "probes by verdict")
+                    if self._reg.enabled:
+                        for verdict in VERDICTS:
+                            self._m_total.inc(0, model="m",
+                                              verdict=verdict)
+
+                def probe_once(self, verdict):
+                    self._m_total.inc(model="m", verdict=verdict)
+        """
+        assert "R11" not in {f.rule for f in rules_fired(src)}
+
+
+class TestR12BlockingTimeout:
+    def test_urlopen_without_timeout_fires_on_fleet_path(self):
+        src = """
+            from urllib.request import urlopen
+
+            def scrape(url):
+                with urlopen(url) as r:
+                    return r.read()
+        """
+        fs = [f for f in fleet_rules_fired(src) if f.rule == "R12"]
+        assert len(fs) == 1
+        assert "urlopen" in fs[0].message
+
+    def test_urlopen_with_timeout_silent(self):
+        src = """
+            from urllib.request import urlopen
+
+            def scrape(url):
+                with urlopen(url, timeout=5.0) as r:
+                    return r.read()
+        """
+        assert "R12" not in {f.rule for f in fleet_rules_fired(src)}
+
+    def test_ungated_path_not_flagged(self):
+        # the same timeout-less call OUTSIDE fleet/federate paths is not
+        # R12's business (R12 polices the wire tier, not the whole repo)
+        src = """
+            from urllib.request import urlopen
+
+            def scrape(url):
+                return urlopen(url).read()
+        """
+        fs = fleet_rules_fired(src, path="pkg/datasets/fetch.py")
+        assert "R12" not in {f.rule for f in fs}
+
+    def test_bare_join_and_get_fire(self):
+        src = """
+            def wait(thread, q):
+                thread.join()
+                return q.get()
+        """
+        fs = [f for f in fleet_rules_fired(src) if f.rule == "R12"]
+        assert len(fs) == 2
+
+    def test_bounded_join_get_communicate_silent(self):
+        src = """
+            def wait(thread, q, proc):
+                thread.join(timeout=5.0)
+                out = proc.communicate(timeout=10.0)
+                return q.get(timeout=1.0), out
+        """
+        assert "R12" not in {f.rule for f in fleet_rules_fired(src)}
+
+    def test_communicate_without_timeout_fires(self):
+        src = """
+            def reap(proc):
+                return proc.communicate()
+        """
+        fs = [f for f in fleet_rules_fired(src) if f.rule == "R12"]
+        assert len(fs) == 1
+
+    def test_unbounded_queue_put_silent_bounded_fires(self):
+        src = """
+            import queue
+
+            class Router:
+                def __init__(self):
+                    self._open = queue.Queue()
+                    self._tight = queue.Queue(8)
+
+                def enqueue(self, item):
+                    self._open.put(item)      # unbounded: never blocks
+
+                def admit(self, item):
+                    self._tight.put(item)     # bounded: producer hang
+        """
+        fs = [f for f in fleet_rules_fired(src) if f.rule == "R12"]
+        assert len(fs) == 1
+        assert "_tight" in fs[0].message
+
+    def test_str_join_not_flagged(self):
+        src = """
+            def fmt(parts):
+                return ", ".join(parts)
+        """
+        assert "R12" not in {f.rule for f in fleet_rules_fired(src)}
+
+
+class TestR13LabelCardinality:
+    def test_raw_path_label_fires(self):
+        src = """
+            import telemetry as _tm
+
+            class H:
+                def __init__(self):
+                    reg = _tm.get_registry()
+                    self._m = reg.counter("http_total", "requests")
+
+                def count(self, path):
+                    self._m.inc(path=path)
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R13"]
+        assert len(fs) == 1
+        assert "raw request path" in fs[0].message
+
+    def test_derived_path_local_fires(self):
+        # the pre-fix worker shape: a local derived from the raw path
+        src = """
+            import telemetry as _tm
+
+            class H:
+                def __init__(self):
+                    reg = _tm.get_registry()
+                    self._m = reg.counter("http_total", "requests")
+
+                def count(self, path):
+                    root = "/" + path.split("/")[0]
+                    self._m.inc(path=root)
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R13"]
+        assert len(fs) == 1
+
+    def test_closed_set_bucketing_silent(self):
+        # the fix idiom: x if x in KNOWN else "other"
+        src = """
+            import telemetry as _tm
+
+            ROUTES = ("/health", "/stats")
+
+            class H:
+                def __init__(self):
+                    reg = _tm.get_registry()
+                    self._m = reg.counter("http_total", "requests")
+
+                def count(self, path):
+                    root = "/" + path.split("/")[0]
+                    root = root if root in ROUTES else "/other"
+                    self._m.inc(path=root)
+        """
+        assert "R13" not in {f.rule for f in rules_fired(src)}
+
+    def test_exception_text_label_fires(self):
+        src = """
+            import telemetry as _tm
+
+            class H:
+                def __init__(self):
+                    reg = _tm.get_registry()
+                    self._m = reg.counter("errors_total", "errors")
+
+                def run(self, fn):
+                    try:
+                        fn()
+                    except Exception as e:
+                        self._m.inc(error=str(e))
+        """
+        fs = [f for f in rules_fired(src) if f.rule == "R13"]
+        assert len(fs) == 1
+        assert "exception text" in fs[0].message
+
+    def test_enum_literal_label_silent(self):
+        src = """
+            import telemetry as _tm
+
+            class H:
+                def __init__(self):
+                    reg = _tm.get_registry()
+                    self._m = reg.counter("errors_total", "errors")
+
+                def run(self):
+                    self._m.inc(outcome="ok", model="m")
+        """
+        assert "R13" not in {f.rule for f in rules_fired(src)}
+
+
+class TestContractRulesCleanAtHead:
+    def test_no_contract_findings_with_empty_baseline(self):
+        # ISSUE 19 acceptance: R10-R13 surface nothing at HEAD (findings
+        # were FIXED, not baselined) and the ledger holds zero entries
+        findings = lint_paths([PKG], root=REPO,
+                              rules=["R10", "R11", "R12", "R13"])
+        assert findings == [], "\n".join(f.human() for f in findings)
+        assert load_baseline(REPO / "graftlint.baseline.json") == {}
+
+    def test_worker_http_counter_buckets_paths(self):
+        # the R13 finding at HEAD stays fixed: the wire counter buckets
+        # through GET_ROUTES instead of minting a series per raw path
+        src = (PKG / "fleet" / "worker.py").read_text()
+        assert "root if root in GET_ROUTES" in src
+        assert "graftlint: disable=R13" not in src
+
+    def test_enum_counters_preregister_at_zero(self):
+        # the PR 18 prober-class sweep stays swept: every verdict/
+        # outcome counter pre-registers with inc(0, ...) at init
+        for rel in ("fleet/router.py", "serving/engine.py",
+                    "continuous/trainer.py", "telemetry/history.py",
+                    "telemetry/federate.py", "hostfleet/supervisor.py",
+                    "parallel/distributed.py", "datasets/iterator.py",
+                    "datasets/cacheable.py"):
+            src = (PKG / rel).read_text()
+            assert ".inc(0," in src, rel
+
+
+class TestSchemaArtifact:
+    def test_schema_regenerates_deterministically(self):
+        from deeplearning4j_tpu.analysis import build_schema, parse_paths
+        from deeplearning4j_tpu.analysis.reporters import schema_json_text
+
+        mods1, e1 = parse_paths([PKG], root=REPO)
+        mods2, e2 = parse_paths([PKG], root=REPO)
+        assert e1 == [] and e2 == []
+        assert (schema_json_text(build_schema(mods1))
+                == schema_json_text(build_schema(mods2)))
+
+    def test_committed_artifact_matches_source(self):
+        # the tier-1 drift gate's exact comparison, as a test: SCHEMA.json
+        # and METRICS.md at HEAD are the contract the source harvests to
+        from deeplearning4j_tpu.analysis import build_schema, parse_paths
+        from deeplearning4j_tpu.analysis.reporters import (metrics_md_text,
+                                                           schema_json_text)
+
+        mods, errs = parse_paths([PKG], root=REPO)
+        assert errs == []
+        schema = build_schema(mods)
+        assert (REPO / "SCHEMA.json").read_text() == schema_json_text(schema)
+        assert (REPO / "METRICS.md").read_text() == metrics_md_text(schema)
+
+    def test_schema_covers_the_load_bearing_series(self):
+        schema = json.loads((REPO / "SCHEMA.json").read_text())
+        for name in ("fleet_requests_total", "probe_total",
+                     "serving_model_requests_total", "slo_alerts_total",
+                     "federate_scrape_total"):
+            assert name in schema["metrics"], name
+        assert schema["metrics"]["probe_total"]["preregistered"]
+        assert "verdict" in (schema["metrics"]["probe_total"]["labels"]
+                             + schema["metrics"]["probe_total"]
+                             ["optional_labels"])
+        routes = {r["path"] for r in schema["wire"]["routes"]}
+        assert {"/submit", "/health", "/metrics"} <= routes
+        assert "X-DL4J-Trace-Id" in schema["wire"]["headers"]
+
+    def test_emit_schema_cli_writes_both_artifacts(self, tmp_path):
+        rc = main(["lint", "--emit-schema", "--schema-dir",
+                   str(tmp_path)])
+        assert rc == 0
+        assert (tmp_path / "SCHEMA.json").exists()
+        assert (tmp_path / "METRICS.md").exists()
+        got = json.loads((tmp_path / "SCHEMA.json").read_text())
+        assert got == json.loads((REPO / "SCHEMA.json").read_text())
