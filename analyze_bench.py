@@ -115,6 +115,13 @@ def _headlines(rec):
                 float(fleet["probe_latency_p50_ms"]), False)
         if isinstance(fleet.get("ledger_rows"), (int, float)):
             out["usage_ledger_rows"] = (float(fleet["ledger_rows"]), True)
+    if isinstance(rec.get("padded_waste_ratio"), (int, float)):
+        # the 2-D shape grid's padded/real token ratio on its grid leg:
+        # 1.0 is zero padding, growth means the seq buckets stopped
+        # fitting the workload (the headline the grid exists to hold
+        # down; the record's `value` carries the flat-vs-grid cut)
+        out["padded_waste_ratio"] = (float(rec["padded_waste_ratio"]),
+                                     False)
     return out
 
 

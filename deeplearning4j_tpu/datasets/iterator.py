@@ -54,6 +54,13 @@ def _leading_dim(tree):
     return jax.tree_util.tree_leaves(tree)[0].shape[0]
 
 
+def _seq_dim(tree):
+    """Sequence length (axis 1) of a (pytree of) array(s), or None when
+    the leading leaf has no time axis (plain [B, F] features)."""
+    first = jax.tree_util.tree_leaves(tree)[0]
+    return first.shape[1] if first.ndim >= 2 else None
+
+
 def _pad_rows(tree, target):
     """Zero-pad every leaf of ``tree`` to ``target`` rows along axis 0
     (host-side: part of ETL batch assembly, before device placement)."""
@@ -70,17 +77,46 @@ def _pad_rows(tree, target):
     return jax.tree_util.tree_map(pad, tree)
 
 
-def validity_mask(labels, n_valid, target):
+def _pad_seq(tree, target, min_ndim=2):
+    """Zero-pad every leaf of ``tree`` with ``ndim >= min_ndim`` to
+    ``target`` steps along axis 1 (the sequence axis). Leaves below
+    ``min_ndim`` pass through untouched — a [B, C] class-label leaf has
+    no time axis and must not be stretched."""
+    def pad(a):
+        a = np.asarray(a)
+        if a.ndim < min_ndim:
+            return a
+        t = a.shape[1]
+        if t == target:
+            return a
+        if t > target:
+            raise ValueError(f"sequence of {t} steps exceeds the bucketed "
+                             f"shape {target}")
+        width = [(0, 0)] * a.ndim
+        width[1] = (0, target - t)
+        return np.pad(a, width)
+    return jax.tree_util.tree_map(pad, tree)
+
+
+def validity_mask(labels, n_valid, target, *, seq_valid=None,
+                  seq_target=None):
     """[target] (or [target, T] for time-distributed labels) float mask:
-    1 for the first ``n_valid`` examples, 0 for bucketing padding."""
+    1 for the first ``n_valid`` examples, 0 for bucketing padding. With a
+    2-D shape bucket (``seq_target``/``seq_valid``), the time axis is the
+    PADDED length and steps past ``seq_valid`` are masked 0 too, so the
+    masked-mean losses stay exact under seq-axis padding."""
     first = jax.tree_util.tree_leaves(labels)[0]
     valid = (np.arange(target) < n_valid).astype(np.float32)
     if first.ndim >= 3:  # [B, T, ...] labels score per timestep
-        return np.repeat(valid[:, None], first.shape[1], axis=1)
+        t = int(seq_target) if seq_target else first.shape[1]
+        mask = np.repeat(valid[:, None], t, axis=1)
+        if seq_valid is not None:
+            mask = mask * (np.arange(t) < seq_valid).astype(np.float32)[None]
+        return mask
     return valid
 
 
-def pad_batch(x, y, m, target):
+def pad_batch(x, y, m, target, *, seq_target=None):
     """Bucket one ``(x, y, mask)`` minibatch to ``target`` examples.
 
     Returns ``(x, y, mask, n_valid)`` where the mask is ALWAYS present —
@@ -88,14 +124,27 @@ def pad_batch(x, y, m, target):
     stream presents one jit signature for the whole epoch (a mask that
     appears only on the tail batch would itself force a recompile).
     ``x``/``y`` may be pytrees (the ComputationGraph dict form).
+
+    ``seq_target`` grows the pad onto the sequence axis (2-D shape
+    bucket): features pad along axis 1, time-distributed ``[B, T, ...]``
+    labels pad along axis 1 too, and the returned mask zeroes both the
+    padded rows AND the padded timesteps — real-row/real-step slicing and
+    the masked-mean losses see bit-identical values either way.
     """
     n = _leading_dim(x)
+    seq = _seq_dim(x) if seq_target is not None else None
     x = _pad_rows(x, target)
     y_padded = _pad_rows(y, target)
+    if seq_target is not None:
+        x = _pad_seq(x, seq_target)
+        y_padded = _pad_seq(y_padded, seq_target, min_ndim=3)
     if m is None:
-        m = validity_mask(y, n, target)
+        m = validity_mask(y, n, target, seq_valid=seq,
+                          seq_target=seq_target)
     else:
         m = _pad_rows(m, target)
+        if seq_target is not None:
+            m = _pad_seq(m, seq_target)
     return x, y_padded, m, n
 
 
@@ -156,6 +205,164 @@ class BucketRegistry:
 
     def __repr__(self):
         return f"BucketRegistry({self._sizes})"
+
+
+class ShapeBuckets:
+    """2-D **(batch, seq)** shape grid: the finite set of padded shapes a
+    transformer-serving process compiles for.
+
+    The 1-D :class:`BucketRegistry` removes ragged-BATCH recompiles but
+    still pads every request's sequence axis to ``max_seq`` — a 128-token
+    prompt burns the FLOPs of the longest one. This registry declares a
+    seq axis too: ``bucket_for(rows, seq)`` returns the smallest
+    ``(batch_bucket, seq_bucket)`` covering the request (``None`` past
+    either max), so the engine AOT-compiles exactly
+    ``len(batch) * len(seq)`` executables and a short prompt runs in a
+    short shape. Seq edges come from ``powers_of_two`` or from the
+    demand history's token-length distribution (:meth:`from_demand`).
+    """
+
+    def __init__(self, batch_sizes, seq_sizes):
+        self._batch = (batch_sizes if isinstance(batch_sizes, BucketRegistry)
+                       else BucketRegistry(batch_sizes))
+        self._seq = (seq_sizes if isinstance(seq_sizes, BucketRegistry)
+                     else BucketRegistry(seq_sizes))
+
+    @classmethod
+    def powers_of_two(cls, max_batch, max_seq, *, min_batch=1, min_seq=None):
+        """Power-of-two grid on both axes. ``min_seq`` defaults to
+        ``min(16, max_seq)`` — sub-16-step buckets would mint executables
+        whose padded-FLOPs savings can't pay their warmup back."""
+        if min_seq is None:
+            min_seq = min(16, int(max_seq))
+        return cls(BucketRegistry.powers_of_two(max_batch, min_batch),
+                   BucketRegistry.powers_of_two(max_seq, min_seq))
+
+    @classmethod
+    def from_demand(cls, batch_sizes, max_seq, *, history=None,
+                    series="serving_request_seq_len",
+                    quantiles=(0.5, 0.9)):
+        """Derive seq edges from the token-length distribution retained
+        in :mod:`telemetry.history`: the histogram bucket bound covering
+        each demand quantile becomes a grid edge (``max_seq`` always
+        included, so every admissible request still maps). With no
+        retained demand the grid falls back to powers of two — a cold
+        process must still serve."""
+        edges = seq_edges_from_demand(max_seq, history=history,
+                                      series=series, quantiles=quantiles)
+        if edges is None:
+            edges = BucketRegistry.powers_of_two(
+                max_seq, min(16, int(max_seq)))
+        return cls(batch_sizes, edges)
+
+    def with_batch(self, batch_sizes):
+        """Same seq grid, replaced batch axis."""
+        return ShapeBuckets(batch_sizes, self._seq)
+
+    @property
+    def batch(self):
+        """The batch-axis :class:`BucketRegistry`."""
+        return self._batch
+
+    @property
+    def seq(self):
+        """The seq-axis :class:`BucketRegistry`."""
+        return self._seq
+
+    @property
+    def max(self):
+        """Largest batch bucket (callers chunk oversized batches by it,
+        exactly as with the 1-D registry)."""
+        return self._batch.max
+
+    @property
+    def max_seq(self):
+        """Largest seq bucket — requests longer than this are rejected,
+        not chunked (a sequence can't be split without changing the
+        model's math)."""
+        return self._seq.max
+
+    def bucket_for(self, rows, seq):
+        """Smallest ``(batch_bucket, seq_bucket)`` with
+        ``batch_bucket >= rows`` and ``seq_bucket >= seq``, or ``None``
+        when either axis exceeds its max."""
+        b = self._batch.bucket_for(rows)
+        s = self._seq.bucket_for(seq)
+        if b is None or s is None:
+            return None
+        return (b, s)
+
+    def round_up_to_multiple(self, m):
+        """A new grid with every BATCH bucket rounded up to a multiple of
+        ``m`` (mesh serving: the padded batch must split over the data
+        axis). The seq axis is untouched — sharding splits rows, never
+        timesteps."""
+        return ShapeBuckets(self._batch.round_up_to_multiple(m), self._seq)
+
+    def sizes(self):
+        """The full grid as ``[(batch, seq), ...]``, seq-major within
+        batch (warmup iteration order)."""
+        return [(b, s) for b in self._batch for s in self._seq]
+
+    def signature(self):
+        """Stable string identity of the grid — folded into warm-manifest
+        keys so a grid change invalidates stale executables."""
+        return ("b=" + ",".join(map(str, self._batch)) +
+                ";s=" + ",".join(map(str, self._seq)))
+
+    def __iter__(self):
+        return iter(self.sizes())
+
+    def __len__(self):
+        return len(self._batch) * len(self._seq)
+
+    def __repr__(self):
+        return (f"ShapeBuckets(batch={self._batch.sizes()}, "
+                f"seq={self._seq.sizes()})")
+
+
+def seq_edges_from_demand(max_seq, *, history=None,
+                          series="serving_request_seq_len",
+                          quantiles=(0.5, 0.9)):
+    """Seq grid edges from the token-length histogram retained in
+    metrics history: for each demand quantile, the smallest histogram
+    bucket bound covering it (clamped to ``max_seq``), plus ``max_seq``
+    itself. Returns ``None`` when the history holds no samples of the
+    series — callers fall back to powers of two."""
+    if history is None:
+        from deeplearning4j_tpu.telemetry.history import get_history
+        history = get_history()
+    merged = {}
+    for sample in history.samples():
+        doc = (sample.get("metrics") or {}).get(series)
+        if not isinstance(doc, dict):
+            continue
+        for s in doc.get("series", ()):
+            buckets = (s.get("value") or {}).get("buckets")
+            if not buckets:
+                continue
+            for le, count in buckets.items():
+                # cumulative snapshots: the LAST retained sample per
+                # series wins (counts only grow)
+                merged[le] = max(merged.get(le, 0), int(count))
+    total = sum(merged.values())
+    if not total:
+        return None
+    bounds = sorted((float("inf") if le == "+Inf" else float(le), count)
+                    for le, count in merged.items())
+    edges = set()
+    for q in quantiles:
+        rank = q * total
+        cum = 0
+        for bound, count in bounds:
+            cum += count
+            if cum >= rank:
+                edge = int(max_seq) if bound == float("inf") \
+                    else min(int(bound), int(max_seq))
+                edges.add(max(1, edge))
+                break
+    edges.add(int(max_seq))
+    return sorted(edges)
 
 
 class DataSetIterator:

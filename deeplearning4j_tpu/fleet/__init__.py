@@ -43,7 +43,8 @@ from __future__ import annotations
 
 import threading
 
-from deeplearning4j_tpu.fleet.prober import FleetProber
+from deeplearning4j_tpu.fleet.prober import (FleetProber,
+                                             seq_sweep_canaries)
 from deeplearning4j_tpu.fleet.router import FleetRouter
 from deeplearning4j_tpu.fleet.supervisor import (FleetSupervisor,
                                                  default_worker_env)
@@ -51,7 +52,7 @@ from deeplearning4j_tpu.fleet.worker import FleetWorker
 
 __all__ = ["FleetProber", "FleetRouter", "FleetSupervisor", "FleetWorker",
            "default_worker_env", "fleet_status", "get_default_front",
-           "reset", "set_default_front"]
+           "reset", "seq_sweep_canaries", "set_default_front"]
 
 _front_lock = threading.Lock()
 _front = {"router": None, "supervisor": None}

@@ -30,6 +30,13 @@ Verdicts (the ``probe_total{model,verdict}`` label):
 ``extra_probes`` extends the loop beyond inference: ``(name, fn)``
 pairs where ``fn()`` returning truthy is ok — e.g. a canary train-step
 probe against the continuous loop's registry handoff.
+
+For a 2-D (batch × seq) serving grid, :func:`seq_sweep_canaries` builds
+the canary set at varied sequence lengths (shortest bucket, just under
+the median bucket, the max bucket) so the outside-in correctness floor
+exercises seq-bucket selection AND the pad-then-slice round trip — a
+wrong 2-D bucket or a bad seq slice is a ``wrong_answer`` verdict, not
+a silent waste regression.
 """
 
 from __future__ import annotations
@@ -222,6 +229,37 @@ class FleetProber:
                 "probes": last,
                 "ok": all(r["verdict"] == "ok" for r in last.values())
                 if last else None}
+
+
+def seq_sweep_canaries(reference, feature_shape, seq_buckets, *,
+                       model="default", seed=0):
+    """Known-answer canaries at varied sequence lengths for a 2-D grid.
+
+    Picks three lengths from ``seq_buckets``: the shortest bucket
+    (exact fit), one just UNDER the median bucket (forces a seq-axis pad
+    and the slice back to real steps), and the max bucket (the old
+    max_seq path). Each canary's ``expect`` is pinned NOW through
+    ``reference`` — a callable taking one ``[n, T, ...]`` batch (e.g.
+    ``net.output``) — so the prober later judges the serving path
+    against the unbucketed forward at probe-build time.
+
+    ``feature_shape``: per-step trailing shape (e.g. ``(n_features,)``);
+    inputs are deterministic ``float32`` draws seeded per length, so a
+    respawned prober pins identical canaries.
+    """
+    bs = sorted({int(b) for b in seq_buckets})
+    if not bs:
+        raise ValueError("seq_sweep_canaries needs a non-empty seq grid")
+    lengths = sorted({bs[0], max(1, bs[len(bs) // 2] - 1), bs[-1]})
+    canaries = []
+    for length in lengths:
+        rng = np.random.default_rng(seed + length)
+        x = rng.standard_normal(
+            (length,) + tuple(feature_shape)).astype(np.float32)
+        expect = np.asarray(reference(x[None]))[0]
+        canaries.append({"x": x, "expect": expect,
+                         "name": f"seq{length}", "model": model})
+    return canaries
 
 
 # ---- process-default prober ----

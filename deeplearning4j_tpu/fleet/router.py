@@ -110,8 +110,15 @@ class FleetRouter:
                  max_inflight_rows=64, max_dispatch_rows=32,
                  default_deadline_s=None, batch_window_s=0.0,
                  concurrency=4, retries=2, request_timeout_s=30.0,
-                 probe_timeout_s=2.0, no_worker_grace_s=15.0):
+                 probe_timeout_s=2.0, no_worker_grace_s=15.0,
+                 seq_aware=False):
         self.name = name
+        #: seq-aware fronts read each request's sequence length (leaf
+        #: axis 1) into the entry meta, so the meta-uniform chunking seam
+        #: below also makes wire chunks SEQ-uniform — a short prompt is
+        #: never concatenated into (and padded up to) a long batch before
+        #: it even reaches a worker's 2-D bucket grid
+        self.seq_aware = bool(seq_aware)
         self.max_queue = max_queue
         self.max_inflight_rows = max_inflight_rows
         self.max_dispatch_rows = max_dispatch_rows
@@ -293,6 +300,17 @@ class FleetRouter:
             nrows = None
             item = _tree_map(lambda a: a[None], item)
         rows = 1 if nrows is None else nrows
+        if self.seq_aware:
+            lead = _leaves(item)[0]
+            if np.ndim(lead) < 2:
+                raise ValueError(
+                    f"fleet {self.name!r} is seq-aware but the input "
+                    f"carries no sequence axis (leaf shape "
+                    f"{tuple(np.shape(lead))})")
+            # seq rides the entry meta: chunk assembly compares meta for
+            # uniformity, so co-drained entries with different lengths
+            # ship as separate wire payloads (each rectangular as-is)
+            meta = dict(meta or {}, seq=int(np.shape(lead)[1]))
         fut = InferenceFuture()
         # the fleet-level causal trace roots HERE: dispatch attempts and
         # the worker-side device spans (grafted from the /submit response)
@@ -524,6 +542,10 @@ class FleetRouter:
         attempt = 0
         tried = set()
         t_wait0 = time.perf_counter()
+        # chunks are meta-uniform, so the lead entry speaks for the batch
+        meta = entries[0][6] or {}
+        span_args = ({} if meta.get("seq") is None
+                     else {"seq_len": meta["seq"]})
         while True:
             if self._stop.is_set():
                 self._fail_entries(entries, ServingShutdown(
@@ -567,13 +589,16 @@ class FleetRouter:
                 payload = {"rows": _tree_map(lambda a: a.tolist(), xs)}
                 if remaining is not None:
                     payload["deadline_ms"] = max(1e3 * remaining, 1.0)
-                # demand attribution rides the payload (chunks are
-                # meta-uniform, so the lead entry speaks for the batch)
-                meta = entries[0][6] or {}
+                # demand attribution rides the payload
                 if meta.get("tenant") is not None:
                     payload["tenant"] = meta["tenant"]
                 if meta.get("origin") is not None:
                     payload["origin"] = meta["origin"]
+                if meta.get("seq") is not None:
+                    # the seq length the router batched on, declared so
+                    # the worker can cross-check it against the rows it
+                    # decodes (routing/metering/trace all see ONE bucket)
+                    payload["seq_len"] = meta["seq"]
                 timeout = self.request_timeout_s
                 if remaining is not None:
                     timeout = min(timeout, remaining + 5.0)
@@ -614,7 +639,7 @@ class FleetRouter:
                     sent_unix, recv_unix)
                 self._note_attempt(entries, w.wid, attempt, "ok", t_att,
                                    graft_doc=doc.get("trace"),
-                                   offset_s=offset_s)
+                                   offset_s=offset_s, **span_args)
                 self._resolve(entries, doc)
                 return
             if code == 429:

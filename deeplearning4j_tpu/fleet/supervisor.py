@@ -97,7 +97,8 @@ class FleetSupervisor:
     """Spawn, probe, and elastically replace N fleet worker processes."""
 
     def __init__(self, n_workers, *, model_path=None, zoo=None,
-                 name="default", buckets=None, input_shape=None,
+                 name="default", buckets=None, seq_buckets=None,
+                 input_shape=None,
                  warm_manifest=None, compile_cache=None, max_queue=256,
                  max_batch=32, deadline_ms=None, batch_window_ms=1.0,
                  env=None, worker_command=None, python=None,
@@ -113,6 +114,7 @@ class FleetSupervisor:
         self.zoo = zoo
         self.name = name
         self.buckets = buckets
+        self.seq_buckets = seq_buckets
         self.input_shape = input_shape
         self.warm_manifest = warm_manifest
         self.compile_cache = compile_cache
@@ -172,6 +174,9 @@ class FleetSupervisor:
         if self.buckets:
             cmd += ["--buckets",
                     ",".join(str(int(b)) for b in self.buckets)]
+        if self.seq_buckets:
+            cmd += ["--seq-buckets",
+                    ",".join(str(int(b)) for b in self.seq_buckets)]
         if self.input_shape:
             cmd += ["--input-shape",
                     ",".join(str(int(d)) for d in self.input_shape)]

@@ -190,6 +190,20 @@ class FleetWorker:
                     worker=worker.worker_id)
                 try:
                     rows = _rows_from_json(doc["rows"])
+                    seq_len = doc.get("seq_len")
+                    if seq_len is not None:
+                        # a seq-aware router declares the length it
+                        # batched on; cross-check against the decoded
+                        # rows so routing and engine can never silently
+                        # disagree about which 2-D bucket this batch is
+                        lead = (next(iter(rows.values()))
+                                if isinstance(rows, dict) else rows)
+                        got = (int(lead.shape[1]) if lead.ndim >= 2
+                               else None)
+                        if got != int(seq_len):
+                            raise ValueError(
+                                f"payload seq_len={seq_len} disagrees "
+                                f"with the rows' sequence axis ({got})")
                     deadline_ms = doc.get("deadline_ms")
                     fut = worker.engine.submit(
                         rows, batched=True,
@@ -340,6 +354,7 @@ class FleetWorker:
         return {"fleet_worker_ready": True, "worker_id": self.worker_id,
                 "pid": os.getpid(), "port": self.port,
                 "model": self.engine.name, "buckets": stats["buckets"],
+                "seq_buckets": stats.get("seq_buckets"),
                 "warmup_s": stats["warmup_s"], "aot": stats["aot"],
                 "compile_cache_events": _cc.event_counts(),
                 # clock-alignment seed: the spawner pairs this with its
@@ -363,6 +378,11 @@ def _build_parser():
                         "port is printed in the ready line)")
     p.add_argument("--buckets",
                    help="comma-separated batch buckets to AOT-warm")
+    p.add_argument("--seq-buckets",
+                   help="comma-separated sequence-length buckets: the "
+                        "engine warms the full (batch x seq) grid and "
+                        "pads each request to its seq bucket instead of "
+                        "max_seq")
     p.add_argument("--max-batch", type=int, default=32)
     p.add_argument("--input-shape",
                    help="per-example feature shape, e.g. 28,28,1 "
@@ -394,9 +414,12 @@ def main(argv=None):
     net = _load_model(args)
     buckets = ([int(b) for b in args.buckets.split(",") if b.strip()]
                if args.buckets else None)
+    seq_buckets = ([int(b) for b in args.seq_buckets.split(",")
+                    if b.strip()] if args.seq_buckets else None)
     engine = ServingEngine(
         net, name=args.name, input_spec=_serve_input_spec(args, net),
-        buckets=buckets, max_batch_size=args.max_batch,
+        buckets=buckets, seq_buckets=seq_buckets,
+        max_batch_size=args.max_batch,
         max_queue=args.max_queue,
         default_deadline_s=(None if args.deadline_ms is None
                             else args.deadline_ms / 1e3),

@@ -30,6 +30,7 @@ model's params with another's apply_fn, and no queued request is dropped.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import queue
@@ -42,7 +43,7 @@ import numpy as np
 
 from deeplearning4j_tpu import telemetry as _tm
 from deeplearning4j_tpu.telemetry import tracectx as _tracectx
-from deeplearning4j_tpu.datasets.iterator import BucketRegistry
+from deeplearning4j_tpu.datasets.iterator import BucketRegistry, ShapeBuckets
 from deeplearning4j_tpu.serving import metering as _metering
 from deeplearning4j_tpu.utils import compile_cache as _cc
 
@@ -140,15 +141,23 @@ class InferenceFuture:
         return self._value
 
 
-def _example_structs(input_spec, batch, dtype):
+def _example_structs(input_spec, batch, dtype, seq=None):
     """Pytree of ``jax.ShapeDtypeStruct`` for a ``batch``-sized input.
 
     ``input_spec`` is a per-example shape tuple, or a dict of them (the
-    ComputationGraph multi-input form).
+    ComputationGraph multi-input form). With ``seq`` (2-D shape buckets),
+    the per-example leading axis — the sequence axis of a ``[T, ...]``
+    spec — is replaced by the bucketed length.
     """
     def struct(shape):
-        return jax.ShapeDtypeStruct((batch,) + tuple(int(d) for d in shape),
-                                    dtype)
+        shape = tuple(int(d) for d in shape)
+        if seq is not None:
+            if not shape:
+                raise ValueError(
+                    "seq-bucketed serving needs a per-example input spec "
+                    "with a leading sequence axis (got a scalar spec)")
+            shape = (int(seq),) + shape[1:]
+        return jax.ShapeDtypeStruct((batch,) + shape, dtype)
     if isinstance(input_spec, dict):
         return {k: struct(v) for k, v in input_spec.items()}
     return struct(input_spec)
@@ -164,16 +173,40 @@ def _as_input(x):
     return np.asarray(x)
 
 
-def _pad_rows_np(tree, target):
-    """Zero-pad every leaf to ``target`` rows along axis 0 (host-side)."""
+def _pad_rows_np(tree, target, seq_target=None):
+    """Zero-pad every leaf to ``target`` rows along axis 0 (host-side).
+    With ``seq_target`` (2-D shape bucket) leaves carrying a sequence
+    axis (``ndim >= 2``) are zero-padded along axis 1 as well — the
+    exact pad whose real-row/real-step slice is bit-identical to the
+    unpadded forward."""
     def pad(a):
         a = np.asarray(a)
         n = a.shape[0]
-        if n == target:
-            return a
-        return np.concatenate(
-            [a, np.zeros((target - n,) + a.shape[1:], a.dtype)])
+        if n != target:
+            a = np.concatenate(
+                [a, np.zeros((target - n,) + a.shape[1:], a.dtype)])
+        if seq_target is not None and a.ndim >= 2 \
+                and a.shape[1] != seq_target:
+            width = [(0, 0)] * a.ndim
+            width[1] = (0, seq_target - a.shape[1])
+            a = np.pad(a, width)
+        return a
     return jax.tree_util.tree_map(pad, tree)
+
+
+def _slice_seq(tree, padded_seq, real_seq):
+    """Undo the seq-axis pad on a forward's outputs: slice axis 1 back to
+    ``real_seq`` on every leaf whose axis 1 is the padded length. A
+    pooled ``[B, C]`` head (no time axis) passes through untouched unless
+    C collides with the padded length — callers that pool to exactly the
+    bucket width should size buckets away from their class count."""
+    if real_seq == padded_seq:
+        return tree
+    def cut(a):
+        if a.ndim >= 2 and a.shape[1] == padded_seq:
+            return a[:, :real_seq]
+        return a
+    return jax.tree_util.tree_map(cut, tree)
 
 
 class BucketedForward:
@@ -201,12 +234,6 @@ class BucketedForward:
         self.net = net
         self.mesh = mesh
         self.site = site
-        # mesh executables bake in shardings over a concrete device set:
-        # scope the manifest key by mesh shape + device count so a pod
-        # topology change can never resurrect a stale executable
-        self._manifest_kind = ("serving" if mesh is None else
-                               f"serving:mesh={sorted(mesh.shape.items())}"
-                               f":ndev={len(jax.devices())}")
         self._manifest_state = "none"
         if manifest is not None:
             if manifest.matches(net):
@@ -254,6 +281,21 @@ class BucketedForward:
         self._placed = None       # (params_repl, state_repl)
         self._placed_src = None   # (net.params, net.state) they came from
         self.buckets = buckets
+        #: 2-D (batch, seq) grid vs the 1-D batch-only registry — decides
+        #: the pad/slice path and the warmup iteration space
+        self.seq_aware = isinstance(buckets, ShapeBuckets)
+        # mesh executables bake in shardings over a concrete device set:
+        # scope the manifest key by mesh shape + device count so a pod
+        # topology change can never resurrect a stale executable. The 2-D
+        # seq grid folds in too (AFTER any mesh rounding): a grid change
+        # must invalidate stale executables, not resurrect shapes the new
+        # grid never declares
+        kind = ("serving" if mesh is None else
+                f"serving:mesh={sorted(mesh.shape.items())}"
+                f":ndev={len(jax.devices())}")
+        if self.seq_aware:
+            kind += f":grid={buckets.signature()}"
+        self._manifest_kind = kind
         self._compiled = {}  # input signature -> AOT executable (False=jit)
         # manifest signature (incl. the tuning-DB fingerprint) captured
         # WHEN each executable compiled — export must ship that stamp,
@@ -269,6 +311,12 @@ class BucketedForward:
         self._m_fill = reg.histogram(
             "serving_batch_fill_ratio",
             "fraction of each padded device batch holding real examples",
+            buckets=FILL_BUCKETS)
+        self._m_token_fill = reg.histogram(
+            "serving_batch_token_fill_ratio",
+            "fraction of each padded (batch, seq) device shape holding "
+            "real tokens — the padded-FLOPs waste signal; equals the row "
+            "fill on batch-only (1-D) buckets",
             buckets=FILL_BUCKETS)
         self._m_aot = reg.counter(
             "serving_aot_cache_total",
@@ -289,9 +337,16 @@ class BucketedForward:
         the startup cost that buys a compile-free request path."""
         t0 = time.perf_counter()
         dtype = self.dtype if self.dtype is not None else np.dtype("float32")
-        for b in self.buckets:
-            self._ensure_compiled(_example_structs(input_spec, b, dtype),
-                                  warm=True)
+        if self.seq_aware:
+            # the full (batch, seq) grid: len(batch) * len(seq) executables
+            for b, s in self.buckets:
+                self._ensure_compiled(
+                    _example_structs(input_spec, b, dtype, seq=s),
+                    warm=True)
+        else:
+            for b in self.buckets:
+                self._ensure_compiled(_example_structs(input_spec, b, dtype),
+                                      warm=True)
         self._warmed = True
         return time.perf_counter() - t0
 
@@ -449,14 +504,26 @@ class BucketedForward:
                 _phases.append(("serving.device_exec", t0,
                                 time.perf_counter(), {}))
 
-    def __call__(self, x, _phases=None):
+    def __call__(self, x, _phases=None, _usage=None):
         """Padded, bucketed forward of a host batch (any leading size):
-        chunks by the largest bucket, pads each chunk up to its nearest
-        registered bucket, slices real rows back out. ``_phases`` collects
-        per-phase timing windows for causal tracing (serving worker)."""
+        chunks by the largest batch bucket, pads each chunk up to its
+        nearest registered bucket — BOTH axes under a 2-D grid: rows to
+        the batch bucket, the sequence axis to the seq bucket — and
+        slices real rows (and real timesteps) back out. ``_phases``
+        collects per-phase timing windows for causal tracing (serving
+        worker); ``_usage`` (a list) collects one
+        ``{rows, seq, batch_bucket, seq_bucket}`` record per device chunk
+        so the caller can meter padded vs real tokens exactly."""
         x = _as_input(x)
         first = jax.tree_util.tree_leaves(x)[0]
         n = first.shape[0]
+        seq_in = (first.shape[1]
+                  if self.seq_aware and first.ndim >= 2 else None)
+        if self.seq_aware and seq_in is None:
+            raise ValueError(
+                f"{self.site}: seq-bucketed serving requires inputs with "
+                f"a sequence axis ([rows, steps, ...]); got shape "
+                f"{tuple(first.shape)}")
         outs = []
         step = self.buckets.max
         for i in range(0, n, step):
@@ -464,23 +531,48 @@ class BucketedForward:
             chunk = jax.tree_util.tree_map(
                 lambda a: np.asarray(a[i:i + step], dtype=self.dtype), x)
             real = jax.tree_util.tree_leaves(chunk)[0].shape[0]
-            bucket = self.buckets.bucket_for(real)
-            padded = _pad_rows_np(chunk, bucket)
+            if self.seq_aware:
+                shape = self.buckets.bucket_for(real, seq_in)
+                if shape is None:
+                    raise ValueError(
+                        f"{self.site}: sequence of {seq_in} steps exceeds "
+                        f"the largest registered seq bucket "
+                        f"({self.buckets.max_seq}) — sequences cannot be "
+                        "chunked")
+                bucket, seq_bucket = shape
+                fill = real / bucket
+                token_fill = (real * seq_in) / (bucket * seq_bucket)
+            else:
+                bucket, seq_bucket = self.buckets.bucket_for(real), None
+                fill = token_fill = real / bucket
+            padded = _pad_rows_np(chunk, bucket, seq_target=seq_bucket)
+            if _usage is not None:
+                _usage.append({"rows": real, "seq": seq_in or 1,
+                               "batch_bucket": bucket,
+                               "seq_bucket": seq_bucket or 1})
             if _phases is not None:
                 _phases.append(("serving.pad", t0, time.perf_counter(),
                                 {"bucket": bucket,
-                                 "fill": round(real / bucket, 4)}))
-            with _tm.span("serving.forward", fill=real / bucket,
-                          bucket=bucket):
+                                 "seq_bucket": seq_bucket,
+                                 "fill": round(fill, 4),
+                                 "token_fill": round(token_fill, 4)}))
+            with _tm.span("serving.forward", fill=fill, bucket=bucket,
+                          seq_bucket=seq_bucket):
                 y = self._run(padded, _phases)
                 t0 = time.perf_counter() if _phases is not None else 0.0
                 y = jax.tree_util.tree_map(
                     lambda a: np.asarray(a)[:real], y)
+                if seq_bucket is not None:
+                    y = _slice_seq(y, seq_bucket, seq_in)
                 if _phases is not None:
                     _phases.append(("serving.fetch", t0,
                                     time.perf_counter(), {}))
             if self._reg.enabled:
-                self._m_fill.observe(real / bucket, site=self.site)
+                self._m_fill.observe(fill, site=self.site)
+                # token fill rides beside row fill: a full batch of short
+                # prompts padded to a long seq bucket reads 1.0 rows but
+                # near-zero tokens — the waste row fill can't see
+                self._m_token_fill.observe(token_fill, site=self.site)
             outs.append(y)
         if len(outs) == 1:
             return outs[0]
@@ -498,7 +590,8 @@ class ServingEngine:
     """
 
     def __init__(self, net, *, name="default", input_spec=None,
-                 buckets=None, max_batch_size=32, mesh=None, max_queue=256,
+                 buckets=None, seq_buckets=None, max_batch_size=32,
+                 mesh=None, max_queue=256,
                  default_deadline_s=None, batch_window_s=0.0,
                  dtype=np.float32, warmup=None, warm_manifest=None):
         self.name = name
@@ -515,19 +608,30 @@ class ServingEngine:
             warm_manifest = _cc.WarmManifest.load_lenient(
                 warm_manifest, context=f"warm manifest {warm_manifest!r}")
         self._warm_manifest = warm_manifest
-        if buckets is None:
-            buckets = BucketRegistry.powers_of_two(max_batch_size)
-        elif not isinstance(buckets, BucketRegistry):
-            buckets = BucketRegistry(buckets)
+        if not isinstance(buckets, ShapeBuckets):
+            if buckets is None:
+                buckets = BucketRegistry.powers_of_two(max_batch_size)
+            elif not isinstance(buckets, BucketRegistry):
+                buckets = BucketRegistry(buckets)
+            if seq_buckets is not None:
+                # the 2-D grid: batch sizes x declared seq edges
+                buckets = ShapeBuckets(buckets, seq_buckets)
         self._fwd = BucketedForward(net, buckets, mesh,
                                     site=f"serving:{name}", dtype=dtype,
                                     manifest=warm_manifest)
-        self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
         self.max_queue = max_queue
         self._pending_rows = 0  # queued EXAMPLES (a batched entry is n)
         self._stop = threading.Event()
         self._thread = None
         self._lock = threading.Lock()
+        # seq-aware continuous batching: one deque PER SEQ BUCKET (a
+        # single None key on 1-D registries, which keeps the historical
+        # one-global-queue behavior bit-for-bit), so requests coalesce
+        # within a seq bucket and a short prompt is never padded into a
+        # long batch. The condition shares the admission lock: enqueue,
+        # drain and the pending-rows bound stay one atomic story.
+        self._queues = {}
+        self._not_empty = threading.Condition(self._lock)
         self._counts = {"submitted": 0, "served": 0, "shed_queue_full": 0,
                         "shed_deadline": 0, "errors": 0, "swaps": 0}
         self._recent_latencies = []   # bounded ring; /serving works even
@@ -556,6 +660,12 @@ class ServingEngine:
         self._m_warm = reg.gauge(
             "serving_warmup_seconds",
             "wall seconds the AOT bucket warmup took at startup, per model")
+        self._m_seq_len = reg.histogram(
+            "serving_request_seq_len",
+            "requested sequence lengths (steps) per model — the demand "
+            "distribution seq grid edges derive from "
+            "(datasets.iterator.seq_edges_from_demand)",
+            buckets=(16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192))
         if reg.enabled:
             # pre-register every outcome series at zero (the prober
             # idiom): a shed/error series born mid-storm contributes
@@ -597,25 +707,26 @@ class ServingEngine:
             self._thread = None
         self._fail_pending()
 
-    def _take(self, block=True, timeout=None):
-        """Pop one queue entry, releasing its admission rows (the submit
-        side charged them). Raises queue.Empty like Queue.get."""
-        item = self._queue.get(block=block, timeout=timeout)
-        with self._lock:
-            self._pending_rows -= item[5] or 1
-        return item
+    def _pop_locked(self, dq):
+        """Pop one entry off ``dq`` (holding the lock), releasing its
+        admission rows (the submit side charged them)."""
+        entry = dq.popleft()
+        self._pending_rows -= entry[5] or 1  # graftlint: disable=R6 -- every caller holds self._not_empty (the _locked contract)
+        return entry
 
     def _fail_pending(self):
-        """Drain the queue, failing every pending request with
-        :class:`ServingShutdown` (stop(), and submit()'s race guard)."""
+        """Drain every seq-bucket queue, failing every pending request
+        with :class:`ServingShutdown` (stop(), and submit()'s race
+        guard)."""
         err = ServingShutdown(
             f"serving engine {self.name!r} stopped before serving this "
             f"request")
-        while True:
-            try:
-                _, fut, _t, _dl, tctx, _n, _meta = self._take(block=False)
-            except queue.Empty:
-                break
+        with self._not_empty:
+            drained = []
+            for dq in self._queues.values():
+                while dq:
+                    drained.append(self._pop_locked(dq))
+        for _, fut, _t, _dl, tctx, _n, _meta in drained:
             if not fut.done():
                 fut._set_error(err)
                 self._count("errors")
@@ -638,12 +749,24 @@ class ServingEngine:
     def buckets(self):
         return self._fwd.buckets
 
-    def update_model(self, net, warm=None):
+    def update_model(self, net, warm=None, *, manifest=None):
         """Hot-swap the served model. The replacement BucketedForward is
         built and (by default, when the engine knows its input spec) AOT-
         warmed OFF the serving path, then atomically rebound — in-flight
         batches finish on the old snapshot, later batches use the new one,
-        and no queued request is dropped or errored by the swap."""
+        and no queued request is dropped or errored by the swap. The
+        engine's shape grid (1-D or 2-D) is reused as-is: a swap changes
+        weights, never shapes. ``manifest``: warm manifest shipped WITH
+        the replacement (a bundle's instant-restart artifact); it
+        replaces the construction-time one for this and later swaps.
+        Callers gating grids should validate it first
+        (serving.registry.ModelRegistry.update_model does)."""
+        if manifest is not None:
+            if isinstance(manifest, (str, os.PathLike)):
+                manifest = _cc.WarmManifest.load_lenient(
+                    manifest, context=f"warm manifest {manifest!r}")
+            if manifest is not None:
+                self._warm_manifest = manifest
         fresh = BucketedForward(net, self._fwd.buckets, self.mesh,
                                 site=f"serving:{self.name}",
                                 dtype=self._dtype,
@@ -805,6 +928,29 @@ class ServingEngine:
             else:
                 nrows = None
                 item = jax.tree_util.tree_map(lambda a: a[None], item)
+            skey = None
+            if self._fwd.seq_aware:
+                lead = jax.tree_util.tree_leaves(item)[0]
+                if lead.ndim < 2:
+                    raise ValueError(
+                        f"model {self.name!r} serves 2-D (batch, seq) "
+                        "buckets: requests need a sequence axis "
+                        "([steps, ...] per example)")
+                seq = int(lead.shape[1])
+                skey = self._fwd.buckets.seq.bucket_for(seq)
+                if skey is None:
+                    # a sizing error, not load: shedding it would read as
+                    # transient and retry forever (same stance as an
+                    # inadmissibly large batched submit)
+                    raise ValueError(
+                        f"model {self.name!r}: sequence of {seq} steps "
+                        f"exceeds the largest registered seq bucket "
+                        f"({self._fwd.buckets.max_seq})")
+                # the demand distribution grid edges derive from; and the
+                # wire/meter view of the seq the engine bucketed
+                meta = dict(meta or {}, seq=seq)
+                if self._reg.enabled:
+                    self._m_seq_len.observe(seq, model=self.name, **olab)
         except BaseException:
             if tctx is not None:
                 # malformed input (asarray raised): the request never
@@ -813,7 +959,7 @@ class ServingEngine:
             raise
         rows = 1 if nrows is None else nrows
         try:
-            with self._lock:
+            with self._not_empty:
                 # admission bounds queued EXAMPLES, not queue entries: a
                 # batched entry spends one slot per row, so batching
                 # cannot smuggle unbounded work past the load-shedding
@@ -821,14 +967,12 @@ class ServingEngine:
                 if self._pending_rows + rows > self.max_queue:
                     raise queue.Full
                 self._pending_rows += rows
-            try:
-                self._queue.put_nowait((item, fut, now, deadline,
-                                        None if tctx is None
-                                        else tctx.handoff(), nrows, meta))
-            except queue.Full:
-                with self._lock:
-                    self._pending_rows -= rows
-                raise
+                self._queues.setdefault(
+                    skey, collections.deque()).append(
+                        (item, fut, now, deadline,
+                         None if tctx is None else tctx.handoff(),
+                         nrows, meta))
+                self._not_empty.notify()
         except queue.Full:
             self._count("shed_queue_full")
             if self._reg.enabled:
@@ -859,35 +1003,61 @@ class ServingEngine:
 
     def _drain(self):
         """Continuous-batching drain: block briefly for the FIRST request,
-        then take everything already queued with ``get_nowait()`` (no
+        then take everything already queued in ITS seq bucket (no
         per-slot waits), then — only if the batch still has room and a
         batch window is configured — wait under ONE shared deadline for
-        stragglers. The worst-case added latency is ``batch_window_s``
-        total, not per empty slot."""
+        same-bucket stragglers. The worst-case added latency is
+        ``batch_window_s`` total, not per empty slot.
+
+        Seq-awareness: a drain batch is drawn from exactly ONE seq-bucket
+        queue — the one whose head request has waited longest (arrival
+        order across buckets, so no bucket starves) — because co-batching
+        requests across seq buckets would pad every short prompt in the
+        batch to the longest one's bucket, which is precisely the waste
+        the 2-D grid exists to cut. On a 1-D registry there is a single
+        ``None`` bucket and this is the historical global-queue drain."""
         cap = self._fwd.buckets.max
 
-        def rows(b):
+        def entry_rows(e):
             # entries carry [n, ...] rows (batched submits n > 1); the cap
             # bounds device-batch ROWS, not queue entries
-            return sum(it[5] or 1 for it in b)
-        try:
-            batch = [self._take(timeout=0.05)]
-        except queue.Empty:
-            return []
-        try:
-            while rows(batch) < cap:
-                batch.append(self._take(block=False))
-        except queue.Empty:
-            if self.batch_window_s > 0:
+            return e[5] or 1
+
+        def oldest_key():
+            # (found, key): the 1-D path queues under key None, so None
+            # itself can't double as the "nothing queued" signal
+            live = [k for k, dq in self._queues.items() if dq]
+            if not live:
+                return False, None
+            return True, min(live, key=lambda k: self._queues[k][0][2])
+
+        batch, rows = [], 0
+        with self._not_empty:
+            found, skey = oldest_key()
+            if not found:
+                self._not_empty.wait(timeout=0.05)
+                found, skey = oldest_key()
+                if not found:
+                    return []
+            dq = self._queues[skey]
+            while dq and rows < cap:
+                e = self._pop_locked(dq)
+                batch.append(e)
+                rows += entry_rows(e)
+            if rows < cap and self.batch_window_s > 0:
                 deadline = time.perf_counter() + self.batch_window_s
-                while rows(batch) < cap:
+                while rows < cap:
                     remaining = deadline - time.perf_counter()
-                    if remaining <= 0:
+                    if remaining <= 0 or \
+                            not self._not_empty.wait(timeout=remaining):
                         break
-                    try:
-                        batch.append(self._take(timeout=remaining))
-                    except queue.Empty:
-                        break
+                    # woken: stragglers may have landed in OUR bucket (a
+                    # notify for another bucket's arrival just loops)
+                    dq = self._queues.get(skey)
+                    while dq and rows < cap:
+                        e = self._pop_locked(dq)
+                        batch.append(e)
+                        rows += entry_rows(e)
         return batch
 
     def _worker(self):
@@ -939,44 +1109,77 @@ class ServingEngine:
                 phases = ([] if any(it[4] is not None for it in live)
                           else None)
                 n_rows = sum(it[5] or 1 for it in live)
+                seq_aware = self._fwd.seq_aware
                 with _tm.span("serving.batch", model=self.name,
                               size=n_rows):
                     t_asm = time.perf_counter()
                     # every entry is [n, ...] rows (single submits n=1, so
-                    # this is the old stack): concatenate dict inputs too
+                    # this is the old stack): concatenate dict inputs too.
+                    # A seq-aware drain batch is seq-bucket-uniform, but
+                    # real lengths inside the bucket still vary — pad each
+                    # entry's seq axis to the batch max (still <= the
+                    # bucket BucketedForward pads to) so the concat is
+                    # rectangular
+                    parts = [b[0] for b in live]
+                    batch_seq = None
+                    if seq_aware:
+                        batch_seq = max((b[6] or {}).get("seq", 1)
+                                        for b in live)
+                        parts = [
+                            _pad_rows_np(p, b[5] or 1, seq_target=batch_seq)
+                            for p, b in zip(parts, live)]
                     xs = jax.tree_util.tree_map(
-                        lambda *leaves: np.concatenate(leaves),
-                        *[b[0] for b in live])
+                        lambda *leaves: np.concatenate(leaves), *parts)
                     if phases is not None:
                         phases.append(("serving.assemble", t_asm,
                                        time.perf_counter(),
                                        {"size": n_rows}))
                     t_fwd = time.perf_counter()
-                    ys = self._fwd(xs, _phases=phases)  # one atomic
-                    #                                     model snapshot
+                    usage = []
+                    ys = self._fwd(xs, _phases=phases,  # one atomic
+                                   _usage=usage)        # model snapshot
                 done = time.perf_counter()
                 device_s = done - t_fwd
+                # FLOPs priced at the padded (batch, seq) device shapes
+                # the forward ACTUALLY ran — the 2-D grid makes this fall
+                # for short prompts; the 1-D path degenerates to the old
+                # padded-rows charge (seq bucket 1)
+                padded_rows = sum(u["batch_bucket"] for u in usage)
+                padded_tokens = sum(u["batch_bucket"] * u["seq_bucket"]
+                                    for u in usage)
                 flops = _metering.estimate_flops(
-                    self._param_count(), self._padded_rows(n_rows))
+                    self._param_count(), padded_rows,
+                    padded_tokens=padded_tokens)
                 meter = _metering.get_meter()
                 _cc.note_first_request()
                 lats, ctxs, origins, off = [], [], [], 0
                 for x_in, fut, t_sub, _dl, tctx, n, meta in live:
                     width = n or 1
+                    real_seq = (meta or {}).get("seq", 1) if seq_aware \
+                        else 1
                     # the usage ledger: every served row is attributed
                     # (probe traffic included — device time is device
-                    # time), device wall and FLOPs prorated by rows
+                    # time), device wall, FLOPs and padded tokens
+                    # prorated by rows; seq_tokens are the entry's REAL
+                    # tokens, so padded - seq is the waste column
                     meter.record(
                         self.name, rows=width,
                         tokens=sum(int(np.size(l)) for l in
                                    jax.tree_util.tree_leaves(x_in)),
+                        seq_tokens=width * real_seq,
+                        padded_tokens=padded_tokens * width / n_rows,
                         queue_s=now - t_sub,
                         device_s=device_s * width / n_rows,
                         flops=flops * width / n_rows,
                         tenant=(meta or {}).get("tenant"))
                     y = jax.tree_util.tree_map(
-                        lambda a: (a[off:off + width] if n is not None
-                                   else a[off]), ys)
+                        lambda a: a[off:off + width], ys)
+                    if batch_seq is not None:
+                        # back to the entry's REAL length before the row
+                        # axis is dropped (axis 1 is still the seq axis)
+                        y = _slice_seq(y, batch_seq, real_seq)
+                    if n is None:
+                        y = jax.tree_util.tree_map(lambda a: a[0], y)
                     off += width
                     lats.append(done - t_sub)
                     ctxs.append(tctx)
@@ -1055,18 +1258,6 @@ class ServingEngine:
         except Exception:
             return 0
 
-    def _padded_rows(self, n_rows):
-        """Rows the device actually ran for an ``n_rows`` host batch:
-        the same chunk-by-largest-bucket walk BucketedForward takes,
-        each chunk charged at its padded bucket size (padding burns the
-        device all the same — FLOPs attribution must price it)."""
-        step = self._fwd.buckets.max
-        padded = 0
-        for i in range(0, int(n_rows), step):
-            padded += self._fwd.buckets.bucket_for(
-                min(step, int(n_rows) - i))
-        return padded
-
     # ---- status ----
 
     def health(self):
@@ -1098,10 +1289,17 @@ class ServingEngine:
         with self._lock:
             counts = dict(self._counts)
         p50, p99 = self.latency_percentiles()
+        fwd = self._fwd
         return {
             "model": self.name,
             "running": self.running,
-            "buckets": self._fwd.buckets.sizes(),
+            # 1-D: flat batch sizes (the historical payload); 2-D: the
+            # batch axis, with the seq axis beside it — wire consumers
+            # (fleet describe/health) keep reading ints either way
+            "buckets": (fwd.buckets.batch.sizes() if fwd.seq_aware
+                        else fwd.buckets.sizes()),
+            "seq_buckets": (fwd.buckets.seq.sizes() if fwd.seq_aware
+                            else None),
             "mesh": None if self.mesh is None else dict(self.mesh.shape),
             "max_queue": self.max_queue,
             "queue_depth": self._pending_rows,  # EXAMPLES, matching

@@ -33,7 +33,8 @@ from deeplearning4j_tpu.telemetry import registry as _registry
 #: ledger label for unattributed traffic (no tenant field on submit)
 NO_TENANT = "-"
 
-_FIELDS = ("rows", "tokens", "queue_seconds", "device_seconds", "flops")
+_FIELDS = ("rows", "tokens", "seq_tokens", "padded_tokens",
+           "queue_seconds", "device_seconds", "flops")
 
 
 class UsageMeter:
@@ -51,6 +52,16 @@ class UsageMeter:
             "tokens": self._reg.counter(
                 "usage_tokens_total",
                 "input elements consumed per model and tenant"),
+            "seq_tokens": self._reg.counter(
+                "usage_seq_tokens_total",
+                "REAL sequence tokens served per model and tenant "
+                "(rows x real steps; rows on batch-only models)"),
+            "padded_tokens": self._reg.counter(
+                "usage_padded_tokens_total",
+                "PADDED sequence tokens the device ran per model and "
+                "tenant (batch_bucket x seq_bucket per chunk, prorated "
+                "by rows) — minus usage_seq_tokens_total this is the "
+                "padded-waste column the 2-D shape grid exists to cut"),
             "queue_seconds": self._reg.counter(
                 "usage_queue_seconds_total",
                 "seconds requests spent queued per model and tenant"),
@@ -64,14 +75,19 @@ class UsageMeter:
                 "(2 * params * padded rows, prorated)"),
         }
 
-    def record(self, model, *, rows=0, tokens=0, queue_s=0.0,
-               device_s=0.0, flops=0.0, tenant=None):
+    def record(self, model, *, rows=0, tokens=0, seq_tokens=0,
+               padded_tokens=0, queue_s=0.0, device_s=0.0, flops=0.0,
+               tenant=None):
         """One request's consumption. Negative clock skew is clamped —
-        the ledger is monotone by construction."""
+        the ledger is monotone by construction. ``seq_tokens`` /
+        ``padded_tokens`` are the real-vs-padded sides of the seq-axis
+        waste column (engine worker; zero on paths that predate it)."""
         model = str(model)
         tenant = NO_TENANT if tenant is None else str(tenant)
         vals = {"rows": max(int(rows), 0),
                 "tokens": max(int(tokens), 0),
+                "seq_tokens": max(float(seq_tokens), 0.0),
+                "padded_tokens": max(float(padded_tokens), 0.0),
                 "queue_seconds": max(float(queue_s), 0.0),
                 "device_seconds": max(float(device_s), 0.0),
                 "flops": max(float(flops), 0.0)}
@@ -121,12 +137,17 @@ def _num(v):
     return int(v) if float(v).is_integer() else float(v)
 
 
-def estimate_flops(param_count, padded_rows):
+def estimate_flops(param_count, padded_rows, *, padded_tokens=None):
     """Dense-forward estimate from the registered shapes: 2 FLOPs per
     parameter per padded row (multiply + add). Deliberately crude — a
     ranking signal for attribution, not a performance model; padding is
-    charged because padding burns the device all the same."""
-    return 2.0 * float(param_count) * float(padded_rows)
+    charged because padding burns the device all the same. With
+    ``padded_tokens`` (2-D shape buckets) the charge is per padded
+    ``batch_bucket x seq_bucket`` TOKEN instead — on a batch-only engine
+    the two are the same number (seq bucket 1), so the ledger's FLOPs
+    column falls exactly when the seq grid stops padding to max_seq."""
+    units = padded_rows if padded_tokens is None else padded_tokens
+    return 2.0 * float(param_count) * float(units)
 
 
 # ---- process-default meter ----

@@ -4,13 +4,39 @@ Reference analog: TensorFlow Serving's model manager (the serving half of
 the system paper, PAPERS.md arxiv 1605.08695) — named models, each with its
 own continuous-batching engine, atomic ``update_model`` hot swaps, and one
 status surface (`/serving` on the UIServer, the ``serve`` CLI verb).
+
+The registry PERSISTS each model's engine kwargs (the 2-D ``buckets``/
+``seq_buckets`` shape grid included): :meth:`ModelRegistry.register_like`
+registers an A/B challenger under the incumbent's exact serving config,
+and a hot swap keeps the engine's grid by construction. A swap bundle
+that ships a warm manifest is gated first — a manifest whose executables
+were baked for a DIFFERENT shape grid is rejected with a counted
+``serving_bundle_rejected_total`` increment (never silently attached,
+which would degrade every request to a lazy compile).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 
+from deeplearning4j_tpu import telemetry as _tm
 from deeplearning4j_tpu.serving.engine import ServingEngine
+from deeplearning4j_tpu.utils import compile_cache as _cc
+
+
+def manifest_grid_signatures(manifest):
+    """The set of 2-D grid signatures a warm manifest's SERVING
+    executables were compiled for — ``None`` in the set stands for
+    batch-only (1-D) entries whose kind carries no ``:grid=`` tag.
+    Empty when the manifest holds no serving executables at all."""
+    grids = set()
+    for kind, _sig in manifest.keys():
+        if not str(kind).startswith("serving"):
+            continue
+        grids.add(kind.split(":grid=", 1)[1] if ":grid=" in kind
+                  else None)
+    return grids
 
 
 class ModelRegistry:
@@ -19,13 +45,22 @@ class ModelRegistry:
     def __init__(self):
         self._lock = threading.RLock()
         self._engines = {}
+        self._engine_kw = {}  # name -> kwargs register() built with
+        self._m_rejected = _tm.get_registry().counter(
+            "serving_bundle_rejected_total",
+            "hot-swap bundles refused per model and reason "
+            "(grid_mismatch: the bundle's warm manifest was baked for a "
+            "different shape grid than the registered engine serves)")
 
     def register(self, name, net, *, start=True, **engine_kw):
         """Build (and by default start) a serving engine for ``net`` under
-        ``name``. Engine kwargs (``input_spec``, ``buckets``, ``mesh``,
-        ``max_queue``, ``default_deadline_s``, ...) pass through; with an
-        ``input_spec`` the engine AOT-warms every bucket before this
-        returns, so the model is compile-free from its first request."""
+        ``name``. Engine kwargs (``input_spec``, ``buckets``,
+        ``seq_buckets``, ``mesh``, ``max_queue``, ``default_deadline_s``,
+        ...) pass through; with an ``input_spec`` the engine AOT-warms
+        every bucket before this returns, so the model is compile-free
+        from its first request. The kwargs are retained per model —
+        the A/B (:meth:`register_like`) and hot-swap paths carry the
+        same serving config, the 2-D shape grid included."""
         def duplicate():
             return ValueError(f"model {name!r} already registered; use "
                               f"update_model for a hot swap")
@@ -41,9 +76,28 @@ class ModelRegistry:
             if name in self._engines:  # raced a concurrent register
                 raise duplicate()
             self._engines[name] = engine
+            self._engine_kw[name] = dict(engine_kw)
         if start:
             engine.start()
         return engine
+
+    def engine_kwargs(self, name):
+        """The engine kwargs ``name`` was registered with (a copy)."""
+        self.engine(name)  # raise the helpful KeyError on unknown names
+        with self._lock:
+            return dict(self._engine_kw.get(name, {}))
+
+    def register_like(self, src_name, name, net, *, start=True,
+                      **overrides):
+        """A/B helper: register ``net`` under ``name`` with the SAME
+        engine kwargs as the incumbent ``src_name`` (input spec, shape
+        grid, deadlines — the whole serving config), ``overrides``
+        applied on top. The challenger then pads/buckets identically to
+        the champion, so latency and waste comparisons are
+        apples-to-apples."""
+        kw = self.engine_kwargs(src_name)
+        kw.update(overrides)
+        return self.register(name, net, start=start, **kw)
 
     def engine(self, name) -> ServingEngine:
         with self._lock:
@@ -54,10 +108,49 @@ class ModelRegistry:
                     f"no model {name!r} registered; known: "
                     f"{sorted(self._engines)}") from None
 
-    def update_model(self, name, net, warm=None):
+    def update_model(self, name, net, warm=None, *, manifest=None):
         """Atomic hot swap of one named model (in-flight batches finish on
-        the old snapshot; no queued request is dropped)."""
-        self.engine(name).update_model(net, warm=warm)
+        the old snapshot; no queued request is dropped). The engine keeps
+        its registered shape grid — a swap changes weights, never shapes.
+
+        ``manifest``: the replacement bundle's warm manifest (a
+        :class:`~deeplearning4j_tpu.utils.compile_cache.WarmManifest` or
+        a path to one). It is gated BEFORE the swap: executables baked
+        for a different (batch, seq) grid than this engine serves are a
+        config error, not a warm start — the swap is rejected with a
+        ``ValueError`` and a ``serving_bundle_rejected_total`` count,
+        never silently attached (every request would otherwise pay a
+        lazy compile while the stale executables sit unused)."""
+        engine = self.engine(name)
+        if manifest is not None:
+            self._gate_bundle_grid(engine, manifest)
+        engine.update_model(net, warm=warm)
+
+    def _gate_bundle_grid(self, engine, manifest):
+        if isinstance(manifest, (str, os.PathLike)):
+            manifest = _cc.WarmManifest.load_lenient(
+                manifest, context=f"swap bundle manifest {manifest!r}")
+            if manifest is None:  # unreadable file: cold swap, not a gate
+                return
+        declared = manifest_grid_signatures(manifest)
+        if not declared:
+            return  # no serving executables to disagree with
+        fwd = engine._fwd
+        registered = (fwd.buckets.signature() if fwd.seq_aware else None)
+        if declared != {registered}:
+            def show(g):
+                return sorted("batch-only" if s is None else s
+                              for s in g)
+            if _tm.get_registry().enabled:
+                self._m_rejected.inc(model=engine.name,
+                                     reason="grid_mismatch")
+            raise ValueError(
+                f"model {engine.name!r}: swap bundle's warm manifest "
+                f"was baked for shape grid(s) {show(declared)} but the "
+                f"registered engine serves "
+                f"{show({registered})} — re-export the manifest on the "
+                f"registered grid (counted in "
+                f"serving_bundle_rejected_total)")
 
     def unregister(self, name):
         with self._lock:

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""tier1.sh seq-serving gate: parse a `bench.py seq_serving` JSONL
+stream and fail unless the 2-D (batch, seq) shape grid held its
+contracts. STRUCTURAL — ledger exactness, counters and parity — NEVER
+wall time:
+
+* ledger: per leg, the usage ledger's rows equal the submitted requests
+  EXACTLY, its real seq tokens equal the workload's summed lengths
+  EXACTLY, and FLOPs are priced at exactly 2 * params * padded_tokens
+  (the padding charge the grid exists to cut);
+* counters: each leg AOT-warmed its full grid up front and served the
+  whole ragged workload with ZERO lazy compiles — a finite bucket grid
+  means a finite executable set, recompiles are a bug;
+* parity: the grid leg's outputs match the flat (pad-to-max) leg's and
+  a direct model reference to <= 1e-6 — less padding must never mean
+  different answers;
+* waste: the flat leg's padded/real token ratio is at least 2x the grid
+  leg's — the measured padded-FLOPs cut the 2-D grid claims.
+
+Usage: check_seq_serving.py <jsonl-file>
+"""
+
+import json
+import sys
+
+PARITY_TOL = 1e-6
+MIN_WASTE_CUT = 2.0
+
+
+def main(argv):
+    path = argv[1]
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    recs = [r for r in rows
+            if str(r.get("metric", "")).startswith("seq_serving")]
+    if not recs:
+        print("check_seq_serving: no seq_serving record in", path)
+        return 1
+    rec = recs[-1]
+    if "FAILED" in rec.get("metric", ""):
+        print("check_seq_serving: bench leg failed:", rec.get("error"))
+        return 1
+    errors = []
+
+    n = rec.get("requests") or 0
+    real_tokens = rec.get("real_seq_tokens")
+    params = rec.get("param_count") or 0
+    legs = rec.get("legs") or {}
+    if n <= 0 or not real_tokens or params <= 0:
+        errors.append(f"degenerate workload: requests={n}, "
+                      f"real_seq_tokens={real_tokens}, params={params}")
+
+    for name in ("grid", "flat"):
+        leg = legs.get(name)
+        if not leg:
+            errors.append(f"missing {name} leg")
+            continue
+        led = leg.get("ledger") or {}
+
+        # --- ledger exactness -----------------------------------------
+        if led.get("rows") != n:
+            errors.append(f"{name}: ledger rows {led.get('rows')} != "
+                          f"submitted requests {n}")
+        if leg.get("served") != n:
+            errors.append(f"{name}: engine served {leg.get('served')} "
+                          f"of {n} requests")
+        if led.get("seq_tokens") != real_tokens:
+            errors.append(f"{name}: ledger real tokens "
+                          f"{led.get('seq_tokens')} != workload tokens "
+                          f"{real_tokens}")
+        padded = float(led.get("padded_tokens") or 0)
+        if padded < (real_tokens or 0):
+            errors.append(f"{name}: padded tokens {padded} below real "
+                          f"tokens {real_tokens} — the ledger lost "
+                          f"padding")
+        flops = float(led.get("flops") or 0)
+        want = 2.0 * params * padded
+        if want and abs(flops - want) > 1e-6 * want:
+            errors.append(f"{name}: FLOPs {flops} not priced at "
+                          f"2*params*padded_tokens = {want}")
+
+        # --- counters: full grid warmed, zero lazy compiles -----------
+        aot = leg.get("aot") or {}
+        grid_size = (len(leg.get("buckets") or [])
+                     * len(leg.get("seq_buckets") or []))
+        if aot.get("warmed") != grid_size:
+            errors.append(f"{name}: warmed {aot.get('warmed')} "
+                          f"executables, grid has {grid_size}")
+        if aot.get("lazy_compiles") != 0:
+            errors.append(f"{name}: {aot.get('lazy_compiles')} lazy "
+                          f"compiles after warmup — the finite grid "
+                          f"leaked a shape")
+
+    # --- parity -------------------------------------------------------
+    parity = rec.get("parity") or {}
+    err = parity.get("max_abs_err")
+    if err is None or not parity.get("checked"):
+        errors.append(f"no parity evidence: {parity}")
+    elif err > PARITY_TOL:
+        errors.append(f"grid/flat/reference outputs disagree: "
+                      f"|err|={err} > {PARITY_TOL}")
+
+    # --- the waste cut itself ------------------------------------------
+    gw = (legs.get("grid") or {}).get("waste_ratio")
+    fw = (legs.get("flat") or {}).get("waste_ratio")
+    cut = rec.get("value")
+    if not gw or not fw:
+        errors.append(f"waste ratios missing: grid={gw}, flat={fw}")
+    elif fw / gw < MIN_WASTE_CUT:
+        errors.append(f"2-D grid cut padded waste only {fw / gw:.2f}x "
+                      f"(flat {fw} -> grid {gw}); gate is "
+                      f">= {MIN_WASTE_CUT}x")
+
+    print(f"seq_serving: {n} ragged requests ({real_tokens} real "
+          f"tokens); padded/real {fw} flat -> {gw} grid "
+          f"({cut}x cut); parity |err|={err} over "
+          f"{parity.get('checked')} references")
+    for e in errors:
+        print("check_seq_serving FAIL:", e)
+    if not errors:
+        print("check_seq_serving: ledger exact, FLOPs priced at padded "
+              "tokens, zero lazy compiles, parity held, waste cut "
+              f">= {MIN_WASTE_CUT}x — held")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

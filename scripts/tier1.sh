@@ -46,7 +46,7 @@ timeout -k 10 600 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   tests/test_streaming.py tests/test_parallel.py tests/test_native.py \
   tests/test_ui.py tests/test_sanitizer.py tests/test_fleet.py \
   tests/test_continuous.py tests/test_hostfleet.py \
-  tests/test_demand.py \
+  tests/test_demand.py tests/test_seq_buckets.py \
   -q -m 'not slow' \
   -p no:cacheprovider -p no:xdist -p no:randomly || {
     echo "tier1: graftsan stage FAILED"; exit 1; }
@@ -284,5 +284,24 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
        echo "tier1: drifted, probe traffic leaked into organic series,"
        echo "tier1: the usage ledger did not balance, or the probe gate"
        echo "tier1: never fired/recovered)"; exit 1; }
+
+# Stage 14: seq-serving padded-waste smoke (2-D shape grid, ISSUE 20) —
+# one ragged-length RNN workload served twice through the real engine
+# (seq grid vs pad-to-max), the usage ledger's padded-vs-real token
+# columns read back per leg. scripts/check_seq_serving.py gates on
+# LEDGER EXACTNESS, COUNTERS AND PARITY (rows and real tokens balance
+# exactly, FLOPs priced at 2*params*padded_tokens, full grid warmed with
+# zero lazy compiles, grid == flat == reference <= 1e-6, padded-waste
+# cut >= 2x) — never wall time on CPU.
+echo "== seq-serving padded-waste smoke =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_PREFLIGHT=1 \
+  timeout -k 10 300 python bench.py seq_serving \
+  > /tmp/_seq_serving.jsonl \
+  && tee -a BENCH_smoke.json < /tmp/_seq_serving.jsonl > /dev/null \
+  && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python scripts/check_seq_serving.py /tmp/_seq_serving.jsonl \
+  || { echo "tier1: seq-serving smoke FAILED (ledger drifted, a shape"
+       echo "tier1: leaked a lazy compile, parity broke, or the 2-D"
+       echo "tier1: grid stopped cutting padded waste >= 2x)"; exit 1; }
 
 exit $rc
